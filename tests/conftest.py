@@ -1,0 +1,16 @@
+"""Test bootstrap: force an 8-device virtual CPU platform BEFORE jax imports.
+
+Multi-chip hardware is not available in CI; sharding/collective tests run on
+a virtual 8-device CPU mesh exactly as the driver's dryrun does.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# Make the repo root importable regardless of pytest invocation dir.
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)

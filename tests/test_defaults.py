@@ -1,0 +1,46 @@
+"""Defaulting tests (reference parity: v1alpha2/defaults_test.go)."""
+
+from tf_operator_tpu.api import (
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    TPUJob,
+    TPUJobSpec,
+    set_defaults,
+)
+from tf_operator_tpu.api.types import DEFAULT_COORDINATOR_PORT
+
+
+def _job(**replica_kwargs):
+    return TPUJob(
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    template=ProcessTemplate(entrypoint="m:f"), **replica_kwargs
+                ),
+                ReplicaType.EVALUATOR: ReplicaSpec(template=ProcessTemplate(entrypoint="m:f")),
+            }
+        )
+    )
+
+
+def test_default_replicas_and_port():
+    job = set_defaults(_job())
+    rs = job.spec.replica_specs[ReplicaType.WORKER]
+    assert rs.replicas == 1
+    assert rs.port == DEFAULT_COORDINATOR_PORT
+
+
+def test_default_restart_policies():
+    job = set_defaults(_job())
+    assert job.spec.replica_specs[ReplicaType.WORKER].restart_policy is RestartPolicy.EXIT_CODE
+    assert job.spec.replica_specs[ReplicaType.EVALUATOR].restart_policy is RestartPolicy.ON_FAILURE
+
+
+def test_defaults_idempotent_and_preserving():
+    job = _job(replicas=4, port=1234, restart_policy=RestartPolicy.NEVER)
+    set_defaults(job)
+    set_defaults(job)
+    rs = job.spec.replica_specs[ReplicaType.WORKER]
+    assert (rs.replicas, rs.port, rs.restart_policy) == (4, 1234, RestartPolicy.NEVER)

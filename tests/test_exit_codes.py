@@ -1,0 +1,41 @@
+"""Exit-code taxonomy tests (reference parity: train_util.go semantics +
+TestIsRetryableTerminationState, pkg/trainer/training_test.go:33+)."""
+
+import pytest
+
+from tf_operator_tpu.utils import ExitClass, classify_exit_code, is_permanent, is_retryable
+
+
+def test_success():
+    assert classify_exit_code(0) is ExitClass.SUCCEEDED
+
+
+@pytest.mark.parametrize("code", [1, 2, 126, 127, 128, 139])
+def test_permanent_codes(code):
+    assert is_permanent(code)
+
+
+@pytest.mark.parametrize("code", [130, 137, 143])
+def test_retryable_codes(code):
+    assert is_retryable(code)
+
+
+def test_user_defined_retryable_138():
+    assert is_retryable(138)
+
+
+def test_oom_always_permanent():
+    # training.go:193-206: OOMKilled overrides even retryable codes.
+    assert classify_exit_code(137, oom_killed=True) is ExitClass.PERMANENT
+    assert classify_exit_code(0, oom_killed=True) is ExitClass.PERMANENT
+
+
+def test_negative_signal_codes():
+    # subprocess returncode -9 == killed by SIGKILL == 137 == retryable
+    assert is_retryable(-9)
+    assert is_retryable(-15)
+
+
+def test_unknown_nonzero_permanent():
+    assert is_permanent(3)
+    assert is_permanent(42)

@@ -1,0 +1,86 @@
+"""Validation tests (reference parity: validation/validation_test.go)."""
+
+import pytest
+
+from tf_operator_tpu.api import (
+    ObjectMeta,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    TopologySpec,
+    TPUJob,
+    TPUJobSpec,
+    ValidationError,
+    validate_job,
+    validate_spec,
+)
+
+
+def good_spec():
+    return TPUJobSpec(
+        replica_specs={
+            ReplicaType.COORDINATOR: ReplicaSpec(
+                replicas=1, template=ProcessTemplate(entrypoint="m.mod:fn")
+            ),
+            ReplicaType.WORKER: ReplicaSpec(
+                replicas=3, template=ProcessTemplate(entrypoint="m.mod:fn")
+            ),
+        },
+        topology=TopologySpec(num_hosts=1, chips_per_host=8, mesh_axes={"dp": 2, "tp": 4}),
+    )
+
+
+def test_valid_spec_passes():
+    validate_spec(good_spec())
+
+
+def test_empty_replica_specs_rejected():
+    with pytest.raises(ValidationError, match="must not be empty"):
+        validate_spec(TPUJobSpec())
+
+
+def test_missing_entrypoint_rejected():
+    s = good_spec()
+    s.replica_specs[ReplicaType.WORKER].template.entrypoint = ""
+    with pytest.raises(ValidationError, match="entrypoint is required"):
+        validate_spec(s)
+
+
+def test_malformed_entrypoint_rejected():
+    s = good_spec()
+    s.replica_specs[ReplicaType.WORKER].template.entrypoint = "no_colon_here"
+    with pytest.raises(ValidationError, match="pkg.module:fn"):
+        validate_spec(s)
+
+
+def test_multi_coordinator_rejected():
+    s = good_spec()
+    s.replica_specs[ReplicaType.COORDINATOR].replicas = 2
+    with pytest.raises(ValidationError, match="Coordinator"):
+        validate_spec(s)
+
+
+def test_bad_port_rejected():
+    s = good_spec()
+    s.replica_specs[ReplicaType.WORKER].port = 70000
+    with pytest.raises(ValidationError, match="valid port"):
+        validate_spec(s)
+
+
+def test_mesh_chip_mismatch_rejected():
+    s = good_spec()
+    s.topology.mesh_axes = {"dp": 3}  # 3 != 8 chips
+    with pytest.raises(ValidationError, match="multiply"):
+        validate_spec(s)
+
+
+def test_job_requires_name():
+    with pytest.raises(ValidationError, match="name"):
+        validate_job(TPUJob(metadata=ObjectMeta(name=""), spec=good_spec()))
+
+
+def test_negative_replicas_rejected():
+    s = good_spec()
+    s.replica_specs[ReplicaType.WORKER].replicas = 0
+    with pytest.raises(ValidationError, match=">= 1"):
+        validate_spec(s)

@@ -1,0 +1,30 @@
+"""tf_operator_tpu — a TPU-native distributed-training job framework.
+
+A ground-up rebuild of the capabilities of kubeflow/tf-operator (reference at
+/root/reference) designed for TPUs: a declarative ``TPUJob`` spec with typed
+replica roles, an idempotent reconciling control plane with gang placement,
+exit-code-driven restart policies, conditions-based status, events, and a
+hermetic fake-backend test pyramid — with the parameter-server/gRPC data plane
+replaced by SPMD JAX over a device mesh (pjit/shard_map, XLA collectives over
+ICI/DCN, Pallas kernels for hot ops).
+
+Layer map (mirrors SURVEY.md §1 of the reference):
+
+- ``api``        — job spec/status types + defaulting + validation
+                   (reference: pkg/apis/tensorflow/{v1alpha1,v1alpha2})
+- ``runtime``    — object store with watches + process backends; the
+                   "cluster" substrate (reference: k8s apiserver + kubelet)
+- ``controller`` — workqueue, expectations, reconciler, status conditions,
+                   events (reference: pkg/controller.v2)
+- ``rendezvous`` — per-process jax.distributed coordinates
+                   (reference: TF_CONFIG generator)
+- ``parallel``   — mesh builder, DP/FSDP/TP/PP/CP/EP shardings, ring
+                   attention, pipeline schedules (new surface; the reference
+                   delegated all of this to user code)
+- ``ops``        — Pallas/TPU kernels and reference implementations
+- ``models``     — MNIST / ResNet / BERT / Llama model families
+- ``train``      — pjit train loops, checkpointing, MFU telemetry
+- ``utils``      — naming, logging, exit-code taxonomy
+"""
+
+__version__ = "0.1.0"

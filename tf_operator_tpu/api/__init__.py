@@ -1,0 +1,26 @@
+"""API layer: TPUJob spec/status types, defaulting, validation.
+
+Reference parity: pkg/apis/tensorflow/v1alpha2 (map-based replica specs,
+conditions-based status) with a v1alpha1 compatibility view (list-based
+specs, phase-based status) in ``compat``.
+"""
+
+from tf_operator_tpu.api.types import (  # noqa: F401
+    Condition,
+    ConditionType,
+    JobPhase,
+    ObjectMeta,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaStatus,
+    ReplicaType,
+    RestartPolicy,
+    RunPolicy,
+    CleanupPolicy,
+    TopologySpec,
+    TPUJob,
+    TPUJobSpec,
+    TPUJobStatus,
+)
+from tf_operator_tpu.api.defaults import set_defaults  # noqa: F401
+from tf_operator_tpu.api.validation import ValidationError, validate_job, validate_spec  # noqa: F401

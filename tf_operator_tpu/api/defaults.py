@@ -1,0 +1,40 @@
+"""Defaulting for TPUJob specs.
+
+Reference parity: pkg/apis/tensorflow/v1alpha2/defaults.go (setDefaultPort
+:33-55, setDefaultReplicas :57-61, SetDefaults_TFJob :64-69). Defaulting is
+idempotent and runs on every reconcile after DeepCopy, matching
+controller.v2/controller.go:357-361.
+"""
+
+from __future__ import annotations
+
+from tf_operator_tpu.api.types import (
+    DEFAULT_COORDINATOR_PORT,
+    ReplicaType,
+    RestartPolicy,
+    TPUJob,
+    TPUJobSpec,
+)
+
+
+def set_defaults(job: TPUJob) -> TPUJob:
+    """Apply defaults in place and return the job (idempotent)."""
+    set_spec_defaults(job.spec)
+    return job
+
+
+def set_spec_defaults(spec: TPUJobSpec) -> None:
+    for rtype, rs in spec.replica_specs.items():
+        if rs.replicas is None:
+            rs.replicas = 1
+        if rs.port is None:
+            rs.port = DEFAULT_COORDINATOR_PORT
+        if rs.restart_policy is None:
+            # Evaluators are side observers — restart them on failure.
+            # Coordinator/worker failures default to EXIT_CODE so the
+            # taxonomy (utils/exit_codes.py) decides, the reference's most
+            # battle-tested policy (controller_pod.go:77-92).
+            if rtype is ReplicaType.EVALUATOR:
+                rs.restart_policy = RestartPolicy.ON_FAILURE
+            else:
+                rs.restart_policy = RestartPolicy.EXIT_CODE

@@ -1,0 +1,368 @@
+"""TPUJob API types.
+
+Reference parity (capabilities, not code): pkg/apis/tensorflow/v1alpha2/types.go
+(map-based ``TFReplicaSpecs``, RestartPolicy incl. ExitCode, conditions-based
+status with per-replica Active/Succeeded/Failed counters) plus the v1alpha1
+phase enum retained as a derived view (pkg/apis/tensorflow/v1alpha1/types.go
+phases Creating/Running/CleanUp/Failed/Done).
+
+TPU-first deltas from the reference:
+
+- Replica roles are COORDINATOR / WORKER / EVALUATOR. There is no PS role —
+  SPMD over a TPU slice has no parameter servers (the reference's PS/MASTER
+  topology, v1alpha1/types.go:80-84, collapses into a single multi-controller
+  program). COORDINATOR is the chief analogue (v1alpha2/types.go:94-112);
+  when absent, worker 0 carries coordinator semantics, matching the
+  chief-absent ⇒ worker-0 rule of controller_status.go:39-120.
+- The spec carries a ``TopologySpec`` (slice type / mesh axes) because gang
+  placement on TPU means atomic slice provisioning, not a PodDisruptionBudget
+  hack (pkg/trainer/training.go:450-511).
+- Processes, not pods: a ``ProcessTemplate`` names a Python entrypoint
+  (``pkg.module:fn``) instead of a container image; the runtime substrate
+  launches OS processes (or records intended launches in tests).
+
+Everything is a plain dataclass with ``to_dict``/``from_dict`` so objects can
+cross the store/CLI/REST boundaries as JSON, the way CRDs cross the apiserver.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+API_GROUP = "tpujob.tf-operator-tpu.dev"
+API_VERSION = "v1"
+KIND_TPUJOB = "TPUJob"
+KIND_PROCESS = "Process"
+KIND_ENDPOINT = "Endpoint"
+KIND_EVENT = "Event"
+
+# Default port the coordinator's jax.distributed service listens on
+# (replaces the reference's TF gRPC port 2222, v1alpha1/types.go:30).
+DEFAULT_COORDINATOR_PORT = 8476
+
+# Label keys stamped on every managed object (reference: genLabels,
+# controller.v2/controller_helper.go:53-58 and trainer labels incl.
+# task_index, pkg/trainer/replicas.go:121-136).
+LABEL_GROUP = "group_name"
+LABEL_JOB_NAME = "tpu_job_name"
+LABEL_REPLICA_TYPE = "replica_type"
+LABEL_REPLICA_INDEX = "replica_index"
+
+DEFAULT_NAMESPACE = "default"
+
+
+class ReplicaType(str, enum.Enum):
+    """Typed replica roles (reference: v1alpha2/types.go:94-112)."""
+
+    COORDINATOR = "Coordinator"
+    WORKER = "Worker"
+    EVALUATOR = "Evaluator"
+
+    def __str__(self) -> str:  # labels / names want the bare value
+        return self.value
+
+
+class RestartPolicy(str, enum.Enum):
+    """Restart behavior for a replica set (reference: v1alpha2/types.go:79-92).
+
+    EXIT_CODE keeps the reference's most distinctive policy: on failure the
+    controller consults the exit-code taxonomy (utils/exit_codes.py) and
+    restarts only retryable failures (controller_pod.go:77-92).
+    """
+
+    ALWAYS = "Always"
+    ON_FAILURE = "OnFailure"
+    NEVER = "Never"
+    EXIT_CODE = "ExitCode"
+
+
+class JobPhase(str, enum.Enum):
+    """Coarse phase view (reference: v1alpha1/types.go:106-116).
+
+    Derived from conditions; kept for v1alpha1-style clients and the CLI.
+    """
+
+    NONE = ""
+    CREATING = "Creating"
+    RUNNING = "Running"
+    CLEANUP = "CleanUp"
+    FAILED = "Failed"
+    DONE = "Done"
+
+
+class ConditionType(str, enum.Enum):
+    """Job conditions (reference: v1alpha2/types.go:167-196)."""
+
+    CREATED = "Created"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+class CleanupPolicy(str, enum.Enum):
+    """What to do with processes when the job finishes.
+
+    Reference analogue: CleanPodPolicy. ALL tears down every process,
+    RUNNING only still-running ones, NONE keeps them for debugging.
+    """
+
+    ALL = "All"
+    RUNNING = "Running"
+    NONE = "None"
+
+
+@dataclass
+class ObjectMeta:
+    """Object identity + bookkeeping (reference: k8s ObjectMeta subset used
+    by the operator: name/namespace/uid/labels/ownerReferences/resourceVersion).
+    """
+
+    name: str = ""
+    namespace: str = DEFAULT_NAMESPACE
+    uid: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    resource_version: int = 0
+    creation_timestamp: float = 0.0
+    deletion_timestamp: Optional[float] = None
+    # Owner reference by uid: the adoption/orphaning machinery
+    # (controller_pod.go:222-258) pivots on this.
+    owner_uid: Optional[str] = None
+    owner_kind: Optional[str] = None
+    owner_name: Optional[str] = None
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class ProcessTemplate:
+    """Template for worker processes (reference: PodTemplateSpec + the
+    requirement that the trained container be named "tensorflow",
+    validation/validation.go:26-79 — here the analogue is a resolvable
+    ``entrypoint`` of the form ``package.module:function``).
+    """
+
+    entrypoint: str = ""  # "pkg.module:fn" — called as fn(ctx) in-process
+    args: List[str] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)
+    # Resources per process: how many TPU chips this process drives.
+    chips_per_process: int = 0  # 0 ⇒ defaulted from topology
+    # Working directory for launched processes (real backend only).
+    workdir: Optional[str] = None
+
+
+@dataclass
+class ReplicaSpec:
+    """One replica set (reference: v1alpha2 TFReplicaSpec, types.go:45-78)."""
+
+    replicas: Optional[int] = None  # defaulted to 1 (defaults.go:57-61)
+    template: ProcessTemplate = field(default_factory=ProcessTemplate)
+    restart_policy: Optional[RestartPolicy] = None  # defaulted per role
+    port: Optional[int] = None  # coordinator rendezvous port (defaults.go:33-55)
+
+
+@dataclass
+class TopologySpec:
+    """TPU slice topology — the gang-placement unit.
+
+    Either a named slice (``slice_type='v5p-32'``) or explicit counts. The
+    reference approximated gang placement with a PodDisruptionBudget
+    (training.go:450-511); on TPU the slice itself is the atomic unit, so
+    topology is part of the job spec.
+    """
+
+    slice_type: str = ""  # e.g. "v5p-32"; informational if explicit counts set
+    num_hosts: int = 1
+    chips_per_host: int = 0  # 0 ⇒ discover from backend at admission
+    # Logical mesh axis sizes over the slice's devices, e.g.
+    # {"dp": 2, "fsdp": 2, "tp": 2}. Empty ⇒ pure DP over all chips.
+    mesh_axes: Dict[str, int] = field(default_factory=dict)
+
+    def total_chips(self) -> int:
+        return self.num_hosts * self.chips_per_host
+
+
+@dataclass
+class RunPolicy:
+    """Job-level execution policy (reference: backoff consts
+    pkg/controller/controller.go:59-61 + CleanPodPolicy + activeDeadline).
+    """
+
+    cleanup_policy: CleanupPolicy = CleanupPolicy.RUNNING
+    active_deadline_seconds: Optional[float] = None
+    backoff_limit: Optional[int] = None  # max retryable restarts before Failed
+    # Gang semantics: on TPU, one process dying severs the slice's SPMD
+    # program, so the default is whole-gang restart (SURVEY.md §7 hard part b)
+    # rather than the reference's per-pod restart.
+    gang_restart: bool = True
+    scheduler_name: str = ""  # opaque hint, mirrors SchedulerName v1alpha1/types.go:48-63
+
+
+@dataclass
+class TPUJobSpec:
+    """Desired state (reference: v1alpha2 TFJobSpec, types.go:45-54)."""
+
+    replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
+    topology: TopologySpec = field(default_factory=TopologySpec)
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+    # Free-form workload config passed through to every process's context
+    # (hyperparameters etc.) — the data plane reads it, the control plane
+    # never interprets it, preserving the reference's strict control/data
+    # split (tf_job_design_doc.md:96-98).
+    workload: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class Condition:
+    """Status condition (reference: TFJobCondition, v1alpha2/types.go:152-166)."""
+
+    type: ConditionType = ConditionType.CREATED
+    status: bool = True
+    reason: str = ""
+    message: str = ""
+    last_update_time: float = 0.0
+    last_transition_time: float = 0.0
+
+
+@dataclass
+class ReplicaStatus:
+    """Per-replica-set counters (reference: TFReplicaStatus, v1alpha2
+    types.go:135-149)."""
+
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+@dataclass
+class TPUJobStatus:
+    """Observed state (reference: TFJobStatus, v1alpha2/types.go:114-133)."""
+
+    conditions: List[Condition] = field(default_factory=list)
+    replica_statuses: Dict[ReplicaType, ReplicaStatus] = field(default_factory=dict)
+    start_time: Optional[float] = None
+    completion_time: Optional[float] = None
+    last_reconcile_time: Optional[float] = None
+    # Monotonic count of gang restarts (feeds backoff_limit).
+    restart_count: int = 0
+
+    def phase(self) -> JobPhase:
+        """Derived v1alpha1-style phase (v1alpha1/types.go:106-116)."""
+        latest: Optional[Condition] = None
+        for cond in self.conditions:
+            if cond.status:
+                latest = cond
+        if latest is None:
+            return JobPhase.NONE
+        return {
+            ConditionType.CREATED: JobPhase.CREATING,
+            ConditionType.RUNNING: JobPhase.RUNNING,
+            ConditionType.RESTARTING: JobPhase.RUNNING,
+            ConditionType.SUCCEEDED: JobPhase.DONE,
+            ConditionType.FAILED: JobPhase.FAILED,
+        }[latest.type]
+
+
+@dataclass
+class TPUJob:
+    """The job object (reference: TFJob, v1alpha2/types.go:28-43)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TPUJobSpec = field(default_factory=TPUJobSpec)
+    status: TPUJobStatus = field(default_factory=TPUJobStatus)
+    kind: str = KIND_TPUJOB
+
+    def key(self) -> str:
+        return self.metadata.key()
+
+    def deepcopy(self) -> "TPUJob":
+        return copy.deepcopy(self)
+
+    # ---- serialization -------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        return _to_jsonable(self)
+
+    @staticmethod
+    def from_dict(data: Dict[str, Any]) -> "TPUJob":
+        return _tpujob_from_dict(data)
+
+
+def now() -> float:
+    return time.time()
+
+
+# ---------------------------------------------------------------------------
+# JSON (de)serialization. dataclasses.asdict handles the encode side except
+# enum keys; the decode side rebuilds the typed tree.
+# ---------------------------------------------------------------------------
+
+
+def _to_jsonable(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: _to_jsonable(getattr(obj, f.name)) for f in dataclasses.fields(obj)}
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, dict):
+        return {str(k.value if isinstance(k, enum.Enum) else k): _to_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_to_jsonable(v) for v in obj]
+    return obj
+
+
+def _tpujob_from_dict(data: Dict[str, Any]) -> TPUJob:
+    meta = ObjectMeta(**data.get("metadata", {}))
+    spec_d = data.get("spec", {})
+    replica_specs: Dict[ReplicaType, ReplicaSpec] = {}
+    for rtype_s, rs in spec_d.get("replica_specs", {}).items():
+        rs = dict(rs)
+        tmpl = ProcessTemplate(**rs.pop("template", {}))
+        rp = rs.pop("restart_policy", None)
+        replica_specs[ReplicaType(rtype_s)] = ReplicaSpec(
+            template=tmpl,
+            restart_policy=RestartPolicy(rp) if rp else None,
+            **rs,
+        )
+    topo = TopologySpec(**spec_d.get("topology", {}))
+    run_d = dict(spec_d.get("run_policy", {}))
+    cp = run_d.pop("cleanup_policy", None)
+    if cp is not None:  # null ⇒ fall back to the dataclass default
+        run_d["cleanup_policy"] = CleanupPolicy(cp)
+    run = RunPolicy(**run_d)
+    spec = TPUJobSpec(
+        replica_specs=replica_specs,
+        topology=topo,
+        run_policy=run,
+        workload=spec_d.get("workload", {}),
+    )
+    status_d = data.get("status", {})
+    conditions = [
+        Condition(
+            type=ConditionType(c["type"]),
+            status=bool(c.get("status", True)),
+            reason=c.get("reason", ""),
+            message=c.get("message", ""),
+            last_update_time=c.get("last_update_time", 0.0),
+            last_transition_time=c.get("last_transition_time", 0.0),
+        )
+        for c in status_d.get("conditions", [])
+    ]
+    replica_statuses = {
+        ReplicaType(k): ReplicaStatus(**v) for k, v in status_d.get("replica_statuses", {}).items()
+    }
+    status = TPUJobStatus(
+        conditions=conditions,
+        replica_statuses=replica_statuses,
+        start_time=status_d.get("start_time"),
+        completion_time=status_d.get("completion_time"),
+        last_reconcile_time=status_d.get("last_reconcile_time"),
+        restart_count=status_d.get("restart_count", 0),
+    )
+    return TPUJob(metadata=meta, spec=spec, status=status, kind=data.get("kind", KIND_TPUJOB))

@@ -1,0 +1,80 @@
+"""TPUJob spec validation.
+
+Reference parity: pkg/apis/tensorflow/validation/validation.go:26-79, which
+requires a non-empty replica map, valid replica types, a template per
+replica, a non-nil port, and the trained container to be named "tensorflow".
+The TPU-native analogues are below; mesh/topology consistency checks are new
+(the reference had no notion of device topology).
+"""
+
+from __future__ import annotations
+
+import math
+
+from tf_operator_tpu.api.types import ReplicaType, TPUJob, TPUJobSpec
+
+
+class ValidationError(ValueError):
+    """Raised when a TPUJob spec is invalid (reference: field.ErrorList)."""
+
+
+def validate_job(job: TPUJob) -> None:
+    if not job.metadata.name:
+        raise ValidationError("metadata.name is required")
+    if "/" in job.metadata.name:
+        raise ValidationError("metadata.name must not contain '/'")
+    validate_spec(job.spec)
+
+
+def validate_spec(spec: TPUJobSpec) -> None:
+    if not spec.replica_specs:
+        raise ValidationError("spec.replica_specs must not be empty")
+
+    for rtype, rs in spec.replica_specs.items():
+        if not isinstance(rtype, ReplicaType):
+            raise ValidationError(f"unknown replica type {rtype!r}")
+        prefix = f"spec.replica_specs[{rtype.value}]"
+        if rs.replicas is not None and rs.replicas < 1:
+            raise ValidationError(f"{prefix}.replicas must be >= 1")
+        if rs.port is not None and not (0 < rs.port < 65536):
+            raise ValidationError(f"{prefix}.port must be a valid port")
+        # The reference demands the training container be named "tensorflow"
+        # (validation.go:63-75); our analogue is a resolvable entrypoint.
+        tmpl = rs.template
+        if not tmpl.entrypoint:
+            raise ValidationError(f"{prefix}.template.entrypoint is required")
+        module, sep, func = tmpl.entrypoint.partition(":")
+        if not sep or not module or not func:
+            raise ValidationError(
+                f"{prefix}.template.entrypoint must look like 'pkg.module:fn', "
+                f"got {tmpl.entrypoint!r}"
+            )
+        if tmpl.chips_per_process < 0:
+            raise ValidationError(f"{prefix}.template.chips_per_process must be >= 0")
+
+    coord = spec.replica_specs.get(ReplicaType.COORDINATOR)
+    if coord is not None and coord.replicas not in (None, 1):
+        # Exactly one coordinator, like the chief (v1alpha2/types.go:105-108).
+        raise ValidationError("spec.replica_specs[Coordinator].replicas must be 1")
+
+    _validate_topology(spec)
+
+
+def _validate_topology(spec: TPUJobSpec) -> None:
+    topo = spec.topology
+    if topo.num_hosts < 1:
+        raise ValidationError("spec.topology.num_hosts must be >= 1")
+    if topo.chips_per_host < 0:
+        raise ValidationError("spec.topology.chips_per_host must be >= 0")
+    if topo.mesh_axes:
+        for axis, size in topo.mesh_axes.items():
+            if size < 1:
+                raise ValidationError(f"spec.topology.mesh_axes[{axis!r}] must be >= 1")
+        if topo.chips_per_host:
+            mesh_size = math.prod(topo.mesh_axes.values())
+            total = topo.total_chips()
+            if mesh_size != total:
+                raise ValidationError(
+                    f"mesh axes {topo.mesh_axes} multiply to {mesh_size} "
+                    f"but topology has {total} chips"
+                )

@@ -1,0 +1,12 @@
+"""Utilities: exit-code taxonomy, naming, logging.
+
+Reference parity: pkg/util (util.go, train/train_util.go, k8sutil).
+"""
+
+from tf_operator_tpu.utils.exit_codes import (  # noqa: F401
+    ExitClass,
+    classify_exit_code,
+    is_permanent,
+    is_retryable,
+)
+from tf_operator_tpu.utils.naming import gen_name, gen_runtime_id, rand_string  # noqa: F401

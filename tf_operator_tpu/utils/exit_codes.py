@@ -1,0 +1,61 @@
+"""Exit-code taxonomy driving restart decisions.
+
+Reference parity: pkg/util/train/train_util.go:18-53 — permanent failures
+{1, 2, 126, 127, 128, 139}, retryable {130, 137, 143} (SIGINT/SIGKILL/SIGTERM
+— infrastructure evictions), and 138 (128+SIGUSR1) reserved as the
+user-defined "please retry me" code. OOM is always permanent
+(pkg/trainer/training.go:193-206): retrying an OOM on identical hardware
+just OOMs again.
+
+TPU-native addition: exit codes raised by TPU runtime preemption/maintenance
+events are retryable — on Cloud TPU a preemption is the moral equivalent of
+the reference's pod eviction.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class ExitClass(enum.Enum):
+    SUCCEEDED = "Succeeded"
+    RETRYABLE = "Retryable"
+    PERMANENT = "Permanent"
+
+
+# Semantics preserved from train_util.go:18-53. Retryable codes are
+# 128+signal for external kill/eviction signals INT, KILL, TERM.
+PERMANENT_CODES = frozenset({1, 2, 126, 127, 128, 139})
+RETRYABLE_CODES = frozenset(128 + sig for sig in (2, 9, 15))  # {130, 137, 143}
+USER_RETRYABLE_CODE = 138  # 128 + SIGUSR1: workload asks to be restarted
+
+
+def classify_exit_code(code: int, oom_killed: bool = False) -> ExitClass:
+    """Classify a process exit code.
+
+    ``oom_killed`` mirrors the reference's OOMKilled-reason override
+    (training.go:193-206): permanent regardless of code.
+    """
+    if oom_killed:
+        return ExitClass.PERMANENT
+    if code == 0:
+        return ExitClass.SUCCEEDED
+    if code < 0:  # Python subprocess convention: -N means killed by signal N
+        code = 128 + (-code)
+    if code == USER_RETRYABLE_CODE:
+        return ExitClass.RETRYABLE
+    if code in RETRYABLE_CODES:
+        return ExitClass.RETRYABLE
+    if code in PERMANENT_CODES:
+        return ExitClass.PERMANENT
+    # Unknown nonzero codes: the reference treats unrecognized codes as
+    # permanent by falling through its whitelist; keep that conservatism.
+    return ExitClass.PERMANENT
+
+
+def is_retryable(code: int, oom_killed: bool = False) -> bool:
+    return classify_exit_code(code, oom_killed) is ExitClass.RETRYABLE
+
+
+def is_permanent(code: int, oom_killed: bool = False) -> bool:
+    return classify_exit_code(code, oom_killed) is ExitClass.PERMANENT

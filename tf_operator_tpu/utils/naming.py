@@ -1,0 +1,29 @@
+"""Name generation for managed objects.
+
+Reference parity: pkg/util/util.go:30-75 (RandString over a DNS-safe
+alphabet) and pkg/trainer/replicas.go:520-526 (genName
+⟨job⟩-⟨type⟩-⟨runtimeid⟩-⟨index⟩ with the job name truncated to 40 chars).
+"""
+
+from __future__ import annotations
+
+import random
+import string
+
+# DNS-1035-safe: lowercase alphanumerics (names may be used as hostnames).
+_ALPHABET = string.ascii_lowercase + string.digits
+_MAX_JOB_NAME = 40
+
+
+def rand_string(n: int, rng: random.Random | None = None) -> str:
+    r = rng or random
+    return "".join(r.choice(_ALPHABET) for _ in range(n))
+
+
+def gen_runtime_id(rng: random.Random | None = None) -> str:
+    """4-char run id, regenerated per job incarnation (training.go:214-248)."""
+    return rand_string(4, rng)
+
+
+def gen_name(job_name: str, replica_type: str, runtime_id: str, index: int) -> str:
+    return f"{job_name[:_MAX_JOB_NAME]}-{replica_type.lower()}-{runtime_id}-{index}"
