@@ -1,0 +1,117 @@
+"""Process backend tests: fake records intents; real backend launches OS
+processes and reports phase/exit codes into the store."""
+
+import sys
+import time
+
+import pytest
+
+from tf_operator_tpu.api.types import ObjectMeta
+from tf_operator_tpu.runtime import (
+    FakeProcessControl,
+    LocalProcessControl,
+    Process,
+    ProcessPhase,
+    ProcessSpec,
+    Store,
+)
+
+
+def proc(name, env=None):
+    return Process(
+        metadata=ObjectMeta(name=name),
+        spec=ProcessSpec(job_name="j", replica_type="Worker", env=env or {}),
+    )
+
+
+def wait_for(predicate, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_fake_records_actions():
+    fake = FakeProcessControl()
+    fake.create_process(proc("a"))
+    fake.delete_process("default", "a")
+    assert [p.metadata.name for p in fake.created] == ["a"]
+    assert fake.deleted == ["default/a"]
+
+
+def test_fake_error_injection():
+    fake = FakeProcessControl()
+    fake.create_error = RuntimeError("boom")
+    with pytest.raises(RuntimeError):
+        fake.create_process(proc("a"))
+
+
+def script_builder(code):
+    """Run a tiny inline script instead of the rendezvous harness."""
+
+    def build(process):
+        return [sys.executable, "-c", code]
+
+    return build
+
+
+def test_local_backend_success_cycle():
+    store = Store()
+    ctl = LocalProcessControl(store, command_builder=script_builder("import sys; sys.exit(0)"))
+    ctl.create_process(proc("ok"))
+    assert wait_for(
+        lambda: store.get("Process", "default", "ok").status.phase is ProcessPhase.SUCCEEDED
+    )
+    st = store.get("Process", "default", "ok").status
+    assert st.exit_code == 0 and st.pid is not None
+
+
+def test_local_backend_failure_exit_code():
+    store = Store()
+    ctl = LocalProcessControl(store, command_builder=script_builder("import sys; sys.exit(7)"))
+    ctl.create_process(proc("bad"))
+    assert wait_for(
+        lambda: store.get("Process", "default", "bad").status.phase is ProcessPhase.FAILED
+    )
+    assert store.get("Process", "default", "bad").status.exit_code == 7
+
+
+def test_local_backend_env_injection():
+    store = Store()
+    code = "import os, sys; sys.exit(3 if os.environ.get('TPUJOB_X') == 'y' else 1)"
+    ctl = LocalProcessControl(store, command_builder=script_builder(code))
+    ctl.create_process(proc("envy", env={"TPUJOB_X": "y"}))
+    assert wait_for(lambda: store.get("Process", "default", "envy").is_finished())
+    assert store.get("Process", "default", "envy").status.exit_code == 3
+
+
+def test_local_backend_delete_terminates_running_child():
+    store = Store()
+    ctl = LocalProcessControl(store, command_builder=script_builder("import time; time.sleep(60)"))
+    ctl.create_process(proc("sleeper"))
+    assert wait_for(
+        lambda: store.get("Process", "default", "sleeper").status.phase is ProcessPhase.RUNNING
+    )
+    ctl.delete_process("default", "sleeper")
+    # object gone from the store; child reaped
+    from tf_operator_tpu.runtime import NotFoundError
+
+    with pytest.raises(NotFoundError):
+        store.get("Process", "default", "sleeper")
+    assert not ctl._children
+
+
+def test_local_backend_bad_command_reports_failed():
+    store = Store()
+
+    def build(process):
+        return ["/nonexistent/binary"]
+
+    ctl = LocalProcessControl(store, command_builder=build)
+    ctl.create_process(proc("ghost"))
+    assert wait_for(
+        lambda: store.get("Process", "default", "ghost").status.phase is ProcessPhase.FAILED
+    )
+    assert store.get("Process", "default", "ghost").status.exit_code == 127

@@ -1,0 +1,124 @@
+"""Store tests: CRUD, snapshot isolation, optimistic concurrency, watches."""
+
+import threading
+
+import pytest
+
+from tf_operator_tpu.api.types import ObjectMeta
+from tf_operator_tpu.runtime import (
+    AlreadyExistsError,
+    NotFoundError,
+    Process,
+    ProcessPhase,
+    ProcessSpec,
+    Store,
+    WatchEventType,
+)
+from tf_operator_tpu.runtime.store import ConflictError
+
+
+def proc(name, ns="default", labels=None):
+    return Process(
+        metadata=ObjectMeta(name=name, namespace=ns, labels=labels or {}),
+        spec=ProcessSpec(job_name="j", replica_type="Worker", replica_index=0),
+    )
+
+
+def test_create_get_update_delete():
+    s = Store()
+    created = s.create(proc("p0"))
+    assert created.metadata.uid and created.metadata.resource_version > 0
+
+    got = s.get("Process", "default", "p0")
+    got.status.phase = ProcessPhase.RUNNING
+    updated = s.update(got)
+    assert updated.metadata.resource_version > got.metadata.resource_version
+    assert s.get("Process", "default", "p0").status.phase is ProcessPhase.RUNNING
+
+    s.delete("Process", "default", "p0")
+    with pytest.raises(NotFoundError):
+        s.get("Process", "default", "p0")
+
+
+def test_duplicate_create_rejected():
+    s = Store()
+    s.create(proc("p0"))
+    with pytest.raises(AlreadyExistsError):
+        s.create(proc("p0"))
+
+
+def test_snapshot_isolation():
+    s = Store()
+    s.create(proc("p0"))
+    a = s.get("Process", "default", "p0")
+    a.spec.replica_index = 42  # mutating my copy must not touch the store
+    assert s.get("Process", "default", "p0").spec.replica_index == 0
+
+
+def test_optimistic_concurrency():
+    s = Store()
+    s.create(proc("p0"))
+    a = s.get("Process", "default", "p0")
+    b = s.get("Process", "default", "p0")
+    s.update(a, check_version=True)
+    with pytest.raises(ConflictError):
+        s.update(b, check_version=True)  # b is now stale
+
+
+def test_list_with_label_selector_and_namespace():
+    s = Store()
+    s.create(proc("a", labels={"job": "x", "rtype": "Worker"}))
+    s.create(proc("b", labels={"job": "x", "rtype": "Coordinator"}))
+    s.create(proc("c", ns="other", labels={"job": "x", "rtype": "Worker"}))
+    assert len(s.list("Process", label_selector={"job": "x"})) == 3
+    assert [p.metadata.name for p in s.list("Process", namespace="default", label_selector={"rtype": "Worker"})] == ["a"]
+
+
+def test_watch_replays_existing_then_streams():
+    s = Store()
+    s.create(proc("pre"))
+    w = s.watch(kinds=["Process"])
+    ev = w.queue.get(timeout=1)
+    assert (ev.type, ev.obj.metadata.name) == (WatchEventType.ADDED, "pre")
+
+    s.create(proc("live"))
+    ev = w.queue.get(timeout=1)
+    assert (ev.type, ev.obj.metadata.name) == (WatchEventType.ADDED, "live")
+
+    got = s.get("Process", "default", "live")
+    s.update(got)
+    assert w.queue.get(timeout=1).type is WatchEventType.MODIFIED
+    s.delete("Process", "default", "live")
+    assert w.queue.get(timeout=1).type is WatchEventType.DELETED
+    w.stop()
+
+
+def test_watch_kind_filter():
+    s = Store()
+    w = s.watch(kinds=["Endpoint"])
+    s.create(proc("p0"))
+    assert w.queue.empty()
+    w.stop()
+
+
+def test_concurrent_creates_unique_rvs():
+    s = Store()
+    errs = []
+
+    def worker(i):
+        try:
+            for j in range(50):
+                s.create(proc(f"p-{i}-{j}"))
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    objs = s.list("Process")
+    assert len(objs) == 400
+    rvs = [o.metadata.resource_version for o in objs]
+    assert len(set(rvs)) == 400

@@ -1,0 +1,25 @@
+"""Rendezvous: how launched processes learn who they are and where to meet.
+
+Replaces the reference's TF_CONFIG generator + consumer pair
+(controller.v2/controller_tensorflow.go:49-112 on the produce side,
+examples/tf_sample/tf_sample/tf_smoke.py:88-110 on the consume side). On TPU
+the whole cluster-spec map collapses to three values — coordinator address,
+process count, process id — because intra-slice topology is hardware and XLA
+collectives need no address book (SURVEY.md §5 "communication backend").
+"""
+
+from tf_operator_tpu.rendezvous.env import (  # noqa: F401
+    ENV_CHIPS,
+    ENV_COORDINATOR_ADDRESS,
+    ENV_ENTRYPOINT,
+    ENV_JOB_NAME,
+    ENV_MESH_AXES,
+    ENV_NAMESPACE,
+    ENV_NUM_PROCESSES,
+    ENV_PORT,
+    ENV_PROCESS_ID,
+    ENV_REPLICA_INDEX,
+    ENV_REPLICA_TYPE,
+    ENV_WORKLOAD,
+    identity_env,
+)

@@ -1,0 +1,62 @@
+"""The process environment contract (TF_CONFIG analogue).
+
+The reference serializes a cluster-spec map + task identity into one JSON
+env var, ``TF_CONFIG`` (controller.v2/controller_tensorflow.go:49-84). The
+TPU-native contract is flat env vars in two groups:
+
+Identity (injected by the backend from ``ProcessSpec`` — every launched
+process gets these even if the controller adds nothing):
+
+- ``TPUJOB_ENTRYPOINT``      — "pkg.module:fn" the harness resolves and calls
+- ``TPUJOB_NAME``            — owning job name
+- ``TPUJOB_NAMESPACE``       — owning job namespace
+- ``TPUJOB_REPLICA_TYPE``    — Coordinator / Worker / Evaluator
+- ``TPUJOB_REPLICA_INDEX``   — index within the replica set (task_index
+                               analogue, replicas.go:121-136)
+- ``TPUJOB_PORT``            — rendezvous port (meaningful on coordinator)
+- ``TPUJOB_CHIPS``           — TPU chips this process drives
+
+Rendezvous (computed by the controller, consumed by
+``jax.distributed.initialize`` in the harness):
+
+- ``TPUJOB_COORDINATOR_ADDRESS`` — "host:port" of process 0
+- ``TPUJOB_NUM_PROCESSES``       — total process count in the gang
+- ``TPUJOB_PROCESS_ID``          — this process's rank
+- ``TPUJOB_MESH_AXES``           — JSON {"axis": size, ...} logical mesh
+- ``TPUJOB_WORKLOAD``            — JSON passthrough of spec.workload
+"""
+
+from __future__ import annotations
+
+from typing import Dict, TYPE_CHECKING
+
+if TYPE_CHECKING:  # avoid a runtime cycle with runtime.objects
+    from tf_operator_tpu.runtime.objects import ProcessSpec
+
+ENV_ENTRYPOINT = "TPUJOB_ENTRYPOINT"
+ENV_JOB_NAME = "TPUJOB_NAME"
+ENV_NAMESPACE = "TPUJOB_NAMESPACE"
+ENV_REPLICA_TYPE = "TPUJOB_REPLICA_TYPE"
+ENV_REPLICA_INDEX = "TPUJOB_REPLICA_INDEX"
+ENV_PORT = "TPUJOB_PORT"
+ENV_CHIPS = "TPUJOB_CHIPS"
+
+ENV_COORDINATOR_ADDRESS = "TPUJOB_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "TPUJOB_NUM_PROCESSES"
+ENV_PROCESS_ID = "TPUJOB_PROCESS_ID"
+ENV_MESH_AXES = "TPUJOB_MESH_AXES"
+ENV_WORKLOAD = "TPUJOB_WORKLOAD"
+
+
+def identity_env(spec: "ProcessSpec", namespace: str) -> Dict[str, str]:
+    """Identity env derived from a ProcessSpec; the backend injects this so
+    a launched harness can always resolve its entrypoint and identity."""
+    return {
+        ENV_ENTRYPOINT: spec.entrypoint,
+        ENV_JOB_NAME: spec.job_name,
+        ENV_NAMESPACE: namespace,
+        ENV_REPLICA_TYPE: spec.replica_type,
+        ENV_REPLICA_INDEX: str(spec.replica_index),
+        ENV_PORT: str(spec.port),
+        ENV_CHIPS: str(spec.chips),
+    }

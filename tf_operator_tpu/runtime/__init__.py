@@ -1,0 +1,39 @@
+"""Runtime substrate: the framework's "cluster".
+
+Where the reference leans on the Kubernetes apiserver (objects, watches,
+events) and the kubelet (starting containers, reporting exit status), this
+package supplies TPU-native equivalents that work on a bare host or a slice:
+
+- ``objects``          — Process / Endpoint / Event records (Pod / headless
+                         Service / Event analogues)
+- ``store``            — thread-safe object store with resource versions and
+                         watch streams (apiserver analogue; the informer feeds
+                         from it)
+- ``process_backend``  — ``ProcessControl`` seam with a real subprocess
+                         launcher and a fake that records intended actions
+                         (reference: RealPodControl pod_control.go:54-165 and
+                         FakePodControl, the trick that makes the whole
+                         controller testable, controller_test.go:66-68)
+"""
+
+from tf_operator_tpu.runtime.objects import (  # noqa: F401
+    Endpoint,
+    Event,
+    EventType,
+    Process,
+    ProcessPhase,
+    ProcessSpec,
+    ProcessStatus,
+)
+from tf_operator_tpu.runtime.store import (  # noqa: F401
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    Store,
+    WatchEventType,
+)
+from tf_operator_tpu.runtime.process_backend import (  # noqa: F401
+    FakeProcessControl,
+    LocalProcessControl,
+    ProcessControl,
+)

@@ -1,0 +1,125 @@
+"""Runtime objects: Process (Pod analogue), Endpoint (headless-Service
+analogue), Event.
+
+Reference parity: the operator manages exactly three kinds of child objects —
+Pods, Services (headless, one per replica index, replicas.go:139-169), and
+Events (pod_control.go:37-51). A Process here is one OS process driving some
+number of TPU chips; an Endpoint is the stable address record other processes
+use to find the rendezvous coordinator (the surviving remnant of the
+reference's per-replica DNS machinery, SURVEY.md §5 "communication backend").
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tf_operator_tpu.api.types import KIND_ENDPOINT, KIND_EVENT, KIND_PROCESS, ObjectMeta
+
+
+class ProcessPhase(str, enum.Enum):
+    """Pod-phase analogue (k8s PodPhase as consumed by
+    controller_status.go:136-154 and replicas.go:310-363)."""
+
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    UNKNOWN = "Unknown"
+
+
+@dataclass
+class ProcessSpec:
+    """What to run. Identity fields mirror the labels the reference stamps on
+    pods (job name, replica type, replica/task index — replicas.go:121-136)."""
+
+    job_name: str = ""
+    replica_type: str = ""
+    replica_index: int = 0
+    entrypoint: str = ""  # "pkg.module:fn"
+    args: List[str] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)
+    chips: int = 0  # TPU chips this process drives
+    port: int = 0  # rendezvous port (meaningful on the coordinator process)
+    workdir: Optional[str] = None
+
+
+@dataclass
+class ProcessStatus:
+    """Observed process state (analogue of PodStatus + the container
+    termination state the reference mines for exit codes,
+    replicas.go:333-341)."""
+
+    phase: ProcessPhase = ProcessPhase.PENDING
+    pid: Optional[int] = None
+    exit_code: Optional[int] = None
+    oom_killed: bool = False
+    start_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    message: str = ""
+    # Exit code of the previous incarnation, preserved across in-place
+    # restarts (LastTerminationState analogue, replicas.go:333-341).
+    last_termination_exit_code: Optional[int] = None
+
+
+@dataclass
+class Process:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ProcessSpec = field(default_factory=ProcessSpec)
+    status: ProcessStatus = field(default_factory=ProcessStatus)
+    kind: str = KIND_PROCESS
+
+    def key(self) -> str:
+        return self.metadata.key()
+
+    def is_finished(self) -> bool:
+        return self.status.phase in (ProcessPhase.SUCCEEDED, ProcessPhase.FAILED)
+
+
+@dataclass
+class EndpointAddress:
+    host: str = "127.0.0.1"
+    port: int = 0
+
+    def __str__(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+@dataclass
+class Endpoint:
+    """Stable address record for a replica (headless-Service analogue,
+    controller_service.go:91-149). On a single host this is
+    127.0.0.1:port; on a real multi-host deployment the provisioner fills
+    in the host's reachable address."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    address: EndpointAddress = field(default_factory=EndpointAddress)
+    target_process: str = ""  # name of the Process this endpoint fronts
+    kind: str = KIND_ENDPOINT
+
+    def key(self) -> str:
+        return self.metadata.key()
+
+
+class EventType(str, enum.Enum):
+    NORMAL = "Normal"
+    WARNING = "Warning"
+
+
+@dataclass
+class Event:
+    """Recorded occurrence (k8s Event analogue). Events double as a test
+    oracle exactly as in the reference, where the e2e driver asserts
+    creation-event counts equal replica counts (py/test_runner.py:311-338)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    type: EventType = EventType.NORMAL
+    reason: str = ""
+    message: str = ""
+    involved_kind: str = ""
+    involved_name: str = ""
+    involved_namespace: str = ""
+    count: int = 1
+    timestamp: float = 0.0
+    kind: str = KIND_EVENT

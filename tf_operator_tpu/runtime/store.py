@@ -1,0 +1,191 @@
+"""Thread-safe in-memory object store with resource versions and watches.
+
+The apiserver analogue (reference L0/L1, SURVEY.md §1): every managed object
+(TPUJob, Process, Endpoint, Event) lives here; controllers observe it through
+watch streams (feeding the informer, as client-go's ListWatch feeds shared
+informers, pkg/util/unstructured/informer.go:25-62) and mutate it through
+CRUD calls. Snapshot isolation is by deepcopy on every boundary crossing —
+callers never share memory with the store, the same guarantee the apiserver's
+serialization boundary provides (and the reason the reference DeepCopies
+before mutating, controller.v2/controller.go:357-361).
+"""
+
+from __future__ import annotations
+
+import copy
+import enum
+import itertools
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class AlreadyExistsError(ValueError):
+    pass
+
+
+class ConflictError(ValueError):
+    """Stale update: object changed since the caller read it (apiserver 409)."""
+
+
+class WatchEventType(str, enum.Enum):
+    ADDED = "ADDED"
+    MODIFIED = "MODIFIED"
+    DELETED = "DELETED"
+
+
+@dataclass
+class WatchEvent:
+    type: WatchEventType
+    obj: Any  # deepcopy of the stored object
+
+
+class Watch:
+    """A subscription to store changes. Iterate or poll ``queue``."""
+
+    def __init__(self, store: "Store", kinds: Optional[Tuple[str, ...]]):
+        self._store = store
+        self.kinds = kinds
+        self.queue: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        self._stopped = False
+
+    def stop(self) -> None:
+        if not self._stopped:
+            self._stopped = True
+            self._store._remove_watch(self)
+            self.queue.put(None)  # sentinel unblocks consumers
+
+    def __iter__(self):
+        while True:
+            ev = self.queue.get()
+            if ev is None:
+                return
+            yield ev
+
+
+def _key(kind: str, namespace: str, name: str) -> Tuple[str, str, str]:
+    return (kind, namespace, name)
+
+
+class Store:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._objects: Dict[Tuple[str, str, str], Any] = {}
+        self._rv = itertools.count(1)
+        self._watches: List[Watch] = []
+
+    # ---- CRUD ----------------------------------------------------------
+
+    def create(self, obj: Any) -> Any:
+        with self._lock:
+            meta = obj.metadata
+            k = _key(obj.kind, meta.namespace, meta.name)
+            if k in self._objects:
+                raise AlreadyExistsError(f"{obj.kind} {meta.namespace}/{meta.name} already exists")
+            stored = copy.deepcopy(obj)
+            if not stored.metadata.uid:
+                stored.metadata.uid = uuid.uuid4().hex[:12]
+            stored.metadata.resource_version = next(self._rv)
+            stored.metadata.creation_timestamp = time.time()
+            self._objects[k] = stored
+            out = copy.deepcopy(stored)
+            self._notify(WatchEventType.ADDED, stored)
+            return out
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        with self._lock:
+            k = _key(kind, namespace, name)
+            if k not in self._objects:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(self._objects[k])
+
+    def update(self, obj: Any, check_version: bool = False) -> Any:
+        """Replace an object. With ``check_version`` the caller's
+        resource_version must match the stored one (optimistic concurrency,
+        the contract CRD status updates rely on)."""
+        with self._lock:
+            meta = obj.metadata
+            k = _key(obj.kind, meta.namespace, meta.name)
+            if k not in self._objects:
+                raise NotFoundError(f"{obj.kind} {meta.namespace}/{meta.name} not found")
+            current = self._objects[k]
+            if check_version and meta.resource_version != current.metadata.resource_version:
+                raise ConflictError(
+                    f"{obj.kind} {meta.namespace}/{meta.name}: stale resource_version "
+                    f"{meta.resource_version} (current {current.metadata.resource_version})"
+                )
+            stored = copy.deepcopy(obj)
+            stored.metadata.uid = current.metadata.uid
+            stored.metadata.creation_timestamp = current.metadata.creation_timestamp
+            stored.metadata.resource_version = next(self._rv)
+            self._objects[k] = stored
+            out = copy.deepcopy(stored)
+            self._notify(WatchEventType.MODIFIED, stored)
+            return out
+
+    def delete(self, kind: str, namespace: str, name: str) -> Any:
+        with self._lock:
+            k = _key(kind, namespace, name)
+            if k not in self._objects:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            stored = self._objects.pop(k)
+            stored.metadata.deletion_timestamp = time.time()
+            out = copy.deepcopy(stored)
+            self._notify(WatchEventType.DELETED, stored)
+            return out
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Any]:
+        """List objects of ``kind``, optionally filtered by namespace and
+        exact-match labels (the reference lists children by job labels,
+        replicas.go:434-485)."""
+        with self._lock:
+            out = []
+            for (k_kind, k_ns, _), obj in self._objects.items():
+                if k_kind != kind:
+                    continue
+                if namespace is not None and k_ns != namespace:
+                    continue
+                if label_selector and not _labels_match(obj.metadata.labels, label_selector):
+                    continue
+                out.append(copy.deepcopy(obj))
+            out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+            return out
+
+    # ---- watches -------------------------------------------------------
+
+    def watch(self, kinds: Optional[Iterable[str]] = None) -> Watch:
+        """Subscribe to changes; ADDED events for existing objects are
+        replayed first (list+watch semantics, the informer's contract)."""
+        with self._lock:
+            w = Watch(self, tuple(kinds) if kinds else None)
+            for obj in self._objects.values():
+                if w.kinds is None or obj.kind in w.kinds:
+                    w.queue.put(WatchEvent(WatchEventType.ADDED, copy.deepcopy(obj)))
+            self._watches.append(w)
+            return w
+
+    def _remove_watch(self, w: Watch) -> None:
+        with self._lock:
+            if w in self._watches:
+                self._watches.remove(w)
+
+    def _notify(self, etype: WatchEventType, stored: Any) -> None:
+        for w in self._watches:
+            if w.kinds is None or stored.kind in w.kinds:
+                w.queue.put(WatchEvent(etype, copy.deepcopy(stored)))
+
+
+def _labels_match(labels: Dict[str, str], selector: Dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())
