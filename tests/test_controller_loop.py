@@ -1,0 +1,158 @@
+"""End-to-end control-loop tests: real informers + workqueue + OS processes.
+
+The analogue of the reference's e2e smoke (test/e2e/main.go:83-191) run
+against the local runtime instead of GKE: submit a job, watch it reach
+Succeeded, assert child/event bookkeeping, then GC.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+from tf_operator_tpu.api.types import (
+    CleanupPolicy,
+    ConditionType,
+    ObjectMeta,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    TPUJob,
+    TPUJobSpec,
+)
+from tf_operator_tpu.controller import TPUJobController
+from tf_operator_tpu.controller.status import has_condition
+from tf_operator_tpu.runtime import LocalProcessControl, Store
+
+
+def wait_for(predicate, timeout=30.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def rig():
+    store = Store()
+    ctl_holder = {}
+
+    def finalize(command_builder):
+        pc = LocalProcessControl(store, command_builder=command_builder)
+        ctl = TPUJobController(store, pc, resync_period=0.2)
+        ctl.run(workers=2)
+        ctl_holder["ctl"] = ctl
+        ctl_holder["pc"] = pc
+        return store, ctl
+
+    yield finalize
+    if "ctl" in ctl_holder:
+        ctl_holder["ctl"].stop()
+        ctl_holder["pc"].shutdown()
+
+
+def make_job(name, workers=2):
+    return TPUJob(
+        metadata=ObjectMeta(name=name),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.COORDINATOR: ReplicaSpec(
+                    replicas=1, template=ProcessTemplate(entrypoint="wl:main")
+                ),
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=workers, template=ProcessTemplate(entrypoint="wl:main")
+                ),
+            },
+        ),
+    )
+
+
+def test_job_lifecycle_to_succeeded(rig):
+    code = "import sys; sys.exit(0)"
+    store, _ = rig(lambda p: [sys.executable, "-c", code])
+    job = make_job("smoke")
+    job.spec.run_policy.cleanup_policy = CleanupPolicy.ALL
+    store.create(job)
+
+    assert wait_for(
+        lambda: has_condition(
+            store.get("TPUJob", "default", "smoke").status, ConditionType.SUCCEEDED
+        )
+    ), str(store.get("TPUJob", "default", "smoke").status)
+    # cleanup ALL: no processes left
+    assert wait_for(lambda: not store.list("Process"))
+    # events: 3 creations recorded (the reference's oracle)
+    evs = [e for e in store.list("Event") if e.reason == "SuccessfulCreateProcess"]
+    assert sum(e.count for e in evs) == 3
+
+
+def test_gang_restart_then_success(rig, tmp_path):
+    # The worker fails retryably (138) on its first incarnation and succeeds
+    # on the second; the coordinator only succeeds once the worker has — so
+    # chief-success can never race ahead of the worker failure and the gang
+    # restart is deterministic.
+    attempted = tmp_path / "attempted"
+    worker_ok = tmp_path / "worker_ok"
+    worker_code = (
+        "import os, sys\n"
+        f"a, ok = {str(attempted)!r}, {str(worker_ok)!r}\n"
+        "if os.path.exists(a):\n"
+        "    open(ok, 'w').close(); sys.exit(0)\n"
+        "open(a, 'w').close(); sys.exit(138)\n"
+    )
+    coord_code = (
+        "import os, sys, time\n"
+        f"ok = {str(worker_ok)!r}\n"
+        "for _ in range(600):\n"
+        "    if os.path.exists(ok): sys.exit(0)\n"
+        "    time.sleep(0.05)\n"
+        "sys.exit(1)\n"
+    )
+
+    def builder(p):
+        code = coord_code if p.spec.replica_type == "Coordinator" else worker_code
+        return [sys.executable, "-c", code]
+
+    store, _ = rig(builder)
+    job = make_job("phoenix", workers=1)
+    store.create(job)
+
+    assert wait_for(
+        lambda: has_condition(
+            store.get("TPUJob", "default", "phoenix").status, ConditionType.SUCCEEDED
+        ),
+        timeout=45,
+    ), str(store.get("TPUJob", "default", "phoenix").status)
+    st = store.get("TPUJob", "default", "phoenix").status
+    assert st.restart_count >= 1
+
+
+def test_permanent_failure_reaches_failed(rig):
+    code = "import sys; sys.exit(1)"
+    store, _ = rig(lambda p: [sys.executable, "-c", code])
+    job = make_job("doomed", workers=1)
+    store.create(job)
+
+    assert wait_for(
+        lambda: has_condition(
+            store.get("TPUJob", "default", "doomed").status, ConditionType.FAILED
+        )
+    ), str(store.get("TPUJob", "default", "doomed").status)
+
+
+def test_delete_and_resubmit_same_name(rig):
+    # The reference runs two trials with the same name to verify
+    # delete -> recreate works (py/test_runner.py:276-280).
+    code = "import time, sys; time.sleep(30); sys.exit(0)"
+    store, _ = rig(lambda p: [sys.executable, "-c", code])
+    store.create(make_job("reuse", workers=1))
+    assert wait_for(lambda: len(store.list("Process")) == 2)
+    store.delete("TPUJob", "default", "reuse")
+    assert wait_for(lambda: not store.list("Process")), store.list("Process")
+
+    quick_store_job = make_job("reuse", workers=1)
+    store.create(quick_store_job)
+    assert wait_for(lambda: len(store.list("Process")) == 2)

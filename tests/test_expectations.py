@@ -1,0 +1,48 @@
+"""Expectations tests (reference: ControllerExpectations semantics,
+controller.v2/controller.go:125-141,417-436)."""
+
+from tf_operator_tpu.controller.expectations import ControllerExpectations
+
+
+def test_unset_expectations_are_satisfied():
+    e = ControllerExpectations()
+    assert e.satisfied("ns/j/processes")
+
+
+def test_creations_block_until_observed():
+    e = ControllerExpectations()
+    e.expect_creations("k", 2)
+    assert not e.satisfied("k")
+    e.creation_observed("k")
+    assert not e.satisfied("k")
+    e.creation_observed("k")
+    assert e.satisfied("k")
+
+
+def test_deletions_block_until_observed():
+    e = ControllerExpectations()
+    e.expect_deletions("k", 1)
+    assert not e.satisfied("k")
+    e.deletion_observed("k")
+    assert e.satisfied("k")
+
+
+def test_over_observation_is_harmless():
+    e = ControllerExpectations()
+    e.expect_creations("k", 1)
+    e.creation_observed("k")
+    e.creation_observed("k")  # unexpected extra event
+    assert e.satisfied("k")
+
+
+def test_ttl_expiry_unwedges_lost_events():
+    e = ControllerExpectations(ttl=0.0)  # expire immediately
+    e.expect_creations("k", 5)
+    assert e.satisfied("k")  # lost watch event cannot wedge the job
+
+
+def test_delete_expectations():
+    e = ControllerExpectations()
+    e.expect_creations("k", 3)
+    e.delete_expectations("k")
+    assert e.satisfied("k")

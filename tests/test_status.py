@@ -1,0 +1,61 @@
+"""Status engine tests (reference: controller_status_test.go)."""
+
+from tf_operator_tpu.api.types import ConditionType, ReplicaType, TPUJobStatus
+from tf_operator_tpu.api.types import ObjectMeta
+from tf_operator_tpu.controller.status import (
+    get_condition,
+    has_condition,
+    initialize_replica_statuses,
+    is_finished,
+    new_condition,
+    set_condition,
+    update_replica_status,
+)
+from tf_operator_tpu.runtime.objects import Process, ProcessPhase, ProcessStatus
+
+
+def test_set_and_get_condition():
+    st = TPUJobStatus()
+    set_condition(st, new_condition(ConditionType.CREATED, "r", "m"))
+    assert has_condition(st, ConditionType.CREATED)
+    assert get_condition(st, ConditionType.CREATED).reason == "r"
+
+
+def test_running_filters_restarting_and_vice_versa():
+    st = TPUJobStatus()
+    set_condition(st, new_condition(ConditionType.RUNNING, "JobRunning", ""))
+    set_condition(st, new_condition(ConditionType.RESTARTING, "Restarting", ""))
+    assert has_condition(st, ConditionType.RESTARTING)
+    assert not has_condition(st, ConditionType.RUNNING)
+    set_condition(st, new_condition(ConditionType.RUNNING, "JobRunning", ""))
+    assert not has_condition(st, ConditionType.RESTARTING)
+
+
+def test_same_type_updates_in_place():
+    st = TPUJobStatus()
+    set_condition(st, new_condition(ConditionType.RUNNING, "JobRunning", "first"))
+    set_condition(st, new_condition(ConditionType.RUNNING, "JobRunning", "second"))
+    assert len(st.conditions) == 1
+    assert get_condition(st, ConditionType.RUNNING).message == "second"
+
+
+def test_is_finished():
+    st = TPUJobStatus()
+    assert not is_finished(st)
+    set_condition(st, new_condition(ConditionType.SUCCEEDED, "s", ""))
+    assert is_finished(st)
+
+
+def test_replica_status_counters():
+    st = TPUJobStatus()
+    initialize_replica_statuses(st, [ReplicaType.WORKER])
+
+    def proc(phase):
+        return Process(metadata=ObjectMeta(name="p"), status=ProcessStatus(phase=phase))
+
+    update_replica_status(st, ReplicaType.WORKER, proc(ProcessPhase.RUNNING))
+    update_replica_status(st, ReplicaType.WORKER, proc(ProcessPhase.PENDING))
+    update_replica_status(st, ReplicaType.WORKER, proc(ProcessPhase.SUCCEEDED))
+    update_replica_status(st, ReplicaType.WORKER, proc(ProcessPhase.FAILED))
+    rs = st.replica_statuses[ReplicaType.WORKER]
+    assert (rs.active, rs.succeeded, rs.failed) == (2, 1, 1)

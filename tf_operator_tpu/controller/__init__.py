@@ -1,0 +1,16 @@
+"""Control plane: the reconciling job controller.
+
+Reference parity: pkg/controller.v2 — the informer/expectations architecture
+(SURVEY.md §3.3): object events enqueue job keys into a rate-limited
+workqueue; workers pop keys and run an idempotent sync that compares desired
+gang membership against observed processes, creates/deletes children through
+the ProcessControl seam, and drives conditions-based status. The
+expectations cache bridges informer staleness (the subtlest part of the
+reference, controller.v2/controller.go:125-141,417-436).
+"""
+
+from tf_operator_tpu.controller.workqueue import RateLimitingQueue  # noqa: F401
+from tf_operator_tpu.controller.expectations import ControllerExpectations  # noqa: F401
+from tf_operator_tpu.controller.events import EventRecorder  # noqa: F401
+from tf_operator_tpu.controller.informer import Informer  # noqa: F401
+from tf_operator_tpu.controller.reconciler import TPUJobController  # noqa: F401

@@ -1,0 +1,77 @@
+"""Event recorder: durable occurrences for observability and test oracles.
+
+Reference parity: the k8s EventBroadcaster the operator wires at startup
+(pkg/controller/controller.go:107-120) and the per-action events emitted by
+pod/service control (pod_control.go:37-51, replicas.go:470-474). Events
+double as the e2e test oracle — the reference asserts creation-event counts
+equal replica counts (py/test_runner.py:307-338) — so reasons are stable
+API, and repeats aggregate into a count like k8s event compaction.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tf_operator_tpu.api.types import ObjectMeta
+from tf_operator_tpu.runtime.objects import Event, EventType
+from tf_operator_tpu.runtime.store import NotFoundError, Store
+
+# Stable event reasons (reference: SuccessfulCreate/FailedCreate etc.).
+REASON_SUCCESSFUL_CREATE = "SuccessfulCreateProcess"
+REASON_FAILED_CREATE = "FailedCreateProcess"
+REASON_SUCCESSFUL_DELETE = "SuccessfulDeleteProcess"
+REASON_FAILED_DELETE = "FailedDeleteProcess"
+REASON_JOB_RESTARTING = "TPUJobRestarting"
+REASON_JOB_SUCCEEDED = "TPUJobSucceeded"
+REASON_JOB_FAILED = "TPUJobFailed"
+REASON_JOB_RUNNING = "TPUJobRunning"
+REASON_JOB_CREATED = "TPUJobCreated"
+REASON_JOB_DEADLINE = "TPUJobDeadlineExceeded"
+
+
+class EventRecorder:
+    def __init__(self, store: Store, component: str = "tpujob-controller") -> None:
+        self._store = store
+        self._component = component
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def event(
+        self,
+        involved,  # object with .kind and .metadata
+        etype: EventType,
+        reason: str,
+        message: str,
+    ) -> None:
+        meta = involved.metadata
+        # Aggregate repeats: one Event object per (object, reason), count++.
+        name = f"{meta.name}.{reason.lower()}"
+        with self._lock:
+            try:
+                existing = self._store.get("Event", meta.namespace, name)
+                existing.count += 1
+                existing.message = message
+                existing.timestamp = time.time()
+                self._store.update(existing)
+                return
+            except NotFoundError:
+                pass
+            self._seq += 1
+            ev = Event(
+                metadata=ObjectMeta(name=name, namespace=meta.namespace),
+                type=etype,
+                reason=reason,
+                message=message,
+                involved_kind=involved.kind,
+                involved_name=meta.name,
+                involved_namespace=meta.namespace,
+                timestamp=time.time(),
+            )
+            self._store.create(ev)
+
+    def normal(self, involved, reason: str, message: str) -> None:
+        self.event(involved, EventType.NORMAL, reason, message)
+
+    def warning(self, involved, reason: str, message: str) -> None:
+        self.event(involved, EventType.WARNING, reason, message)

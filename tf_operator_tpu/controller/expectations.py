@@ -1,0 +1,101 @@
+"""ControllerExpectations: bridging informer-cache staleness.
+
+Reference parity: k8s.io/kubernetes/pkg/controller expectations as used by
+the operator (controller.v2/controller.go:125-141; SURVEY.md calls this the
+subtlest logic in the reference). After issuing N creates/deletes for a
+(job, replica-type, object-kind) the controller records "I expect to observe
+N creations/deletions"; informer callbacks decrement the counters; a sync
+only trusts its (possibly stale) cache once expectations are satisfied,
+which prevents duplicate creations while watch events are in flight.
+
+Expectations expire after a TTL so a lost watch event cannot wedge a job
+forever (k8s uses 5 minutes).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+EXPECTATION_TTL_SECONDS = 300.0
+
+
+@dataclass
+class _Expectation:
+    adds: int = 0
+    dels: int = 0
+    timestamp: float = field(default_factory=time.monotonic)
+
+    def fulfilled(self) -> bool:
+        return self.adds <= 0 and self.dels <= 0
+
+    def expired(self, ttl: float) -> bool:
+        return time.monotonic() - self.timestamp > ttl
+
+
+class ControllerExpectations:
+    def __init__(self, ttl: float = EXPECTATION_TTL_SECONDS) -> None:
+        self._lock = threading.Lock()
+        self._store: dict = {}  # key -> _Expectation
+        self._ttl = ttl
+
+    def expect_creations(self, key: str, count: int) -> None:
+        self._raise(key, adds=count, dels=0)
+
+    def expect_deletions(self, key: str, count: int) -> None:
+        self._raise(key, adds=0, dels=count)
+
+    def _raise(self, key: str, adds: int, dels: int) -> None:
+        """Accumulate into the live record: one sync may both create missing
+        members and delete failed ones, and the two sets of expectations must
+        coexist (replacing would let the cache be trusted while watch events
+        for the other half are still in flight)."""
+        with self._lock:
+            exp = self._store.get(key)
+            if exp is None or exp.expired(self._ttl):
+                self._store[key] = _Expectation(adds=adds, dels=dels)
+                return
+            exp.adds = max(exp.adds, 0) + adds
+            exp.dels = max(exp.dels, 0) + dels
+            exp.timestamp = time.monotonic()
+
+    def creation_observed(self, key: str) -> None:
+        self._lower(key, adds=1)
+
+    def deletion_observed(self, key: str) -> None:
+        self._lower(key, dels=1)
+
+    def _lower(self, key: str, adds: int = 0, dels: int = 0) -> None:
+        with self._lock:
+            exp = self._store.get(key)
+            if exp is None:
+                return
+            exp.adds -= adds
+            exp.dels -= dels
+
+    def satisfied(self, key: str) -> bool:
+        """True if the cache can be trusted for this key: expectations are
+        fulfilled, expired (assume the watch event was lost), or were never
+        set (fresh job — first sync sets them)."""
+        with self._lock:
+            exp = self._store.get(key)
+            if exp is None:
+                return True
+            if exp.fulfilled() or exp.expired(self._ttl):
+                return True
+            return False
+
+    def delete_expectations(self, key: str) -> None:
+        with self._lock:
+            self._store.pop(key, None)
+
+    # CreationObserved on a creation failure: the reference decrements
+    # expectations when a create call fails so the controller retries
+    # (pod creation bookkeeping in createNewPod, controller_pod.go:123-183).
+    def creation_failed(self, key: str) -> None:
+        self.creation_observed(key)
+
+    def deletion_failed(self, key: str) -> None:
+        self.deletion_observed(key)

@@ -1,0 +1,154 @@
+"""Shared informer: a local cache fed by store watches + event handlers.
+
+Reference parity: client-go SharedInformerFactory as wired by the operator
+(pkg/client/informers/externalversions/factory.go, and the unstructured
+variant pkg/util/unstructured/informer.go:25-62). The informer consumes the
+store's list+watch stream on a background thread, maintains a read-only
+cache (the lister), and dispatches add/update/delete callbacks — the same
+callbacks that do expectations bookkeeping and enqueue job keys in the
+reference (controller_pod.go:285-412).
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from tf_operator_tpu.runtime.store import Store, WatchEventType
+
+log = logging.getLogger(__name__)
+
+Handler = Callable[[Any], None]
+UpdateHandler = Callable[[Any, Any], None]
+
+
+class Informer:
+    """Caches one kind; dispatches handlers serially on the watch thread
+    (client-go delivers each informer's events in order, same here)."""
+
+    def __init__(self, store: Store, kind: str) -> None:
+        self._store = store
+        self.kind = kind
+        self._lock = threading.RLock()
+        self._cache: Dict[Tuple[str, str], Any] = {}  # (ns, name) -> obj
+        self._on_add: List[Handler] = []
+        self._on_update: List[UpdateHandler] = []
+        self._on_delete: List[Handler] = []
+        self._thread: Optional[threading.Thread] = None
+        self._watch = None
+        self._synced = threading.Event()
+
+    # -- registration (before run) ---------------------------------------
+
+    def add_event_handler(
+        self,
+        on_add: Optional[Handler] = None,
+        on_update: Optional[UpdateHandler] = None,
+        on_delete: Optional[Handler] = None,
+    ) -> None:
+        if on_add:
+            self._on_add.append(on_add)
+        if on_update:
+            self._on_update.append(on_update)
+        if on_delete:
+            self._on_delete.append(on_delete)
+
+    # -- lister (reference: pkg/client/listers) ---------------------------
+
+    def get(self, namespace: str, name: str) -> Optional[Any]:
+        with self._lock:
+            obj = self._cache.get((namespace, name))
+            return copy.deepcopy(obj) if obj is not None else None
+
+    def list(
+        self, namespace: Optional[str] = None, label_selector: Optional[Dict[str, str]] = None
+    ) -> List[Any]:
+        with self._lock:
+            out = []
+            for (ns, _), obj in self._cache.items():
+                if namespace is not None and ns != namespace:
+                    continue
+                if label_selector and not all(
+                    obj.metadata.labels.get(k) == v for k, v in label_selector.items()
+                ):
+                    continue
+                out.append(copy.deepcopy(obj))
+            out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+            return out
+
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+    def seed(self, objs) -> None:
+        """Populate the cache directly without a watch — for tests that
+        drive syncs deterministically (the reference's tests inject into
+        informer indexers the same way, controller_test.go:44-70)."""
+        with self._lock:
+            for obj in objs:
+                meta = obj.metadata
+                self._cache[(meta.namespace, meta.name)] = copy.deepcopy(obj)
+        self._synced.set()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def run(self) -> None:
+        """Start consuming the watch on a daemon thread."""
+        if self._thread is not None:
+            return
+        self._watch = self._store.watch(kinds=[self.kind])
+        # The watch replays existing objects as ADDED before live events, so
+        # draining it keeps cache population and handler dispatch in order.
+        self._thread = threading.Thread(
+            target=self._loop, name=f"informer-{self.kind}", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        assert self._watch is not None
+        # Synced once the replayed backlog drains: either the queue empties
+        # after a dispatch or the first 50ms poll comes up empty.
+        import queue as _queue
+
+        while True:
+            try:
+                ev = self._watch.queue.get(timeout=0.05)
+            except _queue.Empty:
+                self._synced.set()
+                continue
+            if ev is None:
+                self._synced.set()
+                return
+            self._dispatch(ev)
+            if self._watch.queue.empty():
+                self._synced.set()
+
+    def _dispatch(self, ev) -> None:
+        meta = ev.obj.metadata
+        key = (meta.namespace, meta.name)
+        with self._lock:
+            old = self._cache.get(key)
+            if ev.type is WatchEventType.DELETED:
+                self._cache.pop(key, None)
+            else:
+                self._cache[key] = ev.obj
+        try:
+            if ev.type is WatchEventType.ADDED:
+                for h in self._on_add:
+                    h(ev.obj)
+            elif ev.type is WatchEventType.MODIFIED:
+                for h in self._on_update:
+                    h(old, ev.obj)
+            else:
+                for h in self._on_delete:
+                    h(ev.obj)
+        except Exception:  # a handler bug must not kill the watch thread
+            log.exception("informer handler failed for %s %s", self.kind, key)
+
+    def stop(self) -> None:
+        if self._watch is not None:
+            self._watch.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,756 @@
+"""TPUJobController: the idempotent reconciler.
+
+Reference parity: pkg/controller.v2 (SURVEY.md §3.3). Object events enqueue
+job keys; workers pop keys and run ``sync_job``, which: trusts the informer
+cache only once expectations are satisfied (controller.go:417-436), claims
+child processes by label + owner uid (ClaimPods analogue,
+controller_pod.go:222-258), creates missing gang members with rendezvous env
+(createNewPod + TF_CONFIG analogue, controller_pod.go:123-206), applies
+restart policy to failures — ExitCode consults the taxonomy and deletes
+retryable-failed children so reconcile recreates them
+(controller_pod.go:77-92) — and drives conditions-based status
+(controller_status.go:39-120).
+
+TPU-first deltas:
+
+- **Gang restart.** One process dying severs the slice-wide SPMD program, so
+  with ``run_policy.gang_restart`` (default) a retryable failure restarts the
+  whole gang — every gang process is deleted and recreated with a fresh
+  rendezvous — rather than the reference's per-pod restart (SURVEY.md §7
+  hard part b). ``status.restart_count`` counts gang restarts against
+  ``backoff_limit``.
+- **Chief semantics.** The job succeeds when the coordinator process (or
+  worker 0 when no coordinator replica exists) succeeds — the reference's
+  chief-present vs worker-0 rule (controller_status.go:39-120).
+- **Rendezvous, not cluster spec.** Each gang member gets coordinator
+  address + process count + rank + mesh axes env instead of a host:port map
+  (SURVEY.md §5 "communication backend").
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tf_operator_tpu.api import set_defaults, validate_job
+from tf_operator_tpu.api.types import (
+    KIND_ENDPOINT,
+    KIND_PROCESS,
+    KIND_TPUJOB,
+    LABEL_GROUP,
+    LABEL_JOB_NAME,
+    LABEL_REPLICA_INDEX,
+    LABEL_REPLICA_TYPE,
+    API_GROUP,
+    CleanupPolicy,
+    ConditionType,
+    ObjectMeta,
+    ReplicaType,
+    RestartPolicy,
+    TPUJob,
+)
+from tf_operator_tpu.api.validation import ValidationError
+from tf_operator_tpu.controller import events as ev
+from tf_operator_tpu.controller.events import EventRecorder
+from tf_operator_tpu.controller.expectations import ControllerExpectations
+from tf_operator_tpu.controller.informer import Informer
+from tf_operator_tpu.controller.status import (
+    has_condition,
+    initialize_replica_statuses,
+    is_finished,
+    new_condition,
+    set_condition,
+    update_replica_status,
+)
+from tf_operator_tpu.controller.workqueue import RateLimitingQueue
+from tf_operator_tpu.rendezvous.env import (
+    ENV_COORDINATOR_ADDRESS,
+    ENV_MESH_AXES,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+    ENV_WORKLOAD,
+)
+from tf_operator_tpu.runtime.objects import (
+    Endpoint,
+    EndpointAddress,
+    Process,
+    ProcessPhase,
+    ProcessSpec,
+)
+from tf_operator_tpu.runtime.process_backend import ProcessControl
+from tf_operator_tpu.runtime.store import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    Store,
+)
+from tf_operator_tpu.utils.exit_codes import ExitClass, classify_exit_code
+
+log = logging.getLogger(__name__)
+
+# Annotation where the controller persists the job's allocated rendezvous
+# port (so reconciles are stable across controller restarts).
+ANNOTATION_PORT = "tpujob.dev/rendezvous-port"
+
+
+def _default_host_resolver(process: Process) -> str:
+    del process
+    return "127.0.0.1"
+
+
+def _default_port_allocator() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TPUJobController:
+    """The reconciling controller (reference: TFJobController,
+    controller.v2/controller.go:82-153)."""
+
+    def __init__(
+        self,
+        store: Store,
+        process_control: ProcessControl,
+        recorder: Optional[EventRecorder] = None,
+        resync_period: float = 15.0,
+        host_resolver: Callable[[Process], str] = _default_host_resolver,
+        port_allocator: Callable[[], int] = _default_port_allocator,
+    ) -> None:
+        self.store = store
+        self.process_control = process_control
+        self.recorder = recorder or EventRecorder(store)
+        self.resync_period = resync_period
+        self.host_resolver = host_resolver
+        self.port_allocator = port_allocator
+
+        self.queue = RateLimitingQueue()
+        self.expectations = ControllerExpectations()
+
+        self.job_informer = Informer(store, KIND_TPUJOB)
+        self.process_informer = Informer(store, KIND_PROCESS)
+
+        self.job_informer.add_event_handler(
+            on_add=self._on_job_add,
+            on_update=self._on_job_update,
+            on_delete=self._on_job_delete,
+        )
+        self.process_informer.add_event_handler(
+            on_add=self._on_process_add,
+            on_update=self._on_process_update,
+            on_delete=self._on_process_delete,
+        )
+
+        self._stop = threading.Event()
+        self._workers: List[threading.Thread] = []
+        self._resync_thread: Optional[threading.Thread] = None
+
+    # ---- informer callbacks (controller_pod.go:285-412) -----------------
+
+    def _on_job_add(self, job) -> None:
+        self.queue.add(job.key())
+
+    def _on_job_update(self, old, new) -> None:
+        del old
+        self.queue.add(new.key())
+
+    def _on_job_delete(self, job) -> None:
+        self.queue.add(job.key())
+
+    def _job_key_for_process(self, process: Process) -> Optional[str]:
+        name = process.spec.job_name or process.metadata.labels.get(LABEL_JOB_NAME)
+        if not name:
+            return None
+        return f"{process.metadata.namespace}/{name}"
+
+    def _on_process_add(self, process: Process) -> None:
+        key = self._job_key_for_process(process)
+        if key:
+            self.expectations.creation_observed(self._exp_key(key))
+            self.queue.add(key)
+
+    def _on_process_update(self, old, new) -> None:
+        del old
+        key = self._job_key_for_process(new)
+        if key:
+            self.queue.add(key)
+
+    def _on_process_delete(self, process: Process) -> None:
+        key = self._job_key_for_process(process)
+        if key:
+            self.expectations.deletion_observed(self._exp_key(key))
+            self.queue.add(key)
+
+    @staticmethod
+    def _exp_key(job_key: str) -> str:
+        return f"{job_key}/processes"
+
+    # ---- lifecycle ------------------------------------------------------
+
+    def run(self, workers: int = 1, wait_synced_timeout: float = 10.0) -> None:
+        """Start informers and worker threads (controller.go:245-277)."""
+        self.job_informer.run()
+        self.process_informer.run()
+        deadline = time.time() + wait_synced_timeout
+        while not (self.job_informer.has_synced() and self.process_informer.has_synced()):
+            if time.time() > deadline:
+                raise TimeoutError("informer caches failed to sync")
+            time.sleep(0.01)
+        for i in range(workers):
+            t = threading.Thread(target=self._worker_loop, name=f"sync-worker-{i}", daemon=True)
+            t.start()
+            self._workers.append(t)
+        self._resync_thread = threading.Thread(
+            target=self._resync_loop, name="resync", daemon=True
+        )
+        self._resync_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.shutdown()
+        self.job_informer.stop()
+        self.process_informer.stop()
+        for t in self._workers:
+            t.join(timeout=5)
+        self._workers.clear()
+
+    def _resync_loop(self) -> None:
+        """Periodic full resync (ReconcilerSyncLoopPeriod, controller.go:63-78)."""
+        while not self._stop.wait(self.resync_period):
+            for job in self.job_informer.list():
+                self.queue.add(job.key())
+
+    def _worker_loop(self) -> None:
+        while self.process_next_item():
+            pass
+
+    def process_next_item(self) -> bool:
+        """One workqueue pop + sync (controller.go:289-321)."""
+        key = self.queue.get()
+        if key is None:
+            return False
+        try:
+            self.sync_job(key)
+        except Exception:
+            log.exception("sync failed for %s; requeueing", key)
+            self.queue.add_rate_limited(key)
+        else:
+            self.queue.forget(key)
+        finally:
+            self.queue.done(key)
+        return True
+
+    # ---- the sync -------------------------------------------------------
+
+    def sync_job(self, key: str) -> None:
+        namespace, name = key.split("/", 1)
+        cached = self.job_informer.get(namespace, name)
+        if cached is None:
+            # Job deleted: cascade-delete children (the reference leans on
+            # k8s GC via owner refs; our store has no GC, so the controller
+            # is the GC).
+            self._delete_children(namespace, name, cleanup=CleanupPolicy.ALL)
+            self.expectations.delete_expectations(self._exp_key(key))
+            return
+
+        job = cached.deepcopy()
+        set_defaults(job)
+        try:
+            validate_job(job)
+        except ValidationError as exc:
+            self._fail_job(job, reason="TPUJobValidationFailed", message=str(exc))
+            self._write_status(job)
+            return
+
+        if is_finished(job.status):
+            self._delete_children(namespace, name, job.spec.run_policy.cleanup_policy)
+            return
+
+        if not self.expectations.satisfied(self._exp_key(key)):
+            return  # watch events still in flight; they will re-enqueue us
+
+        processes = self._claim_processes(job)
+        self._reconcile(job, processes)
+
+    # ---- child accounting ----------------------------------------------
+
+    def _labels_for(self, job: TPUJob) -> Dict[str, str]:
+        return {LABEL_GROUP: API_GROUP, LABEL_JOB_NAME: job.metadata.name}
+
+    def _claim_processes(self, job: TPUJob) -> List[Process]:
+        """List + adopt children (ClaimPods analogue, controller_pod.go:222-258):
+        orphans matching our labels are adopted by stamping owner_uid; children
+        owned by a different uid (an old incarnation) are ignored."""
+        claimed = []
+        for p in self.process_informer.list(
+            namespace=job.metadata.namespace, label_selector=self._labels_for(job)
+        ):
+            if p.metadata.owner_uid is None:
+                try:
+                    fresh = self.store.get(KIND_PROCESS, p.metadata.namespace, p.metadata.name)
+                    if fresh.metadata.owner_uid is None:
+                        fresh.metadata.owner_uid = job.metadata.uid
+                        fresh.metadata.owner_kind = KIND_TPUJOB
+                        fresh.metadata.owner_name = job.metadata.name
+                        p = self.store.update(fresh, check_version=True)
+                    else:
+                        p = fresh
+                except (NotFoundError, ConflictError):
+                    continue
+            if p.metadata.owner_uid == job.metadata.uid:
+                claimed.append(p)
+        return claimed
+
+    def _delete_children(self, namespace: str, job_name: str, cleanup: CleanupPolicy) -> None:
+        if cleanup is CleanupPolicy.NONE:
+            return
+        selector = {LABEL_JOB_NAME: job_name}
+        for p in self.store.list(KIND_PROCESS, namespace=namespace, label_selector=selector):
+            if cleanup is CleanupPolicy.RUNNING and p.is_finished():
+                continue  # keep finished processes for debugging
+            self.process_control.delete_process(namespace, p.metadata.name)
+        for e in self.store.list(KIND_ENDPOINT, namespace=namespace, label_selector=selector):
+            try:
+                self.store.delete(KIND_ENDPOINT, namespace, e.metadata.name)
+            except NotFoundError:
+                pass
+
+    # ---- gang layout ----------------------------------------------------
+
+    @staticmethod
+    def _gang_roles(job: TPUJob) -> List[Tuple[ReplicaType, int]]:
+        """Orderered gang membership: coordinator first, then workers.
+        Evaluators are not gang members — like the evaluator's exclusion
+        from the reference's cluster spec (controller_tensorflow.go:91-95)."""
+        gang: List[Tuple[ReplicaType, int]] = []
+        if ReplicaType.COORDINATOR in job.spec.replica_specs:
+            gang.append((ReplicaType.COORDINATOR, 0))
+        workers = job.spec.replica_specs.get(ReplicaType.WORKER)
+        if workers is not None:
+            gang.extend((ReplicaType.WORKER, i) for i in range(workers.replicas or 1))
+        return gang
+
+    @staticmethod
+    def _chief_role(job: TPUJob) -> Tuple[ReplicaType, int]:
+        """Chief-present vs worker-0 semantics (controller_status.go:39-120)."""
+        if ReplicaType.COORDINATOR in job.spec.replica_specs:
+            return (ReplicaType.COORDINATOR, 0)
+        return (ReplicaType.WORKER, 0)
+
+    @staticmethod
+    def _process_name(job: TPUJob, rtype: ReplicaType, index: int) -> str:
+        # Deterministic v1alpha2-style naming (genGeneralName,
+        # controller_helper.go:60-67) — determinism is what makes create
+        # idempotent under AlreadyExists.
+        return f"{job.metadata.name}-{rtype.value.lower()}-{index}"
+
+    def _rendezvous_port(self, job: TPUJob) -> int:
+        """Stable per-job port, allocated once and persisted as an annotation."""
+        existing = job.metadata.annotations.get(ANNOTATION_PORT)
+        if existing:
+            return int(existing)
+        port = self.port_allocator()
+        job.metadata.annotations[ANNOTATION_PORT] = str(port)
+        # Persist on the stored object so the allocation survives restarts.
+        while True:
+            try:
+                fresh = self.store.get(KIND_TPUJOB, job.metadata.namespace, job.metadata.name)
+            except NotFoundError:
+                break
+            fresh.metadata.annotations[ANNOTATION_PORT] = str(port)
+            try:
+                self.store.update(fresh, check_version=True)
+                break
+            except ConflictError:
+                continue
+        return port
+
+    # ---- the reconcile core ---------------------------------------------
+
+    def _reconcile(self, job: TPUJob, processes: List[Process]) -> None:
+        key = job.key()
+        exp_key = self._exp_key(key)
+        observed: Dict[Tuple[str, int], Process] = {
+            (p.spec.replica_type, p.spec.replica_index): p for p in processes
+        }
+        gang = self._gang_roles(job)
+        evaluators = [
+            (ReplicaType.EVALUATOR, i)
+            for i in range(
+                (job.spec.replica_specs.get(ReplicaType.EVALUATOR).replicas or 1)
+                if ReplicaType.EVALUATOR in job.spec.replica_specs
+                else 0
+            )
+        ]
+
+        if not has_condition(job.status, ConditionType.CREATED):
+            set_condition(
+                job.status,
+                new_condition(
+                    ConditionType.CREATED, ev.REASON_JOB_CREATED, f"TPUJob {key} created"
+                ),
+            )
+            self.recorder.normal(job, ev.REASON_JOB_CREATED, f"TPUJob {key} created")
+
+        # -- active deadline (RunPolicy) ---------------------------------
+        rp = job.spec.run_policy
+        if (
+            rp.active_deadline_seconds is not None
+            and job.status.start_time is not None
+            and time.time() - job.status.start_time > rp.active_deadline_seconds
+        ):
+            self._fail_job(
+                job, ev.REASON_JOB_DEADLINE,
+                f"active deadline {rp.active_deadline_seconds}s exceeded",
+            )
+            self._finish(job)
+            return
+
+        # -- chief success ⇒ job success (checked BEFORE failure handling:
+        # once the chief has exited cleanly the training result exists, and
+        # a co-worker crashing during shutdown must not re-run the job —
+        # chief state drives job state, controller_status.go:39-120) -------
+        chief = self._chief_role(job)
+        chief_proc = observed.get((chief[0].value, chief[1]))
+        if chief_proc is not None and chief_proc.status.phase is ProcessPhase.SUCCEEDED:
+            set_condition(
+                job.status,
+                new_condition(
+                    ConditionType.SUCCEEDED, ev.REASON_JOB_SUCCEEDED,
+                    f"chief {chief_proc.metadata.name} succeeded",
+                ),
+            )
+            self.recorder.normal(job, ev.REASON_JOB_SUCCEEDED, "TPUJob succeeded")
+            job.status.completion_time = time.time()
+            self._finish(job)
+            return
+
+        # -- failure handling --------------------------------------------
+        gang_failed = [
+            observed[(r[0].value, r[1])]
+            for r in gang
+            if _failed(observed.get((r[0].value, r[1])))
+        ]
+        permanent_msgs: List[str] = []
+        retry_needed = False
+        for p in gang_failed:
+            policy = self._policy_for(job, p)
+            cls = classify_exit_code(p.status.exit_code or 0, p.status.oom_killed)
+            if policy is RestartPolicy.NEVER:
+                permanent_msgs.append(
+                    f"{p.metadata.name} exited {p.status.exit_code} (policy Never)"
+                )
+            elif policy is RestartPolicy.EXIT_CODE and cls is ExitClass.PERMANENT:
+                permanent_msgs.append(
+                    f"{p.metadata.name} exited {p.status.exit_code} (permanent"
+                    f"{', oom' if p.status.oom_killed else ''})"
+                )
+            else:  # ALWAYS, ON_FAILURE, or retryable EXIT_CODE
+                retry_needed = True
+
+        if permanent_msgs:
+            self._fail_job(job, ev.REASON_JOB_FAILED, "; ".join(permanent_msgs))
+            self._finish(job)
+            return
+
+        if retry_needed:
+            if rp.backoff_limit is not None and job.status.restart_count >= rp.backoff_limit:
+                self._fail_job(
+                    job, ev.REASON_JOB_FAILED,
+                    f"backoff limit {rp.backoff_limit} exceeded "
+                    f"({job.status.restart_count} restarts)",
+                )
+                self._finish(job)
+                return
+            self._restart_gang(job, gang, observed, exp_key)
+            return
+
+        # ALWAYS policy also restarts gang members that *succeeded*? No —
+        # Always applies to failures and external deletions; a cleanly
+        # succeeded member stays finished (job completion handles it).
+
+        # -- create missing gang members ---------------------------------
+        missing = [r for r in gang + evaluators if (r[0].value, r[1]) not in observed]
+        if missing:
+            self._create_processes(job, missing, exp_key)
+
+        # -- running condition -------------------------------------------
+        gang_running = gang and all(
+            (r[0].value, r[1]) in observed
+            and observed[(r[0].value, r[1])].status.phase is ProcessPhase.RUNNING
+            for r in gang
+        )
+        if gang_running:
+            if job.status.start_time is None:
+                job.status.start_time = time.time()
+            if not has_condition(job.status, ConditionType.RUNNING):
+                set_condition(
+                    job.status,
+                    new_condition(
+                        ConditionType.RUNNING, ev.REASON_JOB_RUNNING, "all gang members running"
+                    ),
+                )
+                self.recorder.normal(job, ev.REASON_JOB_RUNNING, "TPUJob running")
+
+        # -- evaluator restarts (per-replica, not gang) -------------------
+        for r in evaluators:
+            p = observed.get((r[0].value, r[1]))
+            if _failed(p):
+                policy = self._policy_for(job, p)
+                if policy in (RestartPolicy.ALWAYS, RestartPolicy.ON_FAILURE) or (
+                    policy is RestartPolicy.EXIT_CODE
+                    and classify_exit_code(p.status.exit_code or 0, p.status.oom_killed)
+                    is ExitClass.RETRYABLE
+                ):
+                    self.expectations.expect_deletions(exp_key, 1)
+                    try:
+                        self.process_control.delete_process(
+                            p.metadata.namespace, p.metadata.name
+                        )
+                    except Exception:
+                        self.expectations.deletion_failed(exp_key)
+                        raise
+                    self.recorder.normal(
+                        job, ev.REASON_SUCCESSFUL_DELETE,
+                        f"restarting evaluator {p.metadata.name}",
+                    )
+
+        # -- status counters ----------------------------------------------
+        initialize_replica_statuses(job.status, job.spec.replica_specs.keys())
+        for p in processes:
+            try:
+                rtype = ReplicaType(p.spec.replica_type)
+            except ValueError:
+                continue
+            update_replica_status(job.status, rtype, p)
+
+        job.status.last_reconcile_time = time.time()
+        self._write_status(job)
+
+    # ---- actions --------------------------------------------------------
+
+    def _policy_for(self, job: TPUJob, process: Process) -> RestartPolicy:
+        try:
+            rs = job.spec.replica_specs.get(ReplicaType(process.spec.replica_type))
+        except ValueError:
+            rs = None
+        return rs.restart_policy if rs and rs.restart_policy else RestartPolicy.EXIT_CODE
+
+    def _create_processes(
+        self, job: TPUJob, roles: List[Tuple[ReplicaType, int]], exp_key: str
+    ) -> None:
+        gang = self._gang_roles(job)
+        num_processes = len(gang)
+        port = self._rendezvous_port(job)
+        chief_type, chief_idx = self._chief_role(job)
+        chief_name = self._process_name(job, chief_type, chief_idx)
+
+        # Build every Process object first so the chief's host can be
+        # resolved once and injected into ALL members' coordinator address —
+        # resolving per-member would point each process at its own host.
+        procs: List[Process] = []
+        for rtype, index in roles:
+            rs = job.spec.replica_specs[rtype]
+            name = self._process_name(job, rtype, index)
+            labels = {
+                **self._labels_for(job),
+                LABEL_REPLICA_TYPE: rtype.value,
+                LABEL_REPLICA_INDEX: str(index),
+            }
+            is_gang = (rtype, index) in gang
+            rank = gang.index((rtype, index)) if is_gang else 0
+            env = dict(rs.template.env)
+            mesh = job.spec.topology.mesh_axes
+            env.update(
+                {
+                    ENV_NUM_PROCESSES: str(num_processes if is_gang else 1),
+                    ENV_PROCESS_ID: str(rank),
+                    ENV_MESH_AXES: json.dumps(mesh),
+                    ENV_WORKLOAD: json.dumps(job.spec.workload),
+                }
+            )
+            chips = rs.template.chips_per_process or job.spec.topology.chips_per_host
+            procs.append(
+                Process(
+                    metadata=ObjectMeta(
+                        name=name,
+                        namespace=job.metadata.namespace,
+                        labels=labels,
+                        owner_uid=job.metadata.uid,
+                        owner_kind=KIND_TPUJOB,
+                        owner_name=job.metadata.name,
+                    ),
+                    spec=ProcessSpec(
+                        job_name=job.metadata.name,
+                        replica_type=rtype.value,
+                        replica_index=index,
+                        entrypoint=rs.template.entrypoint,
+                        args=list(rs.template.args),
+                        env=env,
+                        chips=chips if is_gang else rs.template.chips_per_process,
+                        port=port if (rtype, index) == (chief_type, chief_idx) else 0,
+                        workdir=rs.template.workdir,
+                    ),
+                )
+            )
+
+        # Chief host: prefer the existing rendezvous Endpoint (the chief may
+        # already be running and we are only recreating lost members);
+        # otherwise resolve from the chief Process being created now.
+        chief_host: Optional[str] = None
+        try:
+            ep = self.store.get(
+                KIND_ENDPOINT, job.metadata.namespace, f"{job.metadata.name}-rendezvous"
+            )
+            chief_host = ep.address.host
+        except NotFoundError:
+            for p in procs:
+                if p.metadata.name == chief_name:
+                    chief_host = self.host_resolver(p)
+                    break
+        if chief_host is None:
+            chief_host = "127.0.0.1"
+        for p in procs:
+            p.spec.env[ENV_COORDINATOR_ADDRESS] = f"{chief_host}:{port}"
+
+        self.expectations.expect_creations(exp_key, len(procs))
+        created = 0
+        try:
+            for proc in procs:
+                try:
+                    self.process_control.create_process(proc)
+                except AlreadyExistsError:
+                    self.expectations.creation_failed(exp_key)
+                else:
+                    created += 1
+                    self.recorder.normal(
+                        job, ev.REASON_SUCCESSFUL_CREATE,
+                        f"created process {proc.metadata.name}",
+                    )
+                if proc.metadata.name == chief_name:
+                    self._ensure_endpoint(job, chief_name, chief_host, port)
+        except Exception as exc:
+            # Roll back unobserved expectations so the job isn't stuck
+            # waiting for creations that will never happen.
+            for _ in range(len(procs) - created):
+                self.expectations.creation_failed(exp_key)
+            self.recorder.warning(job, ev.REASON_FAILED_CREATE, str(exc))
+            raise
+
+    def _ensure_endpoint(self, job: TPUJob, target: str, host: str, port: int) -> None:
+        name = f"{job.metadata.name}-rendezvous"
+        try:
+            self.store.create(
+                Endpoint(
+                    metadata=ObjectMeta(
+                        name=name,
+                        namespace=job.metadata.namespace,
+                        labels=self._labels_for(job),
+                        owner_uid=job.metadata.uid,
+                        owner_kind=KIND_TPUJOB,
+                        owner_name=job.metadata.name,
+                    ),
+                    address=EndpointAddress(host=host, port=port),
+                    target_process=target,
+                )
+            )
+        except AlreadyExistsError:
+            pass
+
+    def _restart_gang(
+        self,
+        job: TPUJob,
+        gang: List[Tuple[ReplicaType, int]],
+        observed: Dict[Tuple[str, int], Process],
+        exp_key: str,
+    ) -> None:
+        """Whole-gang restart: delete every existing gang process; the next
+        sync (after deletions are observed) recreates them."""
+        targets = [observed[(r[0].value, r[1])] for r in gang if (r[0].value, r[1]) in observed]
+        if not job.spec.run_policy.gang_restart:
+            targets = [p for p in targets if _failed(p)]
+        job.status.restart_count += 1
+        set_condition(
+            job.status,
+            new_condition(
+                ConditionType.RESTARTING, ev.REASON_JOB_RESTARTING,
+                f"gang restart #{job.status.restart_count}",
+            ),
+        )
+        self.recorder.normal(
+            job, ev.REASON_JOB_RESTARTING,
+            f"gang restart #{job.status.restart_count} "
+            f"({len(targets)} processes)",
+        )
+        if targets:
+            self.expectations.expect_deletions(exp_key, len(targets))
+            deleted = 0
+            try:
+                for p in targets:
+                    self.process_control.delete_process(p.metadata.namespace, p.metadata.name)
+                    deleted += 1
+            except Exception:
+                # Roll back every unobserved deletion expectation (not just
+                # the failed one) so a transient delete error can't wedge
+                # the job until the expectation TTL.
+                for _ in range(len(targets) - deleted):
+                    self.expectations.deletion_failed(exp_key)
+                raise
+        self._write_status(job)
+
+    def _fail_job(self, job: TPUJob, reason: str, message: str) -> None:
+        set_condition(job.status, new_condition(ConditionType.FAILED, reason, message))
+        if job.status.completion_time is None:
+            job.status.completion_time = time.time()
+        self.recorder.warning(job, reason, message)
+
+    def _finish(self, job: TPUJob) -> None:
+        """Terminal transition: persist status, then clean up children."""
+        self._write_status(job)
+        self._delete_children(
+            job.metadata.namespace, job.metadata.name, job.spec.run_policy.cleanup_policy
+        )
+
+    def _write_status(self, job: TPUJob) -> None:
+        """Persist job.status (status-subresource analogue,
+        controller_status.go:123-126) with optimistic retry. The
+        last_reconcile_time heartbeat is excluded from the change check —
+        stamping it every sync would otherwise make every write produce a
+        MODIFIED event that re-enqueues the job: a hot loop."""
+        while True:
+            try:
+                fresh = self.store.get(KIND_TPUJOB, job.metadata.namespace, job.metadata.name)
+            except NotFoundError:
+                return
+            if (
+                _status_equal_ignoring_heartbeat(fresh.status, job.status)
+                and fresh.metadata.annotations == job.metadata.annotations
+            ):
+                return  # no change — avoid a MODIFIED->enqueue->sync loop
+            fresh.status = job.status
+            fresh.metadata.annotations.update(job.metadata.annotations)
+            try:
+                self.store.update(fresh, check_version=True)
+                return
+            except ConflictError:
+                continue
+            except NotFoundError:
+                return
+
+
+def _failed(p: Optional[Process]) -> bool:
+    return p is not None and p.status.phase is ProcessPhase.FAILED
+
+
+def _status_equal_ignoring_heartbeat(a, b) -> bool:
+    import dataclasses
+
+    return dataclasses.replace(a, last_reconcile_time=None) == dataclasses.replace(
+        b, last_reconcile_time=None
+    )

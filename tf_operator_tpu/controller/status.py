@@ -1,0 +1,107 @@
+"""Conditions status engine.
+
+Reference parity: pkg/controller.v2/controller_status.go — newCondition /
+setCondition / filterOutCondition (:157-215) plus the replica-status counters
+(:136-154). Semantics preserved:
+
+- setting a condition updates an existing one of the same type in place
+  (bumping transition time only when status flips);
+- setting Running filters out Restarting (and vice versa) — they are
+  mutually exclusive "currently" conditions;
+- Succeeded/Failed are terminal; once either is true the job is finished.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from tf_operator_tpu.api.types import (
+    Condition,
+    ConditionType,
+    ReplicaStatus,
+    ReplicaType,
+    TPUJobStatus,
+)
+from tf_operator_tpu.runtime.objects import Process, ProcessPhase
+
+_EXCLUSIVE = {
+    ConditionType.RUNNING: {ConditionType.RESTARTING},
+    ConditionType.RESTARTING: {ConditionType.RUNNING},
+}
+
+
+def new_condition(ctype: ConditionType, reason: str, message: str) -> Condition:
+    now = time.time()
+    return Condition(
+        type=ctype,
+        status=True,
+        reason=reason,
+        message=message,
+        last_update_time=now,
+        last_transition_time=now,
+    )
+
+
+def get_condition(status: TPUJobStatus, ctype: ConditionType) -> Optional[Condition]:
+    for c in status.conditions:
+        if c.type is ctype:
+            return c
+    return None
+
+
+def has_condition(status: TPUJobStatus, ctype: ConditionType) -> bool:
+    c = get_condition(status, ctype)
+    return c is not None and c.status
+
+
+def is_finished(status: TPUJobStatus) -> bool:
+    return has_condition(status, ConditionType.SUCCEEDED) or has_condition(
+        status, ConditionType.FAILED
+    )
+
+
+def set_condition(status: TPUJobStatus, cond: Condition) -> None:
+    """Insert/update ``cond``, dropping mutually-exclusive conditions
+    (controller_status.go setCondition + filterOutCondition)."""
+    drop = _EXCLUSIVE.get(cond.type, set())
+    status.conditions = [c for c in status.conditions if c.type not in drop]
+    existing = get_condition(status, cond.type)
+    if existing is not None:
+        if existing.status == cond.status and existing.reason == cond.reason:
+            existing.message = cond.message
+            existing.last_update_time = cond.last_update_time
+            return
+        cond.last_transition_time = (
+            existing.last_transition_time
+            if existing.status == cond.status
+            else cond.last_transition_time
+        )
+        status.conditions = [c for c in status.conditions if c.type is not cond.type]
+    status.conditions.append(cond)
+
+
+def initialize_replica_statuses(status: TPUJobStatus, rtypes) -> None:
+    """Zero the counters for each replica type (controller_status.go:136-141)."""
+    status.replica_statuses = {ReplicaType(rt): ReplicaStatus() for rt in rtypes}
+
+
+def update_replica_status(status: TPUJobStatus, rtype: ReplicaType, process: Process) -> None:
+    """Fold one observed process into the counters
+    (controller_status.go:143-154: pod phase → Active/Succeeded/Failed)."""
+    rs = status.replica_statuses.setdefault(rtype, ReplicaStatus())
+    if process.status.phase in (ProcessPhase.RUNNING, ProcessPhase.PENDING):
+        rs.active += 1
+    elif process.status.phase is ProcessPhase.SUCCEEDED:
+        rs.succeeded += 1
+    elif process.status.phase is ProcessPhase.FAILED:
+        rs.failed += 1
+
+
+def replica_counts(status: TPUJobStatus) -> Dict[str, int]:
+    totals = {"active": 0, "succeeded": 0, "failed": 0}
+    for rs in status.replica_statuses.values():
+        totals["active"] += rs.active
+        totals["succeeded"] += rs.succeeded
+        totals["failed"] += rs.failed
+    return totals

@@ -1,0 +1,163 @@
+"""Rate-limited deduplicating workqueue.
+
+Reference parity: the k8s client-go workqueue the operator builds on
+(pkg/controller/controller.go:122-126): dedup semantics (a key queued while
+being processed is deferred, never processed concurrently), per-item
+exponential backoff 5 ms → 1000 s, and an overall 10 qps / burst 100 token
+bucket; the combined limiter takes the max of the two delays.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Hashable, List, Optional
+
+
+class ItemExponentialBackoff:
+    """Per-item exponential failure backoff (5ms base, 1000s cap)."""
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0) -> None:
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self._failures: Dict[Hashable, int] = {}
+        self._lock = threading.Lock()
+
+    def when(self, item: Hashable) -> float:
+        with self._lock:
+            n = self._failures.get(item, 0)
+            self._failures[item] = n + 1
+        return min(self.base_delay * (2 ** n), self.max_delay)
+
+    def forget(self, item: Hashable) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+    def num_requeues(self, item: Hashable) -> int:
+        with self._lock:
+            return self._failures.get(item, 0)
+
+
+class TokenBucket:
+    """Overall-rate limiter (10 qps / burst 100 by default)."""
+
+    def __init__(self, qps: float = 10.0, burst: int = 100) -> None:
+        self.qps = qps
+        self.burst = burst
+        self._tokens = float(burst)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def when(self, item: Hashable = None) -> float:
+        del item
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self.burst, self._tokens + (now - self._last) * self.qps)
+            self._last = now
+            self._tokens -= 1.0
+            if self._tokens >= 0:
+                return 0.0
+            return -self._tokens / self.qps
+
+
+class RateLimitingQueue:
+    """Deduplicating queue with delayed adds and combined rate limiting.
+
+    Contract (client-go): ``add`` enqueues unless the key is already queued;
+    a key added while in-flight is re-queued when ``done`` is called;
+    ``add_rate_limited`` delays by max(per-item backoff, bucket);
+    ``forget`` resets the per-item failure history after a successful sync.
+    """
+
+    def __init__(
+        self,
+        base_delay: float = 0.005,
+        max_delay: float = 1000.0,
+        qps: float = 10.0,
+        burst: int = 100,
+    ) -> None:
+        self._cond = threading.Condition()
+        self._queue: List[Hashable] = []
+        self._dirty: set = set()
+        self._processing: set = set()
+        self._shutdown = False
+        self._backoff = ItemExponentialBackoff(base_delay, max_delay)
+        self._bucket = TokenBucket(qps, burst)
+        self._timers: set = set()
+
+    # -- core dedup queue -------------------------------------------------
+
+    def add(self, item: Hashable) -> None:
+        with self._cond:
+            if self._shutdown or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item in self._processing:
+                return  # deferred: re-queued on done()
+            self._queue.append(item)
+            self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Hashable]:
+        """Block for the next item; None on shutdown or timeout."""
+        with self._cond:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while not self._queue and not self._shutdown:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            if self._shutdown and not self._queue:
+                return None
+            item = self._queue.pop(0)
+            self._dirty.discard(item)
+            self._processing.add(item)
+            return item
+
+    def done(self, item: Hashable) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    # -- delays / rate limiting ------------------------------------------
+
+    def add_after(self, item: Hashable, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        timer = threading.Timer(delay, self._timer_fire, args=(item,))
+        timer.daemon = True
+        with self._cond:
+            if self._shutdown:
+                return
+            self._timers.add(timer)
+        timer.start()
+
+    def _timer_fire(self, item: Hashable) -> None:
+        with self._cond:
+            self._timers = {t for t in self._timers if t.is_alive()}
+        self.add(item)
+
+    def add_rate_limited(self, item: Hashable) -> None:
+        self.add_after(item, max(self._backoff.when(item), self._bucket.when(item)))
+
+    def forget(self, item: Hashable) -> None:
+        self._backoff.forget(item)
+
+    def num_requeues(self, item: Hashable) -> int:
+        return self._backoff.num_requeues(item)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            for t in self._timers:
+                t.cancel()
+            self._timers.clear()
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)
