@@ -7,8 +7,20 @@ a virtual 8-device CPU mesh exactly as the driver's dryrun does.
 import os
 import sys
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-set (not setdefault): the ambient environment pins JAX_PLATFORMS to
+# the TPU plugin, which tests must never use.
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The TPU plugin's sitecustomize (triggered by PALLAS_AXON_POOL_IPS) runs at
+# interpreter start — before this conftest — and forcibly sets
+# jax_platforms="axon,cpu". Reset to cpu before any backend initializes.
+try:
+    import jax  # noqa: E402
+except ImportError:  # pure control-plane tests don't need jax
+    pass
+else:
+    jax.config.update("jax_platforms", "cpu")
 
 # Make the repo root importable regardless of pytest invocation dir.
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -8,6 +8,7 @@ process count, process id — because intra-slice topology is hardware and XLA
 collectives need no address book (SURVEY.md §5 "communication backend").
 """
 
+from tf_operator_tpu.rendezvous.context import JobContext, RetryableFailure  # noqa: F401
 from tf_operator_tpu.rendezvous.env import (  # noqa: F401
     ENV_CHIPS,
     ENV_COORDINATOR_ADDRESS,

@@ -1,0 +1,102 @@
+"""JobContext: the workload-facing identity/rendezvous object.
+
+What ``TF_CONFIG`` parsing is to a reference workload
+(examples/tf_sample/tf_sample/tf_smoke.py:88-96), ``JobContext.from_env()``
+is to a TPU workload — except there is no cluster-spec map to interpret:
+the context carries coordinator coordinates, this process's rank, the
+logical mesh axes, and the free-form workload config.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+from tf_operator_tpu.rendezvous.env import (
+    ENV_CHIPS,
+    ENV_COORDINATOR_ADDRESS,
+    ENV_ENTRYPOINT,
+    ENV_JOB_NAME,
+    ENV_MESH_AXES,
+    ENV_NAMESPACE,
+    ENV_NUM_PROCESSES,
+    ENV_PORT,
+    ENV_PROCESS_ID,
+    ENV_REPLICA_INDEX,
+    ENV_REPLICA_TYPE,
+    ENV_WORKLOAD,
+)
+
+
+class RetryableFailure(Exception):
+    """Raise from a workload to request a restart: the harness exits with
+    the user-defined retryable code 138 (train_util.go:18-53 semantics)."""
+
+
+@dataclass
+class JobContext:
+    job_name: str = ""
+    namespace: str = "default"
+    replica_type: str = "Worker"
+    replica_index: int = 0
+    process_id: int = 0
+    num_processes: int = 1
+    coordinator_address: str = ""
+    mesh_axes: Dict[str, int] = field(default_factory=dict)
+    workload: Dict[str, Any] = field(default_factory=dict)
+    chips: int = 0
+    port: int = 0  # rendezvous port (nonzero on the coordinator process)
+    entrypoint: str = ""
+
+    @staticmethod
+    def from_env(env: Dict[str, str] | None = None) -> "JobContext":
+        e = env if env is not None else os.environ
+        return JobContext(
+            job_name=e.get(ENV_JOB_NAME, ""),
+            namespace=e.get(ENV_NAMESPACE, "default"),
+            replica_type=e.get(ENV_REPLICA_TYPE, "Worker"),
+            replica_index=int(e.get(ENV_REPLICA_INDEX, "0") or 0),
+            process_id=int(e.get(ENV_PROCESS_ID, "0") or 0),
+            num_processes=int(e.get(ENV_NUM_PROCESSES, "1") or 1),
+            coordinator_address=e.get(ENV_COORDINATOR_ADDRESS, ""),
+            mesh_axes=json.loads(e.get(ENV_MESH_AXES, "{}") or "{}"),
+            workload=json.loads(e.get(ENV_WORKLOAD, "{}") or "{}"),
+            chips=int(e.get(ENV_CHIPS, "0") or 0),
+            port=int(e.get(ENV_PORT, "0") or 0),
+            entrypoint=e.get(ENV_ENTRYPOINT, ""),
+        )
+
+    # -- device plane helpers (used by workloads after rendezvous) --------
+
+    def initialize_distributed(self) -> None:
+        """Join the gang via jax.distributed (no-op for 1-process jobs).
+        Replaces tf.train.Server bring-up (tf_smoke.py:98-110)."""
+        if self.num_processes <= 1:
+            return
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=self.coordinator_address,
+            num_processes=self.num_processes,
+            process_id=self.process_id,
+        )
+
+    def build_mesh(self):
+        """Build the jax.sharding.Mesh declared by the job topology over the
+        global device set. Empty mesh_axes ⇒ one data-parallel axis over all
+        devices."""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        devices = np.asarray(jax.devices())
+        axes = self.mesh_axes or {"dp": devices.size}
+        names = tuple(axes.keys())
+        sizes = tuple(axes.values())
+        return Mesh(devices.reshape(sizes), names)
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0

@@ -1,0 +1,86 @@
+"""In-process harness: what actually runs inside each launched process.
+
+The analogue of the user container's entry script in the reference: where
+tf_smoke.py reads TF_CONFIG and starts a tf.train.Server
+(examples/tf_sample/tf_sample/tf_smoke.py:77-110), this harness reads the
+TPUJOB_* contract, resolves the declared ``pkg.module:fn`` entrypoint, and
+calls ``fn(ctx)``. Exit-code contract (consumed by the controller's
+restart policies, utils/exit_codes.py):
+
+- 0    — workload returned normally
+- 138  — workload raised RetryableFailure (please restart me)
+- 1    — workload raised any other exception (permanent)
+- 2    — the harness itself could not resolve/launch the entrypoint
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import sys
+import traceback
+
+from tf_operator_tpu.rendezvous.context import JobContext, RetryableFailure
+from tf_operator_tpu.utils.exit_codes import USER_RETRYABLE_CODE
+
+log = logging.getLogger("tpujob.harness")
+
+
+def resolve_entrypoint(spec: str):
+    module_name, sep, fn_name = spec.partition(":")
+    if not sep or not module_name or not fn_name:
+        raise ValueError(f"entrypoint must look like 'pkg.module:fn', got {spec!r}")
+    module = importlib.import_module(module_name)
+    try:
+        return getattr(module, fn_name)
+    except AttributeError as exc:
+        raise ValueError(f"module {module_name!r} has no attribute {fn_name!r}") from exc
+
+
+def main(argv=None) -> int:
+    del argv
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s [%(levelname)s] %(message)s",
+        stream=sys.stderr,
+    )
+    ctx = JobContext.from_env()
+    if not ctx.entrypoint:
+        log.error("no TPUJOB_ENTRYPOINT set")
+        return 2
+    try:
+        fn = resolve_entrypoint(ctx.entrypoint)
+    except Exception:
+        log.error("failed to resolve entrypoint %r:\n%s", ctx.entrypoint, traceback.format_exc())
+        return 2
+
+    log.info(
+        "starting %s: job=%s/%s role=%s[%d] rank=%d/%d coordinator=%s",
+        ctx.entrypoint, ctx.namespace, ctx.job_name, ctx.replica_type,
+        ctx.replica_index, ctx.process_id, ctx.num_processes, ctx.coordinator_address,
+    )
+    try:
+        fn(ctx)
+    except RetryableFailure as exc:
+        log.warning("workload requested retry: %s", exc)
+        return USER_RETRYABLE_CODE
+    except SystemExit as exc:
+        if exc.code is None:
+            return 0
+        if isinstance(exc.code, int):
+            return exc.code
+        log.error("workload exited: %s", exc.code)
+        return 1
+    except KeyboardInterrupt:
+        # SIGINT is infrastructure eviction: re-raise so the interpreter
+        # exits 130, which the taxonomy classifies as retryable — returning
+        # 1 here would turn every preemption into a permanent failure.
+        raise
+    except Exception:
+        log.error("workload failed:\n%s", traceback.format_exc())
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

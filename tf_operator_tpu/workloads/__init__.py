@@ -1,0 +1,7 @@
+"""Built-in workloads: the framework's example/test data-plane programs.
+
+Reference parity: examples/tf_sample/tf_sample/tf_smoke.py (every-device op
+check) and test/e2e/dist-mnist/dist_mnist.py (real distributed training run
+used by CI). These are SPMD JAX programs launched by the harness; each
+receives a JobContext and drives the whole device mesh collectively.
+"""

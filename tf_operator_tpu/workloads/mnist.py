@@ -1,0 +1,111 @@
+"""Distributed MNIST: the framework's dist_mnist analogue.
+
+Reference parity: test/e2e/dist-mnist/dist_mnist.py — a real training run
+(PS-strategy MNIST with optional SyncReplicasOptimizer) used by CI to prove
+end-to-end training works. The TPU-native version is pure data-parallel
+SPMD: an MLP trained under jit over the mesh's first axis, synthetic data
+generated on-device, loss verified to decrease. No parameter servers — the
+gradient all-reduce is inserted by XLA from the sharding annotations.
+
+All global arrays (params, optimizer state, batches) are produced inside
+jit with ``out_shardings``, the multi-controller-safe creation pattern.
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+
+from tf_operator_tpu.rendezvous.context import JobContext
+
+log = logging.getLogger("tpujob.mnist")
+
+
+def init_params(key, sizes):
+    import jax
+    import jax.numpy as jnp
+
+    params = []
+    keys = jax.random.split(key, len(sizes) - 1)
+    for k, (n_in, n_out) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        w = jax.random.normal(k, (n_in, n_out), jnp.float32) * (2.0 / n_in) ** 0.5
+        b = jnp.zeros((n_out,), jnp.float32)
+        params.append((w, b))
+    return params
+
+
+def forward(params, x):
+    import jax
+
+    h = x
+    for w, b in params[:-1]:
+        h = jax.nn.relu(h @ w + b)
+    w, b = params[-1]
+    return h @ w + b
+
+
+def loss_fn(params, x, y):
+    import jax.numpy as jnp
+    import optax
+
+    logits = forward(params, x)
+    return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(logits, y))
+
+
+def main(ctx: JobContext) -> None:
+    ctx.initialize_distributed()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = ctx.build_mesh()
+    axis = mesh.axis_names[0]
+
+    steps = int(ctx.workload.get("steps", 30))
+    global_batch = int(ctx.workload.get("batch_size", 256))
+    lr = float(ctx.workload.get("lr", 0.1))
+    hidden = int(ctx.workload.get("hidden", 128))
+
+    repl = NamedSharding(mesh, P())
+    data_sharding = NamedSharding(mesh, P(axis))
+    tx = optax.sgd(lr, momentum=0.9)
+
+    @partial(jax.jit, out_shardings=repl)
+    def init_fn():
+        params = init_params(jax.random.PRNGKey(0), [784, hidden, 10])
+        return params, tx.init(params)
+
+    @partial(jax.jit, out_shardings=data_sharding)
+    def make_batch(step):
+        dkey = jax.random.PRNGKey(42)
+        centroids = jax.random.normal(dkey, (10, 784)) * 2.0
+        skey = jax.random.fold_in(dkey, step)
+        y = jax.random.randint(skey, (global_batch,), 0, 10)
+        x = centroids[y] + 0.1 * jax.random.normal(
+            jax.random.fold_in(skey, 1), (global_batch, 784)
+        )
+        return x, y
+
+    @jax.jit
+    def train_step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    params, opt_state = init_fn()
+    losses = []
+    for step in range(steps):
+        x, y = make_batch(np.int32(step))
+        params, opt_state, loss = train_step(params, opt_state, x, y)
+        losses.append(float(loss))
+        if step % 10 == 0:
+            log.info("step %d loss %.4f", step, losses[-1])
+
+    first, last = losses[0], losses[-1]
+    log.info("mnist done: loss %.4f -> %.4f over %d steps", first, last, steps)
+    if not last < first:
+        raise AssertionError(f"loss did not decrease: {first} -> {last}")
