@@ -22,6 +22,19 @@ except ImportError:  # pure control-plane tests don't need jax
 else:
     jax.config.update("jax_platforms", "cpu")
 
+
+def wait_for(predicate, timeout=30.0, interval=0.05):
+    """Poll until predicate() is true; one final check after the deadline so
+    a slow scheduler can't produce a spurious timeout."""
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
 # Make the repo root importable regardless of pytest invocation dir.
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:

@@ -5,9 +5,7 @@ against the local runtime instead of GKE: submit a job, watch it reach
 Succeeded, assert child/event bookkeeping, then GC.
 """
 
-import os
 import sys
-import time
 
 import pytest
 
@@ -23,16 +21,10 @@ from tf_operator_tpu.api.types import (
 )
 from tf_operator_tpu.controller import TPUJobController
 from tf_operator_tpu.controller.status import has_condition
+from conftest import wait_for
 from tf_operator_tpu.runtime import LocalProcessControl, Store
 
 
-def wait_for(predicate, timeout=30.0, interval=0.05):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if predicate():
-            return True
-        time.sleep(interval)
-    return False
 
 
 @pytest.fixture

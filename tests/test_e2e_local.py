@@ -7,8 +7,6 @@ no TPU needed), run an SPMD workload across processes, and reach Succeeded.
 """
 
 import os
-import sys
-import time
 
 import pytest
 
@@ -23,6 +21,7 @@ from tf_operator_tpu.api.types import (
 )
 from tf_operator_tpu.controller import TPUJobController
 from tf_operator_tpu.controller.status import get_condition, has_condition
+from conftest import wait_for
 from tf_operator_tpu.runtime import LocalProcessControl, Store
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -38,13 +37,6 @@ DATAPLANE_ENV = {
 }
 
 
-def wait_for(predicate, timeout=120.0, interval=0.1):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if predicate():
-            return True
-        time.sleep(interval)
-    return False
 
 
 @pytest.fixture
@@ -81,7 +73,8 @@ def test_smoke_two_process_gang(rig):
     job.spec.workload = {"dim": 64}
     store.create(job)
     ok = wait_for(
-        lambda: has_condition(job_status(store, "smoke2"), ConditionType.SUCCEEDED)
+        lambda: has_condition(job_status(store, "smoke2"), ConditionType.SUCCEEDED),
+        timeout=120,
     )
     st = job_status(store, "smoke2")
     assert ok, f"conditions: {[(c.type.value, c.reason, c.message) for c in st.conditions]}"
@@ -114,7 +107,8 @@ def test_mnist_data_parallel_training(rig):
     job.spec.workload = {"steps": 12, "batch_size": 128, "hidden": 64}
     store.create(job)
     ok = wait_for(
-        lambda: has_condition(job_status(store, "mnist-dp"), ConditionType.SUCCEEDED)
+        lambda: has_condition(job_status(store, "mnist-dp"), ConditionType.SUCCEEDED),
+        timeout=120,
     )
     st = job_status(store, "mnist-dp")
     assert ok, f"conditions: {[(c.type.value, c.reason, c.message) for c in st.conditions]}"
@@ -137,7 +131,10 @@ def test_bad_entrypoint_is_permanent_failure(rig):
         ),
     )
     store.create(job)
-    ok = wait_for(lambda: has_condition(job_status(store, "ghost"), ConditionType.FAILED))
+    ok = wait_for(
+        lambda: has_condition(job_status(store, "ghost"), ConditionType.FAILED),
+        timeout=120,
+    )
     st = job_status(store, "ghost")
     assert ok, f"conditions: {[(c.type.value, c.reason) for c in st.conditions]}"
     # harness exit 2 => permanent, no restart loop

@@ -2,11 +2,11 @@
 processes and reports phase/exit codes into the store."""
 
 import sys
-import time
 
 import pytest
 
 from tf_operator_tpu.api.types import ObjectMeta
+from conftest import wait_for
 from tf_operator_tpu.runtime import (
     FakeProcessControl,
     LocalProcessControl,
@@ -24,13 +24,6 @@ def proc(name, env=None):
     )
 
 
-def wait_for(predicate, timeout=10.0, interval=0.02):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if predicate():
-            return True
-        time.sleep(interval)
-    return False
 
 
 def test_fake_records_actions():

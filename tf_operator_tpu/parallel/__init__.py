@@ -1,0 +1,30 @@
+"""Parallelism library: meshes, shardings, collectives, and parallel
+attention/pipeline/MoE building blocks.
+
+This layer is NEW surface relative to the reference: kubeflow/tf-operator
+implements exactly one parallelism pattern (PS data parallelism as topology,
+SURVEY.md §2.3) and delegates everything else to user code. On TPU the
+framework owns it: a job declares mesh axes (api.types.TopologySpec), the
+rendezvous layer builds the Mesh, and this package supplies the sharding
+rules and parallel primitives — DP/FSDP/TP via pjit sharding annotations,
+sequence/context parallelism via ring attention over ppermute, pipeline
+parallelism via shard_map microbatch schedules, expert parallelism via
+all-to-all — all compiled to XLA collectives that ride ICI.
+"""
+
+from tf_operator_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_CONTEXT,
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_PIPELINE,
+    AXIS_TENSOR,
+    MeshSpec,
+    build_mesh,
+)
+from tf_operator_tpu.parallel.sharding import (  # noqa: F401
+    ShardingRules,
+    batch_sharding,
+    logical_to_sharding,
+    replicated,
+)

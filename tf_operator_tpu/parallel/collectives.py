@@ -1,0 +1,45 @@
+"""Collective helpers for shard_map bodies.
+
+Thin, named wrappers over the XLA collectives (psum / all_gather /
+reduce_scatter / ppermute) — the framework NEVER reimplements collectives
+(SURVEY.md §2.3: the reference delegated them to TF's runtime; we delegate
+to XLA, which maps them onto ICI rings).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def all_reduce_mean(x, axis_name: str):
+    """Gradient-style mean all-reduce."""
+    return jax.lax.pmean(x, axis_name)
+
+
+def all_reduce_sum(x, axis_name: str):
+    return jax.lax.psum(x, axis_name)
+
+
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    """Gather shards along ``axis`` (FSDP param gather)."""
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter_sum(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    """Sum-reduce then scatter along ``axis`` (FSDP grad reduce)."""
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=tiled)
+
+
+def ring_shift(x, axis_name: str, shift: int = 1):
+    """Rotate shards around the ring (ring attention / pipeline transfers)."""
+    n = jax.lax.psum(1, axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name: str):
+    return jax.lax.axis_index(axis_name)
+
+
+def axis_size(axis_name: str):
+    return jax.lax.psum(1, axis_name)

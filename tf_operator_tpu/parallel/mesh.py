@@ -1,0 +1,94 @@
+"""Mesh construction: logical parallelism axes over physical devices.
+
+The canonical axis vocabulary (the scaling-book recipe — pick a mesh,
+annotate shardings, let XLA insert collectives):
+
+- ``dp``    — data parallelism (batch split, gradient all-reduce)
+- ``fsdp``  — fully-sharded data parallelism (batch split + param shards,
+              all-gather params / reduce-scatter grads)
+- ``tp``    — tensor/model parallelism (matmul shards, activation
+              all-gather/reduce along features)
+- ``cp``    — context/sequence parallelism (ring attention over sequence)
+- ``pp``    — pipeline parallelism (layer stages, ppermute activations)
+- ``ep``    — expert parallelism (MoE experts, all-to-all dispatch)
+
+Axis ORDER matters on TPU: the innermost (last) axes land on adjacent
+devices, so put the most communication-hungry axis (tp) last so its
+collectives ride the shortest ICI paths; dp/pp tolerate distance.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXIS_DATA = "dp"
+AXIS_FSDP = "fsdp"
+AXIS_TENSOR = "tp"
+AXIS_CONTEXT = "cp"
+AXIS_PIPELINE = "pp"
+AXIS_EXPERT = "ep"
+
+# Canonical order, outermost -> innermost (tp innermost: most traffic).
+CANONICAL_ORDER = (AXIS_PIPELINE, AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_CONTEXT, AXIS_TENSOR)
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """A validated logical mesh layout.
+
+    ``axes`` maps axis name -> size; unspecified axes are absent (size 1 is
+    allowed and kept, so sharding rules can reference the axis uniformly).
+    One axis may be -1: it absorbs the remaining devices (like a reshape).
+    """
+
+    axes: Dict[str, int] = field(default_factory=dict)
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        axes = dict(self.axes) or {AXIS_DATA: n_devices}
+        wild = [k for k, v in axes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis allowed, got {wild}")
+        fixed = math.prod(v for v in axes.values() if v != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            axes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh axes {axes} multiply to {fixed} but there are {n_devices} devices"
+            )
+        return MeshSpec(axes)
+
+    def ordered(self) -> Tuple[Tuple[str, int], ...]:
+        """Axes in canonical TPU order; unknown axes keep insertion order,
+        placed before the canonical ones (treated as outermost)."""
+        known = [a for a in CANONICAL_ORDER if a in self.axes]
+        unknown = [a for a in self.axes if a not in CANONICAL_ORDER]
+        return tuple((a, self.axes[a]) for a in unknown + known)
+
+
+def build_mesh(
+    axes: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence] = None,
+):
+    """Build a jax.sharding.Mesh from a logical axis spec.
+
+    Device order: jax.devices() is already ICI-topology-ordered on TPU;
+    reshaping into (ordered axis sizes) keeps the innermost logical axis on
+    physically adjacent chips.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    spec = MeshSpec(dict(axes or {})).resolve(devs.size)
+    ordered = spec.ordered()
+    names = tuple(a for a, _ in ordered)
+    sizes = tuple(s for _, s in ordered)
+    return Mesh(devs.reshape(sizes), names)

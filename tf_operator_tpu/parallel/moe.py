@@ -1,0 +1,117 @@
+"""Expert parallelism: Switch-style top-1 MoE with all-to-all dispatch.
+
+Experts are sharded over the ``ep`` mesh axis; tokens are routed by a gating
+network, dispatched to their expert's device with ``all_to_all`` (ragged
+traffic rides ICI), processed, and combined back weighted by the gate
+probability. Capacity-factor dropping keeps shapes static for XLA (tokens
+over capacity are passed through unchanged).
+
+New TPU-native surface (reference has no MoE support, SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tf_operator_tpu.parallel.collectives import axis_size
+
+
+def _moe_local(x, gate_logits, expert_params, expert_fn, axis_name: str, capacity: int):
+    """Per-device body. x: [tokens_local, d]; gate_logits: [tokens_local, E];
+    expert_params: this device's experts (leading dim E_local)."""
+    n_shards = axis_size(axis_name)
+    tokens, d = x.shape
+    n_experts = gate_logits.shape[-1]
+    experts_per_shard = n_experts // n_shards
+
+    gate_probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(gate_probs, axis=-1)  # [tokens]
+    gate_weight = jnp.take_along_axis(gate_probs, expert_idx[:, None], axis=-1)[:, 0]
+
+    # Position of each token within its expert's queue; beyond capacity drops.
+    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.int32)  # [T, E]
+    pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1) * onehot  # [T, E]
+    pos = jnp.sum(pos_in_expert, axis=-1)  # [T]
+    keep = pos < capacity
+
+    # dispatch[t, e, c] = 1 if token t goes to expert e at slot c
+    pos_onehot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # [T, C]
+    dispatch = (
+        onehot.astype(jnp.float32)[:, :, None]
+        * keep.astype(jnp.float32)[:, None, None]
+        * pos_onehot[:, None, :]
+    )  # [T, E, C]
+    # Expert inboxes from local tokens: [E, C, d]
+    inbox = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+
+    # all_to_all: regroup so each shard holds inboxes for ITS experts from
+    # every shard: [E, C, d] -> [E_local * n_shards, C, d] where the leading
+    # dim interleaves (source_shard, local_expert).
+    inbox = inbox.reshape(n_shards, experts_per_shard, capacity, d)
+    inbox = jax.lax.all_to_all(inbox, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    # Now: [n_shards(source), E_local, C, d] on each device.
+    inbox = inbox.reshape(n_shards, experts_per_shard, capacity, d)
+
+    # Run each local expert over its gathered tokens.
+    def run_expert(e, acc):
+        params_e = jax.tree_util.tree_map(lambda a: a[e], expert_params)
+        toks = inbox[:, e].reshape(n_shards * capacity, d)
+        out = expert_fn(params_e, toks.astype(x.dtype)).astype(jnp.float32)
+        return acc.at[:, e].set(out.reshape(n_shards, capacity, d))
+
+    outbox = jnp.zeros((n_shards, experts_per_shard, capacity, d), jnp.float32)
+    outbox = jax.lax.fori_loop(0, experts_per_shard, run_expert, outbox)
+
+    # Return results to source shards.
+    outbox = jax.lax.all_to_all(outbox, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    outbox = outbox.reshape(n_experts, capacity, d)
+
+    # Combine: weight by gate prob; dropped tokens pass through unchanged.
+    combined = jnp.einsum("tec,ecd->td", dispatch, outbox)
+    out = jnp.where(
+        keep[:, None], combined * gate_weight[:, None], x.astype(jnp.float32)
+    )
+    return out.astype(x.dtype)
+
+
+def moe_apply(
+    x,
+    gate_logits,
+    expert_params,
+    expert_fn: Callable,
+    mesh,
+    axis_name: str = "ep",
+    capacity_factor: float = 2.0,
+):
+    """Top-1 MoE layer with experts sharded over ``axis_name``.
+
+    x: [tokens, d] with tokens sharded over ``axis_name`` — each ep shard
+    routes its own token slice and the all_to_all exchanges (token-shard ×
+    expert-shard) traffic, so every expert processes distinct tokens from
+    every source shard. expert_params: pytree with leading dim n_experts.
+    """
+    from jax import shard_map
+
+    n_experts = gate_logits.shape[-1]
+    ep = mesh.shape[axis_name]
+    if n_experts % ep:
+        raise ValueError(f"{n_experts} experts not divisible by ep={ep}")
+    tokens = x.shape[0]
+    if tokens % ep:
+        raise ValueError(f"{tokens} tokens not divisible by ep={ep}")
+    capacity = max(1, int(capacity_factor * (tokens // ep) / n_experts))
+
+    param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), expert_params)
+    fn = shard_map(
+        partial(_moe_local, expert_fn=expert_fn, axis_name=axis_name, capacity=capacity),
+        mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name), param_specs),
+        out_specs=P(axis_name),
+        check_vma=False,
+    )
+    return fn(x, gate_logits, expert_params)

@@ -1,0 +1,105 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the ``pp`` axis.
+
+Each pipeline stage lives on one slice of the ``pp`` mesh axis and holds its
+own layer parameters; activations flow stage-to-stage with ``ppermute`` over
+neighbor ICI links. The schedule is the classic GPipe fill-drain loop:
+with S stages and M microbatches, T = M + S - 1 ticks; at tick t, stage s
+computes microbatch (t - s) when 0 <= t - s < M. Bubble fraction
+(S-1)/(M+S-1) shrinks as M grows.
+
+The reference has no pipeline support at all (SURVEY.md §2.3); this is new
+TPU-native surface.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tf_operator_tpu.parallel.collectives import axis_index, axis_size, ring_shift
+
+
+def _pipeline_local(stage_params, x_micro, fn: Callable, axis_name: str):
+    """Per-device body (inside shard_map).
+
+    stage_params: this stage's params (leading dim of size 1 stripped).
+    x_micro: [n_micro, mb, ...] — full microbatched input, replicated.
+    Returns [n_micro, mb, ...] outputs (valid on the last stage; psum'ed so
+    every stage returns the same array).
+    """
+    n_stages = axis_size(axis_name)
+    stage = axis_index(axis_name)
+    n_micro = x_micro.shape[0]
+    mb_shape = x_micro.shape[1:]
+
+    total_ticks = n_micro + n_stages - 1
+
+    def tick(carry, t):
+        prev_out, y_acc = carry
+        # Receive activation from the previous stage (stage 0 receives
+        # garbage from the last stage and ignores it).
+        recv = ring_shift(prev_out, axis_name)
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        first_in = jax.lax.dynamic_index_in_dim(x_micro, mb_idx, keepdims=False)
+        x_in = jnp.where(stage == 0, first_in, recv)
+        out = fn(stage_params, x_in)
+        # Last stage writes its result for microbatch t-(S-1) when valid.
+        out_idx = t - (n_stages - 1)
+        valid = (stage == n_stages - 1) & (out_idx >= 0) & (out_idx < n_micro)
+        write_idx = jnp.clip(out_idx, 0, n_micro - 1)
+        prev_slot = jax.lax.dynamic_index_in_dim(y_acc, write_idx, keepdims=False)
+        new_slot = jnp.where(valid, out, prev_slot)
+        y_acc = jax.lax.dynamic_update_index_in_dim(y_acc, new_slot, write_idx, 0)
+        return (out, y_acc), None
+
+    out0 = jnp.zeros(mb_shape, x_micro.dtype)
+    y0 = jnp.zeros((n_micro,) + mb_shape, x_micro.dtype)
+    (_, y), _ = jax.lax.scan(tick, (out0, y0), jnp.arange(total_ticks))
+    # Broadcast the last stage's result to every stage (replicated output).
+    y = jax.lax.psum(
+        jnp.where(stage == n_stages - 1, y, jnp.zeros_like(y)), axis_name
+    )
+    return y
+
+
+def pipeline_apply(
+    stage_params,
+    x,
+    fn: Callable,
+    mesh,
+    n_microbatches: int,
+    axis_name: str = "pp",
+):
+    """Run ``fn(stage_params, x_mb)`` as a pipeline over ``axis_name``.
+
+    stage_params: pytree whose leaves have leading dim == pp size (one slice
+    per stage). x: [batch, ...] replicated input. fn must map a microbatch
+    through ONE stage, preserving shape (classic equal-width pipeline).
+    Returns [batch, ...] outputs, replicated.
+    """
+    from jax import shard_map
+
+    batch = x.shape[0]
+    if batch % n_microbatches:
+        raise ValueError(f"batch {batch} not divisible by {n_microbatches} microbatches")
+    mb = batch // n_microbatches
+    x_micro = x.reshape((n_microbatches, mb) + x.shape[1:])
+
+    param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
+
+    def body(params, xm):
+        # strip the per-stage leading dim of 1
+        local = jax.tree_util.tree_map(lambda a: a[0], params)
+        return _pipeline_local(local, xm, fn, axis_name)
+
+    out = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stage_params, x_micro)
+    return out.reshape((batch,) + out.shape[2:])

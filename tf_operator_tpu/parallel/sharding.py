@@ -1,0 +1,87 @@
+"""Sharding rules: logical array axes -> mesh axes -> NamedShardings.
+
+The pattern (flax ``logical_axis_rules`` reimagined without the flax
+dependency): models annotate arrays with *logical* axis names ("batch",
+"embed", "mlp", "heads", "kv", "seq", "layers", "expert"...), and a
+``ShardingRules`` table maps logical names to mesh axes. Changing the
+parallelism strategy = changing the table, not the model.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+from tf_operator_tpu.parallel.mesh import (
+    AXIS_CONTEXT,
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_TENSOR,
+)
+
+MeshAxes = Union[str, Tuple[str, ...], None]
+
+
+@dataclass(frozen=True)
+class ShardingRules:
+    """Logical-name -> mesh-axis mapping. None = replicate."""
+
+    rules: Dict[str, MeshAxes] = field(default_factory=dict)
+
+    def mesh_axes_for(self, logical: Optional[str]) -> MeshAxes:
+        if logical is None:
+            return None
+        return self.rules.get(logical)
+
+    def sharding(self, mesh, logical_axes: Sequence[Optional[str]]):
+        from jax.sharding import NamedSharding
+
+        # Drop references to axes the mesh doesn't have (e.g. rules mention
+        # "tp" but this job runs pure DP): treat them as replicated.
+        spec_parts = []
+        for ax in logical_axes:
+            m = self.mesh_axes_for(ax)
+            if isinstance(m, str) and m not in mesh.axis_names:
+                m = None
+            elif isinstance(m, tuple):
+                m = tuple(a for a in m if a in mesh.axis_names) or None
+            spec_parts.append(m)
+        from jax.sharding import PartitionSpec
+
+        return NamedSharding(mesh, PartitionSpec(*spec_parts))
+
+
+# The standard rule set for transformer-family models (scaling-book layout):
+# batch over dp+fsdp, params sharded over fsdp (all-gathered per layer) and
+# tp (stay sharded), sequence over cp, experts over ep.
+DEFAULT_RULES = ShardingRules(
+    rules={
+        "batch": (AXIS_DATA, AXIS_FSDP),
+        "seq": AXIS_CONTEXT,
+        "embed": AXIS_FSDP,
+        "heads": AXIS_TENSOR,
+        "kv_heads": AXIS_TENSOR,
+        "mlp": AXIS_TENSOR,
+        "vocab": AXIS_TENSOR,
+        "expert": AXIS_EXPERT,
+        "layers": None,
+        "head_dim": None,
+        "kv": None,
+    }
+)
+
+
+def logical_to_sharding(mesh, logical_axes, rules: ShardingRules = DEFAULT_RULES):
+    return rules.sharding(mesh, logical_axes)
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh, rules: ShardingRules = DEFAULT_RULES):
+    """Sharding for a [batch, ...] data array."""
+    return rules.sharding(mesh, ["batch"])

@@ -76,10 +76,38 @@ def main(argv=None) -> int:
         # exits 130, which the taxonomy classifies as retryable — returning
         # 1 here would turn every preemption into a permanent failure.
         raise
-    except Exception:
+    except Exception as exc:
+        if _is_infrastructure_error(exc):
+            # A peer died / the coordination service went away. The peer's
+            # own exit decides permanence; THIS process must report
+            # retryable, or the first surviving peer to be observed would
+            # convert a retryable preemption into a permanent job failure.
+            log.warning("distributed runtime failure (retryable):\n%s", traceback.format_exc())
+            return USER_RETRYABLE_CODE
         log.error("workload failed:\n%s", traceback.format_exc())
         return 1
     return 0
+
+
+_INFRA_ERROR_MARKERS = (
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "coordination service",
+    "CoordinationService",
+    "heartbeat",
+    "peer",
+    "failed to connect",
+)
+
+
+def _is_infrastructure_error(exc: BaseException) -> bool:
+    """Heuristic: errors surfaced by the distributed runtime when a peer or
+    the coordination service disappears — retryable, not workload bugs."""
+    if type(exc).__name__ in ("XlaRuntimeError", "JaxRuntimeError"):
+        msg = str(exc)
+        return any(marker in msg for marker in _INFRA_ERROR_MARKERS)
+    return False
 
 
 if __name__ == "__main__":

@@ -64,7 +64,8 @@ def main(ctx: JobContext) -> None:
     mesh = ctx.build_mesh()
     axis = mesh.axis_names[0]
 
-    steps = int(ctx.workload.get("steps", 30))
+    # At least 2 steps: the final loss-decrease check needs a before/after.
+    steps = max(2, int(ctx.workload.get("steps", 30)))
     global_batch = int(ctx.workload.get("batch_size", 256))
     lr = float(ctx.workload.get("lr", 0.1))
     hidden = int(ctx.workload.get("hidden", 128))

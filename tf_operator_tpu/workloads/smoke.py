@@ -47,8 +47,12 @@ def main(ctx: JobContext) -> None:
     def checksum(a, b):
         return jnp.sum(jnp.einsum("bij,bjk->bik", a, b))
 
+    import math
+
     total = float(checksum(make_ones(), make_ones()))
     expected = float(n_dev) * dim**3
-    if total != expected:
+    # fp32 accumulation is inexact for large dims; a relative tolerance
+    # still catches any dead device or broken link (whole blocks missing).
+    if not math.isclose(total, expected, rel_tol=1e-5):
         raise AssertionError(f"smoke mismatch: got {total}, expected {expected}")
     log.info("smoke ok: %d devices, checksum %.0f", n_dev, total)
