@@ -1,0 +1,119 @@
+"""Headline benchmark: ResNet-50 training throughput on the attached TPU.
+
+Prints ONE JSON line:
+  {"metric": "resnet50_images_per_sec_per_chip", "value": N,
+   "unit": "images/sec/chip", "vs_baseline": MFU/0.50, ...}
+
+The reference publishes no numbers (BASELINE.md); the driver-supplied north
+star is ResNet-50 at >=50% MFU, so ``vs_baseline`` is achieved-MFU / 0.50 —
+1.0 means the target is met.
+
+Extra diagnostic fields beyond the required four are included (mfu,
+step_time, batch, device) for the record; consumers key on the first four.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.resnet import ResNetConfig, init_resnet, resnet_forward
+    from tf_operator_tpu.train.metrics import mfu, resnet_train_flops
+    from tf_operator_tpu.train.trainer import Trainer, TrainerConfig
+    from tf_operator_tpu.parallel import build_mesh
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    n_chips = jax.device_count()
+
+    batch = int(os.environ.get("BENCH_BATCH", "128" if on_tpu else "16"))
+    image_size = int(os.environ.get("BENCH_IMAGE", "224" if on_tpu else "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "30" if on_tpu else "4"))
+    warmup = 2
+
+    cfg = ResNetConfig.resnet50()
+    mesh = build_mesh({"dp": n_chips})
+
+    def init_fn(key):
+        return init_resnet(key, cfg)
+
+    def loss_fn(params, batch_data, state):
+        images, labels = batch_data
+        logits, new_state = resnet_forward(params, state, images, cfg, train=True)
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+        return loss, new_state
+
+    trainer = Trainer(
+        mesh,
+        loss_fn=loss_fn,
+        init_fn=init_fn,
+        config=TrainerConfig(optimizer="sgd", learning_rate=0.1, grad_clip=None),
+    )
+    t_submit = time.perf_counter()
+    state = trainer.init(jax.random.PRNGKey(0))
+
+    images = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(1), (batch, image_size, image_size, 3)),
+        trainer.batch_sharding,
+    )
+    labels = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, cfg.num_classes),
+        trainer.batch_sharding,
+    )
+    data = (images, labels)
+
+    # Warmup (compile + stabilize). float() forces a host fetch — plain
+    # block_until_ready does not synchronize through the remote TPU tunnel.
+    state, metrics = trainer.step(state, data)
+    _ = float(metrics["loss"])
+    first_step_s = time.perf_counter() - t_submit
+    for _ in range(warmup):
+        state, metrics = trainer.step(state, data)
+    _ = float(metrics["loss"])
+
+    # Timed region: steps dispatched back-to-back (donation chains them on
+    # device), ONE sync at the end — per-step host syncs would serialize on
+    # tunnel RTT and measure latency, not throughput.
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = trainer.step(state, data)
+    _ = float(metrics["loss"])
+    step_s = (time.perf_counter() - t0) / steps
+    images_per_sec = batch / step_s
+    images_per_sec_per_chip = images_per_sec / n_chips
+    fwd_flops = cfg.flops_per_image(image_size)
+    train_flops = resnet_train_flops(fwd_flops, batch)
+    achieved_mfu = mfu(train_flops, step_s, n_chips)
+
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_images_per_sec_per_chip",
+                "value": round(images_per_sec_per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(achieved_mfu / 0.50, 4),
+                "mfu": round(achieved_mfu, 4),
+                "step_time_s": round(step_s, 5),
+                "batch": batch,
+                "image_size": image_size,
+                "n_chips": n_chips,
+                "device": getattr(dev, "device_kind", dev.platform),
+                "submit_to_first_step_s": round(first_step_s, 2),
+                "loss": round(float(metrics["loss"]), 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

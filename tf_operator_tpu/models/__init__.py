@@ -1,0 +1,31 @@
+"""Model families: transformer (GPT/Llama/BERT-class) and ResNet.
+
+These correspond to the reference's benchmark workload families
+(BASELINE.json configs: MNIST-DP, ResNet-50 ImageNet, BERT-base, Llama-2-7B)
+— the reference itself contains no model code (its workloads live in user
+containers); here they are first-class library code, TPU-first:
+
+- pure functional param pytrees (no framework state), so pjit/shard_map
+  compose directly;
+- every parameter carries *logical axis names* consumed by
+  parallel.sharding.ShardingRules — switching DP/FSDP/TP/CP is a rules
+  change, not a model change;
+- layers stored stacked [n_layers, ...] and applied with lax.scan for
+  O(1)-in-depth compile time, with optional jax.checkpoint rematerialization;
+- bfloat16 activations / float32 params+optimizer by default (MXU-friendly).
+"""
+
+from tf_operator_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig,
+    transformer_forward,
+    init_transformer,
+    transformer_logical_axes,
+    lm_loss,
+    PRESETS,
+)
+from tf_operator_tpu.models.resnet import (  # noqa: F401
+    ResNetConfig,
+    init_resnet,
+    resnet_forward,
+    resnet_logical_axes,
+)

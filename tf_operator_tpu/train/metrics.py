@@ -1,0 +1,109 @@
+"""Step-time and MFU telemetry.
+
+The reference's only timing is per-sync controller latency logging
+(SURVEY.md §5); training telemetry is the TPU framework's north-star
+metric surface (BASELINE.md: ≥50% MFU ResNet-50, images/sec/chip,
+submit→first-step latency).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# Peak dense bf16 FLOP/s per chip by device generation.
+_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,  # v5e
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v5": 459e12,
+    "v6 lite": 918e12,  # Trillium
+    "v6e": 918e12,
+}
+
+
+def peak_flops_per_chip(device=None) -> float:
+    """Best-effort peak bf16 FLOP/s for the attached chip; tiny fallback for
+    CPU so MFU stays finite (and obviously non-comparable) in tests."""
+    import jax
+
+    dev = device or jax.devices()[0]
+    kind = getattr(dev, "device_kind", "").lower()
+    for marker, flops in _PEAK_FLOPS.items():
+        if marker in kind:
+            return flops
+    if dev.platform == "tpu":
+        return 197e12  # unknown TPU: assume v5e-class
+    return 1e12  # CPU/debug
+
+
+def mfu(model_flops_per_step: float, step_seconds: float, n_chips: int, device=None) -> float:
+    """Model FLOPs utilization: achieved / peak."""
+    peak = peak_flops_per_chip(device) * n_chips
+    return model_flops_per_step / (step_seconds * peak)
+
+
+def host_fetch(x) -> None:
+    """Force device→host synchronization on one array (or the first leaf of
+    a pytree). IMPORTANT: jax.block_until_ready does NOT synchronize through
+    a remote/tunneled TPU backend — only an actual host fetch does; all
+    timing in this framework must sync via this helper."""
+    import jax
+    import numpy as np
+
+    leaves = jax.tree_util.tree_leaves(x)
+    if leaves:
+        np.asarray(leaves[0])
+
+
+@dataclass
+class StepTimer:
+    """Wall-clock step timing with warmup exclusion (first steps compile).
+
+    ``stop(result)`` host-fetches ``result`` before reading the clock —
+    without it, async dispatch makes the measurement meaningless (and on a
+    tunneled TPU even block_until_ready lies; see host_fetch)."""
+
+    warmup: int = 2
+    _t0: Optional[float] = None
+    durations: List[float] = field(default_factory=list)
+    _seen: int = 0
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, result=None) -> None:
+        if self._t0 is None:
+            return
+        if result is not None:
+            host_fetch(result)
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        self._seen += 1
+        if self._seen > self.warmup:
+            self.durations.append(dt)
+
+    def mean(self) -> float:
+        if not self.durations:
+            return float("nan")
+        return sum(self.durations) / len(self.durations)
+
+    def summary(self, flops_per_step: float = 0.0, n_chips: int = 1) -> Dict[str, float]:
+        m = self.mean()
+        out = {"step_time_s": m, "steps_timed": float(len(self.durations))}
+        if flops_per_step and m == m:  # not nan
+            out["mfu"] = mfu(flops_per_step, m, n_chips)
+            out["tflops_per_chip"] = flops_per_step / m / n_chips / 1e12
+        return out
+
+
+def transformer_train_flops(n_params: int, tokens_per_step: int) -> float:
+    """6ND rule: fwd 2ND + bwd 4ND."""
+    return 6.0 * n_params * tokens_per_step
+
+
+def resnet_train_flops(fwd_flops_per_image: float, images_per_step: int) -> float:
+    """Training ≈ 3× forward."""
+    return 3.0 * fwd_flops_per_image * images_per_step

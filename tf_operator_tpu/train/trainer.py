@@ -1,0 +1,192 @@
+"""Sharded trainer: init/step compiled once over the job's mesh.
+
+Usage shape:
+
+    trainer = Trainer(mesh, loss_fn=..., init_fn=..., logical_axes=...,
+                      config=TrainerConfig(...))
+    state = trainer.init(jax.random.PRNGKey(0))
+    state, metrics = trainer.step(state, batch)   # jitted, donated
+
+Sharding: param placement comes from the model's logical axes through
+parallel.sharding.ShardingRules (DP/FSDP/TP by table edit); optimizer state
+inherits the param shardings; batches shard over ("dp","fsdp").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tf_operator_tpu.parallel.sharding import DEFAULT_RULES, ShardingRules, replicated
+
+
+@dataclass
+class TrainerConfig:
+    optimizer: str = "adamw"  # "adamw" | "sgd"
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.0
+    momentum: float = 0.9
+    beta1: float = 0.9
+    beta2: float = 0.95
+    grad_clip: Optional[float] = 1.0
+    warmup_steps: int = 0
+    lr_schedule: str = "constant"  # "constant" | "cosine"
+    total_steps: int = 10000
+
+
+@dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: Any  # int32 scalar array
+    extra: Any = None  # model state (e.g. BN stats), optional
+
+
+def _make_tx(cfg: TrainerConfig) -> optax.GradientTransformation:
+    if cfg.lr_schedule == "cosine":
+        sched = optax.warmup_cosine_decay_schedule(
+            0.0, cfg.learning_rate, max(cfg.warmup_steps, 1), cfg.total_steps
+        )
+    elif cfg.warmup_steps:
+        sched = optax.linear_schedule(0.0, cfg.learning_rate, cfg.warmup_steps)
+    else:
+        sched = cfg.learning_rate
+    if cfg.optimizer == "adamw":
+        tx = optax.adamw(sched, b1=cfg.beta1, b2=cfg.beta2, weight_decay=cfg.weight_decay)
+    elif cfg.optimizer == "sgd":
+        tx = optax.sgd(sched, momentum=cfg.momentum)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+    if cfg.grad_clip:
+        tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), tx)
+    return tx
+
+
+class Trainer:
+    """Builds sharded, jitted init and train-step functions.
+
+    loss_fn(params, batch, extra) -> loss  OR  (loss, new_extra).
+    init_fn(key) -> params  OR  (params, extra).
+    logical_axes: pytree matching params with logical axis tuples (or None
+    to replicate everything).
+    """
+
+    def __init__(
+        self,
+        mesh,
+        loss_fn: Callable,
+        init_fn: Callable,
+        logical_axes: Any = None,
+        rules: ShardingRules = DEFAULT_RULES,
+        config: Optional[TrainerConfig] = None,
+    ) -> None:
+        self.mesh = mesh
+        self.config = config if config is not None else TrainerConfig()
+        self.tx = _make_tx(self.config)
+        self.loss_fn = loss_fn
+        self.init_fn = init_fn
+        self.rules = rules
+        self.logical_axes = logical_axes
+        self._repl = replicated(mesh)
+
+        # Resolve param shardings by tracing init_fn's output structure
+        # (traced once; _opt_shardings reuses it).
+        shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        self._has_extra = isinstance(shapes, tuple)
+        self._params_shape = shapes[0] if self._has_extra else shapes
+        if logical_axes is None:
+            self.param_shardings = jax.tree_util.tree_map(
+                lambda _: self._repl, self._params_shape
+            )
+        else:
+            self.param_shardings = jax.tree_util.tree_map(
+                lambda axes: self.rules.sharding(mesh, list(axes)),
+                logical_axes,
+                is_leaf=lambda x: isinstance(x, tuple),
+            )
+        self.batch_sharding = self.rules.sharding(mesh, ["batch"])
+
+        self._init_jit = None
+        self._step_jit = None
+
+    # ---- init -----------------------------------------------------------
+
+    def init(self, key) -> TrainState:
+        if self._init_jit is None:
+            opt_shardings = self._opt_shardings()
+            extra_out = self._repl if self._has_extra else None
+
+            def go(key):
+                out = self.init_fn(key)
+                params, extra = out if self._has_extra else (out, None)
+                return params, self.tx.init(params), jnp.zeros((), jnp.int32), extra
+
+            self._init_jit = jax.jit(
+                go,
+                out_shardings=(
+                    self.param_shardings,
+                    opt_shardings,
+                    self._repl,
+                    extra_out,
+                ),
+            )
+        params, opt_state, step, extra = self._init_jit(key)
+        return TrainState(params, opt_state, step, extra)
+
+    def _opt_shardings(self):
+        """Optimizer slots inherit their param's sharding, matched by tree
+        PATH (optimizer moment trees embed the param tree, e.g.
+        mu.layers.wq mirrors params.layers.wq). Shape-based matching would
+        collide for same-shape params with transposed shardings (wq vs wo
+        when n_heads*head_dim == d_model). Scalars and unmatched leaves
+        replicate."""
+        opt_shape = jax.eval_shape(self.tx.init, self._params_shape)
+        param_leaves = jax.tree_util.tree_flatten_with_path(self._params_shape)[0]
+        sharding_leaves = jax.tree_util.tree_flatten(self.param_shardings)[0]
+        path_map = {}
+        for (path, leaf), sharding in zip(param_leaves, sharding_leaves):
+            path_map[tuple(str(p) for p in path)] = (leaf.shape, sharding)
+
+        def pick(opt_path, leaf):
+            key = tuple(str(p) for p in opt_path)
+            # Longest path suffix that names a param with the same shape.
+            for k in range(len(key), 0, -1):
+                hit = path_map.get(key[-k:])
+                if hit is not None:
+                    shape, sharding = hit
+                    if shape == leaf.shape:
+                        return sharding
+                    break
+            return self._repl
+
+        return jax.tree_util.tree_map_with_path(pick, opt_shape)
+
+    # ---- step -----------------------------------------------------------
+
+    def step(self, state: TrainState, batch) -> tuple:
+        if self._step_jit is None:
+            self._step_jit = self._build_step()
+        params, opt_state, step, extra, loss = self._step_jit(
+            state.params, state.opt_state, state.step, state.extra, batch
+        )
+        return TrainState(params, opt_state, step, extra), {"loss": loss}
+
+    def _build_step(self):
+        def go(params, opt_state, step, extra, batch):
+            def wrapped(p):
+                out = self.loss_fn(p, batch, extra)
+                if isinstance(out, tuple):
+                    return out
+                return out, extra
+
+            (loss, new_extra), grads = jax.value_and_grad(wrapped, has_aux=True)(params)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, step + 1, new_extra, loss
+
+        return jax.jit(go, donate_argnums=(0, 1, 3))
