@@ -1,0 +1,66 @@
+"""Leader election tests (reference parity: EndpointsLock semantics,
+cmd/tf-operator/app/server.go:109-132)."""
+
+import threading
+import time
+
+from conftest import wait_for
+from tf_operator_tpu.controller.leader import FileLease, LeaderElector
+
+
+def test_single_holder(tmp_path):
+    path = str(tmp_path / "lease")
+    a = FileLease(path, identity="a", lease_duration=5)
+    b = FileLease(path, identity="b", lease_duration=5)
+    assert a.try_acquire()
+    assert not b.try_acquire()
+    assert a.renew()
+
+
+def test_expired_lease_taken_over(tmp_path):
+    path = str(tmp_path / "lease")
+    a = FileLease(path, identity="a", lease_duration=0.1)
+    b = FileLease(path, identity="b", lease_duration=5)
+    assert a.try_acquire()
+    time.sleep(0.2)
+    assert b.try_acquire()
+    assert not a.renew()  # a lost it
+
+
+def test_release_frees_lease(tmp_path):
+    path = str(tmp_path / "lease")
+    a = FileLease(path, identity="a")
+    b = FileLease(path, identity="b")
+    assert a.try_acquire()
+    a.release()
+    assert b.try_acquire()
+
+
+def test_elector_failover(tmp_path):
+    path = str(tmp_path / "lease")
+    events = []
+    stop_a = threading.Event()
+    stop_b = threading.Event()
+
+    ea = LeaderElector(
+        FileLease(path, identity="a", lease_duration=0.6, renew_period=0.2, retry_period=0.1),
+        on_started_leading=lambda: events.append("a-start"),
+        on_stopped_leading=lambda: events.append("a-stop"),
+        stop_event=stop_a,
+    )
+    eb = LeaderElector(
+        FileLease(path, identity="b", lease_duration=0.6, renew_period=0.2, retry_period=0.1),
+        on_started_leading=lambda: events.append("b-start"),
+        on_stopped_leading=lambda: events.append("b-stop"),
+        stop_event=stop_b,
+    )
+    ea.run_in_background()
+    assert wait_for(ea.is_leader.is_set, timeout=5)
+    eb.run_in_background()
+    time.sleep(0.5)
+    assert not eb.is_leader.is_set()  # a still holds
+
+    stop_a.set()  # a stops renewing; after expiry b takes over
+    assert wait_for(eb.is_leader.is_set, timeout=5)
+    assert events[0] == "a-start" and "b-start" in events
+    stop_b.set()

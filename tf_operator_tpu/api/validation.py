@@ -10,19 +10,32 @@ The TPU-native analogues are below; mesh/topology consistency checks are new
 from __future__ import annotations
 
 import math
+import re
 
 from tf_operator_tpu.api.types import ReplicaType, TPUJob, TPUJobSpec
+
+# DNS-1123-label shape, like k8s object names: also forecloses path
+# traversal in log paths and HTML injection in the dashboard.
+_NAME_RE = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+_MAX_NAME = 63
 
 
 class ValidationError(ValueError):
     """Raised when a TPUJob spec is invalid (reference: field.ErrorList)."""
 
 
+def _validate_dns_label(value: str, field: str) -> None:
+    if not value:
+        raise ValidationError(f"{field} is required")
+    if len(value) > _MAX_NAME or not _NAME_RE.match(value):
+        raise ValidationError(
+            f"{field} must be a lowercase DNS label (a-z, 0-9, '-'), got {value!r}"
+        )
+
+
 def validate_job(job: TPUJob) -> None:
-    if not job.metadata.name:
-        raise ValidationError("metadata.name is required")
-    if "/" in job.metadata.name:
-        raise ValidationError("metadata.name must not contain '/'")
+    _validate_dns_label(job.metadata.name, "metadata.name")
+    _validate_dns_label(job.metadata.namespace, "metadata.namespace")
     validate_spec(job.spec)
 
 

@@ -1,0 +1,8 @@
+"""Process entrypoints (reference: cmd/tf-operator{,.v2} + kubectl usage).
+
+- ``python -m tf_operator_tpu.cli.operator`` — the operator daemon: store +
+  controller + process backend + REST dashboard + optional leader election
+  and chaos injection.
+- ``python -m tf_operator_tpu.cli.tpujob``  — the client CLI (kubectl
+  analogue): submit/list/get/delete/wait/logs/events against a daemon.
+"""

@@ -1,0 +1,176 @@
+"""The operator daemon.
+
+Reference parity: cmd/tf-operator.v2/app/server.go — flag parsing, client
+wiring, informers, leader election, controller Run. One process hosts the
+store (apiserver analogue), the reconciling controller, the local process
+backend, and the REST dashboard.
+
+Beyond the reference: ``--chaos-level`` is actually implemented (the
+reference shipped it as an explicit placeholder,
+cmd/tf-operator/app/options/options.go:40-41): at level L, roughly every
+``--chaos-interval`` seconds each running process is SIGKILLed with
+probability L/10 — exercising the retryable-failure/gang-restart path
+continuously.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import random
+import signal
+import sys
+import threading
+
+log = logging.getLogger("tpujob.operator")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpujob-operator", description="TPUJob operator daemon"
+    )
+    # reference: options.go (v1alpha1:23-47, v2:22-48)
+    p.add_argument("--threadiness", type=int, default=2,
+                   help="controller worker threads (reference default 2)")
+    p.add_argument("--resync-period", type=float, default=15.0,
+                   help="reconciler sync loop period seconds (reference 15s)")
+    p.add_argument("--port", type=int, default=8080, help="dashboard/API port")
+    p.add_argument("--host", default="127.0.0.1", help="dashboard/API bind host")
+    p.add_argument("--json-log-format", action="store_true",
+                   help="structured JSON logs (reference: logrus JSON for Stackdriver)")
+    p.add_argument("--log-dir", default=os.path.join(os.getcwd(), "tpujob-logs"),
+                   help="directory for per-process logs")
+    p.add_argument("--enable-leader-elect", action="store_true",
+                   help="file-lease leader election (reference: EndpointsLock)")
+    p.add_argument("--lease-file", default="/tmp/tpujob-operator.lease")
+    p.add_argument("--chaos-level", type=int, default=0, choices=range(0, 11),
+                   help="0-10: probability/10 of killing each running process "
+                        "per chaos interval (reference flag was unimplemented)")
+    p.add_argument("--chaos-interval", type=float, default=10.0)
+    return p
+
+
+class _JsonFormatter(logging.Formatter):
+    def format(self, record):
+        return json.dumps(
+            {
+                "severity": record.levelname,
+                "message": record.getMessage(),
+                "logger": record.name,
+                "time": self.formatTime(record),
+                "filename": f"{record.filename}:{record.lineno}",
+            }
+        )
+
+
+def setup_logging(json_format: bool) -> None:
+    handler = logging.StreamHandler(sys.stderr)
+    if json_format:
+        handler.setFormatter(_JsonFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(name)s [%(levelname)s] %(filename)s:%(lineno)d %(message)s")
+        )
+    logging.basicConfig(level=logging.INFO, handlers=[handler])
+
+
+class ChaosMonkey:
+    """Implemented --chaos-level (SURVEY.md §5: placeholder in reference)."""
+
+    def __init__(self, store, level: int, interval: float) -> None:
+        self.store = store
+        self.level = level
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self) -> None:
+        if self.level <= 0:
+            return
+        self._thread = threading.Thread(target=self._loop, name="chaos", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        from tf_operator_tpu.runtime.objects import ProcessPhase
+
+        while not self._stop.wait(self.interval):
+            for proc in self.store.list("Process"):
+                if proc.status.phase is ProcessPhase.RUNNING and proc.status.pid:
+                    if random.random() < self.level / 10.0:
+                        log.warning("chaos: killing %s (pid %s)", proc.key(), proc.status.pid)
+                        try:
+                            os.kill(proc.status.pid, signal.SIGKILL)
+                        except OSError:
+                            pass
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_logging(args.json_log_format)
+
+    from tf_operator_tpu.controller import TPUJobController
+    from tf_operator_tpu.controller.leader import FileLease, LeaderElector
+    from tf_operator_tpu.dashboard import DashboardServer
+    from tf_operator_tpu.runtime import LocalProcessControl, Store
+
+    store = Store()
+    backend = LocalProcessControl(store, log_dir=args.log_dir)
+    controller = TPUJobController(
+        store, backend, resync_period=args.resync_period
+    )
+    dashboard = DashboardServer(store, host=args.host, port=args.port)
+    chaos = ChaosMonkey(store, args.chaos_level, args.chaos_interval)
+
+    stop = threading.Event()
+
+    def shutdown(*_):
+        log.info("shutting down")
+        stop.set()
+
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+
+    dashboard.start()
+    log.info("dashboard/API listening on %s", dashboard.url)
+
+    def start_controller():
+        controller.run(workers=args.threadiness)
+        chaos.start()
+        log.info("controller running (%d workers)", args.threadiness)
+
+    rc = {"code": 0}
+
+    def lost_leadership():
+        # RunOrDie semantics: a dead leader must exit NONZERO so a
+        # restart-on-failure supervisor brings a candidate back up.
+        log.error("lost leadership; exiting")
+        rc["code"] = 1
+        stop.set()
+
+    if args.enable_leader_elect:
+        elector = LeaderElector(
+            FileLease(args.lease_file),
+            on_started_leading=start_controller,
+            on_stopped_leading=lost_leadership,
+            stop_event=stop,
+        )
+        elector.run_in_background()
+        log.info("waiting for leadership (lease %s)", args.lease_file)
+    else:
+        start_controller()
+
+    stop.wait()
+    chaos.stop()
+    controller.stop()
+    backend.shutdown()
+    dashboard.stop()
+    return rc["code"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

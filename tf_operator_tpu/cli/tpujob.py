@@ -1,0 +1,95 @@
+"""Client CLI: the kubectl-for-TPUJobs.
+
+Reference parity: the kubectl workflows the reference documents
+(`kubectl create -f examples/tf_job.yaml`, `kubectl get tfjobs`, pod logs)
+plus py/tf_job_client.py's wait_for_job, against the daemon's REST API.
+
+    python -m tf_operator_tpu.cli.tpujob submit examples/smoke.json
+    python -m tf_operator_tpu.cli.tpujob list
+    python -m tf_operator_tpu.cli.tpujob get default smoke
+    python -m tf_operator_tpu.cli.tpujob wait default smoke
+    python -m tf_operator_tpu.cli.tpujob logs default smoke-worker-0
+    python -m tf_operator_tpu.cli.tpujob delete default smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+DEFAULT_SERVER = os.environ.get("TPUJOB_SERVER", "http://127.0.0.1:8080")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpujob", description="TPUJob client")
+    p.add_argument("--server", default=DEFAULT_SERVER, help="operator API URL")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("submit", help="create a job from a JSON spec file")
+    s.add_argument("file")
+    sub.add_parser("list", help="list jobs").add_argument(
+        "--namespace", default=None
+    )
+    for name in ("get", "delete", "wait"):
+        sp = sub.add_parser(name)
+        sp.add_argument("namespace")
+        sp.add_argument("name")
+        if name == "wait":
+            sp.add_argument("--timeout", type=float, default=600.0)
+    lp = sub.add_parser("logs", help="fetch a process's logs")
+    lp.add_argument("namespace")
+    lp.add_argument("process_name")
+    ep = sub.add_parser("events")
+    ep.add_argument("--namespace", default=None)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from tf_operator_tpu.api.types import TPUJob
+    from tf_operator_tpu.dashboard.client import TPUJobApiError, TPUJobClient
+
+    client = TPUJobClient(args.server)
+    try:
+        if args.cmd == "submit":
+            with open(args.file) as f:
+                job = TPUJob.from_dict(json.load(f))
+            created = client.create(job)
+            print(f"tpujob {created.key()} created (uid {created.metadata.uid})")
+        elif args.cmd == "list":
+            jobs = client.list(args.namespace)
+            print(f"{'NAMESPACE':<12} {'NAME':<24} {'PHASE':<10} {'RESTARTS':<8}")
+            for j in jobs:
+                print(
+                    f"{j.metadata.namespace:<12} {j.metadata.name:<24} "
+                    f"{j.status.phase().value or '-':<10} {j.status.restart_count:<8}"
+                )
+        elif args.cmd == "get":
+            print(json.dumps(client.get(args.namespace, args.name), indent=2))
+        elif args.cmd == "delete":
+            client.delete(args.namespace, args.name)
+            print(f"tpujob {args.namespace}/{args.name} deleted")
+        elif args.cmd == "wait":
+            job = client.wait_for_job(args.namespace, args.name, timeout=args.timeout)
+            phase = job.status.phase().value
+            print(f"tpujob {args.namespace}/{args.name}: {phase}")
+            return 0 if phase == "Done" else 3
+        elif args.cmd == "logs":
+            sys.stdout.write(client.logs(args.namespace, args.process_name))
+        elif args.cmd == "events":
+            for e in client.events(args.namespace):
+                print(f"{e['type']:<8} {e['reason']:<28} x{e['count']:<4} {e['message']}")
+    except TPUJobApiError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    except FileNotFoundError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

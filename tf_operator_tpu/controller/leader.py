@@ -1,0 +1,198 @@
+"""Leader election: single-active-controller HA via a file lease.
+
+Reference parity: the operator's EndpointsLock leader election with
+lease 15s / renew 5s / retry 3s (cmd/tf-operator/app/server.go:109-132).
+On a bare host the lock object is a lease file updated atomically
+(write-to-temp + rename); the holder renews on a background thread and
+calls ``on_stopped_leading`` if the lease is lost, at which point the
+daemon must exit (the reference's RunOrDie semantics).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import tempfile
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+LEASE_DURATION = 15.0
+RENEW_PERIOD = 5.0
+RETRY_PERIOD = 3.0
+
+
+@dataclass
+class LeaseRecord:
+    holder: str
+    acquired: float
+    renewed: float
+    lease_duration: float
+
+    def expired(self, now: float) -> bool:
+        return now - self.renewed > self.lease_duration
+
+
+class FileLease:
+    def __init__(
+        self,
+        path: str,
+        identity: Optional[str] = None,
+        lease_duration: float = LEASE_DURATION,
+        renew_period: float = RENEW_PERIOD,
+        retry_period: float = RETRY_PERIOD,
+    ) -> None:
+        self.path = path
+        self.identity = identity or f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+        self.lease_duration = lease_duration
+        self.renew_period = renew_period
+        self.retry_period = retry_period
+
+    # -- record IO (atomic) ----------------------------------------------
+
+    def _read(self) -> Optional[LeaseRecord]:
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+            return LeaseRecord(**data)
+        except (OSError, ValueError, TypeError):
+            return None
+
+    def _write(self, rec: LeaseRecord) -> None:
+        d = os.path.dirname(self.path) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".lease-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(rec.__dict__, f)
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    # -- acquire / renew --------------------------------------------------
+
+    def _mutex(self):
+        """Serialize the read-check-write critical section with an O_EXCL
+        lockfile — without it two candidates can both observe an expired
+        lease, both write, and both believe they won (split brain). A
+        lockfile older than the lease duration is presumed abandoned by a
+        crashed holder and is broken."""
+        return _LockFile(self.path + ".lock", stale_after=self.lease_duration)
+
+    def try_acquire(self) -> bool:
+        mutex = self._mutex()
+        if not mutex.acquire():
+            return False  # someone else is mid-acquire; retry later
+        try:
+            now = time.time()
+            cur = self._read()
+            if cur is not None and cur.holder != self.identity and not cur.expired(now):
+                return False
+            acquired = cur.acquired if (cur and cur.holder == self.identity) else now
+            self._write(LeaseRecord(self.identity, acquired, now, self.lease_duration))
+            return True
+        finally:
+            mutex.release()
+
+    def renew(self) -> bool:
+        cur = self._read()
+        if cur is None or cur.holder != self.identity:
+            return False
+        return self.try_acquire()
+
+    def release(self) -> None:
+        cur = self._read()
+        if cur is not None and cur.holder == self.identity:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+class _LockFile:
+    """O_CREAT|O_EXCL advisory lock with crash-staleness breaking."""
+
+    def __init__(self, path: str, stale_after: float) -> None:
+        self.path = path
+        self.stale_after = stale_after
+
+    def acquire(self) -> bool:
+        d = os.path.dirname(self.path) or "."
+        os.makedirs(d, exist_ok=True)
+        try:
+            fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.close(fd)
+            return True
+        except FileExistsError:
+            try:
+                age = time.time() - os.stat(self.path).st_mtime
+            except OSError:
+                return False
+            if age > self.stale_after:
+                # presumed crashed holder: break the lock and retry once
+                try:
+                    os.unlink(self.path)
+                except OSError:
+                    pass
+                try:
+                    fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                    os.close(fd)
+                    return True
+                except FileExistsError:
+                    return False
+            return False
+
+    def release(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class LeaderElector:
+    """Blocks in run() until elected; renews in the background; invokes
+    on_stopped_leading if the lease is lost (reference: RunOrDie)."""
+
+    def __init__(
+        self,
+        lease: FileLease,
+        on_started_leading: Callable[[], None],
+        on_stopped_leading: Callable[[], None],
+        stop_event: Optional[threading.Event] = None,
+    ) -> None:
+        self.lease = lease
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.stop_event = stop_event or threading.Event()
+        self.is_leader = threading.Event()
+
+    def run(self) -> None:
+        # acquisition loop
+        while not self.stop_event.is_set():
+            if self.lease.try_acquire():
+                break
+            if self.stop_event.wait(self.lease.retry_period):
+                return
+        if self.stop_event.is_set():
+            return
+        self.is_leader.set()
+        self.on_started_leading()
+        # renewal loop
+        while not self.stop_event.wait(self.lease.renew_period):
+            if not self.lease.renew():
+                self.is_leader.clear()
+                self.on_stopped_leading()
+                return
+        self.lease.release()
+
+    def run_in_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.run, name="leader-elector", daemon=True)
+        t.start()
+        return t

@@ -1,0 +1,10 @@
+"""Dashboard: REST read/write API + minimal web UI.
+
+Reference parity: dashboard/backend (go-restful API at /tfjobs/api/...,
+api_handler.go:74-113) and the React frontend, collapsed into one
+threaded HTTP server over the store. The API doubles as the framework's
+remote apiserver surface: the submit CLI and the Python client speak it.
+"""
+
+from tf_operator_tpu.dashboard.server import DashboardServer  # noqa: F401
+from tf_operator_tpu.dashboard.client import TPUJobClient  # noqa: F401

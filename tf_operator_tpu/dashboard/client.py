@@ -1,0 +1,118 @@
+"""Python client for the TPUJob REST API.
+
+Reference parity: py/tf_job_client.py — CRD CRUD via CustomObjectsApi plus
+``wait_for_job`` polling phase (v1alpha1) / conditions (v1alpha2)
+(tf_job_client.py:21-161). Stdlib-only (urllib), no requests dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from tf_operator_tpu.api.types import TPUJob
+
+
+class TPUJobApiError(RuntimeError):
+    def __init__(self, code: int, message: str) -> None:
+        super().__init__(f"HTTP {code}: {message}")
+        self.code = code
+
+
+class TPUJobClient:
+    def __init__(self, base_url: str, timeout: float = 10.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    # -- raw ---------------------------------------------------------------
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=data,
+            method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                raw = resp.read()
+                ctype = resp.headers.get("Content-Type", "")
+        except urllib.error.HTTPError as exc:
+            try:
+                message = json.loads(exc.read()).get("error", str(exc))
+            except Exception:
+                message = str(exc)
+            raise TPUJobApiError(exc.code, message) from None
+        if raw and "application/json" in ctype:
+            return json.loads(raw)
+        return raw.decode(errors="replace")
+
+    # -- CRUD --------------------------------------------------------------
+
+    def create(self, job: TPUJob) -> TPUJob:
+        out = self._request("POST", "/api/tpujob", job.to_dict())
+        out.pop("phase", None)
+        return TPUJob.from_dict(out)
+
+    def list(self, namespace: Optional[str] = None) -> List[TPUJob]:
+        q = f"?namespace={namespace}" if namespace else ""
+        items = self._request("GET", f"/api/tpujob{q}")["items"]
+        return [TPUJob.from_dict({k: v for k, v in d.items() if k != "phase"}) for d in items]
+
+    def get(self, namespace: str, name: str) -> Dict[str, Any]:
+        """Full detail: {"job": ..., "processes": [...], "endpoints": [...]}."""
+        return self._request("GET", f"/api/tpujob/{namespace}/{name}")
+
+    def get_job(self, namespace: str, name: str) -> TPUJob:
+        d = self.get(namespace, name)["job"]
+        d.pop("phase", None)
+        return TPUJob.from_dict(d)
+
+    def delete(self, namespace: str, name: str) -> None:
+        self._request("DELETE", f"/api/tpujob/{namespace}/{name}")
+
+    def logs(self, namespace: str, process_name: str) -> str:
+        raw = self._request("GET", f"/api/process/{namespace}/{process_name}/logs")
+        return raw if isinstance(raw, str) else raw.decode(errors="replace")
+
+    def events(self, namespace: Optional[str] = None) -> List[dict]:
+        q = f"?namespace={namespace}" if namespace else ""
+        return self._request("GET", f"/api/events{q}")["items"]
+
+    # -- waiting (tf_job_client.py:104-161) --------------------------------
+
+    def wait_for_job(
+        self,
+        namespace: str,
+        name: str,
+        timeout: float = 600.0,
+        poll: float = 1.0,
+        target_phases: tuple = ("Done", "Failed"),
+    ) -> TPUJob:
+        deadline = time.time() + timeout
+        while True:
+            job = self.get_job(namespace, name)
+            if job.status.phase().value in target_phases:
+                return job
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"tpujob {namespace}/{name} not in {target_phases} after {timeout}s; "
+                    f"phase={job.status.phase().value}"
+                )
+            time.sleep(poll)
+
+    def wait_for_delete(self, namespace: str, name: str, timeout: float = 60.0) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                self.get(namespace, name)
+            except TPUJobApiError as exc:
+                if exc.code == 404:
+                    return
+                raise
+            time.sleep(0.5)
+        raise TimeoutError(f"tpujob {namespace}/{name} still present after {timeout}s")

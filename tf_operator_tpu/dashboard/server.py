@@ -1,0 +1,243 @@
+"""Threaded HTTP server exposing the store: REST API + static UI.
+
+Routes (reference: dashboard/backend/handler/api_handler.go:74-113):
+
+- GET    /api/tpujob                      — list jobs (?namespace=)
+- POST   /api/tpujob                      — submit a job (JSON body)
+- GET    /api/tpujob/{ns}/{name}          — job detail + processes + endpoints
+- DELETE /api/tpujob/{ns}/{name}          — delete job (controller GCs children)
+- GET    /api/process/{ns}/{name}/logs    — process logs (kubelet-log analogue)
+- GET    /api/events?namespace=           — events (the test oracle surface)
+- GET    /api/namespaces                  — namespaces in use
+- GET    /ui                              — minimal single-page UI
+- GET    /healthz                         — liveness
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from tf_operator_tpu.api.types import (
+    KIND_ENDPOINT,
+    KIND_EVENT,
+    KIND_PROCESS,
+    KIND_TPUJOB,
+    LABEL_JOB_NAME,
+    TPUJob,
+)
+from tf_operator_tpu.api import set_defaults, validate_job, ValidationError
+from tf_operator_tpu.api.types import _to_jsonable
+from tf_operator_tpu.runtime.process_backend import LocalProcessControl
+from tf_operator_tpu.runtime.store import AlreadyExistsError, NotFoundError, Store
+
+_JOB_RE = re.compile(r"^/api/tpujob/([^/]+)/([^/]+)$")
+_LOGS_RE = re.compile(r"^/api/process/([^/]+)/([^/]+)/logs$")
+
+_UI_HTML = """<!doctype html>
+<html><head><title>TPUJob dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem;background:#fafafa}
+ table{border-collapse:collapse;width:100%;background:#fff}
+ th,td{border:1px solid #ddd;padding:6px 10px;text-align:left;font-size:14px}
+ th{background:#f0f0f0} h1{font-size:20px}
+ .Succeeded{color:#0a7d32}.Failed{color:#c0392b}.Running{color:#1a6fb5}
+</style></head>
+<body><h1>TPUJob dashboard</h1><table id="jobs"><thead>
+<tr><th>Namespace</th><th>Name</th><th>Phase</th><th>Replicas</th>
+<th>Restarts</th><th>Conditions</th></tr></thead><tbody></tbody></table>
+<script>
+async function refresh(){
+  const r = await fetch('/api/tpujob'); const jobs = await r.json();
+  const tb = document.querySelector('#jobs tbody'); tb.innerHTML='';
+  for (const j of jobs.items){
+    const conds=(j.status.conditions||[]).map(c=>c.type).join(', ');
+    const phase=j.phase||'';
+    const reps=Object.entries(j.spec.replica_specs||{}).map(([k,v])=>`${k}:${v.replicas}`).join(' ');
+    // textContent assignment only: server-side validation restricts names,
+    // but the UI must never interpret object fields as HTML regardless.
+    const tr = document.createElement('tr');
+    for (const text of [j.metadata.namespace, j.metadata.name, phase, reps,
+                        String(j.status.restart_count||0), conds]){
+      const td = document.createElement('td');
+      td.textContent = text;
+      tr.appendChild(td);
+    }
+    tr.children[2].className = phase;
+    tb.appendChild(tr);
+  }
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>
+"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "tpujob-dashboard/0.1"
+    store: Store = None  # set by server factory
+
+    # silence default request logging
+    def log_message(self, fmt, *args):
+        del fmt, args
+
+    # -- helpers ----------------------------------------------------------
+
+    def _json(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, message: str) -> None:
+        self._json(code, {"error": message})
+
+    def _job_payload(self, job: TPUJob) -> dict:
+        d = job.to_dict()
+        d["phase"] = job.status.phase().value
+        return d
+
+    # -- GET --------------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 (stdlib casing)
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        ns = q.get("namespace", [None])[0]
+        path = url.path
+
+        if path == "/healthz":
+            return self._json(200, {"ok": True})
+        if path in ("/", "/ui"):
+            body = _UI_HTML.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if path == "/api/tpujob":
+            jobs = self.store.list(KIND_TPUJOB, namespace=ns)
+            return self._json(200, {"items": [self._job_payload(j) for j in jobs]})
+        if path == "/api/namespaces":
+            spaces = sorted({j.metadata.namespace for j in self.store.list(KIND_TPUJOB)})
+            return self._json(200, {"items": spaces})
+        if path == "/api/events":
+            evs = self.store.list(KIND_EVENT, namespace=ns)
+            return self._json(200, {"items": [_to_jsonable(e) for e in evs]})
+
+        m = _JOB_RE.match(path)
+        if m:
+            ns, name = m.groups()
+            try:
+                job = self.store.get(KIND_TPUJOB, ns, name)
+            except NotFoundError:
+                return self._error(404, f"tpujob {ns}/{name} not found")
+            procs = self.store.list(
+                KIND_PROCESS, namespace=ns, label_selector={LABEL_JOB_NAME: name}
+            )
+            eps = self.store.list(
+                KIND_ENDPOINT, namespace=ns, label_selector={LABEL_JOB_NAME: name}
+            )
+            return self._json(
+                200,
+                {
+                    "job": self._job_payload(job),
+                    "processes": [_to_jsonable(p) for p in procs],
+                    "endpoints": [_to_jsonable(e) for e in eps],
+                },
+            )
+
+        m = _LOGS_RE.match(path)
+        if m:
+            ns, name = m.groups()
+            try:
+                proc = self.store.get(KIND_PROCESS, ns, name)
+            except NotFoundError:
+                return self._error(404, f"process {ns}/{name} not found")
+            log_path = proc.metadata.annotations.get(LocalProcessControl.LOG_ANNOTATION)
+            if not log_path:
+                return self._error(404, "no logs captured for this process")
+            try:
+                with open(log_path, "rb") as f:
+                    # Tail the last 1MB without reading the whole file.
+                    import os as _os
+
+                    f.seek(0, _os.SEEK_END)
+                    size = f.tell()
+                    f.seek(max(0, size - 1024 * 1024))
+                    data = f.read()
+            except OSError as exc:
+                return self._error(500, str(exc))
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
+
+        self._error(404, f"no route {path}")
+
+    # -- POST / DELETE -----------------------------------------------------
+
+    def do_POST(self):  # noqa: N802
+        if urlparse(self.path).path != "/api/tpujob":
+            return self._error(404, "POST only at /api/tpujob")
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            data = json.loads(self.rfile.read(length) or b"{}")
+            job = TPUJob.from_dict(data)
+            set_defaults(job)
+            validate_job(job)
+        except (ValueError, ValidationError, KeyError, TypeError) as exc:
+            return self._error(400, f"invalid job: {exc}")
+        # Namespace auto-create semantics (api_handler.go:178-218) are
+        # implicit: namespaces exist by use.
+        try:
+            created = self.store.create(job)
+        except AlreadyExistsError as exc:
+            return self._error(409, str(exc))
+        self._json(201, self._job_payload(created))
+
+    def do_DELETE(self):  # noqa: N802
+        m = _JOB_RE.match(urlparse(self.path).path)
+        if not m:
+            return self._error(404, "DELETE only at /api/tpujob/{ns}/{name}")
+        ns, name = m.groups()
+        try:
+            self.store.delete(KIND_TPUJOB, ns, name)
+        except NotFoundError:
+            return self._error(404, f"tpujob {ns}/{name} not found")
+        self._json(200, {"deleted": f"{ns}/{name}"})
+
+
+class DashboardServer:
+    def __init__(self, store: Store, host: str = "127.0.0.1", port: int = 8080) -> None:
+        handler = type("BoundHandler", (_Handler,), {"store": store})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="dashboard", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

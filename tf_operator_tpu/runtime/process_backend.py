@@ -92,15 +92,21 @@ class LocalProcessControl(ProcessControl):
 
     GRACE_SECONDS = 5.0
 
+    LOG_ANNOTATION = "tpujob.dev/log-path"
+
     def __init__(
         self,
         store: Store,
         command_builder: Callable[[Process], List[str]] = default_command_builder,
         inherit_env: bool = True,
+        log_dir: Optional[str] = None,
     ) -> None:
         self._store = store
         self._command_builder = command_builder
         self._inherit_env = inherit_env
+        self._log_dir = log_dir
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
         self._lock = threading.Lock()
         # "ns/name" -> Popen, or None while the launch is still in flight.
         self._children: Dict[str, Optional[subprocess.Popen]] = {}
@@ -112,6 +118,18 @@ class LocalProcessControl(ProcessControl):
     # -- ProcessControl ---------------------------------------------------
 
     def create_process(self, process: Process) -> None:
+        if self._log_dir:
+            # Combined stdout+stderr log (kubelet log analogue; served by the
+            # dashboard's logs endpoint, api_handler.go:236-251). basename()
+            # on each component forecloses path traversal via crafted
+            # namespace/name (validation also rejects them at admission).
+            log_name = (
+                f"{os.path.basename(process.metadata.namespace)}"
+                f"_{os.path.basename(process.metadata.name)}.log"
+            )
+            process.metadata.annotations[self.LOG_ANNOTATION] = os.path.join(
+                self._log_dir, log_name
+            )
         stored = self._store.create(process)
         with self._lock:
             self._children[stored.key()] = None  # reserve before thread start
@@ -153,19 +171,31 @@ class LocalProcessControl(ProcessControl):
         # conflicts — it may override e.g. the entrypoint for a debug run).
         env.update(identity_env(process.spec, process.metadata.namespace))
         env.update(process.spec.env)
+        log_path = process.metadata.annotations.get(self.LOG_ANNOTATION)
+        log_file = None
         try:
+            if log_path:
+                log_file = open(log_path, "ab")
             child = subprocess.Popen(
                 self._command_builder(process),
                 env=env,
                 cwd=process.spec.workdir,
+                stdout=log_file,
+                stderr=subprocess.STDOUT if log_file else None,
                 start_new_session=True,  # isolate signals from the operator
             )
         except OSError as exc:
+            # Covers both a failed log-file open and a failed exec: the
+            # process must be reported FAILED, never left Pending forever.
+            if log_file:
+                log_file.close()
             with self._lock:
                 self._children.pop(key, None)
                 self._tombstones.discard(key)
             self._patch_status(process, ProcessPhase.FAILED, exit_code=127, message=str(exc))
             return
+        if log_file:
+            log_file.close()  # child holds its own descriptor now
         with self._lock:
             doomed = key in self._tombstones or self._shutting_down
             if doomed:

@@ -1,0 +1,88 @@
+"""Transformer language-model training workload (operator-launchable).
+
+Covers the BASELINE.json BERT-base and Llama-2 configs: joins the gang,
+builds the declared mesh (dp/fsdp/tp/cp), trains a transformer preset with
+the sharded Trainer on synthetic tokens, logs tokens/sec and MFU.
+
+workload config keys: preset ("tiny"|"gpt-small"|"bert-base"|"llama2-7b"|
+"llama2-13b"), steps, batch_size, seq_len, lr, attn ("dense"|"ring"),
+plus any TransformerConfig field as an override (e.g. n_layers).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tf_operator_tpu.rendezvous.context import JobContext
+
+log = logging.getLogger("tpujob.lm")
+
+_CFG_FIELDS = {
+    "vocab", "d_model", "n_layers", "n_heads", "n_kv_heads", "d_ff",
+    "max_seq", "causal", "remat",
+}
+
+
+def main(ctx: JobContext) -> None:
+    ctx.initialize_distributed()
+
+    import time
+
+    import jax
+
+    from tf_operator_tpu.models.transformer import (
+        init_transformer,
+        lm_loss,
+        preset,
+        transformer_logical_axes,
+    )
+    from tf_operator_tpu.train.metrics import host_fetch, mfu, transformer_train_flops
+    from tf_operator_tpu.train.trainer import Trainer, TrainerConfig
+
+    wl = ctx.workload
+    steps = max(2, int(wl.get("steps", 10)))
+    batch = int(wl.get("batch_size", 8))
+    seq = int(wl.get("seq_len", 512))
+    overrides = {k: wl[k] for k in _CFG_FIELDS if k in wl}
+    if wl.get("attn") == "ring":
+        overrides["attn_impl"] = "ring"
+    cfg = preset(wl.get("preset", "tiny"), **overrides)
+    mesh = ctx.build_mesh()
+
+    def loss_fn(params, tokens, extra):
+        del extra
+        return lm_loss(params, tokens, cfg, mesh=mesh)
+
+    trainer = Trainer(
+        mesh,
+        loss_fn=loss_fn,
+        init_fn=lambda k: init_transformer(k, cfg),
+        logical_axes=transformer_logical_axes(cfg),
+        config=TrainerConfig(
+            optimizer="adamw", learning_rate=float(wl.get("lr", 3e-4)),
+        ),
+    )
+    state = trainer.init(jax.random.PRNGKey(0))
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab),
+        trainer.batch_sharding,
+    )
+
+    state, m = trainer.step(state, tokens)
+    host_fetch(m["loss"])  # compile boundary
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = trainer.step(state, tokens)
+    loss = float(m["loss"])
+    step_s = (time.perf_counter() - t0) / steps
+    n_chips = mesh.devices.size
+    flops = transformer_train_flops(cfg.n_params(), batch * seq)
+    log.info(
+        "lm done: preset=%s loss=%.4f step=%.2fms tok/s=%.0f mfu=%.3f (%d chips)",
+        wl.get("preset", "tiny"), loss, step_s * 1e3, batch * seq / step_s,
+        mfu(flops, step_s, n_chips), n_chips,
+    )
+    import math
+
+    if not math.isfinite(loss):
+        raise AssertionError(f"non-finite loss {loss}")
