@@ -1,0 +1,164 @@
+"""Checkpoint/resume tests (SURVEY.md §5: restart-based recovery).
+
+Runs on the 8-device virtual CPU mesh from conftest; exercises both the
+orbax and the dependency-free npy backends through one API.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.models.transformer import (
+    init_transformer,
+    lm_loss,
+    preset,
+    transformer_logical_axes,
+)
+from tf_operator_tpu.parallel import build_mesh
+from tf_operator_tpu.train import CheckpointManager, Trainer, TrainerConfig
+
+BACKENDS = ["npy", "orbax"]
+
+
+def _clone(state):
+    """Fresh buffers: trainer.step donates params/opt_state, so tests that
+    step from the shared fixture state must copy it first."""
+    from tf_operator_tpu.train import TrainState
+
+    return TrainState(
+        *(
+            jax.tree_util.tree_map(lambda a: a.copy(), part)
+            for part in (state.params, state.opt_state, state.step, state.extra)
+        )
+    )
+
+
+def _tiny_trainer(mesh):
+    cfg = preset("tiny", dtype=jnp.float32)
+
+    def loss_fn(params, tokens, extra):
+        del extra
+        return lm_loss(params, tokens, cfg, mesh=mesh)
+
+    return (
+        Trainer(
+            mesh,
+            loss_fn=loss_fn,
+            init_fn=lambda k: init_transformer(k, cfg),
+            logical_axes=transformer_logical_axes(cfg),
+            config=TrainerConfig(optimizer="adamw", learning_rate=1e-3),
+        ),
+        cfg,
+    )
+
+
+@pytest.fixture(scope="module")
+def sharded_state():
+    mesh = build_mesh({"dp": 2, "tp": 4})
+    trainer, cfg = _tiny_trainer(mesh)
+    state = trainer.init(jax.random.PRNGKey(0))
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab),
+        trainer.batch_sharding,
+    )
+    state, _ = trainer.step(state, tokens)
+    return mesh, trainer, state, tokens
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_roundtrip_sharded(tmp_path, sharded_state, backend):
+    mesh, trainer, state, _ = sharded_state
+    mgr = CheckpointManager(tmp_path / backend, keep=2, backend=backend)
+    assert mgr.latest_step() is None
+    assert mgr.save(int(state.step), state)
+    assert mgr.all_steps() == [1]
+
+    restored = mgr.restore(trainer.state_template())
+    assert int(restored.step) == int(state.step)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.params),
+        jax.tree_util.tree_leaves(restored.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        # restored leaves land on the template shardings (same mesh here)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.opt_state),
+        jax.tree_util.tree_leaves(restored.opt_state),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    mgr.close()
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_retention_and_latest(tmp_path, sharded_state, backend):
+    _, trainer, state, tokens = sharded_state
+    state = _clone(state)
+    mgr = CheckpointManager(tmp_path / backend, keep=2, backend=backend)
+    for _ in range(3):
+        state, _ = trainer.step(state, tokens)
+        mgr.save(int(state.step), state)
+    steps = mgr.all_steps()
+    assert len(steps) == 2, steps  # keep=2 pruned the oldest
+    assert mgr.latest_step() == steps[-1] == int(state.step)
+    mgr.close()
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_restore_onto_different_mesh(tmp_path, sharded_state, backend):
+    """Resharding on restore: save under dp=2/tp=4, restore under dp=4/tp=2
+    (elastic topology change between runs)."""
+    _, trainer, state, _ = sharded_state
+    mgr = CheckpointManager(tmp_path / backend, keep=2, backend=backend)
+    mgr.save(int(state.step), state)
+
+    mesh2 = build_mesh({"dp": 4, "tp": 2})
+    trainer2, _ = _tiny_trainer(mesh2)
+    restored = mgr.restore(trainer2.state_template())
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.params),
+        jax.tree_util.tree_leaves(restored.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    mgr.close()
+
+
+def test_restore_or_init_resumes(tmp_path, sharded_state):
+    mesh, trainer, state, tokens = sharded_state
+    mgr = CheckpointManager(tmp_path / "resume", keep=3, backend="npy")
+    # no checkpoint -> fresh init at step 0
+    fresh = trainer.restore_or_init(jax.random.PRNGKey(0), mgr)
+    assert int(fresh.step) == 0
+    # checkpoint present -> resume at its step
+    state, _ = trainer.step(_clone(state), tokens)
+    mgr.save(int(state.step), state)
+    resumed = trainer.restore_or_init(jax.random.PRNGKey(0), mgr)
+    assert int(resumed.step) == int(state.step) > 0
+    # and training continues from there
+    resumed2, m = trainer.step(resumed, tokens)
+    assert int(resumed2.step) == int(state.step) + 1
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_restore_empty_raises(tmp_path):
+    mgr = CheckpointManager(tmp_path / "empty", backend="npy")
+    with pytest.raises(FileNotFoundError):
+        mgr.restore(template={"x": jnp.zeros((2,))})
+
+
+def test_save_same_step_is_noop(tmp_path, sharded_state):
+    _, trainer, state, _ = sharded_state
+    mgr = CheckpointManager(tmp_path / "dup", backend="npy")
+    assert mgr.save(int(state.step), state)
+    assert not mgr.save(int(state.step), state)
+    assert mgr.all_steps() == [int(state.step)]
+
+
+def test_npy_restore_rejects_tree_drift(tmp_path):
+    """Restoring onto a template with a different tree structure must fail
+    loudly, not silently load weights into the wrong slots."""
+    mgr = CheckpointManager(tmp_path / "drift", backend="npy")
+    mgr.save(1, {"a": jnp.ones((2,)), "b": jnp.zeros((3,))})
+    with pytest.raises(ValueError, match="does not match"):
+        mgr.restore({"a": jnp.ones((2,)), "c": jnp.zeros((3,))})

@@ -5,7 +5,7 @@ import threading
 import time
 
 from conftest import wait_for
-from tf_operator_tpu.controller.leader import FileLease, LeaderElector
+from tf_operator_tpu.controller.leader import FileLease, LeaderElector, LeaseRecord
 
 
 def test_single_holder(tmp_path):
@@ -64,3 +64,29 @@ def test_elector_failover(tmp_path):
     assert wait_for(eb.is_leader.is_set, timeout=5)
     assert events[0] == "a-start" and "b-start" in events
     stop_b.set()
+
+
+def test_renew_survives_mutex_contention(tmp_path):
+    """A standby candidate holding the .lock mutex mid-check must NOT make
+    the healthy leader's renew() report lease loss (regression: renew
+    previously delegated straight to try_acquire, whose mutex-busy False
+    was indistinguishable from a lost lease, flapping the daemon)."""
+    path = str(tmp_path / "lease")
+    leader = FileLease(path, identity="leader", lease_duration=5.0, renew_period=1.0)
+    assert leader.try_acquire()
+
+    # Simulate a standby mid-acquire: hold the mutex lockfile briefly,
+    # releasing it while the leader's renew() is retrying.
+    mutex = leader._mutex()
+    assert mutex.acquire()
+    timer = threading.Timer(0.15, mutex.release)
+    timer.start()
+    try:
+        assert leader.renew()  # retries past the contention window...
+    finally:
+        timer.cancel()
+
+    # ...but a genuinely stolen lease still reports loss immediately.
+    thief = FileLease(path, identity="thief", lease_duration=5.0)
+    thief._write(LeaseRecord("thief", time.time(), time.time(), 5.0))
+    assert not leader.renew()

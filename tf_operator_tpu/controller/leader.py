@@ -79,12 +79,13 @@ class FileLease:
     # -- acquire / renew --------------------------------------------------
 
     def _mutex(self):
-        """Serialize the read-check-write critical section with an O_EXCL
-        lockfile — without it two candidates can both observe an expired
-        lease, both write, and both believe they won (split brain). A
-        lockfile older than the lease duration is presumed abandoned by a
-        crashed holder and is broken."""
-        return _LockFile(self.path + ".lock", stale_after=self.lease_duration)
+        """Serialize the read-check-write critical section with a kernel
+        flock — without it two candidates can both observe an expired
+        lease, both write, and both believe they won (split brain). flock
+        is released by the kernel when the holder dies, so there is no
+        staleness heuristic to race on (an unlink-based stale-break had a
+        TOCTOU where two candidates could both break the same stale lock)."""
+        return _LockFile(self.path + ".lock")
 
     def try_acquire(self) -> bool:
         mutex = self._mutex()
@@ -102,58 +103,75 @@ class FileLease:
             mutex.release()
 
     def renew(self) -> bool:
-        cur = self._read()
-        if cur is None or cur.holder != self.identity:
-            return False
-        return self.try_acquire()
+        """Renew the held lease. Mutex contention (a standby candidate
+        holding the .lock file for its few-ms expiry check) is NOT lease
+        loss — while the record still names us and the renew budget lasts,
+        keep retrying; only a record naming someone else (or gone) means
+        the lease was genuinely taken. The retry budget is the lease's own
+        expiry (not renew_period): until the record we hold actually
+        expires there is no reason to abdicate — a leaked lockfile from a
+        crashed candidate is broken by _LockFile staleness within that
+        window."""
+        while True:
+            cur = self._read()
+            if cur is None or cur.holder != self.identity:
+                return False
+            if self.try_acquire():
+                return True
+            if time.time() >= cur.renewed + cur.lease_duration:
+                return False
+            time.sleep(0.05)
 
     def release(self) -> None:
-        cur = self._read()
-        if cur is not None and cur.holder == self.identity:
-            try:
-                os.unlink(self.path)
-            except OSError:
-                pass
-
-
-class _LockFile:
-    """O_CREAT|O_EXCL advisory lock with crash-staleness breaking."""
-
-    def __init__(self, path: str, stale_after: float) -> None:
-        self.path = path
-        self.stale_after = stale_after
-
-    def acquire(self) -> bool:
-        d = os.path.dirname(self.path) or "."
-        os.makedirs(d, exist_ok=True)
+        """Release the lease, re-checking ownership UNDER the mutex — a
+        release racing a successor's acquire must not unlink the
+        successor's valid lease."""
+        mutex = self._mutex()
+        if not mutex.acquire():
+            return  # contended; our lease (if any) will simply expire
         try:
-            fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-            os.close(fd)
-            return True
-        except FileExistsError:
-            try:
-                age = time.time() - os.stat(self.path).st_mtime
-            except OSError:
-                return False
-            if age > self.stale_after:
-                # presumed crashed holder: break the lock and retry once
+            cur = self._read()
+            if cur is not None and cur.holder == self.identity:
                 try:
                     os.unlink(self.path)
                 except OSError:
                     pass
-                try:
-                    fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-                    os.close(fd)
-                    return True
-                except FileExistsError:
-                    return False
+        finally:
+            mutex.release()
+
+
+class _LockFile:
+    """Advisory mutex via kernel flock on a persistent file. Crash-safe:
+    the kernel drops the lock when the holding process dies, so no
+    staleness-breaking (and none of its TOCTOU races) is needed."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._fd: Optional[int] = None
+
+    def acquire(self) -> bool:
+        import fcntl
+
+        d = os.path.dirname(self.path) or "."
+        os.makedirs(d, exist_ok=True)
+        fd = os.open(self.path, os.O_CREAT | os.O_RDWR)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
             return False
+        self._fd = fd
+        return True
 
     def release(self) -> None:
-        try:
-            os.unlink(self.path)
-        except OSError:
-            pass
+        import fcntl
+
+        if self._fd is not None:
+            try:
+                fcntl.flock(self._fd, fcntl.LOCK_UN)
+            finally:
+                os.close(self._fd)
+                self._fd = None
 
 
 class LeaderElector:

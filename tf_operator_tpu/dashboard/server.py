@@ -45,7 +45,7 @@ _UI_HTML = """<!doctype html>
  table{border-collapse:collapse;width:100%;background:#fff}
  th,td{border:1px solid #ddd;padding:6px 10px;text-align:left;font-size:14px}
  th{background:#f0f0f0} h1{font-size:20px}
- .Succeeded{color:#0a7d32}.Failed{color:#c0392b}.Running{color:#1a6fb5}
+ .Done{color:#0a7d32}.Failed{color:#c0392b}.Running{color:#1a6fb5}
 </style></head>
 <body><h1>TPUJob dashboard</h1><table id="jobs"><thead>
 <tr><th>Namespace</th><th>Name</th><th>Phase</th><th>Replicas</th>

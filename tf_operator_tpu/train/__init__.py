@@ -7,6 +7,10 @@ is a config, not a program. Exceeds the reference's observability bar
 """
 
 from tf_operator_tpu.train.trainer import TrainState, Trainer, TrainerConfig  # noqa: F401
+from tf_operator_tpu.train.checkpoint import (  # noqa: F401
+    CheckpointManager,
+    WorkloadCheckpointer,
+)
 from tf_operator_tpu.train.metrics import (  # noqa: F401
     StepTimer,
     host_fetch,

@@ -99,6 +99,9 @@ class Trainer:
         shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
         self._has_extra = isinstance(shapes, tuple)
         self._params_shape = shapes[0] if self._has_extra else shapes
+        self._extra_shape = shapes[1] if self._has_extra else None
+        self._opt_shape_cache = None
+        self._opt_shardings_cache = None
         if logical_axes is None:
             self.param_shardings = jax.tree_util.tree_map(
                 lambda _: self._repl, self._params_shape
@@ -138,6 +141,11 @@ class Trainer:
         params, opt_state, step, extra = self._init_jit(key)
         return TrainState(params, opt_state, step, extra)
 
+    def _opt_shape(self):
+        if self._opt_shape_cache is None:
+            self._opt_shape_cache = jax.eval_shape(self.tx.init, self._params_shape)
+        return self._opt_shape_cache
+
     def _opt_shardings(self):
         """Optimizer slots inherit their param's sharding, matched by tree
         PATH (optimizer moment trees embed the param tree, e.g.
@@ -145,7 +153,9 @@ class Trainer:
         collide for same-shape params with transposed shardings (wq vs wo
         when n_heads*head_dim == d_model). Scalars and unmatched leaves
         replicate."""
-        opt_shape = jax.eval_shape(self.tx.init, self._params_shape)
+        if self._opt_shardings_cache is not None:
+            return self._opt_shardings_cache
+        opt_shape = self._opt_shape()
         param_leaves = jax.tree_util.tree_flatten_with_path(self._params_shape)[0]
         sharding_leaves = jax.tree_util.tree_flatten(self.param_shardings)[0]
         path_map = {}
@@ -164,7 +174,46 @@ class Trainer:
                     break
             return self._repl
 
-        return jax.tree_util.tree_map_with_path(pick, opt_shape)
+        self._opt_shardings_cache = jax.tree_util.tree_map_with_path(pick, opt_shape)
+        return self._opt_shardings_cache
+
+    def state_template(self) -> "TrainState":
+        """Abstract TrainState (ShapeDtypeStructs carrying shardings) —
+        the restore target for CheckpointManager.restore without paying
+        an init compile."""
+        opt_shape = self._opt_shape()
+        opt_shardings = self._opt_shardings()
+
+        def tag(shape_tree, sharding_tree):
+            return jax.tree_util.tree_map(
+                lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+                shape_tree,
+                sharding_tree,
+            )
+
+        extra = (
+            jax.tree_util.tree_map(
+                lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=self._repl),
+                self._extra_shape,
+            )
+            if self._extra_shape is not None
+            else None
+        )
+        return TrainState(
+            params=tag(self._params_shape, self.param_shardings),
+            opt_state=tag(opt_shape, opt_shardings),
+            step=jax.ShapeDtypeStruct((), jnp.int32, sharding=self._repl),
+            extra=extra,
+        )
+
+    def restore_or_init(self, key, ckpt=None) -> "TrainState":
+        """Resume from ``ckpt``'s latest checkpoint if one exists, else
+        fresh init — the restart-based recovery contract (SURVEY.md §5):
+        the controller's gang restart relaunches the workload, which lands
+        here and picks up at the saved step."""
+        if ckpt is not None and ckpt.latest_step() is not None:
+            return ckpt.restore(self.state_template())
+        return self.init(key)
 
     # ---- step -----------------------------------------------------------
 

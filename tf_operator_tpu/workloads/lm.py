@@ -6,7 +6,9 @@ the sharded Trainer on synthetic tokens, logs tokens/sec and MFU.
 
 workload config keys: preset ("tiny"|"gpt-small"|"bert-base"|"llama2-7b"|
 "llama2-13b"), steps, batch_size, seq_len, lr, attn ("dense"|"ring"),
-plus any TransformerConfig field as an override (e.g. n_layers).
+checkpoint_dir, checkpoint_every (steps between saves; restart-based
+recovery resumes from the latest checkpoint), plus any TransformerConfig
+field as an override (e.g. n_layers).
 """
 
 from __future__ import annotations
@@ -62,27 +64,44 @@ def main(ctx: JobContext) -> None:
             optimizer="adamw", learning_rate=float(wl.get("lr", 3e-4)),
         ),
     )
-    state = trainer.init(jax.random.PRNGKey(0))
+    from tf_operator_tpu.train.checkpoint import WorkloadCheckpointer
+
+    ckpt = WorkloadCheckpointer(wl)
+    state = ckpt.restore_or_init(trainer, jax.random.PRNGKey(0))
+    if ckpt.is_complete(steps):
+        log.info("already complete at step %d (budget %d); nothing to do",
+                 ckpt.start_step, steps)
+        return
+    timed = ckpt.timed_steps(steps)
     tokens = jax.device_put(
         jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab),
         trainer.batch_sharding,
     )
 
     state, m = trainer.step(state, tokens)
+    ckpt.advance(state)
     host_fetch(m["loss"])  # compile boundary
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for _ in range(timed):
         state, m = trainer.step(state, tokens)
+        ckpt.advance(state)
     loss = float(m["loss"])
-    step_s = (time.perf_counter() - t0) / steps
-    n_chips = mesh.devices.size
-    flops = transformer_train_flops(cfg.n_params(), batch * seq)
-    log.info(
-        "lm done: preset=%s loss=%.4f step=%.2fms tok/s=%.0f mfu=%.3f (%d chips)",
-        wl.get("preset", "tiny"), loss, step_s * 1e3, batch * seq / step_s,
-        mfu(flops, step_s, n_chips), n_chips,
-    )
+    if timed:
+        step_s = (time.perf_counter() - t0) / timed
+        n_chips = mesh.devices.size
+        flops = transformer_train_flops(cfg.n_params(), batch * seq)
+        log.info(
+            "lm done: preset=%s loss=%.4f step=%.2fms tok/s=%.0f mfu=%.3f (%d chips)",
+            wl.get("preset", "tiny"), loss, step_s * 1e3, batch * seq / step_s,
+            mfu(flops, step_s, n_chips), n_chips,
+        )
+    else:
+        log.info("lm done: preset=%s loss=%.4f (no timed steps remained)",
+                 wl.get("preset", "tiny"), loss)
     import math
 
     if not math.isfinite(loss):
+        # deliberately NOT checkpointed: saving a diverged state would make
+        # it the latest checkpoint and poison every restart's resume
         raise AssertionError(f"non-finite loss {loss}")
+    ckpt.final(state)

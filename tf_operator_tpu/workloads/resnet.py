@@ -5,7 +5,7 @@ entrypoint: joins the gang, builds the mesh, trains ResNet on synthetic
 ImageNet-shaped data with the sharded Trainer, logs step time and MFU.
 
 workload config keys: steps, batch_size, image_size, num_classes, lr,
-variant ("resnet50"|"resnet18").
+variant ("resnet50"|"resnet18"), checkpoint_dir, checkpoint_every.
 """
 
 from __future__ import annotations
@@ -53,7 +53,15 @@ def main(ctx: JobContext) -> None:
             optimizer="sgd", learning_rate=float(wl.get("lr", 0.1)), grad_clip=None
         ),
     )
-    state = trainer.init(jax.random.PRNGKey(0))
+    from tf_operator_tpu.train.checkpoint import WorkloadCheckpointer
+
+    ckpt = WorkloadCheckpointer(wl)
+    state = ckpt.restore_or_init(trainer, jax.random.PRNGKey(0))
+    if ckpt.is_complete(steps):
+        log.info("already complete at step %d (budget %d); nothing to do",
+                 ckpt.start_step, steps)
+        return
+    timed = ckpt.timed_steps(steps)
     images = jax.device_put(
         jax.random.normal(jax.random.PRNGKey(1), (batch, image_size, image_size, 3)),
         trainer.batch_sharding,
@@ -67,17 +75,25 @@ def main(ctx: JobContext) -> None:
     import time
 
     state, m = trainer.step(state, data)
+    ckpt.advance(state)
     host_fetch(m["loss"])  # compile boundary
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for _ in range(timed):
         state, m = trainer.step(state, data)
+        ckpt.advance(state)
     loss = float(m["loss"])
-    step_s = (time.perf_counter() - t0) / steps
-    n_chips = mesh.devices.size
-    flops = resnet_train_flops(cfg.flops_per_image(image_size), batch)
-    log.info(
-        "resnet done: loss=%.4f step=%.2fms imgs/s=%.0f mfu=%.3f (%d chips)",
-        loss, step_s * 1e3, batch / step_s, mfu(flops, step_s, n_chips), n_chips,
-    )
+    if timed:
+        step_s = (time.perf_counter() - t0) / timed
+        n_chips = mesh.devices.size
+        flops = resnet_train_flops(cfg.flops_per_image(image_size), batch)
+        log.info(
+            "resnet done: loss=%.4f step=%.2fms imgs/s=%.0f mfu=%.3f (%d chips)",
+            loss, step_s * 1e3, batch / step_s, mfu(flops, step_s, n_chips), n_chips,
+        )
+    else:
+        log.info("resnet done: loss=%.4f (no timed steps remained)", loss)
     if not jnp.isfinite(jnp.asarray(loss)):
+        # deliberately NOT checkpointed: saving a diverged state would make
+        # it the latest checkpoint and poison every restart's resume
         raise AssertionError(f"non-finite loss {loss}")
+    ckpt.final(state)
