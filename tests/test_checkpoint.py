@@ -162,3 +162,59 @@ def test_npy_restore_rejects_tree_drift(tmp_path):
     mgr.save(1, {"a": jnp.ones((2,)), "b": jnp.zeros((3,))})
     with pytest.raises(ValueError, match="does not match"):
         mgr.restore({"a": jnp.ones((2,)), "c": jnp.zeros((3,))})
+
+
+def test_npy_restore_rejects_shape_dtype_drift(tmp_path):
+    """Same tree structure but a changed leaf shape (config drift, e.g.
+    d_model bumped) or dtype must fail loudly at restore time."""
+    mgr = CheckpointManager(tmp_path / "shape", backend="npy")
+    mgr.save(1, {"w": jnp.ones((2, 4)), "b": jnp.zeros((4,))})
+    with pytest.raises(ValueError, match="config changed"):
+        mgr.restore({"w": jnp.ones((2, 8)), "b": jnp.zeros((8,))})
+    with pytest.raises(ValueError, match="config changed"):
+        mgr.restore(
+            {"w": jnp.ones((2, 4), jnp.bfloat16), "b": jnp.zeros((4,), jnp.bfloat16)}
+        )
+
+
+def test_npy_orphan_tmp_dirs_swept(tmp_path):
+    """A crash mid-save leaves .tmp_step_* behind; a fresh manager (new
+    process incarnation) must sweep it."""
+    import os
+
+    root = tmp_path / "orphans"
+    mgr = CheckpointManager(root, backend="npy")
+    mgr.save(1, {"x": jnp.ones((2,))})
+    orphan = root / ".tmp_step_9_12345"
+    orphan.mkdir()
+    (orphan / "leaf_0.npy").write_bytes(b"partial")
+    mgr2 = CheckpointManager(root, backend="npy")
+    assert not orphan.exists()
+    assert mgr2.all_steps() == [1]
+
+
+def test_workload_checkpointer_refuses_nan_save(tmp_path):
+    """A periodic save must never checkpoint a diverged state — that would
+    poison every restart's resume."""
+    from tf_operator_tpu.train.checkpoint import WorkloadCheckpointer
+
+    ckpt = WorkloadCheckpointer(
+        {"checkpoint_dir": str(tmp_path / "nan"), "checkpoint_every": 1}
+    )
+    ckpt.advance({"x": jnp.ones((2,))}, loss=1.25)  # finite: saved
+    assert ckpt.manager.all_steps() == [1]
+    with pytest.raises(AssertionError, match="non-finite"):
+        ckpt.advance({"x": jnp.ones((2,))}, loss=float("nan"))
+    assert ckpt.manager.all_steps() == [1]  # nothing new written
+
+
+def test_workload_checkpointer_is_complete_peeks_without_restore(tmp_path):
+    """is_complete must answer from the manifest alone (before any restore)."""
+    from tf_operator_tpu.train.checkpoint import WorkloadCheckpointer
+
+    wl = {"checkpoint_dir": str(tmp_path / "peek"), "checkpoint_every": 1}
+    ckpt = WorkloadCheckpointer(wl)
+    ckpt.manager.save(6, {"x": jnp.ones((2,))})
+    fresh = WorkloadCheckpointer(wl)  # new incarnation, nothing restored
+    assert fresh.is_complete(5)  # 6 >= 5 + 1 (warmup step)
+    assert not fresh.is_complete(10)

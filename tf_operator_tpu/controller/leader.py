@@ -102,16 +102,18 @@ class FileLease:
         finally:
             mutex.release()
 
-    def renew(self) -> bool:
+    def renew(self, stop: Optional[threading.Event] = None) -> bool:
         """Renew the held lease. Mutex contention (a standby candidate
         holding the .lock file for its few-ms expiry check) is NOT lease
         loss — while the record still names us and the renew budget lasts,
         keep retrying; only a record naming someone else (or gone) means
         the lease was genuinely taken. The retry budget is the lease's own
         expiry (not renew_period): until the record we hold actually
-        expires there is no reason to abdicate — a leaked lockfile from a
-        crashed candidate is broken by _LockFile staleness within that
-        window."""
+        expires there is no reason to abdicate. A mutex held by a DEAD
+        candidate is released by the kernel (flock); one held by a hung
+        but alive thread is never broken — we simply time out at lease
+        expiry and abdicate. ``stop`` aborts the retry loop early so
+        daemon shutdown never waits out the full lease window."""
         while True:
             cur = self._read()
             if cur is None or cur.holder != self.identity:
@@ -120,7 +122,10 @@ class FileLease:
                 return True
             if time.time() >= cur.renewed + cur.lease_duration:
                 return False
-            time.sleep(0.05)
+            if stop is not None and stop.wait(0.05):
+                return False
+            if stop is None:
+                time.sleep(0.05)
 
     def release(self) -> None:
         """Release the lease, re-checking ownership UNDER the mutex — a
@@ -204,7 +209,9 @@ class LeaderElector:
         self.on_started_leading()
         # renewal loop
         while not self.stop_event.wait(self.lease.renew_period):
-            if not self.lease.renew():
+            if not self.lease.renew(stop=self.stop_event):
+                if self.stop_event.is_set():
+                    break  # shutdown requested mid-renew; release below
                 self.is_leader.clear()
                 self.on_stopped_leading()
                 return

@@ -90,6 +90,14 @@ class CheckpointManager:
                 backend = "npy"
         self.backend = backend
         self._ocp_mgr = None
+        if backend == "npy":
+            # Sweep partial-save orphans: a crash mid-_npy_save leaves a
+            # .tmp_step_* dir that a restarted process (new PID) would
+            # otherwise never clean. The npy backend is single-process
+            # (enforced in _npy_save), so nothing live can own these.
+            for name in os.listdir(self.directory):
+                if name.startswith(".tmp_step_"):
+                    shutil.rmtree(os.path.join(self.directory, name), ignore_errors=True)
         if backend == "orbax":
             import orbax.checkpoint as ocp
 
@@ -156,7 +164,12 @@ class CheckpointManager:
             arr = np.asarray(leaf)
             np.save(os.path.join(tmp, f"leaf_{i}.npy"), arr)
             manifest["leaves"].append(
-                {"path": jax.tree_util.keystr(path), "index": i}
+                {
+                    "path": jax.tree_util.keystr(path),
+                    "index": i,
+                    "shape": list(arr.shape),
+                    "dtype": str(arr.dtype),
+                }
             )
         with open(os.path.join(tmp, "manifest.json"), "w") as f:
             json.dump(manifest, f)
@@ -219,6 +232,22 @@ class CheckpointManager:
         arrays = []
         for i, (path, tmpl_leaf) in enumerate(paths):
             arr = np.load(os.path.join(d, f"leaf_{i}.npy"))
+            rec = manifest["leaves"][i]
+            if "shape" in rec:
+                # Path equality alone misses same-structure config drift
+                # (d_model or dtype changed between save and restore) —
+                # fail loudly instead of device_put-ing wrong arrays.
+                tmpl_shape = tuple(getattr(tmpl_leaf, "shape", np.shape(tmpl_leaf)))
+                tmpl_dtype = np.dtype(
+                    getattr(tmpl_leaf, "dtype", None) or np.asarray(tmpl_leaf).dtype
+                )
+                if tuple(rec["shape"]) != tmpl_shape or np.dtype(rec["dtype"]) != tmpl_dtype:
+                    raise ValueError(
+                        f"checkpoint leaf {rec['path']} at step {step} is "
+                        f"{rec['dtype']}{tuple(rec['shape'])} but the restore "
+                        f"template expects {tmpl_dtype}{tmpl_shape} — model/"
+                        "optimizer config changed between save and restore"
+                    )
             sharding = getattr(tmpl_leaf, "sharding", None)
             if sharding is not None:
                 arrays.append(jax.device_put(arr, sharding))
@@ -266,7 +295,13 @@ class WorkloadCheckpointer:
 
     def is_complete(self, steps: int) -> bool:
         """True when a previous run already trained past the step budget
-        (the +1 accounts for the warmup step, which also trains)."""
+        (the +1 accounts for the warmup step, which also trains). Peeks at
+        the manifest only — call BEFORE restore_or_init so an
+        already-complete job skips the full (possibly many-GB) restore."""
+        if self.manager is not None:
+            latest = self.manager.latest_step()
+            if latest is not None:
+                return latest >= steps + 1
         return self.start_step >= steps + 1
 
     def timed_steps(self, steps: int) -> int:
@@ -274,10 +309,23 @@ class WorkloadCheckpointer:
         0 means throughput numbers would be meaningless — don't log them."""
         return max(0, steps - self.start_step)
 
-    def advance(self, state) -> None:
-        """Call once per trainer.step; saves when a periodic save is due."""
+    def advance(self, state, loss=None) -> None:
+        """Call once per trainer.step; saves when a periodic save is due.
+
+        Pass the step's loss so a diverged state is never checkpointed —
+        saving NaN params would make them the latest checkpoint and poison
+        every restart's resume into a permanent crash loop. The finiteness
+        check fetches the loss to host, but only on saving steps, so the
+        hot loop stays sync-free."""
+        import math
+
         self._step += 1
         if self.manager is not None and self.every and self._step % self.every == 0:
+            if loss is not None and not math.isfinite(float(loss)):
+                raise AssertionError(
+                    f"non-finite loss {float(loss)} at step {self._step}; "
+                    "refusing to checkpoint a diverged state"
+                )
             self.manager.save(self._step, state)
 
     def final(self, state) -> None:
@@ -285,6 +333,37 @@ class WorkloadCheckpointer:
         write never pollutes step-time/MFU telemetry."""
         if self.manager is not None:
             self.manager.save(self._step, state)
+
+    def run_loop(self, trainer, key, batch, steps: int):
+        """The one warmup+timed train loop shared by workloads.
+
+        restore-or-init → warmup step (compile boundary) → ``steps -
+        start_step`` timed steps with periodic NaN-gated saves → finiteness
+        guard → final save. Returns ``(state, loss, timed, step_s)`` where
+        ``step_s`` is None when no timed steps remained. Callers must check
+        :meth:`is_complete` first."""
+        import math
+        import time
+
+        from tf_operator_tpu.train.metrics import host_fetch
+
+        state = self.restore_or_init(trainer, key)
+        timed = self.timed_steps(steps)
+        state, m = trainer.step(state, batch)
+        self.advance(state, loss=m["loss"])
+        host_fetch(m["loss"])  # compile boundary
+        t0 = time.perf_counter()
+        for _ in range(timed):
+            state, m = trainer.step(state, batch)
+            self.advance(state, loss=m["loss"])
+        loss = float(m["loss"])
+        step_s = (time.perf_counter() - t0) / timed if timed else None
+        if not math.isfinite(loss):
+            # deliberately NOT checkpointed: saving a diverged state would
+            # poison every restart's resume
+            raise AssertionError(f"non-finite loss {loss}")
+        self.final(state)
+        return state, loss, timed, step_s
 
 
 def _abstractify(tree: Any) -> Any:

@@ -28,8 +28,6 @@ _CFG_FIELDS = {
 def main(ctx: JobContext) -> None:
     ctx.initialize_distributed()
 
-    import time
-
     import jax
 
     from tf_operator_tpu.models.transformer import (
@@ -38,7 +36,7 @@ def main(ctx: JobContext) -> None:
         preset,
         transformer_logical_axes,
     )
-    from tf_operator_tpu.train.metrics import host_fetch, mfu, transformer_train_flops
+    from tf_operator_tpu.train.metrics import mfu, transformer_train_flops
     from tf_operator_tpu.train.trainer import Trainer, TrainerConfig
 
     wl = ctx.workload
@@ -67,27 +65,17 @@ def main(ctx: JobContext) -> None:
     from tf_operator_tpu.train.checkpoint import WorkloadCheckpointer
 
     ckpt = WorkloadCheckpointer(wl)
-    state = ckpt.restore_or_init(trainer, jax.random.PRNGKey(0))
     if ckpt.is_complete(steps):
-        log.info("already complete at step %d (budget %d); nothing to do",
-                 ckpt.start_step, steps)
+        log.info("already complete (budget %d); nothing to do", steps)
         return
-    timed = ckpt.timed_steps(steps)
     tokens = jax.device_put(
         jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab),
         trainer.batch_sharding,
     )
-
-    state, m = trainer.step(state, tokens)
-    ckpt.advance(state)
-    host_fetch(m["loss"])  # compile boundary
-    t0 = time.perf_counter()
-    for _ in range(timed):
-        state, m = trainer.step(state, tokens)
-        ckpt.advance(state)
-    loss = float(m["loss"])
-    if timed:
-        step_s = (time.perf_counter() - t0) / timed
+    state, loss, timed, step_s = ckpt.run_loop(
+        trainer, jax.random.PRNGKey(0), tokens, steps
+    )
+    if step_s is not None:
         n_chips = mesh.devices.size
         flops = transformer_train_flops(cfg.n_params(), batch * seq)
         log.info(
@@ -98,10 +86,3 @@ def main(ctx: JobContext) -> None:
     else:
         log.info("lm done: preset=%s loss=%.4f (no timed steps remained)",
                  wl.get("preset", "tiny"), loss)
-    import math
-
-    if not math.isfinite(loss):
-        # deliberately NOT checkpointed: saving a diverged state would make
-        # it the latest checkpoint and poison every restart's resume
-        raise AssertionError(f"non-finite loss {loss}")
-    ckpt.final(state)

@@ -24,7 +24,7 @@ def main(ctx: JobContext) -> None:
     import jax.numpy as jnp
 
     from tf_operator_tpu.models.resnet import ResNetConfig, init_resnet, resnet_forward
-    from tf_operator_tpu.train.metrics import host_fetch, mfu, resnet_train_flops
+    from tf_operator_tpu.train.metrics import mfu, resnet_train_flops
     from tf_operator_tpu.train.trainer import Trainer, TrainerConfig
 
     wl = ctx.workload
@@ -56,12 +56,9 @@ def main(ctx: JobContext) -> None:
     from tf_operator_tpu.train.checkpoint import WorkloadCheckpointer
 
     ckpt = WorkloadCheckpointer(wl)
-    state = ckpt.restore_or_init(trainer, jax.random.PRNGKey(0))
     if ckpt.is_complete(steps):
-        log.info("already complete at step %d (budget %d); nothing to do",
-                 ckpt.start_step, steps)
+        log.info("already complete (budget %d); nothing to do", steps)
         return
-    timed = ckpt.timed_steps(steps)
     images = jax.device_put(
         jax.random.normal(jax.random.PRNGKey(1), (batch, image_size, image_size, 3)),
         trainer.batch_sharding,
@@ -71,19 +68,10 @@ def main(ctx: JobContext) -> None:
         trainer.batch_sharding,
     )
     data = (images, labels)
-
-    import time
-
-    state, m = trainer.step(state, data)
-    ckpt.advance(state)
-    host_fetch(m["loss"])  # compile boundary
-    t0 = time.perf_counter()
-    for _ in range(timed):
-        state, m = trainer.step(state, data)
-        ckpt.advance(state)
-    loss = float(m["loss"])
-    if timed:
-        step_s = (time.perf_counter() - t0) / timed
+    state, loss, timed, step_s = ckpt.run_loop(
+        trainer, jax.random.PRNGKey(0), data, steps
+    )
+    if step_s is not None:
         n_chips = mesh.devices.size
         flops = resnet_train_flops(cfg.flops_per_image(image_size), batch)
         log.info(
@@ -92,8 +80,3 @@ def main(ctx: JobContext) -> None:
         )
     else:
         log.info("resnet done: loss=%.4f (no timed steps remained)", loss)
-    if not jnp.isfinite(jnp.asarray(loss)):
-        # deliberately NOT checkpointed: saving a diverged state would make
-        # it the latest checkpoint and poison every restart's resume
-        raise AssertionError(f"non-finite loss {loss}")
-    ckpt.final(state)
