@@ -1,6 +1,9 @@
-"""Process backend tests: fake records intents; real backend launches OS
-processes and reports phase/exit codes into the store."""
+"""Process backend tests: fake records intents; the real backends (pure
+Python and native C++ supervisor) launch OS processes and report
+phase/exit codes into the store. Lifecycle tests run against BOTH real
+backends — behavioral parity between them is itself the contract."""
 
+import os
 import sys
 
 import pytest
@@ -10,11 +13,14 @@ from conftest import wait_for
 from tf_operator_tpu.runtime import (
     FakeProcessControl,
     LocalProcessControl,
+    NativeProcessControl,
     Process,
     ProcessPhase,
     ProcessSpec,
     Store,
 )
+
+BACKENDS = [LocalProcessControl, NativeProcessControl]
 
 
 def proc(name, env=None):
@@ -22,8 +28,6 @@ def proc(name, env=None):
         metadata=ObjectMeta(name=name),
         spec=ProcessSpec(job_name="j", replica_type="Worker", env=env or {}),
     )
-
-
 
 
 def test_fake_records_actions():
@@ -50,9 +54,10 @@ def script_builder(code):
     return build
 
 
-def test_local_backend_success_cycle():
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_backend_success_cycle(backend):
     store = Store()
-    ctl = LocalProcessControl(store, command_builder=script_builder("import sys; sys.exit(0)"))
+    ctl = backend(store, command_builder=script_builder("import sys; sys.exit(0)"))
     ctl.create_process(proc("ok"))
     assert wait_for(
         lambda: store.get("Process", "default", "ok").status.phase is ProcessPhase.SUCCEEDED
@@ -61,9 +66,10 @@ def test_local_backend_success_cycle():
     assert st.exit_code == 0 and st.pid is not None
 
 
-def test_local_backend_failure_exit_code():
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_backend_failure_exit_code(backend):
     store = Store()
-    ctl = LocalProcessControl(store, command_builder=script_builder("import sys; sys.exit(7)"))
+    ctl = backend(store, command_builder=script_builder("import sys; sys.exit(7)"))
     ctl.create_process(proc("bad"))
     assert wait_for(
         lambda: store.get("Process", "default", "bad").status.phase is ProcessPhase.FAILED
@@ -71,18 +77,20 @@ def test_local_backend_failure_exit_code():
     assert store.get("Process", "default", "bad").status.exit_code == 7
 
 
-def test_local_backend_env_injection():
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_backend_env_injection(backend):
     store = Store()
     code = "import os, sys; sys.exit(3 if os.environ.get('TPUJOB_X') == 'y' else 1)"
-    ctl = LocalProcessControl(store, command_builder=script_builder(code))
+    ctl = backend(store, command_builder=script_builder(code))
     ctl.create_process(proc("envy", env={"TPUJOB_X": "y"}))
     assert wait_for(lambda: store.get("Process", "default", "envy").is_finished())
     assert store.get("Process", "default", "envy").status.exit_code == 3
 
 
-def test_local_backend_delete_terminates_running_child():
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_backend_delete_terminates_running_child(backend):
     store = Store()
-    ctl = LocalProcessControl(store, command_builder=script_builder("import time; time.sleep(60)"))
+    ctl = backend(store, command_builder=script_builder("import time; time.sleep(60)"))
     ctl.create_process(proc("sleeper"))
     assert wait_for(
         lambda: store.get("Process", "default", "sleeper").status.phase is ProcessPhase.RUNNING
@@ -96,15 +104,100 @@ def test_local_backend_delete_terminates_running_child():
     assert not ctl._children
 
 
-def test_local_backend_bad_command_reports_failed():
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_backend_bad_command_reports_failed(backend):
     store = Store()
 
     def build(process):
         return ["/nonexistent/binary"]
 
-    ctl = LocalProcessControl(store, command_builder=build)
+    ctl = backend(store, command_builder=build)
     ctl.create_process(proc("ghost"))
     assert wait_for(
         lambda: store.get("Process", "default", "ghost").status.phase is ProcessPhase.FAILED
     )
     assert store.get("Process", "default", "ghost").status.exit_code == 127
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_backend_log_capture(backend, tmp_path):
+    store = Store()
+    ctl = backend(
+        store,
+        command_builder=script_builder("print('hello from child', flush=True)"),
+        log_dir=str(tmp_path),
+    )
+    ctl.create_process(proc("logged"))
+    assert wait_for(lambda: store.get("Process", "default", "logged").is_finished())
+    log = tmp_path / "default_logged.log"
+    assert wait_for(lambda: log.exists() and b"hello from child" in log.read_bytes())
+
+
+# ---- native-supervisor specifics -----------------------------------------
+
+
+def test_native_normalizes_signal_exit_codes():
+    """A SIGTERM death must surface as 143 (128+15) — the convention the
+    exit-code taxonomy (train_util.go:18-53) classifies as retryable — not
+    Python's -15."""
+    store = Store()
+    code = "import os, signal; os.kill(os.getpid(), signal.SIGTERM)"
+    ctl = NativeProcessControl(store, command_builder=script_builder(code))
+    ctl.create_process(proc("sig"))
+    assert wait_for(lambda: store.get("Process", "default", "sig").is_finished())
+    assert store.get("Process", "default", "sig").status.exit_code == 143
+
+    from tf_operator_tpu.utils.exit_codes import is_retryable
+
+    assert is_retryable(143)
+
+
+def test_native_group_kill_reaps_grandchildren():
+    """Deleting a process must take down children IT forked (the C++
+    supervisor signals the whole setsid process group)."""
+    import subprocess
+
+    store = Store()
+    marker = "tpujob-native-grandchild-marker"
+    # Child forks a grandchild (identifiable via argv marker) then sleeps.
+    code = (
+        "import subprocess, sys, time; "
+        f"subprocess.Popen([sys.executable, '-c', 'import time; time.sleep(300)', '{marker}']); "
+        "time.sleep(300)"
+    )
+    ctl = NativeProcessControl(store, command_builder=script_builder(code))
+    ctl.create_process(proc("forker"))
+    assert wait_for(
+        lambda: store.get("Process", "default", "forker").status.phase is ProcessPhase.RUNNING
+    )
+
+    def grandchild_alive():
+        out = subprocess.run(["pgrep", "-f", marker], capture_output=True, text=True)
+        return out.returncode == 0
+
+    assert wait_for(grandchild_alive)
+    ctl.delete_process("default", "forker")
+    assert wait_for(lambda: not grandchild_alive(), timeout=10)
+
+
+def test_native_exec_failure_carries_errno():
+    """Exec failures surface synchronously with the child-side errno."""
+    from tf_operator_tpu.runtime.native import NativeSupervisor
+
+    sup = NativeSupervisor()
+    with pytest.raises(OSError) as exc_info:
+        sup.spawn(["/nonexistent/binary"], {"PATH": "/usr/bin"})
+    assert exc_info.value.errno == 2  # ENOENT
+
+
+def test_native_registry_does_not_leak():
+    """Consumed children are forgotten (pids recycle; stale done-entries
+    would lie about future children)."""
+    from tf_operator_tpu.runtime.native import NativeSupervisor
+
+    sup = NativeSupervisor()
+    before = sup.tracked_count()
+    children = [sup.spawn([sys.executable, "-c", "pass"], dict(os.environ)) for _ in range(5)]
+    for c in children:
+        assert c.wait() == 0
+    assert sup.tracked_count() == before

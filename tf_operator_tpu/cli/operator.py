@@ -49,6 +49,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="0-10: probability/10 of killing each running process "
                         "per chaos interval (reference flag was unimplemented)")
     p.add_argument("--chaos-interval", type=float, default=10.0)
+    p.add_argument("--backend", choices=("native", "local"), default="native",
+                   help="process runtime: 'native' = C++ supervisor "
+                        "(group kills, normalized exit codes; built on demand), "
+                        "'local' = pure-Python subprocess fallback")
     return p
 
 
@@ -116,10 +120,22 @@ def main(argv=None) -> int:
     from tf_operator_tpu.controller import TPUJobController
     from tf_operator_tpu.controller.leader import FileLease, LeaderElector
     from tf_operator_tpu.dashboard import DashboardServer
-    from tf_operator_tpu.runtime import LocalProcessControl, Store
+    from tf_operator_tpu.runtime import LocalProcessControl, NativeProcessControl, Store
 
     store = Store()
-    backend = LocalProcessControl(store, log_dir=args.log_dir)
+    if args.backend == "native":
+        from tf_operator_tpu.runtime.native import NativeBuildError
+
+        try:
+            backend = NativeProcessControl(store, log_dir=args.log_dir)
+        except (NativeBuildError, OSError) as exc:
+            # Toolchain missing/broken: degrade, don't die. Anything else
+            # (a bug in the binding) must surface, not silently lose the
+            # native guarantees (group kills, normalized exit codes).
+            log.warning("native supervisor unavailable (%s); using local backend", exc)
+            backend = LocalProcessControl(store, log_dir=args.log_dir)
+    else:
+        backend = LocalProcessControl(store, log_dir=args.log_dir)
     controller = TPUJobController(
         store, backend, resync_period=args.resync_period
     )

@@ -35,5 +35,6 @@ from tf_operator_tpu.runtime.store import (  # noqa: F401
 from tf_operator_tpu.runtime.process_backend import (  # noqa: F401
     FakeProcessControl,
     LocalProcessControl,
+    NativeProcessControl,
     ProcessControl,
 )

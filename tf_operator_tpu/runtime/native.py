@@ -1,0 +1,222 @@
+"""ctypes binding for the native (C++) process supervisor.
+
+The compiled half of the runtime substrate (native/supervisor.cc): spawn
+with setsid + log redirection, thread-safe wait/poll with normalized exit
+codes (128+signal for signal deaths — the convention the exit-code
+taxonomy, reference pkg/util/train/train_util.go:18-53, is written
+against), and group-kill with grace escalation. This module loads the
+shared library, building it on demand with g++ (the toolchain is part of
+the runtime environment; there is no separate install step, mirroring how
+the reference ships its Go operator as one self-contained binary).
+
+``NativeChild`` adapts a supervised pid to the subset of the
+``subprocess.Popen`` surface the process backend drives (pid / poll /
+wait / terminate / kill), so ``NativeProcessControl`` reuses the whole
+monitor/status machinery of ``LocalProcessControl`` unchanged.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import time
+from typing import Dict, List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+LIB_PATH = os.path.join(NATIVE_DIR, "build", "libtpujob_supervisor.so")
+_SOURCE = os.path.join(NATIVE_DIR, "supervisor.cc")
+
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _fresh() -> bool:
+    return os.path.exists(LIB_PATH) and (
+        not os.path.exists(_SOURCE)
+        or os.path.getmtime(LIB_PATH) >= os.path.getmtime(_SOURCE)
+    )
+
+
+def ensure_built() -> str:
+    """Compile the supervisor library if missing or older than its source.
+
+    Safe across threads (in-process lock) AND processes (flock + compile to
+    a temp name, atomically os.replace'd in): several operator candidates
+    on one host may race here, and dlopen of a half-written .so crashes."""
+    import fcntl
+
+    with _build_lock:
+        if _fresh():
+            return LIB_PATH
+        if not os.path.exists(_SOURCE):
+            raise NativeBuildError(f"native source not found: {_SOURCE}")
+        os.makedirs(os.path.dirname(LIB_PATH), exist_ok=True)
+        lock_fd = os.open(LIB_PATH + ".buildlock", os.O_CREAT | os.O_RDWR)
+        try:
+            fcntl.flock(lock_fd, fcntl.LOCK_EX)
+            if _fresh():  # another process built it while we waited
+                return LIB_PATH
+            tmp = f"{LIB_PATH}.{os.getpid()}.tmp"
+            cmd = [
+                os.environ.get("CXX", "g++"),
+                "-std=c++17", "-O2", "-Wall", "-Wextra", "-fPIC", "-pthread",
+                "-shared", "-o", tmp, _SOURCE,
+            ]
+            try:
+                proc = subprocess.run(
+                    cmd, cwd=NATIVE_DIR, capture_output=True, text=True, timeout=120
+                )
+            except (OSError, subprocess.TimeoutExpired) as exc:
+                raise NativeBuildError(f"failed to run {cmd[0]}: {exc}") from exc
+            if proc.returncode != 0:
+                raise NativeBuildError(
+                    f"native build failed ({proc.returncode}):\n{proc.stderr}"
+                )
+            os.replace(tmp, LIB_PATH)
+            return LIB_PATH
+        finally:
+            os.close(lock_fd)
+
+
+def load_library() -> ctypes.CDLL:
+    """Load (building if needed) the supervisor library; cached."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = ensure_built()
+    lib = ctypes.CDLL(path)
+    lib.tpuj_spawn.restype = ctypes.c_long
+    lib.tpuj_spawn.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+    ]
+    lib.tpuj_wait.restype = ctypes.c_int
+    lib.tpuj_wait.argtypes = [ctypes.c_long]
+    lib.tpuj_poll.restype = ctypes.c_int
+    lib.tpuj_poll.argtypes = [ctypes.c_long, ctypes.POINTER(ctypes.c_int)]
+    lib.tpuj_signal.restype = ctypes.c_int
+    lib.tpuj_signal.argtypes = [ctypes.c_long, ctypes.c_int]
+    lib.tpuj_terminate.restype = ctypes.c_int
+    lib.tpuj_terminate.argtypes = [ctypes.c_long, ctypes.c_int]
+    lib.tpuj_forget.restype = None
+    lib.tpuj_forget.argtypes = [ctypes.c_long]
+    lib.tpuj_tracked_count.restype = ctypes.c_int
+    lib.tpuj_tracked_count.argtypes = []
+    _lib = lib
+    return lib
+
+
+def _c_str_array(items: List[bytes]) -> ctypes.Array:
+    arr = (ctypes.c_char_p * (len(items) + 1))()
+    for i, s in enumerate(items):
+        arr[i] = s
+    arr[len(items)] = None
+    return arr
+
+
+class NativeChild:
+    """Popen-compatible handle over one supervised pid."""
+
+    def __init__(self, lib: ctypes.CDLL, pid: int) -> None:
+        self._lib = lib
+        self.pid = pid
+        self.returncode: Optional[int] = None
+
+    def _finish(self, code: int) -> int:
+        if self.returncode is None:
+            self.returncode = code
+            self._lib.tpuj_forget(self.pid)  # pid may recycle; drop the slot
+        return self.returncode
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is not None:
+            return self.returncode
+        code = ctypes.c_int()
+        if self._lib.tpuj_poll(self.pid, ctypes.byref(code)) == 1:
+            return self._finish(code.value)
+        return None
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        if self.returncode is not None:
+            return self.returncode
+        if timeout is None:
+            # Blocking waitpid in C; ctypes releases the GIL for the call.
+            return self._finish(self._lib.tpuj_wait(self.pid))
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            rc = self.poll()
+            if rc is not None:
+                return rc
+            time.sleep(0.01)
+        rc = self.poll()
+        if rc is not None:
+            return rc
+        raise subprocess.TimeoutExpired(cmd=f"pid {self.pid}", timeout=timeout)
+
+    def terminate(self) -> None:
+        import signal as _signal
+
+        self._lib.tpuj_signal(self.pid, _signal.SIGTERM)
+
+    def kill(self) -> None:
+        import signal as _signal
+
+        self._lib.tpuj_signal(self.pid, _signal.SIGKILL)
+
+
+class NativeSupervisor:
+    """Spawn/track children through the native library."""
+
+    def __init__(self) -> None:
+        self._lib = load_library()
+
+    def spawn(
+        self,
+        argv: List[str],
+        env: Dict[str, str],
+        workdir: Optional[str] = None,
+        log_path: Optional[str] = None,
+    ) -> NativeChild:
+        """Launch argv; raises OSError (with the child-side errno for exec
+        failures) so callers report a FAILED process, not a hung one."""
+        if not argv:
+            raise OSError(22, "empty argv")
+        exe = argv[0]
+        if os.sep not in exe:  # execve takes a path, not a $PATH lookup
+            import shutil
+
+            resolved = shutil.which(exe, path=env.get("PATH", os.environ.get("PATH")))
+            if resolved is None:
+                raise OSError(2, f"executable not found: {exe}")
+            argv = [resolved] + list(argv[1:])
+        c_argv = _c_str_array([a.encode() for a in argv])
+        c_envp = _c_str_array([f"{k}={v}".encode() for k, v in env.items()])
+        pid = self._lib.tpuj_spawn(
+            c_argv,
+            c_envp,
+            workdir.encode() if workdir else None,
+            log_path.encode() if log_path else None,
+        )
+        if pid < 0:
+            err = -pid
+            raise OSError(err, f"{os.strerror(err)}: {argv[0]}")
+        return NativeChild(self._lib, pid)
+
+    def terminate(self, child: NativeChild, grace_seconds: float) -> int:
+        """Graceful group stop with native escalation (TERM → grace → KILL)."""
+        if child.returncode is not None:
+            return child.returncode
+        code = self._lib.tpuj_terminate(child.pid, int(grace_seconds * 1000))
+        return child._finish(code)
+
+    def tracked_count(self) -> int:
+        return self._lib.tpuj_tracked_count()

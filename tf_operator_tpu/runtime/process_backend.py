@@ -164,6 +164,24 @@ class LocalProcessControl(ProcessControl):
 
     # -- internals --------------------------------------------------------
 
+    def _spawn(self, process: Process, env: Dict[str, str], log_path: Optional[str]):
+        """Launch the child; returns a Popen-like handle (pid / poll / wait /
+        terminate / kill). Raises OSError on any launch failure (log-file
+        open or exec). The seam NativeProcessControl overrides."""
+        log_file = open(log_path, "ab") if log_path else None
+        try:
+            return subprocess.Popen(
+                self._command_builder(process),
+                env=env,
+                cwd=process.spec.workdir,
+                stdout=log_file,
+                stderr=subprocess.STDOUT if log_file else None,
+                start_new_session=True,  # isolate signals from the operator
+            )
+        finally:
+            if log_file:
+                log_file.close()  # child holds its own descriptor now
+
     def _launch_and_monitor(self, process: Process) -> None:
         key = process.key()
         env = dict(os.environ) if self._inherit_env else {}
@@ -172,30 +190,16 @@ class LocalProcessControl(ProcessControl):
         env.update(identity_env(process.spec, process.metadata.namespace))
         env.update(process.spec.env)
         log_path = process.metadata.annotations.get(self.LOG_ANNOTATION)
-        log_file = None
         try:
-            if log_path:
-                log_file = open(log_path, "ab")
-            child = subprocess.Popen(
-                self._command_builder(process),
-                env=env,
-                cwd=process.spec.workdir,
-                stdout=log_file,
-                stderr=subprocess.STDOUT if log_file else None,
-                start_new_session=True,  # isolate signals from the operator
-            )
+            child = self._spawn(process, env, log_path)
         except OSError as exc:
             # Covers both a failed log-file open and a failed exec: the
             # process must be reported FAILED, never left Pending forever.
-            if log_file:
-                log_file.close()
             with self._lock:
                 self._children.pop(key, None)
                 self._tombstones.discard(key)
             self._patch_status(process, ProcessPhase.FAILED, exit_code=127, message=str(exc))
             return
-        if log_file:
-            log_file.close()  # child holds its own descriptor now
         with self._lock:
             doomed = key in self._tombstones or self._shutting_down
             if doomed:
@@ -266,6 +270,40 @@ class LocalProcessControl(ProcessControl):
                 child.wait(timeout=self.GRACE_SECONDS)
             except subprocess.TimeoutExpired:
                 child.kill()
+
+
+class NativeProcessControl(LocalProcessControl):
+    """LocalProcessControl with spawn/monitor/kill supplied by the native
+    C++ supervisor (native/supervisor.cc via runtime.native).
+
+    Differences from the pure-Python backend, all in the compiled layer:
+    children are setsid process-group leaders and deletion kills the whole
+    group (a harness that forked data loaders leaves no orphans); exit
+    codes arrive normalized to the 128+signal convention the taxonomy
+    (reference pkg/util/train/train_util.go:18-53) is written against
+    (SIGKILL → 137, SIGTERM → 143, never Python's -9/-15); and exec
+    failures are reported synchronously with the child-side errno instead
+    of a generic exit-127 corpse."""
+
+    def __init__(self, *args, **kwargs) -> None:
+        from tf_operator_tpu.runtime.native import NativeSupervisor
+
+        super().__init__(*args, **kwargs)
+        self._sup = NativeSupervisor()
+
+    def _spawn(self, process: Process, env: Dict[str, str], log_path: Optional[str]):
+        return self._sup.spawn(
+            self._command_builder(process), env, process.spec.workdir, log_path
+        )
+
+    def _terminate(self, child) -> None:
+        from tf_operator_tpu.runtime.native import NativeChild
+
+        if isinstance(child, NativeChild):
+            # Native escalation: TERM → grace → KILL, on the whole group.
+            self._sup.terminate(child, self.GRACE_SECONDS)
+        else:  # pragma: no cover - children are always NativeChild here
+            super()._terminate(child)
 
 
 def _was_oom_killed(code: int) -> bool:
