@@ -210,12 +210,30 @@ int tpuj_terminate(long pid, int grace_ms) {
   while (waited < grace_ms) {
     int r = tpuj_poll(pid, &code);
     if (r == 1) return code;
+    if (r == -ECHILD) {
+      // A concurrent tpuj_wait won the waitpid race and its record() has
+      // not committed yet; tpuj_wait's registry-poll path resolves it.
+      // Returning the raw -ECHILD here would be consumed as an "exit
+      // code" and poison the caller's view of a recycled pid.
+      return tpuj_wait(pid);
+    }
     if (r < 0) return r;
     sleep_ms(10);
     waited += 10;
   }
   tpuj_signal(pid, SIGKILL);
   return tpuj_wait(pid);
+}
+
+// Kill whatever remains of the child's process GROUP, regardless of the
+// leader's registry state. Used after the leader has been reaped: setsid
+// group members (forked data loaders etc.) survive their leader, and the
+// pod semantic is that they must not — a dead leader means a dead gang
+// member, and its whole local process tree goes with it. ESRCH (group
+// fully gone — the common case) is success.
+int tpuj_kill_group(long pid, int sig) {
+  if (kill((pid_t)-pid, sig) == 0) return 0;
+  return errno == ESRCH ? 0 : -(int)errno;
 }
 
 // Drop a reaped pid's registry slot (call after the exit code has been

@@ -180,6 +180,30 @@ def test_native_group_kill_reaps_grandchildren():
     assert wait_for(lambda: not grandchild_alive(), timeout=10)
 
 
+def test_native_group_reaped_when_leader_dies_on_its_own():
+    """Pod semantics: the leader exiting by itself (crash, chaos kill) must
+    still take its forked children down — not only explicit deletes."""
+    import subprocess
+
+    store = Store()
+    marker = "tpujob-native-selfdeath-marker"
+    # Child forks a long-lived grandchild then EXITS on its own.
+    code = (
+        "import subprocess, sys; "
+        f"subprocess.Popen([sys.executable, '-c', 'import time; time.sleep(300)', '{marker}']); "
+        "sys.exit(0)"
+    )
+    ctl = NativeProcessControl(store, command_builder=script_builder(code))
+    ctl.create_process(proc("selfdeath"))
+    assert wait_for(lambda: store.get("Process", "default", "selfdeath").is_finished())
+
+    def grandchild_alive():
+        out = subprocess.run(["pgrep", "-f", marker], capture_output=True, text=True)
+        return out.returncode == 0
+
+    assert wait_for(lambda: not grandchild_alive(), timeout=10)
+
+
 def test_native_exec_failure_carries_errno():
     """Exec failures surface synchronously with the child-side errno."""
     from tf_operator_tpu.runtime.native import NativeSupervisor

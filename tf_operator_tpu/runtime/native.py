@@ -63,23 +63,44 @@ def ensure_built() -> str:
             fcntl.flock(lock_fd, fcntl.LOCK_EX)
             if _fresh():  # another process built it while we waited
                 return LIB_PATH
-            tmp = f"{LIB_PATH}.{os.getpid()}.tmp"
-            cmd = [
-                os.environ.get("CXX", "g++"),
-                "-std=c++17", "-O2", "-Wall", "-Wextra", "-fPIC", "-pthread",
-                "-shared", "-o", tmp, _SOURCE,
+            # The Makefile is the single source of truth for build flags;
+            # build into a private BUILD dir and atomically replace in, so
+            # a concurrent dlopen never sees a half-written .so. Direct g++
+            # only as fallback when make itself is absent.
+            tmp_dir = os.path.join(NATIVE_DIR, "build", f".mk.{os.getpid()}")
+            tmp_lib = os.path.join(tmp_dir, os.path.basename(LIB_PATH))
+            cmds = [
+                ["make", "-C", NATIVE_DIR, f"BUILD={tmp_dir}"],
+                [
+                    os.environ.get("CXX", "g++"),
+                    "-std=c++17", "-O2", "-Wall", "-Wextra", "-fPIC", "-pthread",
+                    "-shared", "-o", tmp_lib, _SOURCE,
+                ],
             ]
             try:
-                proc = subprocess.run(
-                    cmd, cwd=NATIVE_DIR, capture_output=True, text=True, timeout=120
-                )
-            except (OSError, subprocess.TimeoutExpired) as exc:
-                raise NativeBuildError(f"failed to run {cmd[0]}: {exc}") from exc
-            if proc.returncode != 0:
-                raise NativeBuildError(
-                    f"native build failed ({proc.returncode}):\n{proc.stderr}"
-                )
-            os.replace(tmp, LIB_PATH)
+                os.makedirs(tmp_dir, exist_ok=True)
+                for i, cmd in enumerate(cmds):
+                    try:
+                        proc = subprocess.run(
+                            cmd, cwd=NATIVE_DIR, capture_output=True, text=True,
+                            timeout=120,
+                        )
+                    except OSError as exc:
+                        if i + 1 < len(cmds):  # make missing: try g++
+                            continue
+                        raise NativeBuildError(f"failed to run {cmd[0]}: {exc}") from exc
+                    except subprocess.TimeoutExpired as exc:
+                        raise NativeBuildError(f"build timed out: {exc}") from exc
+                    if proc.returncode != 0:
+                        raise NativeBuildError(
+                            f"native build failed ({proc.returncode}):\n{proc.stderr}"
+                        )
+                    break
+                os.replace(tmp_lib, LIB_PATH)
+            finally:
+                import shutil
+
+                shutil.rmtree(tmp_dir, ignore_errors=True)
             return LIB_PATH
         finally:
             os.close(lock_fd)
@@ -107,6 +128,8 @@ def load_library() -> ctypes.CDLL:
     lib.tpuj_signal.argtypes = [ctypes.c_long, ctypes.c_int]
     lib.tpuj_terminate.restype = ctypes.c_int
     lib.tpuj_terminate.argtypes = [ctypes.c_long, ctypes.c_int]
+    lib.tpuj_kill_group.restype = ctypes.c_int
+    lib.tpuj_kill_group.argtypes = [ctypes.c_long, ctypes.c_int]
     lib.tpuj_forget.restype = None
     lib.tpuj_forget.argtypes = [ctypes.c_long]
     lib.tpuj_tracked_count.restype = ctypes.c_int
@@ -134,7 +157,14 @@ class NativeChild:
     def _finish(self, code: int) -> int:
         if self.returncode is None:
             self.returncode = code
-            self._lib.tpuj_forget(self.pid)  # pid may recycle; drop the slot
+            # Leader reaped ⇒ its whole setsid group goes too: members it
+            # forked (data loaders …) must not outlive it holding devices,
+            # ports, or the log file. Then drop the registry slot (pids
+            # recycle; a stale done-entry would lie about a future child).
+            import signal as _signal
+
+            self._lib.tpuj_kill_group(self.pid, _signal.SIGKILL)
+            self._lib.tpuj_forget(self.pid)
         return self.returncode
 
     def poll(self) -> Optional[int]:
@@ -190,6 +220,11 @@ class NativeSupervisor:
         failures) so callers report a FAILED process, not a hung one."""
         if not argv:
             raise OSError(22, "empty argv")
+        if log_path:
+            # Pre-validate here: the C side can't distinguish a failed log
+            # open from a failed exec in its -errno, and a log-open error
+            # blamed on the executable sends debugging the wrong way.
+            open(log_path, "ab").close()
         exe = argv[0]
         if os.sep not in exe:  # execve takes a path, not a $PATH lookup
             import shutil
@@ -216,6 +251,11 @@ class NativeSupervisor:
         if child.returncode is not None:
             return child.returncode
         code = self._lib.tpuj_terminate(child.pid, int(grace_seconds * 1000))
+        if code < 0:
+            # Never record a -errno as an exit code (it would poison the
+            # registry slot for a recycled pid); let the winner's record
+            # resolve through the idempotent wait path.
+            return child.wait()
         return child._finish(code)
 
     def tracked_count(self) -> int:

@@ -1,0 +1,3 @@
+"""CI/test/release tooling (reference: the ``py/`` tree — test runner,
+deploy, release, prow glue — and ``test/e2e`` — the TAP smoke driver).
+Run modules from the repo root: ``python -m tools.test_runner …``."""

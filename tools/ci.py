@@ -1,0 +1,73 @@
+"""CI pipeline runner.
+
+Reference parity: the Prow→Argo orchestration (prow_config.yaml +
+test/workflows/components/workflows.libsonnet) collapsed into a local stage
+runner: sequential stages, fail-fast except ``always`` stages (the
+teardown-cluster semantics), artifacts dir for junit XML (the
+copy-artifacts/GCS step).
+
+Usage:
+    python -m tools.ci [--pipeline ci/pipeline.yaml] [--artifacts /tmp/ci-out]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import socket
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_stage(stage: dict, subs: dict) -> int:
+    cmd = stage["run"].format(**subs)
+    print(f"\n=== stage {stage['name']}: {cmd}", flush=True)
+    t0 = time.perf_counter()
+    r = subprocess.run(shlex.split(cmd), cwd=REPO_ROOT)
+    print(f"=== stage {stage['name']}: exit {r.returncode} "
+          f"({time.perf_counter() - t0:.1f}s)", flush=True)
+    return r.returncode
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpujob-ci")
+    p.add_argument("--pipeline", default=os.path.join(REPO_ROOT, "ci", "pipeline.yaml"))
+    p.add_argument("--artifacts", default="/tmp/tpujob-ci-artifacts")
+    args = p.parse_args(argv)
+
+    import yaml
+
+    with open(args.pipeline) as f:
+        pipeline = yaml.safe_load(f)
+    os.makedirs(args.artifacts, exist_ok=True)
+    subs = {"port": free_port(), "artifacts": args.artifacts}
+
+    failed = None
+    results = []
+    for stage in pipeline["stages"]:
+        if failed is not None and not stage.get("always"):
+            results.append((stage["name"], "skipped"))
+            continue
+        rc = run_stage(stage, subs)
+        results.append((stage["name"], "ok" if rc == 0 else f"FAIL({rc})"))
+        if rc != 0 and failed is None:
+            failed = stage["name"]
+
+    print(f"\n{pipeline.get('name', 'pipeline')} summary:")
+    for name, outcome in results:
+        print(f"  {outcome:10} {name}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,146 @@
+"""Bring a local control plane up / down.
+
+Reference parity: py/deploy.py — cluster setup/teardown around the test
+runner (GKE + ksonnet there; a supervised operator daemon here — the
+"cluster" on a TPU host is the operator process itself). State lives in a
+deploy dir: the daemon pid, its log, and the API URL the other tools read.
+
+Usage:
+    python -m tools.deploy up   [--port 8080] [--deploy-dir /tmp/tpujob-deploy]
+    python -m tools.deploy status
+    python -m tools.deploy down
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+DEFAULT_DIR = "/tmp/tpujob-deploy"
+
+
+def _paths(d: str) -> dict:
+    return {
+        "pid": os.path.join(d, "operator.pid"),
+        "url": os.path.join(d, "server.url"),
+        "log": os.path.join(d, "operator.log"),
+        "proc_logs": os.path.join(d, "process-logs"),
+    }
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+def up(args) -> int:
+    paths = _paths(args.deploy_dir)
+    os.makedirs(args.deploy_dir, exist_ok=True)
+    if os.path.exists(paths["pid"]):
+        pid = int(open(paths["pid"]).read())
+        if _alive(pid):
+            print(f"operator already running (pid {pid})")
+            return 0
+        os.unlink(paths["pid"])
+    log = open(paths["log"], "ab")
+    cmd = [
+        sys.executable, "-m", "tf_operator_tpu.cli.operator",
+        "--port", str(args.port), "--host", args.host,
+        "--log-dir", paths["proc_logs"],
+        "--backend", args.backend,
+    ]
+    if args.chaos_level:
+        cmd += ["--chaos-level", str(args.chaos_level)]
+    child = subprocess.Popen(
+        cmd, stdout=log, stderr=subprocess.STDOUT, start_new_session=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    log.close()
+    url = f"http://{args.host}:{args.port}"
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url + "/healthz", timeout=2):
+                break
+        except OSError:
+            if child.poll() is not None:
+                print(f"operator exited {child.returncode}; see {paths['log']}")
+                return 1
+            time.sleep(0.3)
+    else:
+        child.terminate()
+        print("operator never became healthy")
+        return 1
+    with open(paths["pid"], "w") as f:
+        f.write(str(child.pid))
+    with open(paths["url"], "w") as f:
+        f.write(url)
+    print(f"operator up: pid {child.pid}, api {url}, ui {url}/ui")
+    return 0
+
+
+def status(args) -> int:
+    paths = _paths(args.deploy_dir)
+    if not os.path.exists(paths["pid"]):
+        print("not deployed")
+        return 1
+    pid = int(open(paths["pid"]).read())
+    url = open(paths["url"]).read() if os.path.exists(paths["url"]) else "?"
+    if not _alive(pid):
+        print(f"stale deploy (pid {pid} dead)")
+        return 1
+    try:
+        with urllib.request.urlopen(url + "/api/tpujob", timeout=3) as resp:
+            n = len(json.load(resp)["items"])
+    except OSError:
+        print(f"operator pid {pid} alive but API unreachable at {url}")
+        return 1
+    print(f"operator pid {pid}, api {url}, {n} jobs")
+    return 0
+
+
+def down(args) -> int:
+    paths = _paths(args.deploy_dir)
+    if not os.path.exists(paths["pid"]):
+        print("not deployed")
+        return 0
+    pid = int(open(paths["pid"]).read())
+    if _alive(pid):
+        os.kill(pid, signal.SIGTERM)
+        deadline = time.time() + 15
+        while time.time() < deadline and _alive(pid):
+            time.sleep(0.2)
+        if _alive(pid):
+            os.kill(pid, signal.SIGKILL)
+    for key in ("pid", "url"):
+        try:
+            os.unlink(paths[key])
+        except OSError:
+            pass
+    print(f"operator pid {pid} stopped")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpujob-deploy")
+    p.add_argument("command", choices=("up", "status", "down"))
+    p.add_argument("--deploy-dir", default=DEFAULT_DIR)
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--backend", choices=("native", "local"), default="native")
+    p.add_argument("--chaos-level", type=int, default=0)
+    args = p.parse_args(argv)
+    return {"up": up, "status": status, "down": down}[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

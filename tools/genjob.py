@@ -1,0 +1,104 @@
+"""TPUJob load generator.
+
+Reference parity: hack/genjob/genjob.go — templated job generation for
+controller load/gang-scheduling experiments (``--nr-tfjobs``,
+``--scheduler-name``); here ``--nr-jobs`` with optional direct submission
+so one command can put O(100) concurrent jobs on the operator (the
+reference's design scale target, tf_job_design_doc.md:24-26).
+
+Usage:
+    python -m tools.genjob --nr-jobs 20 --out-dir /tmp/jobs        # write specs
+    python -m tools.genjob --nr-jobs 20 --submit --server http://… # submit
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tf_operator_tpu.api.types import (
+    ObjectMeta,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    TPUJob,
+    TPUJobSpec,
+    TopologySpec,
+)
+from tf_operator_tpu.api.types import _to_jsonable
+
+
+def build_job(
+    name: str,
+    workers: int,
+    steps: int,
+    entrypoint: str,
+    topology: str,
+    cpu_env: bool,
+) -> TPUJob:
+    env = {}
+    if cpu_env:
+        env = {
+            "JAX_PLATFORMS": "cpu",
+            "JAX_CPU_COLLECTIVES_IMPLEMENTATION": "gloo",
+            "PALLAS_AXON_POOL_IPS": "",
+            "XLA_FLAGS": "",
+        }
+    template = ProcessTemplate(entrypoint=entrypoint, env=env)
+    spec = TPUJobSpec(
+        replica_specs={ReplicaType.WORKER: ReplicaSpec(replicas=workers, template=template)},
+        workload={"dim": 16, "steps": steps},
+    )
+    if topology:
+        spec.topology = TopologySpec(slice_type=topology)
+    return TPUJob(metadata=ObjectMeta(name=name), spec=spec)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpujob-genjob")
+    p.add_argument("--nr-jobs", type=int, default=1)
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--steps", type=int, default=2)
+    p.add_argument("--prefix", default="genjob")
+    p.add_argument("--entrypoint", default="tf_operator_tpu.workloads.smoke:main")
+    p.add_argument("--topology", default="", help="slice type, e.g. v5p-32")
+    p.add_argument("--no-cpu-env", action="store_true",
+                   help="don't inject the CPU-platform env (run on real TPU)")
+    p.add_argument("--out-dir", default=None, help="write one JSON spec per job")
+    p.add_argument("--submit", action="store_true", help="submit to the operator")
+    p.add_argument("--server", default="http://127.0.0.1:8080")
+    args = p.parse_args(argv)
+
+    jobs = [
+        build_job(
+            f"{args.prefix}-{i}", args.workers, args.steps, args.entrypoint,
+            args.topology, not args.no_cpu_env,
+        )
+        for i in range(args.nr_jobs)
+    ]
+
+    if args.out_dir:
+        os.makedirs(args.out_dir, exist_ok=True)
+        for job in jobs:
+            path = os.path.join(args.out_dir, f"{job.metadata.name}.json")
+            with open(path, "w") as f:
+                json.dump(_to_jsonable(job.to_dict()), f, indent=2)
+        print(f"wrote {len(jobs)} specs to {args.out_dir}")
+
+    if args.submit:
+        from tf_operator_tpu.dashboard.client import TPUJobClient
+
+        client = TPUJobClient(args.server)
+        for job in jobs:
+            client.create(job)
+        print(f"submitted {len(jobs)} jobs to {args.server}")
+    elif not args.out_dir:
+        for job in jobs:
+            print(json.dumps(_to_jsonable(job.to_dict())))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

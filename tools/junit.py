@@ -1,0 +1,75 @@
+"""Junit XML emission (reference: py/test_util.py:1-191 — TestCase records
+with failure messages serialized for the CI artifact store)."""
+
+from __future__ import annotations
+
+import time
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class TestCase:
+    name: str
+    class_name: str = "tpujob"
+    time_s: float = 0.0
+    failure_message: Optional[str] = None
+
+    @property
+    def failed(self) -> bool:
+        return self.failure_message is not None
+
+
+@dataclass
+class TestSuite:
+    name: str
+    cases: List[TestCase] = field(default_factory=list)
+
+    def timed_case(self, name: str):
+        """Context manager: times the block; an exception marks the case
+        failed (and is re-raised unless it's an AssertionError, which is
+        recorded and swallowed so later cases still run)."""
+        suite = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.case = TestCase(name=name)
+                self.t0 = time.perf_counter()
+                return self.case
+
+            def __exit__(self, exc_type, exc, tb):
+                self.case.time_s = time.perf_counter() - self.t0
+                if exc is not None:
+                    self.case.failure_message = f"{exc_type.__name__}: {exc}"
+                suite.cases.append(self.case)
+                return exc_type is not None and issubclass(exc_type, AssertionError)
+
+        return _Ctx()
+
+    @property
+    def failures(self) -> int:
+        return sum(1 for c in self.cases if c.failed)
+
+    def to_xml(self) -> str:
+        root = ET.Element(
+            "testsuite",
+            name=self.name,
+            tests=str(len(self.cases)),
+            failures=str(self.failures),
+            time=f"{sum(c.time_s for c in self.cases):.3f}",
+        )
+        for c in self.cases:
+            el = ET.SubElement(
+                root, "testcase", classname=c.class_name, name=c.name,
+                time=f"{c.time_s:.3f}",
+            )
+            if c.failed:
+                f = ET.SubElement(el, "failure", message=c.failure_message or "")
+                f.text = c.failure_message
+        return ET.tostring(root, encoding="unicode")
+
+    def write(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write('<?xml version="1.0" encoding="UTF-8"?>\n')
+            f.write(self.to_xml())

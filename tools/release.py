@@ -1,0 +1,117 @@
+"""Release builder.
+
+Reference parity: py/release.py — clone-at-green, build artifact, publish
+(GCB + helm there). The TPU-native artifact is a versioned source tarball
+(git archive of HEAD) whose smoke test proves it is self-contained: extract
+to a clean dir, import the package, compile the native supervisor, run a
+unit probe — all from the artifact, never from the working tree.
+
+Usage:
+    python -m tools.release build [--out-dir dist]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tarfile
+import tempfile
+import time
+
+import tf_operator_tpu
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def git_sha() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=REPO_ROOT,
+            capture_output=True, text=True, timeout=10,
+        )
+        return out.stdout.strip()[:12] if out.returncode == 0 else "unknown"
+    except OSError:
+        return "unknown"
+
+
+def build(args) -> int:
+    os.makedirs(args.out_dir, exist_ok=True)
+    version = tf_operator_tpu.__version__
+    sha = git_sha()
+    name = f"tf-operator-tpu-{version}+{sha}"
+    tarball = os.path.join(args.out_dir, f"{name}.tar.gz")
+
+    r = subprocess.run(
+        ["git", "archive", "--format=tar.gz", f"--prefix={name}/",
+         "-o", tarball, "HEAD"],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+    )
+    if r.returncode != 0:
+        print(f"git archive failed: {r.stderr}", file=sys.stderr)
+        return 1
+
+    manifest = {
+        "name": name,
+        "version": version,
+        "git_sha": sha,
+        "built_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "artifact": os.path.basename(tarball),
+    }
+    with open(os.path.join(args.out_dir, f"{name}.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+
+    if not args.skip_smoke:
+        rc = smoke_test(tarball, name)
+        if rc != 0:
+            return rc
+    print(f"release ok: {tarball}")
+    return 0
+
+
+def smoke_test(tarball: str, name: str) -> int:
+    """Prove the artifact is self-contained (py/release.py's build-then-test
+    discipline): extract, import, native build, tiny API round trip."""
+    tmp = tempfile.mkdtemp(prefix="tpujob-release-")
+    try:
+        with tarfile.open(tarball) as tf:
+            tf.extractall(tmp, filter="data")
+        root = os.path.join(tmp, name)
+        probe = (
+            "import tf_operator_tpu, json;"
+            "from tf_operator_tpu.api.types import TPUJob;"
+            "from tf_operator_tpu.runtime.native import ensure_built;"
+            "ensure_built();"
+            "from tests.test_api_types import make_job;"
+            "j = make_job();"
+            "assert TPUJob.from_dict(j.to_dict()).to_dict() == j.to_dict();"
+            "print('artifact smoke ok', tf_operator_tpu.__version__)"
+        )
+        env = dict(os.environ, PYTHONPATH=root)
+        r = subprocess.run(
+            [sys.executable, "-c", probe], cwd=root, env=env,
+            capture_output=True, text=True, timeout=300,
+        )
+        sys.stdout.write(r.stdout)
+        if r.returncode != 0:
+            print(f"artifact smoke FAILED:\n{r.stderr}", file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpujob-release")
+    p.add_argument("command", choices=("build",))
+    p.add_argument("--out-dir", default=os.path.join(REPO_ROOT, "dist"))
+    p.add_argument("--skip-smoke", action="store_true")
+    args = p.parse_args(argv)
+    return {"build": build}[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,115 @@
+"""Orchestration-level test runner against a live operator.
+
+Reference parity: py/test_runner.py — submit the job, wait for the terminal
+state, assert the EVENTS ORACLE (number of process-create events equals the
+sum of replica counts, test_runner.py:311-338), delete, assert GC, and run
+two trials under the same name to prove delete→recreate works
+(test_runner.py:276-280). Junit XML output for the CI artifact store.
+
+Usage:
+    python -m tools.test_runner --server http://127.0.0.1:8080 \
+        --spec examples/smoke_local_cpu.json [--junit-path out.xml]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from tf_operator_tpu.api.types import TPUJob
+from tf_operator_tpu.controller.events import REASON_SUCCESSFUL_CREATE
+from tf_operator_tpu.dashboard.client import TPUJobApiError, TPUJobClient
+from tools.junit import TestSuite
+
+
+def _create_event_count(client: TPUJobClient, namespace: str, job_name: str) -> int:
+    """Total aggregated SuccessfulCreateProcess count for the job."""
+    total = 0
+    for ev in client.events(namespace):
+        if (
+            ev.get("reason") == REASON_SUCCESSFUL_CREATE
+            and ev.get("involved_name") == job_name
+        ):
+            total += int(ev.get("count", 1))
+    return total
+
+
+def expected_replicas(job: TPUJob) -> int:
+    return sum(spec.replicas or 1 for spec in job.spec.replica_specs.values())
+
+
+def run_trial(
+    client: TPUJobClient,
+    job: TPUJob,
+    timeout: float,
+    trial: int,
+    suite: TestSuite,
+) -> None:
+    ns = job.metadata.namespace or "default"
+    name = job.metadata.name
+    base_events = _create_event_count(client, ns, name)  # trials share the name
+
+    with suite.timed_case(f"trial{trial}-submit-and-complete"):
+        client.create(job)
+        done = client.wait_for_job(ns, name, timeout=timeout)
+        phase = done.status.phase().value
+        assert phase == "Done", f"job finished {phase}: {done.status.message}"
+
+    with suite.timed_case(f"trial{trial}-events-oracle"):
+        want = expected_replicas(job)
+        got = _create_event_count(client, ns, name) - base_events
+        assert got == want, (
+            f"process-create events {got} != sum of replicas {want} "
+            "(reference oracle: test_runner.py:311-338)"
+        )
+
+    with suite.timed_case(f"trial{trial}-delete-and-gc"):
+        client.delete(ns, name)
+        client.wait_for_delete(ns, name, timeout=60)
+        # Children are GC'd with the job: the detail endpoint 404s and no
+        # process of this job remains (wait_for_pods_to_be_deleted analogue).
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                client.get(ns, name)
+            except TPUJobApiError as exc:
+                if exc.code == 404:
+                    return
+            time.sleep(0.5)
+        raise AssertionError("job detail still served after delete")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpujob-test-runner")
+    p.add_argument("--server", default="http://127.0.0.1:8080")
+    p.add_argument("--spec", required=True, help="TPUJob JSON spec file")
+    p.add_argument("--trials", type=int, default=2,
+                   help="submissions under the same name (reference runs 2 "
+                        "to verify delete->recreate, test_runner.py:276-280)")
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--junit-path", default=None)
+    args = p.parse_args(argv)
+
+    with open(args.spec) as f:
+        spec = json.load(f)
+
+    client = TPUJobClient(args.server)
+    suite = TestSuite(name=f"test_runner:{args.spec}")
+    for trial in range(1, args.trials + 1):
+        job = TPUJob.from_dict(json.loads(json.dumps(spec)))
+        run_trial(client, job, args.timeout, trial, suite)
+
+    if args.junit_path:
+        suite.write(args.junit_path)
+    for case in suite.cases:
+        status = "FAIL" if case.failed else "ok"
+        print(f"{status:4} {case.name} ({case.time_s:.1f}s)"
+              + (f" — {case.failure_message}" if case.failed else ""))
+    print(f"{len(suite.cases)} cases, {suite.failures} failures")
+    return 1 if suite.failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
