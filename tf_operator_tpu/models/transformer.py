@@ -43,7 +43,7 @@ class TransformerConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
-    attn_impl: str = "dense"  # "dense" | "ring"
+    attn_impl: str = "dense"  # "dense" | "ring" | "flash" (Pallas kernel)
     cp_axis: str = "cp"
 
     @property
@@ -180,6 +180,31 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
         return ring_attention(
             q, k, v, mesh, axis_name=cfg.cp_axis, causal=cfg.causal, batch_axes=batch_axes
         )
+    if cfg.attn_impl == "flash":
+        from tf_operator_tpu.ops.flash_attention import flash_attention
+
+        # Pallas online-softmax kernel on TPU; identical-math jnp fallback
+        # elsewhere, so one config runs on the CPU test mesh too. Under a
+        # mesh the pallas_call has no GSPMD partitioning rule, so wrap in
+        # shard_map — attention is independent per (batch, head), so batch
+        # shards over dp/fsdp and heads over tp with no collectives. A
+        # sequence-sharded (cp) mesh needs ring attention instead.
+        if mesh is not None and mesh.devices.size > 1:
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            batch = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
+            heads = "tp" if "tp" in mesh.axis_names else None
+            spec = P(batch, None, heads, None)
+            fn = shard_map(
+                lambda q, k, v: flash_attention(q, k, v, causal=cfg.causal),
+                mesh=mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_rep=False,
+            )
+            return fn(q, k, v)
+        return flash_attention(q, k, v, causal=cfg.causal)
     # dense path; logits accumulated in f32 ON the MXU (bf16 inputs with a
     # pre-rounded bf16 result would lose resolution between near-tied logits)
     scale = cfg.head_dim**-0.5

@@ -1,0 +1,10 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference contains no numerics code at all (SURVEY.md §2: the operator
+configures TensorFlow, it never touches tensors); this package is the
+TPU-native data-plane layer the workload library builds on — attention is
+where long-context FLOPs and HBM traffic concentrate, so it gets a
+hand-written kernel while everything else rides XLA fusion.
+"""
+
+from tf_operator_tpu.ops.flash_attention import flash_attention  # noqa: F401

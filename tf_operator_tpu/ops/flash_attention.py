@@ -1,0 +1,390 @@
+"""Flash attention as a Pallas TPU kernel (forward + custom-VJP backward).
+
+Why a kernel at all: dense attention materializes the [t, t] score matrix
+in HBM — O(t²) bytes of traffic on the op XLA cannot fuse away. The
+flash/online-softmax formulation streams K/V blocks through VMEM and keeps
+only [block_q, d] / [block_k, d] tiles plus per-row (m, l) accumulators
+resident, so HBM traffic is O(t·d) and the MXU stays fed. The backward
+pass recomputes P from the saved logsumexp instead of storing it (the
+standard flash recipe), trading FLOPs for HBM exactly as TPUs want.
+
+Kernel structure: the contraction dimension is a GRID dimension, not a
+VMEM-resident loop — grid (b, h, nq, nk) for forward/dq and (b, h, nk, nq)
+for dk/dv, with the running (m, l, acc) state in VMEM scratch that
+persists across the innermost grid dimension (TPU grids iterate the last
+dimension sequentially, which is what makes carried scratch sound). VMEM
+holds only one block of each operand at a time, so sequence length is
+bounded by HBM, not by the ~16 MB VMEM budget. Causal grids skip
+above-diagonal blocks with `pl.when` (zero compute, still one grid step).
+
+Layout: q/k/v are [b, t, h, d] (the model layout), transposed to
+[b, h, t, d] so seq is the sublane dim and head_dim the lane dim. The
+kernel path engages on TPU when t divides into 8-aligned blocks and
+d % 128 == 0 (at d=64 the half-width MXU measured ~7% slower than XLA's
+fused dense path, so those shapes fall back). Off-TPU the entry falls
+back to a jnp reference (same math, same f32 softmax) so one model config
+runs everywhere; ``interpret=True`` forces the Pallas interpreter — the
+CPU test path for the kernel logic.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-negative instead of -inf: exp() of a whole masked
+                 # row must give 0 without generating inf-inf = nan
+LSE_LANES = 128  # lse/delta carry a full lane dim to satisfy TPU tiling
+
+
+def _use_kernel(t: int, d: int, block_q: int, block_k: int, interpret: bool) -> bool:
+    if t % block_q or t % block_k:
+        return False  # kernels assume exact tiling; odd lengths fall back
+    if block_q % 8 or block_k % 8:
+        return False  # clamped blocks (short t) must stay sublane-aligned
+    if interpret:
+        return True
+    return jax.default_backend() == "tpu" and d % 128 == 0
+
+
+def reference_attention(q, k, v, causal: bool = False):
+    """Dense attention, f32 softmax — the correctness oracle and the
+    off-TPU fallback (same contract as the kernel path)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * (d**-0.5)
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _causal_mask(s, qi, kb, block_q, block_k):
+    qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    kpos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return jnp.where(kpos <= qpos, s, NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# forward kernel — grid (b, h, nq, nk), carry (m, l, acc) in scratch
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, causal, block_q, block_k, scale):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    kb = pl.program_id(3)
+    nkb = pl.num_programs(3)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:, :] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:, :] = jnp.zeros_like(l_scr)
+        acc_scr[:, :] = jnp.zeros_like(acc_scr)
+
+    # Above-diagonal blocks contribute nothing under causal masking.
+    live = (kb * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale  # [bq, d]
+        k = k_ref[0, 0, :, :].astype(jnp.float32)          # [bk, d]
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        if causal:
+            s = _causal_mask(s, qi, kb, block_q, block_k)
+        m_prev = m_scr[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[:, :] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[:, :] = l_scr[:, :] * alpha[:, None] + jnp.sum(p, axis=1)[:, None]
+        acc_scr[:, :] = acc_scr[:, :] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(kb == nkb - 1)
+    def _finish():
+        l = l_scr[:, 0]
+        o_ref[0, 0, :, :] = (acc_scr[:, :] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0, :, :] = jnp.broadcast_to(
+            (m_scr[:, 0] + jnp.log(l))[:, None], lse_ref.shape[2:]
+        )
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, t, h, d = q.shape
+    scale = d**-0.5
+    # [b, t, h, d] -> [b, h, t, d]: sequence in the sublane dim, head_dim in
+    # lanes — the MXU-native layout for the q·kᵀ and p·v contractions.
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k, scale=scale
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, t // block_q, t // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, kb: (bi, hi, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, kb: (bi, hi, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, kb: (bi, hi, kb, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, kb: (bi, hi, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, LSE_LANES), lambda bi, hi, qi, kb: (bi, hi, qi, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, t, LSE_LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LSE_LANES), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, LSE_LANES), jnp.float32),  # running sum l
+            pltpu.VMEM((block_q, d), jnp.float32),          # output accumulator
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return o.transpose(0, 2, 1, 3), (qt, kt, vt, o, lse)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels — same streaming-grid structure
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+                   *, causal, block_q, block_k, scale):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    kb = pl.program_id(3)
+    nkb = pl.num_programs(3)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[:, :] = jnp.zeros_like(dq_scr)
+
+    live = (kb * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, :1]      # [bq, 1] (value replicated on lanes)
+        delta = delta_ref[0, 0, :, :1]  # [bq, 1]
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            s = _causal_mask(s, qi, kb, block_q, block_k)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        dq_scr[:, :] = dq_scr[:, :] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(kb == nkb - 1)
+    def _finish():
+        dq_ref[0, 0, :, :] = (dq_scr[:, :] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                    dk_scr, dv_scr, *, causal, block_q, block_k, scale):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+    qb = pl.program_id(3)
+    nqb = pl.num_programs(3)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[:, :] = jnp.zeros_like(dk_scr)
+        dv_scr[:, :] = jnp.zeros_like(dv_scr)
+
+    # Causal: q-blocks strictly before this k-block see none of it.
+    live = (qb * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(live)
+    def _step():
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, :1]
+        delta = delta_ref[0, 0, :, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        if causal:
+            s = _causal_mask(s, qb, ki, block_q, block_k)
+        p = jnp.exp(s - lse)  # [bq, bk]
+        dv_scr[:, :] = dv_scr[:, :] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        ds = p * (dp - delta)
+        dk_scr[:, :] = dk_scr[:, :] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bk, d]
+
+    @pl.when(qb == nqb - 1)
+    def _finish():
+        dk_ref[0, 0, :, :] = dk_scr[:, :].astype(dk_ref.dtype)  # q pre-scaled
+        dv_ref[0, 0, :, :] = dv_scr[:, :].astype(dv_ref.dtype)
+
+
+def _bwd(causal, block_q, block_k, interpret, residuals, g):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    qt, kt, vt, o, lse = residuals
+    b, h, t, d = qt.shape
+    scale = d**-0.5
+    do = g.transpose(0, 2, 1, 3)
+    # delta_i = rowsum(do_i * o_i) — the softmax-jacobian correction term —
+    # lane-broadcast to the same [b,h,t,LSE_LANES] layout as lse.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (b, h, t, LSE_LANES))
+
+    def qspec(idx):  # block over the q/sequence dim, selected by grid dim idx
+        return pl.BlockSpec(
+            (1, 1, block_q, d),
+            lambda bi, hi, i, j, idx=idx: (bi, hi, (i, j)[idx], 0),
+            memory_space=pltpu.VMEM,
+        )
+
+    def lspec(idx):  # lse/delta blocks, same sequence indexing
+        return pl.BlockSpec(
+            (1, 1, block_q, LSE_LANES),
+            lambda bi, hi, i, j, idx=idx: (bi, hi, (i, j)[idx], 0),
+            memory_space=pltpu.VMEM,
+        )
+
+    def kspec(idx):
+        return pl.BlockSpec(
+            (1, 1, block_k, d),
+            lambda bi, hi, i, j, idx=idx: (bi, hi, (i, j)[idx], 0),
+            memory_space=pltpu.VMEM,
+        )
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, causal=causal, block_q=block_q, block_k=block_k, scale=scale
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, t // block_q, t // block_k),
+        in_specs=[qspec(0), kspec(1), kspec(1), qspec(0), lspec(0), lspec(0)],
+        out_specs=qspec(0),
+        out_shape=jax.ShapeDtypeStruct((b, h, t, d), qt.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, do, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, causal=causal, block_q=block_q, block_k=block_k, scale=scale
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, t // block_k, t // block_q),
+        in_specs=[qspec(1), kspec(0), kspec(0), qspec(1), lspec(1), lspec(1)],
+        out_specs=[kspec(0), kspec(0)],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, t, d), kt.dtype),
+            jax.ShapeDtypeStruct((b, h, t, d), vt.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, do, lse, delta)
+
+    to_model = lambda x: x.transpose(0, 2, 1, 3)
+    return to_model(dq), to_model(dk), to_model(dv)
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _fwd(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
+    return _bwd(causal, block_q, block_k, interpret, residuals, g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _pick_block(t: int, target: int) -> int:
+    """Largest 8-aligned divisor of t not exceeding target (grid overhead
+    falls with block size: 512/1024 blocks measured 2.2x faster than
+    128/128 at t=2048 on v5e). Returns target when none divides — the
+    _use_kernel gate then routes to the dense fallback."""
+    if t <= target:
+        return t
+    for cand in range(target, 7, -8):
+        if t % cand == 0:
+            return cand
+    return target
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """Self-attention over [b, t, h, d] with softmax(q·kᵀ/√d)·v semantics.
+
+    Dispatches to the Pallas kernel on TPU when shapes tile cleanly
+    (t divisible by both block sizes, blocks 8-aligned, d a multiple of
+    128); otherwise the jnp reference (identical math). Blocks default to
+    the largest divisors of t up to 512 (q) / 1024 (k) — measured optimum
+    on v5e. ``interpret=True`` forces the kernel through the Pallas
+    interpreter — the CPU test path for kernel logic."""
+    t, d = q.shape[1], q.shape[3]
+    block_q = _pick_block(t, block_q or 512)
+    block_k = _pick_block(t, block_k or 1024)
+    if not _use_kernel(t, d, block_q, block_k, bool(interpret)):
+        return reference_attention(q, k, v, causal=causal)
+    return _flash(q, k, v, causal, block_q, block_k, bool(interpret))

@@ -5,7 +5,7 @@ builds the declared mesh (dp/fsdp/tp/cp), trains a transformer preset with
 the sharded Trainer on synthetic tokens, logs tokens/sec and MFU.
 
 workload config keys: preset ("tiny"|"gpt-small"|"bert-base"|"llama2-7b"|
-"llama2-13b"), steps, batch_size, seq_len, lr, attn ("dense"|"ring"),
+"llama2-13b"), steps, batch_size, seq_len, lr, attn ("dense"|"ring"|"flash"),
 checkpoint_dir, checkpoint_every (steps between saves; restart-based
 recovery resumes from the latest checkpoint), plus any TransformerConfig
 field as an override (e.g. n_layers).
@@ -44,8 +44,8 @@ def main(ctx: JobContext) -> None:
     batch = int(wl.get("batch_size", 8))
     seq = int(wl.get("seq_len", 512))
     overrides = {k: wl[k] for k in _CFG_FIELDS if k in wl}
-    if wl.get("attn") == "ring":
-        overrides["attn_impl"] = "ring"
+    if wl.get("attn") in ("ring", "flash", "dense"):
+        overrides["attn_impl"] = wl["attn"]
     cfg = preset(wl.get("preset", "tiny"), **overrides)
     mesh = ctx.build_mesh()
 
