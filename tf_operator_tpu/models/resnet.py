@@ -30,6 +30,15 @@ class ResNetConfig:
     widths: Tuple[int, ...] = (64, 128, 256, 512)
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
+    # "s2d": space-to-depth stem — the 7x7/s2 conv on 3 channels packs only
+    # 3 of the MXU's 128 input lanes; rearranging 2x2 pixel blocks into
+    # channels (4x4/s1 conv on [112,112,12]) computes the same receptive
+    # field at 4x the lane utilization (standard TPU ResNet reformulation).
+    # "conv7": the literal 7x7 stride-2 stem.
+    stem: str = "s2d"
+    # Apply BN normalization in the activation dtype (stats always f32):
+    # halves elementwise HBM traffic vs normalizing in f32.
+    bn_in_activation_dtype: bool = True
 
     @staticmethod
     def resnet50(num_classes: int = 1000) -> "ResNetConfig":
@@ -108,9 +117,13 @@ def resnet_logical_axes(params) -> Dict:
     return jax.tree_util.tree_map(lambda a: tuple(None for _ in a.shape), params)
 
 
-def _batch_norm(x, p, s, train: bool):
+def _batch_norm(x, p, s, train: bool, in_act_dtype: bool = True):
     """x: [b,h,w,c] activations (any float dtype). Stats in f32.
-    Returns (y, new_state)."""
+    Returns (y, new_state).
+
+    With ``in_act_dtype`` the per-channel affine (a = scale/sqrt(var+eps),
+    b = bias - mean*a) is folded in f32 and applied in the activation dtype
+    — one bf16 fma per element instead of f32 widen/normalize/narrow."""
     xf = x.astype(jnp.float32)
     if train:
         mean = jnp.mean(xf, axis=(0, 1, 2))
@@ -122,8 +135,11 @@ def _batch_norm(x, p, s, train: bool):
     else:
         mean, var = s["mean"], s["var"]
         new_s = s
-    y = (xf - mean) * jax.lax.rsqrt(var + BN_EPS) * p["scale"] + p["bias"]
-    return y.astype(x.dtype), new_s
+    a = jax.lax.rsqrt(var + BN_EPS) * p["scale"]
+    b = p["bias"] - mean * a
+    if in_act_dtype:
+        return x * a.astype(x.dtype) + b.astype(x.dtype), new_s
+    return (xf * a + b).astype(x.dtype), new_s
 
 
 def _conv(x, w, stride=1):
@@ -136,16 +152,45 @@ def _conv(x, w, stride=1):
     )
 
 
-def _bottleneck(x, bp, bs, stride, train):
-    y, s1 = _batch_norm(_conv(x, bp["conv1"]), bp["bn1"], bs["bn1"], train)
+def _space_to_depth(x, block: int = 2):
+    """[b,h,w,c] -> [b,h/2,w/2,4c]: 2x2 pixel blocks become channels."""
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // block, w // block, block * block * c)
+
+
+def _stem_s2d(x, w7):
+    """Exact reformulation of SAME 7x7/s2 conv as a 4x4/s1 conv on
+    space-to-depth(2) input: the 7x7 kernel is zero-padded to 8x8 and its
+    2x2 phase structure folded into input channels. Output position i reads
+    original rows 2i-2..2i+4, identical to SAME padding (2,3)."""
+    xs = _space_to_depth(x, 2)
+    k8 = jnp.pad(w7, ((0, 1), (0, 1), (0, 0), (0, 0)))
+    cin, cout = w7.shape[2], w7.shape[3]
+    k = (
+        k8.reshape(4, 2, 4, 2, cin, cout)
+        .transpose(0, 2, 1, 3, 4, 5)
+        .reshape(4, 4, 4 * cin, cout)
+    )
+    return jax.lax.conv_general_dilated(
+        xs,
+        k.astype(xs.dtype),
+        window_strides=(1, 1),
+        padding=((1, 2), (1, 2)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _bottleneck(x, bp, bs, stride, train, bn_act):
+    y, s1 = _batch_norm(_conv(x, bp["conv1"]), bp["bn1"], bs["bn1"], train, bn_act)
     y = jax.nn.relu(y)
-    y, s2 = _batch_norm(_conv(y, bp["conv2"], stride), bp["bn2"], bs["bn2"], train)
+    y, s2 = _batch_norm(_conv(y, bp["conv2"], stride), bp["bn2"], bs["bn2"], train, bn_act)
     y = jax.nn.relu(y)
-    y, s3 = _batch_norm(_conv(y, bp["conv3"]), bp["bn3"], bs["bn3"], train)
+    y, s3 = _batch_norm(_conv(y, bp["conv3"]), bp["bn3"], bs["bn3"], train, bn_act)
     new_bs = {"bn1": s1, "bn2": s2, "bn3": s3}
     if "proj" in bp:
         shortcut, sp = _batch_norm(
-            _conv(x, bp["proj"], stride), bp["proj_bn"], bs["proj_bn"], train
+            _conv(x, bp["proj"], stride), bp["proj_bn"], bs["proj_bn"], train, bn_act
         )
         new_bs["proj_bn"] = sp
     else:
@@ -155,9 +200,15 @@ def _bottleneck(x, bp, bs, stride, train):
 
 def resnet_forward(params, state, images, cfg: ResNetConfig, train: bool = True):
     """images: [b, h, w, 3] -> (logits [b, classes] f32, new_state)."""
+    bn_act = cfg.bn_in_activation_dtype
     x = images.astype(cfg.dtype)
-    x = _conv(x, params["stem"]["conv"], stride=2)
-    x, stem_s = _batch_norm(x, params["stem"]["bn"], state["stem"], train)
+    # s2d needs even spatial dims (2x2 blocks); odd sizes take the literal
+    # 7x7/s2 path, which SAME-pads any size.
+    if cfg.stem == "s2d" and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
+        x = _stem_s2d(x, params["stem"]["conv"])
+    else:
+        x = _conv(x, params["stem"]["conv"], stride=2)
+    x, stem_s = _batch_norm(x, params["stem"]["bn"], state["stem"], train, bn_act)
     x = jax.nn.relu(x)
     x = jax.lax.reduce_window(
         x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
@@ -168,7 +219,7 @@ def resnet_forward(params, state, images, cfg: ResNetConfig, train: bool = True)
         for bi in range(n_blocks):
             stride = 2 if (si > 0 and bi == 0) else 1
             x, bs = _bottleneck(
-                x, params[f"stage{si}"][bi], state[f"stage{si}"][bi], stride, train
+                x, params[f"stage{si}"][bi], state[f"stage{si}"][bi], stride, train, bn_act
             )
             stage_s.append(bs)
         new_state[f"stage{si}"] = stage_s
