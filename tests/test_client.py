@@ -1,0 +1,201 @@
+"""Typed clientset / informer factory tests.
+
+Mirrors the reference's generated-client usage: typed CRUD
+(pkg/client/clientset/versioned/typed/kubeflow/v1alpha2/tfjob.go),
+action-recording fakes (fake_tfjob.go), factory start + cache sync
+(informers/externalversions/factory.go)."""
+
+import pytest
+
+from tf_operator_tpu.api.types import (
+    KIND_PROCESS,
+    KIND_TPUJOB,
+    ObjectMeta,
+    ReplicaSpec,
+    ReplicaType,
+    TPUJob,
+    TPUJobSpec,
+    ProcessTemplate,
+)
+from tf_operator_tpu.client import Clientset, FakeClientset, InformerFactory
+from tf_operator_tpu.runtime.objects import Process, ProcessSpec
+from tf_operator_tpu.runtime.store import ConflictError, NotFoundError, Store, WatchEventType
+
+
+def make_job(name="j1", ns="default"):
+    return TPUJob(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=2, template=ProcessTemplate(entrypoint="m:f")
+                )
+            }
+        ),
+    )
+
+
+class TestKindClient:
+    def test_crud_roundtrip(self):
+        cs = Clientset(Store())
+        jobs = cs.tpujobs("default")
+        created = jobs.create(make_job())
+        assert created.metadata.uid
+        got = jobs.get("j1")
+        assert got.metadata.name == "j1"
+        got.spec.replica_specs[ReplicaType.WORKER].replicas = 3
+        jobs.update(got)
+        assert jobs.get("j1").spec.replica_specs[ReplicaType.WORKER].replicas == 3
+        jobs.delete("j1")
+        with pytest.raises(NotFoundError):
+            jobs.get("j1")
+
+    def test_namespace_binding_and_cross_namespace(self):
+        cs = Clientset(Store())
+        cs.tpujobs("ns-a").create(make_job("a", "ns-a"))
+        cs.tpujobs("ns-b").create(make_job("b", "ns-b"))
+        assert [j.metadata.name for j in cs.tpujobs("ns-a").list()] == ["a"]
+        # unbound client lists across namespaces
+        assert len(cs.tpujobs().list()) == 2
+        with pytest.raises(ValueError):
+            cs.tpujobs().get("a")  # unbound get needs explicit namespace
+        assert cs.tpujobs().get("a", namespace="ns-a").metadata.name == "a"
+
+    def test_update_status_subresource_preserves_spec(self):
+        """A status writer holding a stale spec must not clobber a newer
+        spec edit (the reason UpdateStatus is a subresource)."""
+        cs = Clientset(Store())
+        jobs = cs.tpujobs("default")
+        jobs.create(make_job())
+        stale = jobs.get("j1")  # reader snapshot
+        fresh = jobs.get("j1")
+        fresh.spec.replica_specs[ReplicaType.WORKER].replicas = 5
+        jobs.update(fresh)  # spec edit lands first
+        stale.status.restart_count = 7
+        jobs.update_status(stale)  # stale-spec status write
+        final = jobs.get("j1")
+        assert final.spec.replica_specs[ReplicaType.WORKER].replicas == 5
+        assert final.status.restart_count == 7
+
+    def test_update_status_retries_past_conflicting_writer(self):
+        """update_status must re-read on version conflict, not lose the
+        concurrent write (optimistic-concurrency retry loop)."""
+        store = Store()
+        cs = Clientset(store)
+        jobs = cs.tpujobs("default")
+        jobs.create(make_job())
+        snapshot = jobs.get("j1")
+        real_update = store.update
+        raced = {"done": False}
+
+        def racing_update(obj, check_version=False):
+            # First status write finds the object changed underneath it.
+            if not raced["done"]:
+                raced["done"] = True
+                fresh = store.get(obj.kind, obj.metadata.namespace, obj.metadata.name)
+                fresh.spec.replica_specs[ReplicaType.WORKER].replicas = 9
+                real_update(fresh)
+            return real_update(obj, check_version=check_version)
+
+        store.update = racing_update
+        snapshot.status.restart_count = 4
+        jobs.update_status(snapshot)
+        final = jobs.get("j1")
+        assert final.spec.replica_specs[ReplicaType.WORKER].replicas == 9
+        assert final.status.restart_count == 4
+
+    def test_optimistic_concurrency(self):
+        cs = Clientset(Store())
+        jobs = cs.tpujobs("default")
+        jobs.create(make_job())
+        a = jobs.get("j1")
+        b = jobs.get("j1")
+        jobs.update(a, check_version=True)
+        with pytest.raises(ConflictError):
+            jobs.update(b, check_version=True)
+
+    def test_delete_collection_by_label(self):
+        cs = Clientset(Store())
+        procs = cs.processes("default")
+        for i in range(3):
+            procs.create(
+                Process(
+                    metadata=ObjectMeta(
+                        name=f"p{i}",
+                        namespace="default",
+                        labels={"job": "a" if i < 2 else "b"},
+                    ),
+                    spec=ProcessSpec(job_name="a"),
+                )
+            )
+        assert procs.delete_collection(label_selector={"job": "a"}) == 2
+        assert [p.metadata.name for p in procs.list()] == ["p2"]
+
+    def test_watch_streams_typed_kind_only(self):
+        cs = Clientset(Store())
+        w = cs.tpujobs("default").watch()
+        cs.processes("default").create(
+            Process(metadata=ObjectMeta(name="p0", namespace="default"))
+        )
+        cs.tpujobs("default").create(make_job())
+        ev = w.queue.get(timeout=2)
+        assert ev.type is WatchEventType.ADDED and ev.obj.kind == KIND_TPUJOB
+        w.stop()
+
+
+class TestFakeClientset:
+    def test_records_actions_and_serves_reads(self):
+        fake = FakeClientset()
+        jobs = fake.tpujobs("default")
+        jobs.create(make_job())
+        jobs.get("j1")
+        jobs.list()
+        jobs.delete("j1")
+        verbs = [a.verb for a in fake.actions]
+        assert verbs == ["create", "get", "list", "delete"]
+        assert all(a.kind == KIND_TPUJOB for a in fake.actions)
+        assert fake.recorder.matching(verb="create")[0].name == "j1"
+
+    def test_private_store_isolation(self):
+        a, b = FakeClientset(), FakeClientset()
+        a.tpujobs("default").create(make_job())
+        assert b.tpujobs("default").list() == []
+
+
+class TestInformerFactory:
+    def test_shared_per_kind(self):
+        f = InformerFactory(Store())
+        assert f.informer(KIND_TPUJOB) is f.informer(KIND_TPUJOB)
+        assert f.informer(KIND_TPUJOB) is not f.informer(KIND_PROCESS)
+        assert f.lister(KIND_TPUJOB) is f.informer(KIND_TPUJOB)
+
+    def test_start_and_sync_sees_preexisting_and_live_objects(self):
+        store = Store()
+        cs = Clientset(store)
+        cs.tpujobs("default").create(make_job("pre"))
+        f = InformerFactory(store)
+        inf = f.informer(KIND_TPUJOB)
+        f.start()
+        assert f.wait_for_cache_sync(timeout=5)
+        assert inf.get("default", "pre") is not None
+        cs.tpujobs("default").create(make_job("live"))
+        for _ in range(200):
+            if inf.get("default", "live") is not None:
+                break
+            import time
+
+            time.sleep(0.01)
+        assert inf.get("default", "live") is not None
+        f.stop()
+
+    def test_late_informer_after_start_runs(self):
+        store = Store()
+        Clientset(store).processes("default").create(
+            Process(metadata=ObjectMeta(name="p0", namespace="default"))
+        )
+        f = InformerFactory(store)
+        f.start()
+        late = f.informer(KIND_PROCESS)  # created after Start — must still run
+        assert f.wait_for_cache_sync(timeout=5, kinds=[KIND_PROCESS])
+        assert late.get("default", "p0") is not None
+        f.stop()

@@ -1,0 +1,20 @@
+"""Client layer: typed clientsets, fakes, and the shared informer factory
+(reference L1, pkg/client/** — SURVEY.md §1)."""
+
+from tf_operator_tpu.client.clientset import (
+    Action,
+    ActionRecorder,
+    Clientset,
+    FakeClientset,
+    KindClient,
+)
+from tf_operator_tpu.client.factory import InformerFactory
+
+__all__ = [
+    "Action",
+    "ActionRecorder",
+    "Clientset",
+    "FakeClientset",
+    "KindClient",
+    "InformerFactory",
+]

@@ -1,0 +1,181 @@
+"""Typed clientset over the object store.
+
+Reference parity: the generated client layer (SURVEY.md §1 L1) —
+``pkg/client/clientset/versioned/typed/kubeflow/v1alpha2/tfjob.go:1-155``
+(per-kind typed CRUD with namespace binding and an UpdateStatus
+subresource) and its action-recording fake
+(``pkg/client/clientset/versioned/.../fake/fake_tfjob.go:1-126``). The
+reference generates this layer with k8s code-generator; here one generic
+``KindClient`` parameterized by kind serves all four kinds, since every
+managed object shares the ObjectMeta + to_dict/from_dict contract.
+
+Controllers may talk to the Store directly (as the operator talks to the
+apiserver through client-go); this layer is the *public* programmatic
+surface — what ``py/tf_job_client.py`` users would import — and the seam
+tests fake (the FakePodControl trick, controller_test.go:66-68).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from tf_operator_tpu.api.types import (
+    KIND_ENDPOINT,
+    KIND_EVENT,
+    KIND_PROCESS,
+    KIND_TPUJOB,
+)
+from tf_operator_tpu.runtime.store import Store, Watch
+
+
+class KindClient:
+    """CRUD for one kind, optionally bound to a namespace
+    (tfjob.go:1-155: newTFJobs(c, namespace) binding)."""
+
+    def __init__(self, store: Store, kind: str, namespace: Optional[str] = None,
+                 recorder: Optional["ActionRecorder"] = None) -> None:
+        self._store = store
+        self.kind = kind
+        self.namespace = namespace
+        self._rec = recorder
+
+    def _ns(self, obj=None, namespace: Optional[str] = None) -> str:
+        if namespace is not None:
+            return namespace
+        if obj is not None:
+            return obj.metadata.namespace
+        if self.namespace is None:
+            raise ValueError(f"{self.kind} client not namespace-bound; pass namespace=")
+        return self.namespace
+
+    def _record(self, verb: str, namespace: str, name: str) -> None:
+        if self._rec is not None:
+            self._rec.record(verb, self.kind, namespace, name)
+
+    # -- CRUD (tfjob.go Create/Get/Update/UpdateStatus/Delete/List/Watch) --
+
+    def create(self, obj):
+        if self.namespace is not None and not obj.metadata.namespace:
+            obj.metadata.namespace = self.namespace
+        self._record("create", obj.metadata.namespace, obj.metadata.name)
+        return self._store.create(obj)
+
+    def get(self, name: str, namespace: Optional[str] = None):
+        ns = self._ns(namespace=namespace)
+        self._record("get", ns, name)
+        return self._store.get(self.kind, ns, name)
+
+    def update(self, obj, check_version: bool = False):
+        self._record("update", obj.metadata.namespace, obj.metadata.name)
+        return self._store.update(obj, check_version=check_version)
+
+    def update_status(self, obj, _retries: int = 5):
+        """Subresource semantics (UpdateStatus): only ``status`` is taken
+        from the caller; spec/labels come from the stored object, so a
+        status writer can never clobber a concurrent spec edit. The
+        read-modify-write runs under optimistic concurrency with retries —
+        a concurrent spec update triggers a re-read, never a lost write."""
+        from tf_operator_tpu.runtime.store import ConflictError
+
+        self._record("update_status", obj.metadata.namespace, obj.metadata.name)
+        last_exc: Exception = RuntimeError("unreachable")
+        for _ in range(_retries):
+            stored = self._store.get(
+                self.kind, obj.metadata.namespace, obj.metadata.name
+            )
+            stored.status = obj.status
+            try:
+                return self._store.update(stored, check_version=True)
+            except ConflictError as exc:
+                last_exc = exc
+        raise last_exc
+
+    def delete(self, name: str, namespace: Optional[str] = None):
+        ns = self._ns(namespace=namespace)
+        self._record("delete", ns, name)
+        return self._store.delete(self.kind, ns, name)
+
+    def list(self, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None) -> List[Any]:
+        ns = namespace if namespace is not None else self.namespace
+        self._record("list", ns or "*", "*")
+        return self._store.list(self.kind, namespace=ns, label_selector=label_selector)
+
+    def delete_collection(self, namespace: Optional[str] = None,
+                          label_selector: Optional[Dict[str, str]] = None) -> int:
+        """Delete everything matching (DeleteCollection); returns count."""
+        n = 0
+        for obj in self.list(namespace=namespace, label_selector=label_selector):
+            try:
+                self.delete(obj.metadata.name, namespace=obj.metadata.namespace)
+                n += 1
+            except KeyError:
+                pass  # raced with another deleter
+        return n
+
+    def watch(self) -> Watch:
+        self._record("watch", self.namespace or "*", "*")
+        return self._store.watch(kinds=[self.kind])
+
+
+class Clientset:
+    """Per-kind typed accessors (versioned clientset,
+    pkg/client/clientset/versioned/clientset.go analogue)."""
+
+    def __init__(self, store: Store, recorder: Optional["ActionRecorder"] = None) -> None:
+        self.store = store
+        self._rec = recorder
+
+    def tpujobs(self, namespace: Optional[str] = None) -> KindClient:
+        return KindClient(self.store, KIND_TPUJOB, namespace, self._rec)
+
+    def processes(self, namespace: Optional[str] = None) -> KindClient:
+        return KindClient(self.store, KIND_PROCESS, namespace, self._rec)
+
+    def endpoints(self, namespace: Optional[str] = None) -> KindClient:
+        return KindClient(self.store, KIND_ENDPOINT, namespace, self._rec)
+
+    def events(self, namespace: Optional[str] = None) -> KindClient:
+        return KindClient(self.store, KIND_EVENT, namespace, self._rec)
+
+
+@dataclass
+class Action:
+    """One recorded client action (k8s testing.Action analogue)."""
+
+    verb: str
+    kind: str
+    namespace: str
+    name: str
+
+
+class ActionRecorder:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.actions: List[Action] = []
+
+    def record(self, verb: str, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            self.actions.append(Action(verb, kind, namespace, name))
+
+    def matching(self, verb: Optional[str] = None, kind: Optional[str] = None) -> List[Action]:
+        with self._lock:
+            return [a for a in self.actions
+                    if (verb is None or a.verb == verb) and (kind is None or a.kind == kind)]
+
+
+class FakeClientset(Clientset):
+    """Clientset over a private in-memory store, recording every action —
+    the fake clientset tests inject (fake_tfjob.go; used throughout
+    training_test.go:21-31). Fully functional: reads/writes hit the
+    private store, so tests can both assert intent and observe effects."""
+
+    def __init__(self, store: Optional[Store] = None) -> None:
+        self.recorder = ActionRecorder()
+        super().__init__(store if store is not None else Store(), self.recorder)
+
+    @property
+    def actions(self) -> List[Action]:
+        return list(self.recorder.actions)
