@@ -1,0 +1,171 @@
+"""API helper tests (reference: helpers_test.go — AsOwner and
+ConfigureAcceleratorsForTFJobSpec coverage, 248 LoC)."""
+
+import json
+
+import pytest
+
+from tf_operator_tpu.api.helpers import (
+    AcceleratorConfig,
+    ControllerConfig,
+    accelerator_env,
+    as_owner,
+)
+from tf_operator_tpu.api.types import (
+    KIND_TPUJOB,
+    ObjectMeta,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    TopologySpec,
+    TPUJob,
+    TPUJobSpec,
+)
+from tf_operator_tpu.controller import TPUJobController
+from tf_operator_tpu.runtime.process_backend import FakeProcessControl
+from tf_operator_tpu.runtime.store import Store
+
+
+def test_as_owner_fields():
+    job = TPUJob(metadata=ObjectMeta(name="j", namespace="ns", uid="abc123"))
+    o = as_owner(job)
+    assert o == {"owner_uid": "abc123", "owner_kind": KIND_TPUJOB, "owner_name": "j"}
+
+
+class TestControllerConfig:
+    def make(self):
+        return ControllerConfig.from_dict(
+            {
+                "accelerators": {
+                    "v5p": {"env": {"A": "v5p"}, "library_paths": ["/lib/tpu"]},
+                    "v5p-128": {"env": {"A": "v5p-128"}},
+                    "*": {"env": {"A": "any", "B": "1"}},
+                }
+            }
+        )
+
+    def test_longest_prefix_match(self):
+        cfg = self.make()
+        assert cfg.match("v5p-128").env["A"] == "v5p-128"
+        assert cfg.match("v5p-32").env["A"] == "v5p"
+        assert cfg.match("v5e-4").env["A"] == "any"
+
+    def test_match_respects_token_boundaries(self):
+        """'v5p-16' must not match key 'v5p-1' (prefix without the '-'
+        boundary)."""
+        cfg = ControllerConfig.from_dict(
+            {
+                "accelerators": {
+                    "v5p-1": {"env": {"A": "one"}},
+                    "v5p": {"env": {"A": "family"}},
+                }
+            }
+        )
+        assert cfg.match("v5p-16").env["A"] == "family"
+        assert cfg.match("v5p-1").env["A"] == "one"
+
+    def test_match_any_fallback_and_none(self):
+        cfg = ControllerConfig.from_dict(
+            {"accelerators": {"v5p": {"env": {"A": "x"}}}}
+        )
+        assert cfg.match("v4-8") is None
+        assert accelerator_env(cfg, "v4-8") == {}
+        assert accelerator_env(None, "v5p-32") == {}
+
+    def test_library_paths_merge_ld_library_path(self):
+        cfg = ControllerConfig.from_dict(
+            {"accelerators": {"v5e": {"library_paths": ["/a", "/b"]}}}
+        )
+        env = accelerator_env(cfg, "v5e-8", base_ld_library_path="/base")
+        assert env["LD_LIBRARY_PATH"] == "/a:/b:/base"
+        env = accelerator_env(cfg, "v5e-8", base_ld_library_path="")
+        assert env["LD_LIBRARY_PATH"].startswith("/a:/b")
+
+    def test_load_json_file(self, tmp_path):
+        p = tmp_path / "cc.json"
+        p.write_text(json.dumps({"accelerators": {"v5e": {"env": {"X": "1"}}}}))
+        cfg = ControllerConfig.load(str(p))
+        assert cfg.match("v5e-4").env == {"X": "1"}
+
+    def test_load_rejects_non_mapping(self, tmp_path):
+        p = tmp_path / "cc.json"
+        p.write_text("[1, 2]")
+        with pytest.raises(ValueError):
+            ControllerConfig.load(str(p))
+
+
+class TestInjectionIntoProcesses:
+    def test_env_precedence_admin_then_user_then_identity(self):
+        """Admin env is a default; user template env overrides it; the
+        rendezvous identity always wins (reconciler layering)."""
+        store = Store()
+        control = FakeProcessControl()
+        cc = ControllerConfig(
+            accelerators={
+                "v5e": AcceleratorConfig(
+                    env={"ADMIN_ONLY": "yes", "SHARED": "admin"},
+                    library_paths=["/opt/tpu/lib"],
+                )
+            }
+        )
+        ctl = TPUJobController(store, control, controller_config=cc)
+        job = TPUJob(
+            metadata=ObjectMeta(name="j", namespace="default"),
+            spec=TPUJobSpec(
+                replica_specs={
+                    ReplicaType.WORKER: ReplicaSpec(
+                        replicas=1,
+                        template=ProcessTemplate(
+                            entrypoint="m:f", env={"SHARED": "user"}
+                        ),
+                    )
+                },
+                topology=TopologySpec(slice_type="v5e-8", num_hosts=1, chips_per_host=8),
+            ),
+        )
+        from tf_operator_tpu.api import set_defaults
+
+        set_defaults(job)
+        created = store.create(job)
+        ctl.job_informer.seed([created])
+        ctl.process_informer.seed([])
+        ctl.sync_job(created.key())
+        assert control.created, "no processes created"
+        env = control.created[0].spec.env
+        assert env["ADMIN_ONLY"] == "yes"
+        assert env["SHARED"] == "user"  # user template beats admin
+        assert env["LD_LIBRARY_PATH"].startswith("/opt/tpu/lib")
+        assert "TPUJOB_COORDINATOR_ADDRESS" in env  # identity still present
+
+    def test_user_ld_library_path_merges_with_admin_paths(self):
+        """A template that sets LD_LIBRARY_PATH must not evict the admin
+        libtpu/driver dirs — the values path-merge (admin first)."""
+        store = Store()
+        control = FakeProcessControl()
+        cc = ControllerConfig(
+            accelerators={"v5e": AcceleratorConfig(library_paths=["/opt/tpu/lib"])}
+        )
+        ctl = TPUJobController(store, control, controller_config=cc)
+        job = TPUJob(
+            metadata=ObjectMeta(name="j2", namespace="default"),
+            spec=TPUJobSpec(
+                replica_specs={
+                    ReplicaType.WORKER: ReplicaSpec(
+                        replicas=1,
+                        template=ProcessTemplate(
+                            entrypoint="m:f", env={"LD_LIBRARY_PATH": "/my/deps"}
+                        ),
+                    )
+                },
+                topology=TopologySpec(slice_type="v5e-8", num_hosts=1, chips_per_host=8),
+            ),
+        )
+        from tf_operator_tpu.api import set_defaults
+
+        set_defaults(job)
+        created = store.create(job)
+        ctl.job_informer.seed([created])
+        ctl.process_informer.seed([])
+        ctl.sync_job(created.key())
+        env = control.created[0].spec.env
+        assert env["LD_LIBRARY_PATH"] == "/opt/tpu/lib:/my/deps"

@@ -1,0 +1,133 @@
+"""API helpers: owner references + admin accelerator/runtime injection.
+
+Reference parity: ``pkg/apis/tensorflow/helper/helpers.go`` — ``AsOwner``
+(:36-47) and ``ConfigureAcceleratorsForTFJobSpec`` (:50-104), where an
+admin-supplied ControllerConfig (loaded from a YAML file by the daemon,
+``cmd/tf-operator/app/server.go:138-156``) maps an accelerator resource
+name (e.g. ``alpha.kubernetes.io/nvidia-gpu``) to hostPath volumes and env
+vars injected into matching containers.
+
+TPU-native shape: processes, not containers, so "volumes" become library
+directories prepended to ``LD_LIBRARY_PATH`` and plain env vars (the way
+libtpu/driver paths reach a JAX process). Matching pivots on the job's
+slice type (``v5p-32`` matches config key ``v5p``) instead of container
+resource limits — chip kind is the resource on a TPU cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from tf_operator_tpu.api.types import KIND_TPUJOB, TPUJob
+
+# Accelerator key that matches any slice type (admin catch-all).
+MATCH_ANY = "*"
+
+
+def as_owner(job: TPUJob) -> Dict[str, str]:
+    """Owner-reference fields for a child of ``job`` (AsOwner,
+    helpers.go:36-47 — there BlockOwnerDeletion/Controller flags, here the
+    uid/kind/name triple the adoption machinery pivots on)."""
+    return {
+        "owner_uid": job.metadata.uid,
+        "owner_kind": KIND_TPUJOB,
+        "owner_name": job.metadata.name,
+    }
+
+
+@dataclass
+class AcceleratorConfig:
+    """Injection recipe for one chip kind (AcceleratorConfig,
+    v1alpha1/types.go:175-204: Volumes + EnvVars)."""
+
+    env: Dict[str, str] = field(default_factory=dict)
+    # Directories prepended (in order) to LD_LIBRARY_PATH — the hostPath
+    # volume analogue for an OS-process runtime.
+    library_paths: List[str] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Dict) -> "AcceleratorConfig":
+        return AcceleratorConfig(
+            env={str(k): str(v) for k, v in d.get("env", {}).items()},
+            library_paths=[str(p) for p in d.get("library_paths", [])],
+        )
+
+
+@dataclass
+class ControllerConfig:
+    """Admin-level operator configuration (ControllerConfig,
+    v1alpha1/types.go:175-204), keyed by chip kind."""
+
+    accelerators: Dict[str, AcceleratorConfig] = field(default_factory=dict)
+
+    @staticmethod
+    def from_dict(d: Dict) -> "ControllerConfig":
+        return ControllerConfig(
+            accelerators={
+                str(k): AcceleratorConfig.from_dict(v)
+                for k, v in d.get("accelerators", {}).items()
+            }
+        )
+
+    @staticmethod
+    def load(path: str) -> "ControllerConfig":
+        """Read a JSON (or, if PyYAML is present, YAML) config file
+        (readControllerConfig, server.go:138-156)."""
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+        try:
+            data = json.loads(text)
+        except json.JSONDecodeError:
+            try:
+                import yaml  # type: ignore
+            except ImportError as exc:
+                raise ValueError(
+                    f"{path}: not valid JSON and PyYAML unavailable"
+                ) from exc
+            data = yaml.safe_load(text)
+        if not isinstance(data, dict):
+            raise ValueError(f"{path}: expected a mapping at top level")
+        return ControllerConfig.from_dict(data)
+
+    def match(self, slice_type: str) -> Optional[AcceleratorConfig]:
+        """Longest-prefix match of slice type against accelerator keys
+        ('v5p-32' prefers key 'v5p-32' over 'v5p' over '*') — the
+        resource-limit matching loop of helpers.go:50-104 recast for
+        slice types."""
+        best: Tuple[int, Optional[AcceleratorConfig]] = (-1, None)
+        for key, cfg in self.accelerators.items():
+            if key == MATCH_ANY:
+                if best[0] < 0:
+                    best = (0, cfg)
+            elif slice_type == key or slice_type.startswith(key + "-"):
+                if len(key) > best[0]:
+                    best = (len(key), cfg)
+        return best[1]
+
+
+def accelerator_env(
+    config: Optional[ControllerConfig],
+    slice_type: str,
+    base_ld_library_path: str = "",
+) -> Dict[str, str]:
+    """Env-var injection for a process of a job on ``slice_type``.
+
+    Returns the admin env plus a merged LD_LIBRARY_PATH. Injected values
+    are *defaults*: callers layer user template env and rendezvous
+    identity on top (the reference appends admin volumes/env to the
+    container; user-specified values keep precedence here, which is the
+    safer direction for env maps)."""
+    if config is None:
+        return {}
+    accel = config.match(slice_type)
+    if accel is None:
+        return {}
+    env = dict(accel.env)
+    if accel.library_paths:
+        merged = ":".join(accel.library_paths)
+        base = base_ld_library_path or os.environ.get("LD_LIBRARY_PATH", "")
+        env["LD_LIBRARY_PATH"] = f"{merged}:{base}" if base else merged
+    return env

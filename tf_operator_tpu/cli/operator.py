@@ -49,6 +49,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="0-10: probability/10 of killing each running process "
                         "per chaos interval (reference flag was unimplemented)")
     p.add_argument("--chaos-interval", type=float, default=10.0)
+    p.add_argument("--controller-config-file", default=None,
+                   help="admin ControllerConfig (JSON/YAML) mapping chip kinds "
+                        "to env/library injection (reference: "
+                        "--controller-config-file, server.go:138-156)")
     p.add_argument("--backend", choices=("native", "local"), default="native",
                    help="process runtime: 'native' = C++ supervisor "
                         "(group kills, normalized exit codes; built on demand), "
@@ -136,8 +140,15 @@ def main(argv=None) -> int:
             backend = LocalProcessControl(store, log_dir=args.log_dir)
     else:
         backend = LocalProcessControl(store, log_dir=args.log_dir)
+    controller_config = None
+    if args.controller_config_file:
+        from tf_operator_tpu.api.helpers import ControllerConfig
+
+        controller_config = ControllerConfig.load(args.controller_config_file)
+        log.info("loaded controller config from %s", args.controller_config_file)
     controller = TPUJobController(
-        store, backend, resync_period=args.resync_period
+        store, backend, resync_period=args.resync_period,
+        controller_config=controller_config,
     )
     dashboard = DashboardServer(store, host=args.host, port=args.port)
     chaos = ChaosMonkey(store, args.chaos_level, args.chaos_interval)

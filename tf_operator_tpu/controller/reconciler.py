@@ -53,6 +53,7 @@ from tf_operator_tpu.api.types import (
     RestartPolicy,
     TPUJob,
 )
+from tf_operator_tpu.api.helpers import accelerator_env, as_owner
 from tf_operator_tpu.api.validation import ValidationError
 from tf_operator_tpu.controller import events as ev
 from tf_operator_tpu.controller.events import EventRecorder
@@ -122,6 +123,7 @@ class TPUJobController:
         resync_period: float = 15.0,
         host_resolver: Callable[[Process], str] = _default_host_resolver,
         port_allocator: Callable[[], int] = _default_port_allocator,
+        controller_config=None,
     ) -> None:
         self.store = store
         self.process_control = process_control
@@ -129,6 +131,9 @@ class TPUJobController:
         self.resync_period = resync_period
         self.host_resolver = host_resolver
         self.port_allocator = port_allocator
+        # Admin accelerator/runtime injection (ControllerConfig,
+        # api/helpers.py; reference server.go:138-156 + helpers.go:50-104).
+        self.controller_config = controller_config
 
         self.queue = RateLimitingQueue()
         self.expectations = ControllerExpectations()
@@ -565,7 +570,22 @@ class TPUJobController:
             }
             is_gang = (rtype, index) in gang
             rank = gang.index((rtype, index)) if is_gang else 0
-            env = dict(rs.template.env)
+            # Admin accelerator env first (defaults), user template env on
+            # top, rendezvous identity last (helpers.go:50-104 analogue).
+            # LD_LIBRARY_PATH path-merges instead of clobbering: admin
+            # library dirs (libtpu/driver) are prepended to the template's
+            # own value (or the ambient one) by accelerator_env — the
+            # reference appends admin volumes unconditionally.
+            admin_env = accelerator_env(
+                self.controller_config,
+                job.spec.topology.slice_type,
+                base_ld_library_path=rs.template.env.get("LD_LIBRARY_PATH", ""),
+            )
+            env = dict(admin_env)
+            tmpl_env = dict(rs.template.env)
+            if "LD_LIBRARY_PATH" in admin_env:
+                tmpl_env.pop("LD_LIBRARY_PATH", None)  # already merged in
+            env.update(tmpl_env)
             mesh = job.spec.topology.mesh_axes
             env.update(
                 {
@@ -582,9 +602,7 @@ class TPUJobController:
                         name=name,
                         namespace=job.metadata.namespace,
                         labels=labels,
-                        owner_uid=job.metadata.uid,
-                        owner_kind=KIND_TPUJOB,
-                        owner_name=job.metadata.name,
+                        **as_owner(job),
                     ),
                     spec=ProcessSpec(
                         job_name=job.metadata.name,
@@ -652,9 +670,7 @@ class TPUJobController:
                         name=name,
                         namespace=job.metadata.namespace,
                         labels=self._labels_for(job),
-                        owner_uid=job.metadata.uid,
-                        owner_kind=KIND_TPUJOB,
-                        owner_name=job.metadata.name,
+                        **as_owner(job),
                     ),
                     address=EndpointAddress(host=host, port=port),
                     target_process=target,
