@@ -1,0 +1,193 @@
+"""v1alpha1 compat-generation tests (reference: the dual-generation API,
+v1alpha1/types.go list-based spec; conversion semantics per SURVEY.md §7
+— PS collapses, MASTER becomes Coordinator)."""
+
+import pytest
+
+from tf_operator_tpu.api.types import ReplicaType, RestartPolicy, TPUJob
+from tf_operator_tpu.api.v1alpha1 import (
+    convert_v1alpha1,
+    is_v1alpha1,
+    parse_job,
+    to_v1alpha1,
+)
+from tf_operator_tpu.api.validation import ValidationError
+
+
+def v1_doc(**spec_extra):
+    return {
+        "api_version": "v1alpha1",
+        "metadata": {"name": "old-job", "namespace": "default"},
+        "spec": {
+            "runtime_id": "a1b2",
+            "replica_specs": [
+                {
+                    "replica_type": "MASTER",
+                    "replicas": 1,
+                    "template": {"entrypoint": "m:chief"},
+                },
+                {
+                    "replica_type": "WORKER",
+                    "replicas": 3,
+                    "template": {"entrypoint": "m:train", "env": {"X": "1"}},
+                    "restart_policy": "ExitCode",
+                },
+            ],
+            **spec_extra,
+        },
+    }
+
+
+class TestDetection:
+    def test_explicit_version(self):
+        assert is_v1alpha1({"api_version": "v1alpha1", "spec": {}})
+
+    def test_list_shape_detected(self):
+        assert is_v1alpha1({"spec": {"replica_specs": []}})
+
+    def test_map_shape_is_primary(self):
+        assert not is_v1alpha1({"spec": {"replica_specs": {}}})
+
+
+class TestConversion:
+    def test_master_becomes_coordinator(self):
+        job = convert_v1alpha1(v1_doc())
+        assert set(job.spec.replica_specs) == {
+            ReplicaType.COORDINATOR,
+            ReplicaType.WORKER,
+        }
+        coord = job.spec.replica_specs[ReplicaType.COORDINATOR]
+        assert coord.replicas == 1 and coord.template.entrypoint == "m:chief"
+        worker = job.spec.replica_specs[ReplicaType.WORKER]
+        assert worker.replicas == 3
+        assert worker.restart_policy is RestartPolicy.EXIT_CODE
+        assert worker.template.env == {"X": "1"}
+
+    def test_runtime_id_preserved_as_annotation(self):
+        job = convert_v1alpha1(v1_doc())
+        assert job.metadata.annotations["tpujob.v1alpha1/runtime-id"] == "a1b2"
+
+    def test_ps_rejected_with_explanation(self):
+        doc = v1_doc()
+        doc["spec"]["replica_specs"].append(
+            {"replica_type": "PS", "replicas": 2, "template": {}}
+        )
+        with pytest.raises(ValidationError, match="parameter servers"):
+            convert_v1alpha1(doc)
+
+    def test_duplicate_role_rejected(self):
+        doc = v1_doc()
+        doc["spec"]["replica_specs"].append(
+            {"replica_type": "CHIEF", "replicas": 1, "template": {}}
+        )  # CHIEF also maps to Coordinator -> duplicate
+        with pytest.raises(ValidationError, match="duplicate"):
+            convert_v1alpha1(doc)
+
+    def test_unknown_type_rejected(self):
+        doc = v1_doc()
+        doc["spec"]["replica_specs"][0]["replica_type"] = "GLUON"
+        with pytest.raises(ValidationError, match="unknown replica_type"):
+            convert_v1alpha1(doc)
+
+    def test_termination_policy_worker0_without_coordinator_ok(self):
+        doc = {
+            "api_version": "v1alpha1",
+            "metadata": {"name": "w", "namespace": "default"},
+            "spec": {
+                "replica_specs": [
+                    {"replica_type": "WORKER", "replicas": 2,
+                     "template": {"entrypoint": "m:f"}}
+                ],
+                "termination_policy": {
+                    "chief": {"replica_name": "WORKER", "replica_index": 0}
+                },
+            },
+        }
+        job = convert_v1alpha1(doc)
+        assert set(job.spec.replica_specs) == {ReplicaType.WORKER}
+
+    def test_chief_master_without_coordinator_replica_rejected(self):
+        doc = {
+            "api_version": "v1alpha1",
+            "metadata": {"name": "w", "namespace": "default"},
+            "spec": {
+                "replica_specs": [
+                    {"replica_type": "WORKER", "replicas": 2,
+                     "template": {"entrypoint": "m:f"}}
+                ],
+                "termination_policy": {
+                    "chief": {"replica_name": "MASTER", "replica_index": 0}
+                },
+            },
+        }
+        with pytest.raises(ValidationError, match="no coordinator"):
+            convert_v1alpha1(doc)
+
+    def test_termination_policy_nonzero_worker_rejected(self):
+        doc = v1_doc(
+            termination_policy={"chief": {"replica_name": "WORKER",
+                                          "replica_index": 2}}
+        )
+        with pytest.raises(ValidationError, match="chief"):
+            convert_v1alpha1(doc)
+
+    def test_topology_and_workload_pass_through(self):
+        job = convert_v1alpha1(
+            v1_doc(topology={"slice_type": "v5e-8", "num_hosts": 1,
+                             "chips_per_host": 8},
+                   workload={"steps": 5})
+        )
+        assert job.spec.topology.slice_type == "v5e-8"
+        assert job.spec.workload == {"steps": 5}
+
+
+class TestParseAndRoundTrip:
+    def test_parse_job_dispatches_both_generations(self):
+        old = parse_job(v1_doc())
+        assert ReplicaType.COORDINATOR in old.spec.replica_specs
+        new = parse_job(old.to_dict())
+        assert new.to_dict() == old.to_dict()
+
+    def test_down_convert_round_trip(self):
+        job = convert_v1alpha1(v1_doc())
+        doc = to_v1alpha1(job)
+        assert doc["api_version"] == "v1alpha1"
+        types = {e["replica_type"] for e in doc["spec"]["replica_specs"]}
+        assert types == {"MASTER", "WORKER"}
+        back = parse_job(doc)
+        assert {r.value for r in back.spec.replica_specs} == {
+            r.value for r in job.spec.replica_specs
+        }
+        assert (
+            back.spec.replica_specs[ReplicaType.WORKER].template.env
+            == job.spec.replica_specs[ReplicaType.WORKER].template.env
+        )
+
+
+class TestRestSurface:
+    def test_rest_accepts_v1alpha1_document(self):
+        from tf_operator_tpu.dashboard import DashboardServer
+        from tf_operator_tpu.dashboard.client import TPUJobClient
+        from tf_operator_tpu.runtime.store import Store
+        import json as _json
+        import urllib.request
+
+        store = Store()
+        srv = DashboardServer(store, port=0)
+        srv.start()
+        try:
+            doc = v1_doc()
+            req = urllib.request.Request(
+                srv.url + "/api/tpujob",
+                data=_json.dumps(doc).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                out = _json.loads(resp.read())
+            assert resp.status == 201
+            assert "Coordinator" in out["spec"]["replica_specs"]
+            jobs = TPUJobClient(srv.url).list("default")
+            assert jobs[0].metadata.name == "old-job"
+        finally:
+            srv.stop()

@@ -1,0 +1,215 @@
+"""v1alpha1-compatible job spec: list-based replica specs + conversion.
+
+Reference parity: the repo carries TWO coexisting API generations
+(SURVEY.md §0) — v1alpha1 (``pkg/apis/tensorflow/v1alpha1/types.go:40-160``:
+``ReplicaSpecs []*TFReplicaSpec`` with ``TFReplicaType`` per entry, a
+``TerminationPolicy`` naming the chief, and a job-level ``RuntimeId``) and
+v1alpha2 (map-based). The primary API here (api/types.py) is the
+v1alpha2-shaped one; this module accepts the older list shape and converts,
+so v1alpha1-style job documents keep working — the same compatibility story
+the reference's dual controllers provide.
+
+Wire format accepted::
+
+    {"api_version": "v1alpha1",
+     "metadata": {...},
+     "spec": {"replica_specs": [
+         {"replica_type": "Coordinator"|"Worker"|"Evaluator"
+                          |"MASTER"|"CHIEF"|"PS"|"WORKER"|"EVALUATOR",
+          "replicas": 2, "template": {...}, "port": 8476,
+          "restart_policy": "ExitCode"},
+        ...],
+      "termination_policy": {"chief": {"replica_name": "WORKER",
+                                        "replica_index": 0}},
+      "topology": {...}, "run_policy": {...}, "workload": {...}}}
+
+Reference-role mapping (v1alpha1/types.go:80-84): MASTER/CHIEF →
+Coordinator, WORKER → Worker, EVALUATOR → Evaluator. PS is rejected — SPMD
+has no parameter servers (SURVEY.md §7: the PS role *collapses*); jobs that
+carried PS replicas must drop them, and the error says so explicitly.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List
+
+from tf_operator_tpu.api.types import (
+    ObjectMeta,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    RunPolicy,
+    TopologySpec,
+    TPUJob,
+    TPUJobSpec,
+)
+from tf_operator_tpu.api.validation import ValidationError
+
+API_VERSION_V1ALPHA1 = "v1alpha1"
+
+# v1alpha1 replica-type vocabulary → TPU-native roles.
+_ROLE_MAP = {
+    "MASTER": ReplicaType.COORDINATOR,
+    "CHIEF": ReplicaType.COORDINATOR,
+    "COORDINATOR": ReplicaType.COORDINATOR,
+    "WORKER": ReplicaType.WORKER,
+    "EVALUATOR": ReplicaType.EVALUATOR,
+}
+
+
+def is_v1alpha1(data: Dict[str, Any]) -> bool:
+    """A document is v1alpha1-shaped if it says so or if its replica_specs
+    is a list (the generation-defining difference)."""
+    if data.get("api_version") == API_VERSION_V1ALPHA1:
+        return True
+    rs = data.get("spec", {}).get("replica_specs")
+    return isinstance(rs, list)
+
+
+def convert_v1alpha1(data: Dict[str, Any]) -> TPUJob:
+    """Convert a v1alpha1-shaped dict into the primary TPUJob type.
+
+    Raises ValidationError for PS replicas, duplicate roles, and unknown
+    replica types — conversion failures must be loud, not lossy.
+    """
+    spec_d = data.get("spec", {})
+    entries = spec_d.get("replica_specs", [])
+    if not isinstance(entries, list):
+        raise ValidationError("v1alpha1 spec.replica_specs must be a list")
+
+    replica_specs: Dict[ReplicaType, ReplicaSpec] = {}
+    for i, entry in enumerate(entries):
+        raw_type = str(
+            entry.get("replica_type", entry.get("tpu_replica_type", ""))
+        ).upper()
+        if raw_type == "PS":
+            raise ValidationError(
+                "v1alpha1 PS replicas have no TPU equivalent: SPMD training "
+                "has no parameter servers — drop the PS replica set and let "
+                "data parallelism shard the batch (SURVEY.md §2.3)"
+            )
+        role = _ROLE_MAP.get(raw_type)
+        if role is None:
+            raise ValidationError(
+                f"replica_specs[{i}]: unknown replica_type {raw_type!r}"
+            )
+        if role in replica_specs:
+            raise ValidationError(
+                f"replica_specs[{i}]: duplicate role {role.value} "
+                f"(v1alpha1 lists may not repeat a type)"
+            )
+        entry = dict(entry)
+        entry.pop("replica_type", None)
+        entry.pop("tpu_replica_type", None)
+        try:
+            tmpl = ProcessTemplate(**entry.pop("template", {}))
+            rp = entry.pop("restart_policy", None)
+            replica_specs[role] = ReplicaSpec(
+                template=tmpl,
+                restart_policy=RestartPolicy(rp) if rp else None,
+                **entry,
+            )
+        except (TypeError, ValueError) as exc:
+            # Loud, typed failures: unknown keys / bad values must surface
+            # as ValidationError, the error the CLI/REST surfaces render.
+            raise ValidationError(f"replica_specs[{i}]: {exc}") from exc
+
+    # TerminationPolicy (v1alpha1/types.go:48-63): the chief designation.
+    # Coordinator-present already means chief; otherwise only the default
+    # (worker 0) is expressible in the new API — reject anything else
+    # rather than silently changing which process decides job success.
+    term = spec_d.get("termination_policy") or {}
+    chief = term.get("chief") or {}
+    if chief:
+        cname = str(chief.get("replica_name", "")).upper()
+        try:
+            cidx = int(chief.get("replica_index", 0))
+        except (TypeError, ValueError) as exc:
+            raise ValidationError(
+                f"termination_policy chief replica_index "
+                f"{chief.get('replica_index')!r} is not an integer"
+            ) from exc
+        crole = _ROLE_MAP.get(cname)
+        if crole is None:
+            raise ValidationError(f"termination_policy chief {cname!r} unknown")
+        if crole is ReplicaType.COORDINATOR:
+            if ReplicaType.COORDINATOR not in replica_specs:
+                raise ValidationError(
+                    f"termination_policy: chief {cname!r} named but the job "
+                    "declares no coordinator/master replica set"
+                )
+        elif not (crole is ReplicaType.WORKER and cidx == 0
+                  and ReplicaType.COORDINATOR not in replica_specs):
+            raise ValidationError(
+                "termination_policy: only the coordinator (or worker 0 when "
+                "no coordinator exists) can be chief in the TPU-native API"
+            )
+
+    meta_d = dict(data.get("metadata", {}))
+    # v1alpha1 carried a job-level RuntimeId (types.go:48-63); preserve it
+    # as an annotation for traceability.
+    runtime_id = spec_d.get("runtime_id")
+    annotations = dict(meta_d.get("annotations", {}))
+    if runtime_id:
+        annotations["tpujob.v1alpha1/runtime-id"] = str(runtime_id)
+    meta_d["annotations"] = annotations
+    try:
+        meta = ObjectMeta(**meta_d)
+    except TypeError as exc:
+        raise ValidationError(f"metadata: {exc}") from exc
+
+    from tf_operator_tpu.api.types import _tpujob_from_dict
+
+    # Reuse the primary decoder for topology/run_policy/workload by
+    # building a v1-shaped dict around the converted replica specs.
+    shell = {
+        "metadata": {},
+        "spec": {
+            "topology": spec_d.get("topology", {}),
+            "run_policy": spec_d.get("run_policy", {}),
+            "workload": spec_d.get("workload", {}),
+        },
+    }
+    try:
+        job = _tpujob_from_dict(copy.deepcopy(shell))
+    except (TypeError, ValueError, KeyError) as exc:
+        raise ValidationError(f"v1alpha1 spec: {exc}") from exc
+    job.metadata = meta
+    job.spec.replica_specs = replica_specs
+    return job
+
+
+def parse_job(data: Dict[str, Any]) -> TPUJob:
+    """Decode either API generation: v1alpha1 documents are converted,
+    anything else goes through the primary decoder."""
+    if is_v1alpha1(data):
+        return convert_v1alpha1(data)
+    return TPUJob.from_dict(data)
+
+
+def to_v1alpha1(job: TPUJob) -> Dict[str, Any]:
+    """Down-convert for v1alpha1-generation clients (round-trip surface)."""
+    entries: List[Dict[str, Any]] = []
+    for role, rs in job.spec.replica_specs.items():
+        d = {
+            "replica_type": "MASTER" if role is ReplicaType.COORDINATOR else role.value.upper(),
+            "replicas": rs.replicas,
+            "template": {
+                "entrypoint": rs.template.entrypoint,
+                "args": list(rs.template.args),
+                "env": dict(rs.template.env),
+                "chips_per_process": rs.template.chips_per_process,
+                "workdir": rs.template.workdir,
+            },
+        }
+        if rs.restart_policy is not None:
+            d["restart_policy"] = rs.restart_policy.value
+        if rs.port is not None:
+            d["port"] = rs.port
+        entries.append(d)
+    out = job.to_dict()
+    out["api_version"] = API_VERSION_V1ALPHA1
+    out["spec"]["replica_specs"] = entries
+    return out

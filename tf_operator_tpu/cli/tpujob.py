@@ -19,6 +19,8 @@ import json
 import os
 import sys
 
+from tf_operator_tpu.api.validation import ValidationError
+
 DEFAULT_SERVER = os.environ.get("TPUJOB_SERVER", "http://127.0.0.1:8080")
 
 
@@ -55,8 +57,10 @@ def main(argv=None) -> int:
     client = TPUJobClient(args.server)
     try:
         if args.cmd == "submit":
+            from tf_operator_tpu.api.v1alpha1 import parse_job
+
             with open(args.file) as f:
-                job = TPUJob.from_dict(json.load(f))
+                job = parse_job(json.load(f))  # accepts both API generations
             created = client.create(job)
             print(f"tpujob {created.key()} created (uid {created.metadata.uid})")
         elif args.cmd == "list":
@@ -85,8 +89,11 @@ def main(argv=None) -> int:
     except TPUJobApiError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 1
-    except FileNotFoundError as exc:
+    except (FileNotFoundError, json.JSONDecodeError) as exc:
         print(f"error: {exc}", file=sys.stderr)
+        return 1
+    except ValidationError as exc:  # e.g. v1alpha1 PS rejection
+        print(f"invalid job: {exc}", file=sys.stderr)
         return 1
     return 0
 

@@ -9,7 +9,9 @@ Routes (reference: dashboard/backend/handler/api_handler.go:74-113):
 - GET    /api/process/{ns}/{name}/logs    — process logs (kubelet-log analogue)
 - GET    /api/events?namespace=           — events (the test oracle surface)
 - GET    /api/namespaces                  — namespaces in use
-- GET    /ui                              — minimal single-page UI
+- GET    /ui                              — single-page app (dashboard/ui.py):
+  job list/detail with processes+logs+events, create form, events view —
+  the reference React frontend's JobList/JobDetail/CreateJob surface
 - GET    /healthz                         — liveness
 """
 
@@ -35,45 +37,10 @@ from tf_operator_tpu.api.types import _to_jsonable
 from tf_operator_tpu.runtime.process_backend import LocalProcessControl
 from tf_operator_tpu.runtime.store import AlreadyExistsError, NotFoundError, Store
 
+from tf_operator_tpu.dashboard.ui import UI_HTML as _UI_HTML
+
 _JOB_RE = re.compile(r"^/api/tpujob/([^/]+)/([^/]+)$")
 _LOGS_RE = re.compile(r"^/api/process/([^/]+)/([^/]+)/logs$")
-
-_UI_HTML = """<!doctype html>
-<html><head><title>TPUJob dashboard</title>
-<style>
- body{font-family:system-ui,sans-serif;margin:2rem;background:#fafafa}
- table{border-collapse:collapse;width:100%;background:#fff}
- th,td{border:1px solid #ddd;padding:6px 10px;text-align:left;font-size:14px}
- th{background:#f0f0f0} h1{font-size:20px}
- .Done{color:#0a7d32}.Failed{color:#c0392b}.Running{color:#1a6fb5}
-</style></head>
-<body><h1>TPUJob dashboard</h1><table id="jobs"><thead>
-<tr><th>Namespace</th><th>Name</th><th>Phase</th><th>Replicas</th>
-<th>Restarts</th><th>Conditions</th></tr></thead><tbody></tbody></table>
-<script>
-async function refresh(){
-  const r = await fetch('/api/tpujob'); const jobs = await r.json();
-  const tb = document.querySelector('#jobs tbody'); tb.innerHTML='';
-  for (const j of jobs.items){
-    const conds=(j.status.conditions||[]).map(c=>c.type).join(', ');
-    const phase=j.phase||'';
-    const reps=Object.entries(j.spec.replica_specs||{}).map(([k,v])=>`${k}:${v.replicas}`).join(' ');
-    // textContent assignment only: server-side validation restricts names,
-    // but the UI must never interpret object fields as HTML regardless.
-    const tr = document.createElement('tr');
-    for (const text of [j.metadata.namespace, j.metadata.name, phase, reps,
-                        String(j.status.restart_count||0), conds]){
-      const td = document.createElement('td');
-      td.textContent = text;
-      tr.appendChild(td);
-    }
-    tr.children[2].className = phase;
-    tb.appendChild(tr);
-  }
-}
-refresh(); setInterval(refresh, 2000);
-</script></body></html>
-"""
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -190,7 +157,11 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         try:
             data = json.loads(self.rfile.read(length) or b"{}")
-            job = TPUJob.from_dict(data)
+            # Dual API generations (SURVEY.md §0): list-based v1alpha1
+            # documents are converted, map-based ones decode directly.
+            from tf_operator_tpu.api.v1alpha1 import parse_job
+
+            job = parse_job(data)
             set_defaults(job)
             validate_job(job)
         except (ValueError, ValidationError, KeyError, TypeError) as exc:
