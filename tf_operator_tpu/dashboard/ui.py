@@ -1,0 +1,355 @@
+"""Single-page dashboard UI (served at /ui).
+
+Reference parity: dashboard/frontend/src/** — the React components JobList,
+JobDetail (with pod list + logs), CreateJob (+ CreateReplicaSpec,
+EnvVarCreator), and the namespace selector (services.js API client). Here a
+dependency-free vanilla-JS SPA with hash routing over the same REST API;
+all dynamic content is inserted via textContent so object fields are never
+interpreted as HTML.
+
+Routes: #/jobs  #/job/<ns>/<name>  #/create  #/events
+"""
+
+UI_HTML = r"""<!doctype html>
+<html><head><meta charset="utf-8"><title>TPUJob dashboard</title>
+<style>
+ :root{--fg:#1a1a1a;--muted:#667;--line:#ddd;--bg:#fafafa;--card:#fff;
+       --ok:#0a7d32;--bad:#c0392b;--run:#1a6fb5;--warn:#b26a00}
+ body{font-family:system-ui,sans-serif;margin:0;background:var(--bg);color:var(--fg)}
+ header{display:flex;align-items:center;gap:1rem;padding:.7rem 1.2rem;
+        background:#222;color:#eee}
+ header h1{font-size:16px;margin:0;font-weight:600}
+ header a{color:#bcd;text-decoration:none;font-size:14px;padding:.2rem .5rem;border-radius:4px}
+ header a.active{background:#444;color:#fff}
+ main{padding:1rem 1.2rem;max-width:1100px;margin:0 auto}
+ table{border-collapse:collapse;width:100%;background:var(--card);font-size:13.5px}
+ th,td{border:1px solid var(--line);padding:5px 9px;text-align:left;vertical-align:top}
+ th{background:#f0f0f0;font-weight:600}
+ .Done,.Succeeded,.phase-Done{color:var(--ok)}
+ .Failed,.phase-Failed{color:var(--bad)}
+ .Running,.phase-Running{color:var(--run)}
+ .CleanUp,.Restarting{color:var(--warn)}
+ .muted{color:var(--muted)} .mono{font-family:ui-monospace,monospace;font-size:12.5px}
+ button{font:inherit;padding:.25rem .7rem;border:1px solid #aaa;border-radius:4px;
+        background:#fff;cursor:pointer} button:hover{background:#f0f0f0}
+ button.danger{color:var(--bad);border-color:var(--bad)}
+ select,input,textarea{font:inherit;padding:.25rem .4rem;border:1px solid #bbb;border-radius:4px}
+ .card{background:var(--card);border:1px solid var(--line);border-radius:6px;
+       padding:.8rem 1rem;margin-bottom:1rem}
+ .card h2{font-size:15px;margin:.1rem 0 .6rem}
+ .row{display:flex;gap:1rem;flex-wrap:wrap;align-items:center;margin-bottom:.6rem}
+ pre.logs{background:#111;color:#dfe;padding:.7rem;border-radius:6px;max-height:420px;
+          overflow:auto;font-size:12px;white-space:pre-wrap}
+ .kv{display:grid;grid-template-columns:max-content 1fr;gap:.15rem .9rem;font-size:13.5px}
+ .kv b{font-weight:600}
+ .err{color:var(--bad);white-space:pre-wrap;font-size:13px}
+ label{font-size:13px;color:var(--muted);display:block}
+ .replica{border:1px dashed #ccc;border-radius:6px;padding:.6rem;margin:.4rem 0}
+ textarea{width:100%;min-height:180px}
+</style></head>
+<body>
+<header>
+ <h1>TPUJob</h1>
+ <a href="#/jobs" data-nav="jobs">Jobs</a>
+ <a href="#/create" data-nav="create">Create</a>
+ <a href="#/events" data-nav="events">Events</a>
+ <span style="flex:1"></span>
+ <select id="nsSel" title="namespace"><option value="">all namespaces</option></select>
+</header>
+<main id="main"></main>
+<script>
+'use strict';
+const $main = document.getElementById('main');
+const $ns = document.getElementById('nsSel');
+let timer = null;
+
+function el(tag, attrs, ...children){
+  const e = document.createElement(tag);
+  for (const [k,v] of Object.entries(attrs||{})){
+    if (k === 'class') e.className = v;
+    else if (k.startsWith('on')) e.addEventListener(k.slice(2), v);
+    else e.setAttribute(k, v);
+  }
+  for (const c of children)
+    e.appendChild(typeof c === 'string' ? document.createTextNode(c) : c);
+  return e;
+}
+async function api(path, opts){
+  const r = await fetch(path, opts);
+  const ctype = r.headers.get('Content-Type') || '';
+  const body = ctype.includes('json') ? await r.json() : await r.text();
+  if (!r.ok) throw new Error((body && body.error) || (r.status + ' ' + r.statusText));
+  return body;
+}
+function age(ts){
+  if (!ts) return '';
+  let s = Math.max(0, (Date.now()/1000) - ts);
+  if (s < 90) return Math.round(s) + 's';
+  if (s < 5400) return Math.round(s/60) + 'm';
+  return (s/3600).toFixed(1) + 'h';
+}
+function fmtTime(ts){ return ts ? new Date(ts*1000).toLocaleString() : ''; }
+function qns(){ return $ns.value ? ('?namespace=' + encodeURIComponent($ns.value)) : ''; }
+
+async function refreshNamespaces(){
+  try{
+    const d = await api('/api/namespaces');
+    const cur = $ns.value;
+    while ($ns.options.length > 1) $ns.remove(1);
+    for (const n of d.items) $ns.appendChild(el('option', {value:n}, n));
+    $ns.value = cur;
+  }catch(e){/* header stays */}
+}
+$ns.addEventListener('change', route);
+
+// ---- job list --------------------------------------------------------------
+async function viewJobs(){
+  const d = await api('/api/tpujob' + qns());
+  const tbody = el('tbody');
+  for (const j of d.items){
+    const reps = Object.entries(j.spec.replica_specs||{})
+      .map(([k,v])=>k+':'+v.replicas).join(' ');
+    const conds = (j.status.conditions||[]).filter(c=>c.status).map(c=>c.type).join(', ');
+    const link = el('a', {href:'#/job/'+j.metadata.namespace+'/'+j.metadata.name},
+                    j.metadata.name);
+    const del = el('button', {class:'danger', onclick: async (ev)=>{
+      ev.preventDefault();
+      if (!confirm('Delete '+j.metadata.namespace+'/'+j.metadata.name+'?')) return;
+      await api('/api/tpujob/'+j.metadata.namespace+'/'+j.metadata.name, {method:'DELETE'});
+      route();
+    }}, 'delete');
+    tbody.appendChild(el('tr', null,
+      el('td', null, j.metadata.namespace), el('td', null, link),
+      el('td', {class:'phase-'+j.phase}, j.phase||''),
+      el('td', null, reps),
+      el('td', null, String(j.status.restart_count||0)),
+      el('td', {class:'muted'}, conds),
+      el('td', {class:'muted'}, age(j.metadata.creation_timestamp)),
+      el('td', null, del)));
+  }
+  render(el('div', null, el('table', null,
+    el('thead', null, el('tr', null, ...['Namespace','Name','Phase','Replicas',
+      'Restarts','Conditions','Age',''].map(h=>el('th',null,h)))), tbody)));
+}
+
+// ---- job detail ------------------------------------------------------------
+async function viewJob(ns, name){
+  let d;
+  try{ d = await api('/api/tpujob/'+ns+'/'+name); }
+  catch(e){ return render(el('div',{class:'err'}, String(e.message))); }
+  const j = d.job;
+  const root = el('div');
+
+  const kv = el('div', {class:'kv'});
+  const pairs = [
+    ['Phase', j.phase||''], ['Created', fmtTime(j.metadata.creation_timestamp)],
+    ['Started', fmtTime(j.status.start_time)],
+    ['Completed', fmtTime(j.status.completion_time)],
+    ['Gang restarts', String(j.status.restart_count||0)],
+    ['Slice', j.spec.topology.slice_type ||
+       (j.spec.topology.num_hosts+'x'+j.spec.topology.chips_per_host+' chips')],
+    ['Mesh', JSON.stringify(j.spec.topology.mesh_axes||{})],
+    ['UID', j.metadata.uid],
+  ];
+  for (const [k,v] of pairs){ kv.appendChild(el('b',null,k)); kv.appendChild(el('span',null,v)); }
+  root.appendChild(el('div',{class:'card'},
+    el('h2',null, ns+'/'+name),
+    kv));
+
+  const ctb = el('tbody');
+  for (const c of (j.status.conditions||[]))
+    ctb.appendChild(el('tr', null,
+      el('td',{class:c.type}, c.type), el('td',null,String(c.status)),
+      el('td',null,c.reason||''), el('td',{class:'muted'},c.message||''),
+      el('td',{class:'muted'}, fmtTime(c.last_transition_time))));
+  root.appendChild(el('div',{class:'card'}, el('h2',null,'Conditions'),
+    el('table',null, el('thead',null, el('tr',null,
+      ...['Type','Status','Reason','Message','Transition'].map(h=>el('th',null,h)))), ctb)));
+
+  const rtb = el('tbody');
+  for (const [rt, rs] of Object.entries(j.status.replica_statuses||{}))
+    rtb.appendChild(el('tr',null, el('td',null,rt),
+      el('td',null,String(rs.active)), el('td',null,String(rs.succeeded)),
+      el('td',null,String(rs.failed))));
+  root.appendChild(el('div',{class:'card'}, el('h2',null,'Replica status'),
+    el('table',null, el('thead',null, el('tr',null,
+      ...['Type','Active','Succeeded','Failed'].map(h=>el('th',null,h)))), rtb)));
+
+  const logsPre = el('pre', {class:'logs', style:'display:none'});
+  const ptb = el('tbody');
+  for (const p of (d.processes||[])){
+    const st = p.status||{};
+    const exit = (st.exit_code===null||st.exit_code===undefined)?'':String(st.exit_code);
+    const logBtn = el('button', {onclick: async ()=>{
+      logsPre.style.display = '';
+      logsPre.textContent = '(loading '+p.metadata.name+' logs…)';
+      try{
+        logsPre.textContent = await api('/api/process/'+ns+'/'+p.metadata.name+'/logs');
+      }catch(e){ logsPre.textContent = 'error: '+e.message; }
+    }}, 'logs');
+    ptb.appendChild(el('tr',null,
+      el('td',{class:'mono'},p.metadata.name),
+      el('td',null,p.spec.replica_type), el('td',null,String(p.spec.replica_index)),
+      el('td',{class:st.phase},st.phase||''), el('td',null,exit),
+      el('td',{class:'muted'},st.reason||''), el('td',null,logBtn)));
+  }
+  root.appendChild(el('div',{class:'card'}, el('h2',null,'Processes'),
+    el('table',null, el('thead',null, el('tr',null,
+      ...['Name','Type','Index','Phase','Exit','Reason',''].map(h=>el('th',null,h)))), ptb),
+    logsPre));
+
+  const etb = el('tbody');
+  try{
+    const evs = await api('/api/events?namespace='+encodeURIComponent(ns));
+    const mine = (e)=>{const n = e.involved_name||'';
+      return n === name || n.startsWith(name+'-');};
+    for (const e of evs.items.filter(mine).slice(-30).reverse())
+      etb.appendChild(el('tr',null,
+        el('td',{class:e.type==='Warning'?'Failed':'muted'},e.type),
+        el('td',null,e.reason||''), el('td',{class:'muted'},e.message||''),
+        el('td',{class:'muted'},age(e.metadata.creation_timestamp)+' ago')));
+  }catch(err){}
+  root.appendChild(el('div',{class:'card'}, el('h2',null,'Events'),
+    el('table',null, el('thead',null, el('tr',null,
+      ...['Type','Reason','Message','Age'].map(h=>el('th',null,h)))), etb)));
+  render(root);
+}
+
+// ---- create ----------------------------------------------------------------
+function replicaBlock(rt, entry, n){
+  const b = el('div', {class:'replica'});
+  b.appendChild(el('div',{class:'row'},
+    el('span',null, el('label',null,'role'),
+      el('select',{'data-f':'rtype'},
+        ...['Worker','Coordinator','Evaluator'].map(v=>{
+          const o = el('option',{value:v},v); if (v===rt) o.selected = true; return o;}))),
+    el('span',null, el('label',null,'replicas'),
+      el('input',{'data-f':'replicas',type:'number',min:'0',value:String(n),style:'width:5rem'})),
+    el('span',null, el('label',null,'entrypoint (pkg.module:fn)'),
+      el('input',{'data-f':'entrypoint',value:entry,style:'width:22rem',class:'mono'})),
+    el('span',null, el('label',null,'restart policy'),
+      el('select',{'data-f':'rp'}, ...['','ExitCode','Always','OnFailure','Never']
+        .map(v=>el('option',{value:v}, v||'(default)')))),
+    el('button',{onclick:(e)=>{e.preventDefault(); b.remove();}},'remove role')));
+  b.appendChild(el('div',{class:'row'},
+    el('span',{style:'flex:1'},
+      el('label',null,'env (KEY=VALUE per line)'),
+      el('textarea',{'data-f':'env',style:'min-height:3.2rem'})),
+    el('span',{style:'flex:1'},
+      el('label',null,'args (one per line)'),
+      el('textarea',{'data-f':'args',style:'min-height:3.2rem',class:'mono'}))));
+  return b;
+}
+function viewCreate(){
+  const errBox = el('div',{class:'err'});
+  const nameIn = el('input',{value:'job-'+Math.random().toString(36).slice(2,7)});
+  const nsIn = el('input',{value:$ns.value||'default'});
+  const sliceIn = el('input',{value:'',placeholder:'e.g. v5e-8'});
+  const hostsIn = el('input',{type:'number',min:'1',value:'1',style:'width:5rem'});
+  const chipsIn = el('input',{type:'number',min:'0',value:'0',style:'width:5rem'});
+  const meshIn = el('input',{value:'{}',class:'mono',style:'width:14rem'});
+  const wlIn = el('textarea',{style:'min-height:4rem',class:'mono'});
+  wlIn.value = '{}';
+  const reps = el('div');
+  reps.appendChild(replicaBlock('Worker','tf_operator_tpu.workloads.smoke:run',2));
+  const addBtn = el('button',{onclick:(e)=>{e.preventDefault();
+    reps.appendChild(replicaBlock('Worker','',1));}},'+ add role');
+
+  const jsonArea = el('textarea',{class:'mono'});
+  function buildSpec(){
+    const replica_specs = {};
+    for (const b of reps.querySelectorAll('.replica')){
+      const f = (sel)=>b.querySelector('[data-f='+sel+']');
+      const env = {};
+      for (const line of f('env').value.split('\n').map(s=>s.trim()).filter(Boolean)){
+        const i = line.indexOf('='); if (i>0) env[line.slice(0,i)] = line.slice(i+1);
+      }
+      const spec = {replicas: Number(f('replicas').value),
+        template: {entrypoint: f('entrypoint').value, env,
+                   args: f('args').value.split('\n').map(s=>s.trim()).filter(Boolean)}};
+      if (f('rp').value) spec.restart_policy = f('rp').value;
+      replica_specs[f('rtype').value] = spec;
+    }
+    let mesh = {}, wl = {};
+    try{ mesh = JSON.parse(meshIn.value||'{}'); }catch(e){ throw new Error('mesh axes: '+e.message); }
+    try{ wl = JSON.parse(wlIn.value||'{}'); }catch(e){ throw new Error('workload: '+e.message); }
+    return {metadata:{name:nameIn.value, namespace:nsIn.value},
+      spec:{replica_specs,
+        topology:{slice_type:sliceIn.value, num_hosts:Number(hostsIn.value),
+                  chips_per_host:Number(chipsIn.value), mesh_axes:mesh},
+        workload: wl}};
+  }
+  async function submit(body){
+    errBox.textContent = '';
+    try{
+      const out = await api('/api/tpujob', {method:'POST',
+        headers:{'Content-Type':'application/json'}, body: JSON.stringify(body)});
+      location.hash = '#/job/'+out.metadata.namespace+'/'+out.metadata.name;
+    }catch(e){ errBox.textContent = e.message; }
+  }
+  render(el('div', null,
+    el('div',{class:'card'}, el('h2',null,'Create TPUJob'),
+      el('div',{class:'row'},
+        el('span',null, el('label',null,'name'), nameIn),
+        el('span',null, el('label',null,'namespace'), nsIn)),
+      el('div',{class:'row'},
+        el('span',null, el('label',null,'slice type'), sliceIn),
+        el('span',null, el('label',null,'hosts'), hostsIn),
+        el('span',null, el('label',null,'chips/host'), chipsIn),
+        el('span',null, el('label',null,'mesh axes (JSON)'), meshIn)),
+      el('label',null,'workload config (JSON, passed to every process)'), wlIn,
+      reps, addBtn, el('span',null,' '),
+      el('button',{onclick:(e)=>{e.preventDefault();
+        try{ submit(buildSpec()); }catch(err){ errBox.textContent = err.message; }}},
+        'Submit'),
+      el('span',null,' '),
+      el('button',{onclick:(e)=>{e.preventDefault();
+        try{ jsonArea.value = JSON.stringify(buildSpec(), null, 2); }
+        catch(err){ errBox.textContent = err.message; }}}, 'Form → JSON'),
+      errBox),
+    el('div',{class:'card'}, el('h2',null,'Raw JSON'),
+      jsonArea,
+      el('div',{class:'row'},
+        el('button',{onclick:(e)=>{e.preventDefault();
+          try{ submit(JSON.parse(jsonArea.value)); }
+          catch(err){ errBox.textContent = err.message; }}}, 'Submit JSON')))));
+}
+
+// ---- events ----------------------------------------------------------------
+async function viewEvents(){
+  const d = await api('/api/events' + qns());
+  const tb = el('tbody');
+  for (const e of d.items.slice(-200).reverse())
+    tb.appendChild(el('tr',null,
+      el('td',{class:e.type==='Warning'?'Failed':'muted'},e.type),
+      el('td',null,e.metadata.namespace),
+      el('td',{class:'mono'},e.involved_name||''),
+      el('td',null,e.reason||''), el('td',{class:'muted'},e.message||''),
+      el('td',{class:'muted'},age(e.metadata.creation_timestamp)+' ago')));
+  render(el('table',null, el('thead',null, el('tr',null,
+    ...['Type','Namespace','Object','Reason','Message','Age'].map(h=>el('th',null,h)))), tb));
+}
+
+// ---- router ----------------------------------------------------------------
+function render(node){ $main.innerHTML=''; $main.appendChild(node); }
+function setNav(which){
+  for (const a of document.querySelectorAll('header a'))
+    a.classList.toggle('active', a.dataset.nav === which);
+}
+async function route(){
+  if (timer) clearTimeout(timer);
+  refreshNamespaces();
+  const h = location.hash || '#/jobs';
+  const parts = h.slice(2).split('/');
+  try{
+    if (parts[0] === 'job' && parts.length >= 3){ setNav('jobs'); await viewJob(parts[1], parts.slice(2).join('/')); }
+    else if (parts[0] === 'create'){ setNav('create'); viewCreate(); return; } // no auto-refresh while editing
+    else if (parts[0] === 'events'){ setNav('events'); await viewEvents(); }
+    else { setNav('jobs'); await viewJobs(); }
+  }catch(e){ render(el('div',{class:'err'}, String(e.message||e))); }
+  timer = setTimeout(route, 3000);
+}
+window.addEventListener('hashchange', route);
+route();
+</script></body></html>
+"""
