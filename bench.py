@@ -23,6 +23,10 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def main() -> None:
+    from tf_operator_tpu.train.compile_cache import enable as enable_compile_cache
+
+    cache_dir = enable_compile_cache()
+
     import jax
     import jax.numpy as jnp
 
@@ -109,6 +113,7 @@ def main() -> None:
                 "n_chips": n_chips,
                 "device": getattr(dev, "device_kind", dev.platform),
                 "submit_to_first_step_s": round(first_step_s, 2),
+                "compile_cache": bool(cache_dir),
                 "loss": round(float(metrics["loss"]), 4),
             }
         )

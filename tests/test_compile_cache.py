@@ -1,0 +1,48 @@
+"""Persistent compilation cache tests (submit→first-step latency lever,
+SURVEY.md §7 hard part d)."""
+
+import os
+
+import tf_operator_tpu.train.compile_cache as cc
+
+
+def test_enable_creates_and_configures_dir(tmp_path, monkeypatch):
+    target = str(tmp_path / "xla-cache")
+    got = cc.enable(target)
+    assert got == target and os.path.isdir(target)
+    import jax
+
+    assert jax.config.jax_compilation_cache_dir == target
+
+
+def test_env_dir_override(tmp_path, monkeypatch):
+    target = str(tmp_path / "from-env")
+    monkeypatch.setenv(cc.ENV_DIR, target)
+    assert cc.enable() == target
+
+
+def test_disable_env(monkeypatch, tmp_path):
+    monkeypatch.setenv(cc.ENV_DISABLE, "1")
+    assert cc.enable(str(tmp_path / "x")) is None
+    assert not (tmp_path / "x").exists()
+
+
+def test_unwritable_dir_degrades_to_none(monkeypatch, tmp_path):
+    blocker = tmp_path / "file"
+    blocker.write_text("not a dir")
+    assert cc.enable(str(blocker / "sub")) is None
+
+
+def test_cache_populates_on_compile(tmp_path):
+    """A jitted computation lands executables in the cache directory."""
+    target = str(tmp_path / "xla-cache")
+    assert cc.enable(target) == target
+    import jax
+    import jax.numpy as jnp
+
+    # A distinctive shape to avoid any earlier in-memory hit being the
+    # only artifact; the persistent cache writes on cache miss.
+    x = jnp.arange(37.0)
+    jax.jit(lambda v: (v * 3 + 1).sum())(x).block_until_ready()
+    entries = os.listdir(target)
+    assert entries, "compilation cache is empty after a jit compile"

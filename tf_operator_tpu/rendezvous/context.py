@@ -72,7 +72,12 @@ class JobContext:
 
     def initialize_distributed(self) -> None:
         """Join the gang via jax.distributed (no-op for 1-process jobs).
-        Replaces tf.train.Server bring-up (tf_smoke.py:98-110)."""
+        Replaces tf.train.Server bring-up (tf_smoke.py:98-110). Also turns
+        on the persistent compilation cache so gang restarts (the recovery
+        path) and repeat submissions skip XLA recompilation."""
+        from tf_operator_tpu.train.compile_cache import enable as _enable_cache
+
+        _enable_cache()
         if self.num_processes <= 1:
             return
         import jax

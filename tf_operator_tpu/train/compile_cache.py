@@ -1,0 +1,51 @@
+"""Persistent XLA compilation cache: submit→first-step latency control.
+
+The north-star latency metric (BASELINE.json; SURVEY.md §7 hard part d) is
+submit→first-step, and on TPU it is dominated by XLA compilation (~20-40 s
+for the bench models) — a cost the reference never had to manage because it
+ran TF's pre-compiled kernels. The TPU-native answer is JAX's persistent
+compilation cache: executables are keyed by (HLO, compile options, backend)
+and reloaded from disk, so
+
+- a gang restart (the framework's recovery path — restart-based recovery,
+  SURVEY.md §5) relaunches the training program at near-interactive speed,
+- repeat submissions of the same workload skip straight to step 1.
+
+``enable()`` is called by the rendezvous harness before user ``train_fn``
+runs (every operator-launched process gets it), and by ``bench.py``. Safe
+to call multiple times; honors an explicit ``JAX_COMPILATION_CACHE_DIR``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger("tpujob.compile_cache")
+
+DEFAULT_CACHE_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "tf_operator_tpu", "xla"
+)
+ENV_DIR = "JAX_COMPILATION_CACHE_DIR"
+ENV_DISABLE = "TPUJOB_NO_COMPILE_CACHE"
+
+
+def enable(cache_dir: str | None = None) -> str | None:
+    """Turn on the persistent compilation cache; returns the directory in
+    use, or None when disabled via TPUJOB_NO_COMPILE_CACHE=1."""
+    if os.environ.get(ENV_DISABLE, "") == "1":
+        return None
+    path = cache_dir or os.environ.get(ENV_DIR) or DEFAULT_CACHE_DIR
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError as exc:
+        log.warning("compilation cache dir %s unusable: %s", path, exc)
+        return None
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    # Cache even small/fast-compiling programs: the latency metric counts
+    # every compile on the submit path.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return path
