@@ -106,3 +106,29 @@ def test_ci_pipeline_parses_and_substitutes():
     assert pipeline["stages"][-1].get("always"), "teardown must always run"
     for stage in pipeline["stages"]:
         stage["run"].format(port=1234, artifacts="/tmp/x")  # no KeyError
+
+
+def test_build_image_dry_run_stages_context(tmp_path, capsys, monkeypatch):
+    """Image builder (reference: py/build_and_push_image.py) stages a
+    clean git-archive context with the Dockerfile at its root and prints
+    the build commands in dry-run mode."""
+    from tools import build_image
+
+    # Pin the builder: dry-run output must not depend on which container
+    # runtime this machine happens to have (docker vs podman vs none).
+    monkeypatch.setattr(build_image, "find_builder", lambda: None)
+    ctx = str(tmp_path / "ctx")
+    # Pre-existing stale content must be wiped, not shipped.
+    (tmp_path / "ctx").mkdir()
+    (tmp_path / "ctx" / "stale.txt").write_text("old")
+    rc = build_image.main(["--dry-run", "--context-dir", ctx,
+                           "--registry", "gcr.io/test", "--push"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "docker build -t gcr.io/test/tf-operator-tpu:" in out
+    assert "docker push" in out
+    assert (tmp_path / "ctx" / "Dockerfile").exists()
+    assert (tmp_path / "ctx" / "tf_operator_tpu" / "__init__.py").exists()
+    # context is HEAD, not the working tree: no scratch files leak in
+    assert not (tmp_path / "ctx" / ".git").exists()
+    assert not (tmp_path / "ctx" / "stale.txt").exists()

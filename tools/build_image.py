@@ -1,0 +1,101 @@
+"""Operator image builder.
+
+Reference parity: ``py/build_and_push_image.py`` (176 LoC) +
+``build/images/tf_operator/build_and_push.py`` — stage a build context,
+derive the tag from the git sha, invoke the container builder, optionally
+push. Here the context is the release archive (tools/release.py), the
+Dockerfile is ``build/Dockerfile``, and when no container runtime exists
+(this dev image has none) ``--dry-run`` emits the exact commands, keeping
+the tool testable hermetically — the same posture as the reference's GCB
+path, which also only *drives* an external builder.
+
+Usage:
+    python -m tools.build_image [--registry REG] [--tag TAG] [--push]
+                                [--dry-run] [--context-dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+import tarfile
+import tempfile
+
+from tools.release import REPO_ROOT, git_sha
+
+
+def find_builder() -> str | None:
+    for b in ("docker", "podman"):
+        if shutil.which(b):
+            return b
+    return None
+
+
+def stage_context(context_dir: str) -> str:
+    """Materialize a clean build context: git archive of HEAD + Dockerfile
+    at its root (the reference stages into a scratch dir the same way).
+    An existing context dir is wiped first — stale files from an earlier
+    commit must not ship in the image."""
+    if os.path.isdir(context_dir):
+        shutil.rmtree(context_dir)
+    os.makedirs(context_dir, exist_ok=True)
+    tar_path = os.path.join(context_dir, "src.tar")
+    r = subprocess.run(
+        ["git", "archive", "--format=tar", "-o", tar_path, "HEAD"],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+    )
+    if r.returncode != 0:
+        raise RuntimeError(f"git archive failed: {r.stderr}")
+    with tarfile.open(tar_path) as tf:
+        tf.extractall(context_dir, filter="data")
+    os.unlink(tar_path)
+    shutil.copy(
+        os.path.join(REPO_ROOT, "build", "Dockerfile"),
+        os.path.join(context_dir, "Dockerfile"),
+    )
+    return context_dir
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="build_image")
+    p.add_argument("--registry", default="local",
+                   help="image registry/repo prefix (reference: GCR project)")
+    p.add_argument("--tag", default=None,
+                   help="image tag; default v<sha> like the reference")
+    p.add_argument("--push", action="store_true")
+    p.add_argument("--dry-run", action="store_true",
+                   help="stage the context and print the commands only")
+    p.add_argument("--context-dir", default=None)
+    args = p.parse_args(argv)
+
+    tag = args.tag or f"v-{git_sha()}"
+    image = f"{args.registry}/tf-operator-tpu:{tag}"
+    ctx = args.context_dir or tempfile.mkdtemp(prefix="tpujob-image-")
+    stage_context(ctx)
+
+    builder = find_builder()
+    cmds = [[builder or "docker", "build", "-t", image, ctx]]
+    if args.push:
+        cmds.append([builder or "docker", "push", image])
+
+    if args.dry_run or builder is None:
+        if builder is None and not args.dry_run:
+            print("no container runtime found; dry run:", file=sys.stderr)
+        print(f"context: {ctx}")
+        for c in cmds:
+            print("$ " + " ".join(c))
+        return 0
+
+    for c in cmds:
+        r = subprocess.run(c)
+        if r.returncode != 0:
+            return r.returncode
+    print(f"built {image}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
