@@ -1,0 +1,265 @@
+"""Multi-host runtime integration: controller + gang scheduler + per-host
+agents (kubelet analogue), on a simulated 2-host cluster in one process.
+
+The control-plane split under test is real — the controller only writes
+bound Process objects; each HostAgent watches its own bindings and
+launches through its own LocalProcessControl — exactly the
+controller/kubelet boundary of the reference (SURVEY.md §1). The data
+plane is real too: gang members rendezvous via jax.distributed over gloo.
+"""
+
+import os
+import time
+
+import pytest
+
+from conftest import wait_for
+from tf_operator_tpu.api.types import (
+    ConditionType,
+    KIND_PROCESS,
+    ObjectMeta,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    TopologySpec,
+    TPUJob,
+    TPUJobSpec,
+)
+from tf_operator_tpu.controller import TPUJobController
+from tf_operator_tpu.controller.status import has_condition
+from tf_operator_tpu.runtime import (
+    FakeProcessControl,
+    HostAgent,
+    HostPhase,
+    LocalProcessControl,
+    Store,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DATAPLANE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "JAX_CPU_COLLECTIVES_IMPLEMENTATION": "gloo",
+    "PALLAS_AXON_POOL_IPS": "",
+    "XLA_FLAGS": "",
+    "PYTHONPATH": ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+}
+
+
+def smoke_job(name, num_hosts=2, workers=2, backoff=None):
+    spec = TPUJobSpec(
+        replica_specs={
+            ReplicaType.WORKER: ReplicaSpec(
+                replicas=workers,
+                template=ProcessTemplate(
+                    entrypoint="tf_operator_tpu.workloads.smoke:main",
+                    env=dict(DATAPLANE_ENV),
+                    chips_per_process=1,
+                ),
+            )
+        },
+        topology=TopologySpec(slice_type="", num_hosts=num_hosts, chips_per_host=4),
+    )
+    if backoff is not None:
+        spec.run_policy.backoff_limit = backoff
+    job = TPUJob(metadata=ObjectMeta(name=name), spec=spec)
+    job.spec.workload = {"dim": 32}
+    return job
+
+
+def job_status(store, name):
+    return store.get("TPUJob", "default", name).status
+
+
+@pytest.fixture
+def cluster():
+    """Controller + two host agents over one store. The controller's own
+    process_control is a fake: in managed mode nothing may launch through
+    it — a launch there means the controller/kubelet split leaked."""
+    store = Store()
+    fake = FakeProcessControl()
+    ctl = TPUJobController(store, fake, resync_period=0.5)
+    agents = [
+        HostAgent(store, f"h{i}", address="127.0.0.1", total_chips=4,
+                  heartbeat_interval=0.5,
+                  backend=LocalProcessControl(store))
+        for i in (1, 2)
+    ]
+    for a in agents:
+        a.start()
+    ctl.run(workers=2)
+    yield store, ctl, agents, fake
+    ctl.stop()
+    for a in agents:
+        a.stop()
+
+
+def test_gang_spans_hosts_and_succeeds(cluster):
+    store, ctl, agents, fake = cluster
+    seen_nodes = set()
+
+    def span():
+        # Sample bindings while the job runs: a restart (e.g. a gloo
+        # teardown race) may replace processes later, so the span must be
+        # observed live, not reconstructed after completion.
+        for p in store.list(KIND_PROCESS, namespace="default"):
+            if p.spec.job_name == "mh-smoke" and p.spec.node_name:
+                seen_nodes.add(p.spec.node_name)
+        return seen_nodes == {"h1", "h2"}
+
+    store.create(smoke_job("mh-smoke", num_hosts=2, workers=2))
+    assert wait_for(span, timeout=30), f"gang never spanned both hosts: {seen_nodes}"
+    ok = wait_for(
+        lambda: has_condition(job_status(store, "mh-smoke"), ConditionType.SUCCEEDED),
+        timeout=120,
+    )
+    st = job_status(store, "mh-smoke")
+    assert ok, f"conditions: {[(c.type.value, c.reason, c.message) for c in st.conditions]}"
+    # the controller/kubelet split held: controller launched nothing itself
+    assert fake.created == []
+
+
+def test_unschedulable_gang_stays_pending_with_event(cluster):
+    store, ctl, agents, fake = cluster
+    store.create(smoke_job("mh-big", num_hosts=3, workers=3))  # only 2 hosts
+    wait_for(
+        lambda: any(
+            e.reason == "FailedScheduling"
+            for e in store.list("Event", namespace="default")
+        ),
+        timeout=20,
+    )
+    evs = [e for e in store.list("Event", namespace="default")
+           if e.reason == "FailedScheduling"]
+    assert evs and "need 3" in evs[0].message
+    # nothing was created: atomicity means no partial gang
+    procs = [p for p in store.list(KIND_PROCESS, namespace="default")
+             if p.spec.job_name == "mh-big"]
+    assert procs == []
+    assert not has_condition(job_status(store, "mh-big"), ConditionType.SUCCEEDED)
+
+
+def test_node_lost_triggers_gang_restart_onto_surviving_capacity():
+    """Kill one host's agent mid-run: its processes are marked Failed
+    (NodeLost, exit 137 = retryable), the gang restarts, and with the
+    remaining host now holding enough capacity the job still succeeds."""
+    store = Store()
+    fake = FakeProcessControl()
+    ctl = TPUJobController(store, fake, resync_period=0.5)
+    # TTL/interval margin of 12 missed beats: under full-suite load the
+    # agent threads can stall, and a spurious NodeLost on the SURVIVING
+    # host turns this into a restart storm that outruns the backoff limit.
+    ctl.scheduler.heartbeat_ttl = 3.0
+    a1 = HostAgent(store, "h1", total_chips=4, heartbeat_interval=0.25,
+                   backend=LocalProcessControl(store))
+    a2 = HostAgent(store, "h2", total_chips=4, heartbeat_interval=0.25,
+                   backend=LocalProcessControl(store))
+    a1.start()
+    a2.start()
+    ctl.run(workers=2)
+    try:
+        job = smoke_job("mh-lost", num_hosts=2, workers=2, backoff=8)
+        # long sleep: members are still mid-run when h2 goes silent, and
+        # the zombie on h2 outlives the test's recovery window
+        job.spec.workload = {"dim": 32, "sleep_s": 30}
+        store.create(job)
+        wait_for(
+            lambda: any(
+                p.spec.job_name == "mh-lost" and p.spec.node_name == "h2"
+                for p in store.list(KIND_PROCESS, namespace="default")
+            ),
+            timeout=30,
+        )
+        # Pre-shrink the spec so the post-loss incarnation fits on the
+        # surviving host and skips the sleep (users would resubmit/edit the
+        # same way); the RUNNING gang keeps its original env.
+        fresh = store.get("TPUJob", "default", "mh-lost")
+        fresh.spec.topology.num_hosts = 1
+        fresh.spec.workload = {"dim": 32}
+        store.update(fresh)
+        # h2 crashes SILENTLY: heartbeats stop, its child keeps running
+        # (becomes a zombie member), no exit status ever gets reported —
+        # only the NodeLost path can detect this.
+        a2._stop.set()
+        if a2._watch is not None:
+            a2._watch.stop()
+        ok = wait_for(
+            lambda: has_condition(job_status(store, "mh-lost"), ConditionType.SUCCEEDED),
+            timeout=240,
+        )
+        st = job_status(store, "mh-lost")
+        assert ok, f"conditions: {[(c.type.value, c.reason, c.message) for c in st.conditions]}"
+        assert st.restart_count >= 1
+        evs = [e.reason for e in store.list("Event", namespace="default")]
+        assert "NodeLost" in evs
+        # survivors all on h1
+        nodes = {p.spec.node_name
+                 for p in store.list(KIND_PROCESS, namespace="default")
+                 if p.spec.job_name == "mh-lost" and not p.is_finished()} or {"h1"}
+        assert nodes == {"h1"}
+    finally:
+        ctl.stop()
+        a1.stop()
+        a2.backend.shutdown()  # reap the zombie member
+        fake.clear()
+
+
+def test_agent_restart_fails_orphaned_running_processes():
+    """An agent that restarts over a RUNNING binding it no longer tracks
+    fails it (exit 137, node_lost) — otherwise the fresh heartbeat masks
+    the loss and the job hangs forever."""
+    from tf_operator_tpu.api.types import ObjectMeta as OM
+    from tf_operator_tpu.runtime.objects import Process, ProcessSpec, ProcessStatus
+    from tf_operator_tpu.runtime import ProcessPhase
+
+    store = Store()
+    store.create(
+        Process(
+            metadata=OM(name="orphan", namespace="default"),
+            spec=ProcessSpec(job_name="j", node_name="h7", entrypoint="m:f"),
+            status=ProcessStatus(phase=ProcessPhase.RUNNING, pid=999999),
+        )
+    )
+    agent = HostAgent(store, "h7", total_chips=2, heartbeat_interval=0.2)
+    agent.start()
+    try:
+        def orphan_failed():
+            p = store.get(KIND_PROCESS, "default", "orphan")
+            return p.status.phase is ProcessPhase.FAILED and p.status.node_lost
+        assert wait_for(orphan_failed, timeout=10)
+        p = store.get(KIND_PROCESS, "default", "orphan")
+        assert p.status.exit_code == 137
+    finally:
+        agent.stop()
+
+
+def test_agent_reregisters_after_host_object_deleted():
+    store = Store()
+    agent = HostAgent(store, "h9", total_chips=2, heartbeat_interval=0.2)
+    agent.start()
+    try:
+        assert wait_for(
+            lambda: store.list("Host", namespace="default") != [], timeout=5
+        )
+        store.delete("Host", "default", "h9")
+        assert wait_for(
+            lambda: any(
+                h.metadata.name == "h9" and h.status.phase is HostPhase.READY
+                for h in store.list("Host", namespace="default")
+            ),
+            timeout=5,
+        )
+    finally:
+        agent.stop()
+
+
+def test_graceful_stop_marks_not_ready():
+    store = Store()
+    agent = HostAgent(store, "h8", total_chips=2, heartbeat_interval=0.2)
+    agent.start()
+    assert wait_for(
+        lambda: store.list("Host", namespace="default") != [], timeout=5
+    )
+    agent.stop()
+    h = store.get("Host", "default", "h8")
+    assert h.status.phase is HostPhase.NOT_READY

@@ -136,6 +136,46 @@ def test_backend_log_capture(backend, tmp_path):
 # ---- native-supervisor specifics -----------------------------------------
 
 
+def test_delete_while_launching_does_not_doom_recreated_incarnation():
+    """A tombstone from delete-during-launch is keyed by uid: a same-name
+    recreate (gang restart) must launch normally, not be killed at birth by
+    the OLD incarnation's tombstone (which would wedge the job Pending)."""
+    import threading
+
+    store = Store()
+    gate = threading.Event()
+    ctl = LocalProcessControl(
+        store, command_builder=script_builder("import time; time.sleep(30)")
+    )
+    real_spawn = ctl._spawn
+    blocked_uids = set()
+
+    def gated_spawn(process, env, log_path):
+        if process.metadata.uid in blocked_uids:
+            gate.wait(10)  # hold the FIRST incarnation's launch in flight
+        return real_spawn(process, env, log_path)
+
+    ctl._spawn = gated_spawn
+    first = proc("w0")
+    stored_first = store.create(first)
+    blocked_uids.add(stored_first.metadata.uid)
+    ctl.launch_existing(stored_first)
+    # delete while its launch is blocked: tombstones the first uid
+    ctl.delete_process("default", "w0")
+    # same-name recreate (fresh uid) — must not consume the tombstone
+    ctl.create_process(proc("w0"))
+    gate.set()  # old launch now returns; its child must be reaped silently
+
+    def second_running():
+        p = store.get("Process", "default", "w0")
+        return p.status.phase is ProcessPhase.RUNNING
+
+    assert wait_for(second_running, timeout=10)
+    # old incarnation's monitor must not have clobbered the new entry
+    assert ctl.tracks("default", "w0")
+    ctl.shutdown()
+
+
 def test_native_normalizes_signal_exit_codes():
     """A SIGTERM death must surface as 143 (128+15) — the convention the
     exit-code taxonomy (train_util.go:18-53) classifies as retryable — not

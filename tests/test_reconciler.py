@@ -220,6 +220,76 @@ def test_gang_restart_disabled_deletes_only_failed():
     assert h.fake.deleted == ["default/trainer-worker-0"]
 
 
+def test_dead_incarnation_children_are_garbage_collected():
+    """Delete → same-name recreate race (k8s-GC analogue): the old job's
+    deletion sync can find the NEW job already present and skip cascade
+    GC, leaving an old-uid child squatting on a deterministic process
+    name. The claim path must collect it, or every recreate of that
+    member hits AlreadyExists forever and the job wedges."""
+    job = make_job(workers=2)
+    stale = make_process(job, ReplicaType.WORKER, 0, ProcessPhase.SUCCEEDED, exit_code=0)
+    stale.metadata.owner_uid = "uid-DEAD-incarnation"
+    h = Harness(job, [stale])
+    h.sync()
+    # the squatter was collected...
+    assert "default/trainer-worker-0" in h.fake.deleted
+    # ...and the full new gang was created (not blocked by the stale child)
+    assert {p.metadata.name for p in h.fake.created} == {
+        "trainer-coordinator-0",
+        "trainer-worker-0",
+        "trainer-worker-1",
+    }
+
+
+def test_node_lost_failure_escalates_even_without_gang_restart():
+    """A declared loss (node_lost) may leave the 'failed' process alive as
+    a zombie; even with gang_restart=False the whole gang restarts and the
+    rendezvous port is fenced so the zombie cannot rejoin."""
+    job = make_job(workers=2, gang_restart=False)
+    lost = make_process(job, ReplicaType.WORKER, 1, ProcessPhase.FAILED, exit_code=137)
+    lost.status.node_lost = True
+    procs = [
+        make_process(job, ReplicaType.COORDINATOR, 0, ProcessPhase.RUNNING),
+        make_process(job, ReplicaType.WORKER, 0, ProcessPhase.RUNNING),
+        lost,
+    ]
+    h = Harness(job, procs)
+    h.sync()
+    assert sorted(h.fake.deleted) == [
+        "default/trainer-coordinator-0",
+        "default/trainer-worker-0",
+        "default/trainer-worker-1",
+    ]
+    from tf_operator_tpu.controller.reconciler import ANNOTATION_PORT
+
+    assert ANNOTATION_PORT not in h.stored_job().metadata.annotations
+
+
+def test_chief_death_escalates_to_full_gang_restart():
+    """Even with gang_restart=False, a dead chief restarts the WHOLE gang:
+    survivors hold a coordinator address pointing at the dead chief, so a
+    chief-only recreate (possibly on another host) would leave them
+    rendezvousing with a dead address forever."""
+    job = make_job(workers=2, gang_restart=False)
+    procs = [
+        make_process(job, ReplicaType.COORDINATOR, 0, ProcessPhase.FAILED, exit_code=137),
+        make_process(job, ReplicaType.WORKER, 0, ProcessPhase.RUNNING),
+        make_process(job, ReplicaType.WORKER, 1, ProcessPhase.RUNNING),
+    ]
+    h = Harness(job, procs)
+    h.sync()
+    assert sorted(h.fake.deleted) == [
+        "default/trainer-coordinator-0",
+        "default/trainer-worker-0",
+        "default/trainer-worker-1",
+    ]
+    # the rendezvous fence dropped the port annotation so the next
+    # incarnation allocates a fresh one
+    from tf_operator_tpu.controller.reconciler import ANNOTATION_PORT
+
+    assert ANNOTATION_PORT not in h.stored_job().metadata.annotations
+
+
 def test_permanent_failure_fails_job():
     job = make_job(workers=1)
     procs = [

@@ -1,0 +1,152 @@
+"""Gang scheduler unit tests: slice-atomic placement semantics
+(SURVEY.md §7 hard part b — the PDB gang hack done properly)."""
+
+import time
+
+import pytest
+
+from tf_operator_tpu.api.types import (
+    ObjectMeta,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    TopologySpec,
+    TPUJob,
+    TPUJobSpec,
+)
+from tf_operator_tpu.runtime.objects import (
+    Host,
+    HostPhase,
+    HostSpec,
+    Process,
+    ProcessPhase,
+    ProcessSpec,
+)
+from tf_operator_tpu.runtime.scheduler import GangScheduler, SchedulingError
+from tf_operator_tpu.runtime.store import Store
+
+
+def host(name, chips=8, slice_type="v5p-32", hb_age=0.0, phase=HostPhase.READY,
+         address=None, max_processes=0):
+    h = Host(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=HostSpec(
+            address=address or f"10.0.0.{name[-1]}",
+            slice_type=slice_type,
+            total_chips=chips,
+            max_processes=max_processes,
+        ),
+    )
+    h.status.phase = phase
+    h.status.heartbeat_time = time.time() - hb_age
+    return h
+
+
+def proc(name, chips=4, node=""):
+    return Process(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=ProcessSpec(job_name="j", chips=chips, node_name=node),
+    )
+
+
+def job(num_hosts=1, slice_type="v5p-32", workers=2):
+    return TPUJob(
+        metadata=ObjectMeta(name="j", namespace="default"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=workers, template=ProcessTemplate(entrypoint="m:f")
+                )
+            },
+            topology=TopologySpec(slice_type=slice_type, num_hosts=num_hosts),
+        ),
+    )
+
+
+class TestReadiness:
+    def test_unmanaged_without_hosts(self):
+        s = GangScheduler(Store())
+        assert not s.managed()
+
+    def test_stale_heartbeat_not_ready_and_lost(self):
+        store = Store()
+        store.create(host("h1", hb_age=0.0))
+        store.create(host("h2", hb_age=60.0))
+        s = GangScheduler(store, heartbeat_ttl=15.0)
+        assert [h.metadata.name for h in s.ready_hosts()] == ["h1"]
+        assert [h.metadata.name for h in s.lost_hosts()] == ["h2"]
+
+    def test_not_ready_phase_excluded(self):
+        store = Store()
+        store.create(host("h1", phase=HostPhase.NOT_READY))
+        s = GangScheduler(store)
+        assert s.managed() and s.ready_hosts() == []
+
+
+class TestPlacement:
+    def test_round_robin_over_requested_hosts(self):
+        store = Store()
+        store.create(host("h1"))
+        store.create(host("h2"))
+        s = GangScheduler(store)
+        procs = [proc(f"p{i}", chips=4) for i in range(4)]
+        placement = s.place_gang(job(num_hosts=2, workers=4), procs)
+        nodes = [placement[f"p{i}"].metadata.name for i in range(4)]
+        assert sorted(set(nodes)) == ["h1", "h2"]
+        assert nodes[0] != nodes[1] and nodes[0] == nodes[2]  # round-robin
+
+    def test_atomic_failure_when_too_few_hosts(self):
+        store = Store()
+        store.create(host("h1"))
+        s = GangScheduler(store)
+        with pytest.raises(SchedulingError, match="need 2"):
+            s.place_gang(job(num_hosts=2), [proc("p0"), proc("p1")])
+
+    def test_atomic_failure_when_capacity_short(self):
+        """3rd member does not fit — NOTHING is placed (no partial gang)."""
+        store = Store()
+        store.create(host("h1", chips=8))
+        s = GangScheduler(store)
+        procs = [proc(f"p{i}", chips=4) for i in range(3)]
+        with pytest.raises(SchedulingError, match="lacks"):
+            s.place_gang(job(num_hosts=1, workers=3), procs)
+
+    def test_existing_processes_consume_capacity(self):
+        store = Store()
+        store.create(host("h1", chips=8))
+        store.create(proc("other", chips=6, node="h1"))
+        s = GangScheduler(store)
+        with pytest.raises(SchedulingError):
+            s.place_gang(job(num_hosts=1), [proc("p0", chips=4)])
+        # finished processes release their chips
+        done = store.get("Process", "default", "other")
+        done.status.phase = ProcessPhase.SUCCEEDED
+        store.update(done)
+        assert s.place_gang(job(num_hosts=1), [proc("p0", chips=4)])
+
+    def test_slice_family_matching(self):
+        store = Store()
+        store.create(host("h1", slice_type="v5e-8"))
+        s = GangScheduler(store)
+        with pytest.raises(SchedulingError):
+            s.place_gang(job(slice_type="v5p-32"), [proc("p0")])
+        assert s.place_gang(job(slice_type="v5e-4"), [proc("p0")])
+        assert s.place_gang(job(slice_type=""), [proc("p0")])  # any
+
+    def test_max_processes_cap(self):
+        store = Store()
+        store.create(host("h1", chips=64, max_processes=1))
+        s = GangScheduler(store)
+        with pytest.raises(SchedulingError, match="max_processes"):
+            s.place_gang(job(num_hosts=1, workers=2),
+                         [proc("p0", chips=1), proc("p1", chips=1)])
+
+    def test_prefers_freest_host_deterministically(self):
+        store = Store()
+        store.create(host("h1", chips=4))
+        store.create(host("h2", chips=16))
+        store.create(host("h3", chips=16))
+        s = GangScheduler(store)
+        placement = s.place_gang(job(num_hosts=1), [proc("p0", chips=2)])
+        # h2/h3 tie on free chips; name breaks the tie deterministically
+        assert placement["p0"].metadata.name == "h2"

@@ -40,6 +40,7 @@ KIND_TPUJOB = "TPUJob"
 KIND_PROCESS = "Process"
 KIND_ENDPOINT = "Endpoint"
 KIND_EVENT = "Event"
+KIND_HOST = "Host"
 
 # Default port the coordinator's jax.distributed service listens on
 # (replaces the reference's TF gRPC port 2222, v1alpha1/types.go:30).

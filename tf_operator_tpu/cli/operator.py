@@ -53,6 +53,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="admin ControllerConfig (JSON/YAML) mapping chip kinds "
                         "to env/library injection (reference: "
                         "--controller-config-file, server.go:138-156)")
+    p.add_argument("--local-agents", type=int, default=0,
+                   help="start N in-process host agents (multi-host mode on "
+                        "one machine: gang scheduler + per-host launch; 0 = "
+                        "classic single-host mode)")
+    p.add_argument("--agent-chips", type=int, default=8,
+                   help="chip capacity each local agent advertises")
+    p.add_argument("--agent-slice-type", default="",
+                   help="slice type local agents advertise (e.g. v5e-8)")
     p.add_argument("--backend", choices=("native", "local"), default="native",
                    help="process runtime: 'native' = C++ supervisor "
                         "(group kills, normalized exit codes; built on demand), "
@@ -153,6 +161,26 @@ def main(argv=None) -> int:
     dashboard = DashboardServer(store, host=args.host, port=args.port)
     chaos = ChaosMonkey(store, args.chaos_level, args.chaos_interval)
 
+    # Multi-host mode on one machine: per-host agents launch their bound
+    # processes; the controller only writes bindings (kubelet split).
+    agents = []
+    if args.local_agents > 0:
+        from tf_operator_tpu.runtime.agent import HostAgent
+
+        for i in range(args.local_agents):
+            agents.append(
+                HostAgent(
+                    store,
+                    f"host-{i}",
+                    total_chips=args.agent_chips,
+                    slice_type=args.agent_slice_type,
+                    backend=type(backend)(store, log_dir=args.log_dir),
+                )
+            )
+        for a in agents:
+            a.start()
+        log.info("started %d local host agents", len(agents))
+
     stop = threading.Event()
 
     def shutdown(*_):
@@ -194,6 +222,8 @@ def main(argv=None) -> int:
     stop.wait()
     chaos.stop()
     controller.stop()
+    for a in agents:
+        a.stop()
     backend.shutdown()
     dashboard.stop()
     return rc["code"]

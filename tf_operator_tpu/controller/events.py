@@ -28,6 +28,8 @@ REASON_JOB_FAILED = "TPUJobFailed"
 REASON_JOB_RUNNING = "TPUJobRunning"
 REASON_JOB_CREATED = "TPUJobCreated"
 REASON_JOB_DEADLINE = "TPUJobDeadlineExceeded"
+REASON_FAILED_SCHEDULING = "FailedScheduling"
+REASON_NODE_LOST = "NodeLost"
 
 
 class EventRecorder:

@@ -34,7 +34,7 @@ import logging
 import socket
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from tf_operator_tpu.api import set_defaults, validate_job
 from tf_operator_tpu.api.types import (
@@ -81,8 +81,10 @@ from tf_operator_tpu.runtime.objects import (
     Process,
     ProcessPhase,
     ProcessSpec,
+    declare_lost,
 )
 from tf_operator_tpu.runtime.process_backend import ProcessControl
+from tf_operator_tpu.runtime.scheduler import GangScheduler, SchedulingError
 from tf_operator_tpu.runtime.store import (
     AlreadyExistsError,
     ConflictError,
@@ -137,6 +139,13 @@ class TPUJobController:
 
         self.queue = RateLimitingQueue()
         self.expectations = ControllerExpectations()
+        # Gang-atomic placement onto registered Hosts (runtime/scheduler.py);
+        # with no Hosts the scheduler reports unmanaged and the controller
+        # launches through process_control exactly as before. The lock
+        # serializes place+create across workers so two jobs cannot be
+        # promised the same free chips.
+        self.scheduler = GangScheduler(store)
+        self._sched_lock = threading.Lock()
 
         self.job_informer = Informer(store, KIND_TPUJOB)
         self.process_informer = Informer(store, KIND_PROCESS)
@@ -281,6 +290,7 @@ class TPUJobController:
             return  # watch events still in flight; they will re-enqueue us
 
         processes = self._claim_processes(job)
+        processes = self._mark_node_lost(job, processes)
         self._reconcile(job, processes)
 
     # ---- child accounting ----------------------------------------------
@@ -291,7 +301,13 @@ class TPUJobController:
     def _claim_processes(self, job: TPUJob) -> List[Process]:
         """List + adopt children (ClaimPods analogue, controller_pod.go:222-258):
         orphans matching our labels are adopted by stamping owner_uid; children
-        owned by a different uid (an old incarnation) are ignored."""
+        owned by a DEAD incarnation are garbage-collected here. The reference
+        leans on the k8s GC (ownerReferences to a deleted uid ⇒ collected);
+        our store has no GC, and without this a delete → same-name recreate
+        race wedges the new job: the old job's deletion sync can find the NEW
+        job already in the informer and skip cascade-GC, leaving an
+        old-incarnation child squatting on a deterministic process name so
+        every recreate hits AlreadyExists forever."""
         claimed = []
         for p in self.process_informer.list(
             namespace=job.metadata.namespace, label_selector=self._labels_for(job)
@@ -310,7 +326,42 @@ class TPUJobController:
                     continue
             if p.metadata.owner_uid == job.metadata.uid:
                 claimed.append(p)
+            elif (
+                p.metadata.owner_kind == KIND_TPUJOB
+                and p.metadata.owner_name == job.metadata.name
+            ):
+                # Same job name, different owner uid: names are unique per
+                # namespace, so the owning incarnation is gone. Collect it.
+                try:
+                    self._delete_child(p)
+                except NotFoundError:
+                    pass
         return claimed
+
+    def _mark_node_lost(self, job: TPUJob, processes: List[Process]) -> List[Process]:
+        """Failure detection for dead hosts: a process bound to a host whose
+        agent stopped heartbeating is marked Failed (exit 137, NodeLost) so
+        the normal retry machinery — gang restart for retryable exits —
+        takes over. The kubelet-gone analogue of the reference's
+        pod-status-driven detection (SURVEY.md §5 failure detection)."""
+        lost = {h.metadata.name for h in self.scheduler.lost_hosts()}
+        if not lost:
+            return processes
+        out: List[Process] = []
+        for p in processes:
+            if p.spec.node_name in lost and not p.is_finished():
+                updated = declare_lost(
+                    self.store, p, f"host {p.spec.node_name} lost"
+                )
+                if updated is not None:
+                    p = updated
+                    self.recorder.warning(
+                        job, ev.REASON_NODE_LOST,
+                        f"{p.metadata.name}: host {p.spec.node_name} "
+                        "stopped heartbeating",
+                    )
+            out.append(p)
+        return out
 
     def _delete_children(self, namespace: str, job_name: str, cleanup: CleanupPolicy) -> None:
         if cleanup is CleanupPolicy.NONE:
@@ -319,7 +370,7 @@ class TPUJobController:
         for p in self.store.list(KIND_PROCESS, namespace=namespace, label_selector=selector):
             if cleanup is CleanupPolicy.RUNNING and p.is_finished():
                 continue  # keep finished processes for debugging
-            self.process_control.delete_process(namespace, p.metadata.name)
+            self._delete_child(p)
         for e in self.store.list(KIND_ENDPOINT, namespace=namespace, label_selector=selector):
             try:
                 self.store.delete(KIND_ENDPOINT, namespace, e.metadata.name)
@@ -356,10 +407,23 @@ class TPUJobController:
         return f"{job.metadata.name}-{rtype.value.lower()}-{index}"
 
     def _rendezvous_port(self, job: TPUJob) -> int:
-        """Stable per-job port, allocated once and persisted as an annotation."""
-        existing = job.metadata.annotations.get(ANNOTATION_PORT)
+        """Stable per-job port, allocated once and persisted as an annotation.
+
+        The STORE copy is authoritative: after a gang restart fences the
+        old port (_clear_rendezvous), a sync still running from a stale
+        informer snapshot must not resurrect the cleared annotation and
+        hand the new gang the zombie incarnation's port."""
+        try:
+            stored = self.store.get(
+                KIND_TPUJOB, job.metadata.namespace, job.metadata.name
+            )
+            existing = stored.metadata.annotations.get(ANNOTATION_PORT)
+        except NotFoundError:
+            existing = job.metadata.annotations.get(ANNOTATION_PORT)
         if existing:
+            job.metadata.annotations[ANNOTATION_PORT] = existing
             return int(existing)
+        job.metadata.annotations.pop(ANNOTATION_PORT, None)
         port = self.port_allocator()
         job.metadata.annotations[ANNOTATION_PORT] = str(port)
         # Persist on the stored object so the allocation survives restarts.
@@ -465,6 +529,19 @@ class TPUJobController:
             return
 
         if retry_needed:
+            # Freshen restart_count from the store BEFORE the limit check:
+            # the informer cache may not have absorbed a previous restart's
+            # own status write, and comparing the stale count would allow a
+            # crash-looping job one restart past its backoff_limit.
+            try:
+                stored = self.store.get(
+                    KIND_TPUJOB, job.metadata.namespace, job.metadata.name
+                )
+                job.status.restart_count = max(
+                    job.status.restart_count, stored.status.restart_count
+                )
+            except NotFoundError:
+                pass
             if rp.backoff_limit is not None and job.status.restart_count >= rp.backoff_limit:
                 self._fail_job(
                     job, ev.REASON_JOB_FAILED,
@@ -515,9 +592,7 @@ class TPUJobController:
                 ):
                     self.expectations.expect_deletions(exp_key, 1)
                     try:
-                        self.process_control.delete_process(
-                            p.metadata.namespace, p.metadata.name
-                        )
+                        self._delete_child(p)
                     except Exception:
                         self.expectations.deletion_failed(exp_key)
                         raise
@@ -539,6 +614,23 @@ class TPUJobController:
         self._write_status(job)
 
     # ---- actions --------------------------------------------------------
+
+    def _delete_child(self, process: Process) -> None:
+        """Delete one child process, honoring the controller/kubelet split:
+        a host-bound process is deleted from the store only — its agent
+        observes DELETED and kills the local child; an unbound one goes
+        through the local backend, which kills and deletes."""
+        if process.spec.node_name:
+            try:
+                self.store.delete(
+                    KIND_PROCESS, process.metadata.namespace, process.metadata.name
+                )
+            except NotFoundError:
+                pass
+        else:
+            self.process_control.delete_process(
+                process.metadata.namespace, process.metadata.name
+            )
 
     def _policy_for(self, job: TPUJob, process: Process) -> RestartPolicy:
         try:
@@ -618,48 +710,87 @@ class TPUJobController:
                 )
             )
 
-        # Chief host: prefer the existing rendezvous Endpoint (the chief may
-        # already be running and we are only recreating lost members);
-        # otherwise resolve from the chief Process being created now.
-        chief_host: Optional[str] = None
-        try:
-            ep = self.store.get(
-                KIND_ENDPOINT, job.metadata.namespace, f"{job.metadata.name}-rendezvous"
-            )
-            chief_host = ep.address.host
-        except NotFoundError:
-            for p in procs:
-                if p.metadata.name == chief_name:
-                    chief_host = self.host_resolver(p)
-                    break
-        if chief_host is None:
-            chief_host = "127.0.0.1"
-        for p in procs:
-            p.spec.env[ENV_COORDINATOR_ADDRESS] = f"{chief_host}:{port}"
-
-        self.expectations.expect_creations(exp_key, len(procs))
-        created = 0
-        try:
-            for proc in procs:
+        # Gang-atomic host placement (multi-host mode): bind every process
+        # to a Ready host BEFORE any create — a partially-placed gang must
+        # never exist (SURVEY.md §7 hard part b). The scheduler lock spans
+        # placement through creation so concurrent workers cannot promise
+        # the same free chips to two jobs (uncontended-lock cost in
+        # single-host mode is negligible).
+        placement: Dict[str, Any] = {}
+        with self._sched_lock:
+            if self.scheduler.managed():
                 try:
-                    self.process_control.create_process(proc)
-                except AlreadyExistsError:
-                    self.expectations.creation_failed(exp_key)
-                else:
-                    created += 1
-                    self.recorder.normal(
-                        job, ev.REASON_SUCCESSFUL_CREATE,
-                        f"created process {proc.metadata.name}",
+                    placement = self.scheduler.place_gang(job, procs)
+                except SchedulingError as exc:
+                    self.recorder.warning(
+                        job, ev.REASON_FAILED_SCHEDULING, str(exc)
                     )
-                if proc.metadata.name == chief_name:
-                    self._ensure_endpoint(job, chief_name, chief_host, port)
-        except Exception as exc:
-            # Roll back unobserved expectations so the job isn't stuck
-            # waiting for creations that will never happen.
-            for _ in range(len(procs) - created):
-                self.expectations.creation_failed(exp_key)
-            self.recorder.warning(job, ev.REASON_FAILED_CREATE, str(exc))
-            raise
+                    raise  # rate-limited requeue retries the gang later
+                for p in procs:
+                    p.spec.node_name = placement[p.metadata.name].metadata.name
+
+            # Chief host: prefer the existing rendezvous Endpoint (the chief
+            # may already be running and we are only recreating lost
+            # members); then the chief's bound host; then the resolver. An
+            # endpoint owned by a DEAD incarnation (delete → same-name
+            # recreate race) is garbage, not truth: collect it instead.
+            chief_host: Optional[str] = None
+            try:
+                ep = self.store.get(
+                    KIND_ENDPOINT, job.metadata.namespace,
+                    f"{job.metadata.name}-rendezvous",
+                )
+                if ep.metadata.owner_uid not in (None, job.metadata.uid):
+                    try:
+                        self.store.delete(
+                            KIND_ENDPOINT, ep.metadata.namespace, ep.metadata.name
+                        )
+                    except NotFoundError:
+                        pass
+                    raise NotFoundError(ep.metadata.key())
+                chief_host = ep.address.host
+            except NotFoundError:
+                if chief_name in placement:
+                    chief_host = placement[chief_name].spec.address
+                else:
+                    for p in procs:
+                        if p.metadata.name == chief_name:
+                            chief_host = self.host_resolver(p)
+                            break
+            if chief_host is None:
+                chief_host = "127.0.0.1"
+            for p in procs:
+                p.spec.env[ENV_COORDINATOR_ADDRESS] = f"{chief_host}:{port}"
+
+            self.expectations.expect_creations(exp_key, len(procs))
+            created = 0
+            try:
+                for proc in procs:
+                    try:
+                        if proc.spec.node_name:
+                            # Bound: create the object only — the host's
+                            # agent launches it (controller/kubelet split).
+                            self.store.create(proc)
+                        else:
+                            self.process_control.create_process(proc)
+                    except AlreadyExistsError:
+                        self.expectations.creation_failed(exp_key)
+                    else:
+                        created += 1
+                        self.recorder.normal(
+                            job, ev.REASON_SUCCESSFUL_CREATE,
+                            f"created process {proc.metadata.name}"
+                            + (f" on {proc.spec.node_name}" if proc.spec.node_name else ""),
+                        )
+                    if proc.metadata.name == chief_name:
+                        self._ensure_endpoint(job, chief_name, chief_host, port)
+            except Exception as exc:
+                # Roll back unobserved expectations so the job isn't stuck
+                # waiting for creations that will never happen.
+                for _ in range(len(procs) - created):
+                    self.expectations.creation_failed(exp_key)
+                self.recorder.warning(job, ev.REASON_FAILED_CREATE, str(exc))
+                raise
 
     def _ensure_endpoint(self, job: TPUJob, target: str, host: str, port: int) -> None:
         name = f"{job.metadata.name}-rendezvous"
@@ -689,8 +820,24 @@ class TPUJobController:
         """Whole-gang restart: delete every existing gang process; the next
         sync (after deletions are observed) recreates them."""
         targets = [observed[(r[0].value, r[1])] for r in gang if (r[0].value, r[1]) in observed]
-        if not job.spec.run_policy.gang_restart:
+        # Escalate to a FULL gang restart even with gang_restart=False when
+        # (a) the chief died — every member's coordinator address points at
+        # it, so recreating only the chief (possibly on a new host) would
+        # leave survivors rendezvousing with a dead address forever — or
+        # (b) any failure is a declared loss (NodeLost / agent restart):
+        # the "failed" process may still be ALIVE as a zombie, and a
+        # partial restart would hand its replacement the same rendezvous
+        # port and rank, letting both join the live chief's gang.
+        chief = self._chief_role(job)
+        full = (
+            job.spec.run_policy.gang_restart
+            or _failed(observed.get((chief[0].value, chief[1])))
+            or any(_failed(p) and p.status.node_lost for p in targets)
+        )
+        if not full:
             targets = [p for p in targets if _failed(p)]
+        # restart_count was freshened against the store by _reconcile just
+        # before the backoff_limit check; only the increment happens here.
         job.status.restart_count += 1
         set_condition(
             job.status,
@@ -709,7 +856,7 @@ class TPUJobController:
             deleted = 0
             try:
                 for p in targets:
-                    self.process_control.delete_process(p.metadata.namespace, p.metadata.name)
+                    self._delete_child(p)
                     deleted += 1
             except Exception:
                 # Roll back every unobserved deletion expectation (not just
@@ -718,7 +865,38 @@ class TPUJobController:
                 for _ in range(len(targets) - deleted):
                     self.expectations.deletion_failed(exp_key)
                 raise
+        if full:
+            # Fence the old incarnation: drop the rendezvous port + endpoint
+            # so the next gang gets a FRESH port. A zombie member whose host
+            # went silent (NodeLost) may still be alive; it must rendezvous
+            # with a dead address, never with the new gang.
+            self._clear_rendezvous(job)
         self._write_status(job)
+
+    def _clear_rendezvous(self, job: TPUJob) -> None:
+        job.metadata.annotations.pop(ANNOTATION_PORT, None)
+        while True:
+            try:
+                fresh = self.store.get(
+                    KIND_TPUJOB, job.metadata.namespace, job.metadata.name
+                )
+            except NotFoundError:
+                break
+            if ANNOTATION_PORT not in fresh.metadata.annotations:
+                break
+            fresh.metadata.annotations.pop(ANNOTATION_PORT, None)
+            try:
+                self.store.update(fresh, check_version=True)
+                break
+            except ConflictError:
+                continue
+        try:
+            self.store.delete(
+                KIND_ENDPOINT, job.metadata.namespace,
+                f"{job.metadata.name}-rendezvous",
+            )
+        except NotFoundError:
+            pass
 
     def _fail_job(self, job: TPUJob, reason: str, message: str) -> None:
         set_condition(job.status, new_condition(ConditionType.FAILED, reason, message))
@@ -746,11 +924,23 @@ class TPUJobController:
                 return
             if (
                 _status_equal_ignoring_heartbeat(fresh.status, job.status)
-                and fresh.metadata.annotations == job.metadata.annotations
+                and _annotations_except_port(fresh.metadata.annotations)
+                == _annotations_except_port(job.metadata.annotations)
             ):
                 return  # no change — avoid a MODIFIED->enqueue->sync loop
+            # restart_count is monotonic: a sync that started from a stale
+            # informer snapshot must never roll back restarts recorded by
+            # a sync that raced ahead of the cache.
+            count = max(fresh.status.restart_count, job.status.restart_count)
             fresh.status = job.status
-            fresh.metadata.annotations.update(job.metadata.annotations)
+            fresh.status.restart_count = count
+            # The rendezvous-port annotation is managed store-side
+            # (_rendezvous_port persists it, _clear_rendezvous removes it);
+            # merging it from a stale cached copy here would resurrect a
+            # fenced port, so it is excluded from the merge.
+            fresh.metadata.annotations.update(
+                _annotations_except_port(job.metadata.annotations)
+            )
             try:
                 self.store.update(fresh, check_version=True)
                 return
@@ -762,6 +952,10 @@ class TPUJobController:
 
 def _failed(p: Optional[Process]) -> bool:
     return p is not None and p.status.phase is ProcessPhase.FAILED
+
+
+def _annotations_except_port(annotations: Dict[str, str]) -> Dict[str, str]:
+    return {k: v for k, v in annotations.items() if k != ANNOTATION_PORT}
 
 
 def _status_equal_ignoring_heartbeat(a, b) -> bool:

@@ -14,16 +14,31 @@ package supplies TPU-native equivalents that work on a bare host or a slice:
                          (reference: RealPodControl pod_control.go:54-165 and
                          FakePodControl, the trick that makes the whole
                          controller testable, controller_test.go:66-68)
+- ``scheduler``        — gang-atomic placement of processes onto Hosts
+                         (slice-atomic: replaces the reference's PDB
+                         gang-scheduling hack, training.go:450-511)
+- ``agent``            — per-host launcher daemon (kubelet analogue):
+                         watches its node's Process bindings, launches via
+                         the local/native backend, heartbeats its Host
 """
 
 from tf_operator_tpu.runtime.objects import (  # noqa: F401
     Endpoint,
     Event,
     EventType,
+    Host,
+    HostPhase,
+    HostSpec,
+    HostStatus,
     Process,
     ProcessPhase,
     ProcessSpec,
     ProcessStatus,
+)
+from tf_operator_tpu.runtime.agent import HostAgent  # noqa: F401
+from tf_operator_tpu.runtime.scheduler import (  # noqa: F401
+    GangScheduler,
+    SchedulingError,
 )
 from tf_operator_tpu.runtime.store import (  # noqa: F401
     AlreadyExistsError,

@@ -1,0 +1,212 @@
+"""Per-host agent: launches the processes bound to its Host.
+
+The kubelet analogue. The reconciler (controller.v2 analogue) never
+launches anything in multi-host mode — it writes Process objects with a
+node binding (pod.spec.nodeName analogue) chosen gang-atomically by the
+scheduler, and each host's agent observes its own bindings through the
+watch stream and launches them with the local (or native C++) backend —
+the same watch-driven split as "controller POSTs Pod to apiserver →
+kubelet starts container" (SURVEY.md §1 control/data split).
+
+The agent also owns its Host object: it registers it at start, heartbeats
+``status.heartbeat_time`` (NodeStatus heartbeat analogue), and marks it
+NotReady on graceful stop. A missed heartbeat is how the controller
+detects node loss and triggers gang restart (runtime/scheduler.py TTL).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from tf_operator_tpu.api.types import KIND_HOST, KIND_PROCESS, ObjectMeta
+from tf_operator_tpu.runtime.objects import (
+    Host,
+    HostPhase,
+    HostSpec,
+    Process,
+    ProcessPhase,
+    declare_lost,
+)
+from tf_operator_tpu.runtime.process_backend import LocalProcessControl
+from tf_operator_tpu.runtime.store import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    Store,
+    WatchEventType,
+)
+
+log = logging.getLogger("tpujob.agent")
+
+DEFAULT_HEARTBEAT_INTERVAL = 3.0
+
+
+class HostAgent:
+    def __init__(
+        self,
+        store: Store,
+        name: str,
+        address: str = "127.0.0.1",
+        total_chips: int = 0,
+        slice_type: str = "",
+        max_processes: int = 0,
+        backend: Optional[LocalProcessControl] = None,
+        heartbeat_interval: float = DEFAULT_HEARTBEAT_INTERVAL,
+        log_dir: Optional[str] = None,
+    ) -> None:
+        self.store = store
+        self.name = name
+        self.spec = HostSpec(
+            address=address,
+            slice_type=slice_type,
+            total_chips=total_chips,
+            max_processes=max_processes,
+        )
+        self.backend = backend or LocalProcessControl(store, log_dir=log_dir)
+        self.heartbeat_interval = heartbeat_interval
+        self._stop = threading.Event()
+        self._threads: list = []
+        self._watch = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        self._register()
+        self._watch = self.store.watch(kinds=[KIND_PROCESS])
+        t1 = threading.Thread(target=self._watch_loop, daemon=True,
+                              name=f"agent-{self.name}-watch")
+        t2 = threading.Thread(target=self._heartbeat_loop, daemon=True,
+                              name=f"agent-{self.name}-heartbeat")
+        self._threads = [t1, t2]
+        t1.start()
+        t2.start()
+
+    def stop(self) -> None:
+        """Graceful drain: mark NotReady, stop launching, kill children."""
+        self._stop.set()
+        if self._watch is not None:
+            self._watch.stop()
+        self._set_phase(HostPhase.NOT_READY, "agent stopped")
+        self.backend.shutdown()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    # -- host object ------------------------------------------------------
+
+    def _register(self) -> None:
+        while True:
+            host = Host(
+                metadata=ObjectMeta(name=self.name, namespace="default"),
+                spec=self.spec,
+            )
+            host.status.phase = HostPhase.READY
+            host.status.heartbeat_time = time.time()
+            try:
+                self.store.create(host)
+                return
+            except AlreadyExistsError:
+                pass
+            # Re-registration after restart: adopt, refresh spec + Ready.
+            # If the object vanishes mid-adoption (admin drain racing a
+            # restart) fall through and retry the create — an unhandled
+            # NotFoundError here would kill the heartbeat thread and
+            # permanently mark this host lost.
+            try:
+                while True:
+                    cur = self.store.get(KIND_HOST, "default", self.name)
+                    cur.spec = self.spec
+                    cur.status.phase = HostPhase.READY
+                    cur.status.heartbeat_time = time.time()
+                    cur.status.message = "agent re-registered"
+                    try:
+                        self.store.update(cur, check_version=True)
+                        return
+                    except ConflictError:
+                        continue
+            except NotFoundError:
+                continue
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                self._touch_heartbeat()
+            except NotFoundError:
+                # Host object deleted (drained by an admin): re-register.
+                self._register()
+
+    def _touch_heartbeat(self) -> None:
+        while True:
+            cur = self.store.get(KIND_HOST, "default", self.name)
+            cur.status.heartbeat_time = time.time()
+            try:
+                self.store.update(cur, check_version=True)
+                return
+            except ConflictError:
+                continue
+
+    def _set_phase(self, phase: HostPhase, message: str) -> None:
+        try:
+            while True:
+                cur = self.store.get(KIND_HOST, "default", self.name)
+                cur.status.phase = phase
+                cur.status.message = message
+                try:
+                    self.store.update(cur, check_version=True)
+                    return
+                except ConflictError:
+                    continue
+        except NotFoundError:
+            pass
+
+    # -- process lifecycle ------------------------------------------------
+
+    def _mine(self, proc: Process) -> bool:
+        return proc.spec.node_name == self.name
+
+    def _watch_loop(self) -> None:
+        assert self._watch is not None
+        for ev in self._watch:
+            if self._stop.is_set():
+                return
+            try:
+                self._handle_event(ev)
+            except Exception:
+                # The watch loop must outlive any single bad event: if it
+                # died while the separate heartbeat thread kept the Host
+                # Ready, newly bound processes would sit Pending forever
+                # with NodeLost detection masked by the fresh heartbeat.
+                log.exception(
+                    "agent %s: error handling %s for %s; continuing",
+                    self.name, ev.type.value, ev.obj.metadata.name,
+                )
+
+    def _handle_event(self, ev) -> None:
+        proc = ev.obj
+        if not self._mine(proc):
+            return
+        if ev.type is WatchEventType.DELETED:
+            self.backend.kill_local(proc.metadata.namespace, proc.metadata.name)
+        elif ev.type is WatchEventType.ADDED:
+            # Replays deliver already-finished processes; only Pending
+            # ones are launchable (launch_existing dedupes in-flight).
+            if proc.status.phase is ProcessPhase.PENDING:
+                self.backend.launch_existing(proc)
+            elif proc.status.phase is ProcessPhase.RUNNING and not self.backend.tracks(
+                proc.metadata.namespace, proc.metadata.name
+            ):
+                # Agent restarted over a RUNNING binding it no longer
+                # supervises (kubelet-restart reconcile): the old child is
+                # orphaned — declare it lost so the controller's fenced
+                # gang restart takes over. Without this the fresh heartbeat
+                # masks the loss and the job hangs forever.
+                if declare_lost(
+                    self.store, proc,
+                    f"agent on {self.name} restarted; process lost",
+                ) is not None:
+                    log.warning(
+                        "declared orphaned process %s/%s lost",
+                        proc.metadata.namespace, proc.metadata.name,
+                    )

@@ -12,10 +12,17 @@ reference's per-replica DNS machinery, SURVEY.md §5 "communication backend").
 from __future__ import annotations
 
 import enum
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from tf_operator_tpu.api.types import KIND_ENDPOINT, KIND_EVENT, KIND_PROCESS, ObjectMeta
+from tf_operator_tpu.api.types import (
+    KIND_ENDPOINT,
+    KIND_EVENT,
+    KIND_HOST,
+    KIND_PROCESS,
+    ObjectMeta,
+)
 
 
 class ProcessPhase(str, enum.Enum):
@@ -43,6 +50,9 @@ class ProcessSpec:
     chips: int = 0  # TPU chips this process drives
     port: int = 0  # rendezvous port (meaningful on the coordinator process)
     workdir: Optional[str] = None
+    # Host binding (pod.spec.nodeName analogue): set by the gang scheduler;
+    # empty means "launch wherever the backend runs" (single-host mode).
+    node_name: str = ""
 
 
 @dataclass
@@ -61,6 +71,11 @@ class ProcessStatus:
     # Exit code of the previous incarnation, preserved across in-place
     # restarts (LastTerminationState analogue, replicas.go:333-341).
     last_termination_exit_code: Optional[int] = None
+    # True when this failure was declared, not observed: the supervising
+    # agent/host vanished (NodeLost) or an agent restarted over an untracked
+    # child. The process may still be ALIVE somewhere — restart handling
+    # must fence it out (full gang restart + fresh rendezvous port).
+    node_lost: bool = False
 
 
 @dataclass
@@ -100,6 +115,74 @@ class Endpoint:
 
     def key(self) -> str:
         return self.metadata.key()
+
+
+class HostPhase(str, enum.Enum):
+    """Node-condition analogue: Ready hosts accept placements."""
+
+    READY = "Ready"
+    NOT_READY = "NotReady"
+
+
+@dataclass
+class HostSpec:
+    """A TPU host that can run processes (k8s Node analogue). On TPU the
+    interesting capacity is chips; slice_type scopes which jobs may land
+    here (gang placement is slice-atomic, SURVEY.md §2.3 gang row)."""
+
+    address: str = "127.0.0.1"  # reachable address for rendezvous traffic
+    slice_type: str = ""  # e.g. "v5p-32"; "" accepts any job
+    total_chips: int = 0
+    max_processes: int = 0  # 0 = unlimited
+
+
+@dataclass
+class HostStatus:
+    phase: HostPhase = HostPhase.READY
+    heartbeat_time: float = 0.0  # agent liveness (NodeStatus heartbeat)
+    message: str = ""
+
+
+@dataclass
+class Host:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: HostSpec = field(default_factory=HostSpec)
+    status: HostStatus = field(default_factory=HostStatus)
+    kind: str = KIND_HOST
+
+    def key(self) -> str:
+        return self.metadata.key()
+
+
+def declare_lost(store, process: "Process", message: str) -> Optional["Process"]:
+    """Declare a process lost (FAILED, exit 137, node_lost=True): its host or
+    supervising agent vanished, so the failure is INFERRED — the child may
+    still be alive somewhere, and restart handling must fence it out (full
+    gang restart + fresh rendezvous port). Versioned optimistic write: a
+    concurrent terminal status (e.g. the real supervisor reporting SUCCEEDED)
+    always wins over the inference. Returns the updated Process, or None if
+    it was already finished / gone / a different incarnation."""
+    from tf_operator_tpu.runtime.store import ConflictError, NotFoundError
+
+    meta = process.metadata
+    while True:
+        try:
+            cur = store.get(KIND_PROCESS, meta.namespace, meta.name)
+        except NotFoundError:
+            return None
+        if cur.metadata.uid != meta.uid or cur.is_finished():
+            return None
+        cur.status.phase = ProcessPhase.FAILED
+        cur.status.exit_code = 137  # SIGKILL-class: retryable
+        cur.status.finish_time = time.time()
+        cur.status.message = message
+        cur.status.node_lost = True
+        try:
+            return store.update(cur, check_version=True)
+        except ConflictError:
+            continue
+        except NotFoundError:
+            return None
 
 
 class EventType(str, enum.Enum):

@@ -108,46 +108,109 @@ class LocalProcessControl(ProcessControl):
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
         self._lock = threading.Lock()
-        # "ns/name" -> Popen, or None while the launch is still in flight.
-        self._children: Dict[str, Optional[subprocess.Popen]] = {}
-        # Keys deleted while their launch was in flight: the monitor kills
+        # "ns/name" -> (uid, Popen|None); None while the launch is in flight.
+        # The uid disambiguates incarnations: a delete + same-name recreate
+        # during a gang restart must never let the OLD incarnation's
+        # bookkeeping (tombstone, entry pop) act on the NEW child.
+        self._children: Dict[str, tuple] = {}
+        # Uids deleted while their launch was in flight: the monitor kills
         # the child as soon as Popen returns instead of leaking an orphan.
         self._tombstones: set = set()
         self._shutting_down = False
 
     # -- ProcessControl ---------------------------------------------------
 
+    def _log_path(self, meta) -> str:
+        # Combined stdout+stderr log (kubelet log analogue; served by the
+        # dashboard's logs endpoint, api_handler.go:236-251). basename()
+        # on each component forecloses path traversal via crafted
+        # namespace/name (validation also rejects them at admission).
+        return os.path.join(
+            self._log_dir,
+            f"{os.path.basename(meta.namespace)}_{os.path.basename(meta.name)}.log",
+        )
+
     def create_process(self, process: Process) -> None:
         if self._log_dir:
-            # Combined stdout+stderr log (kubelet log analogue; served by the
-            # dashboard's logs endpoint, api_handler.go:236-251). basename()
-            # on each component forecloses path traversal via crafted
-            # namespace/name (validation also rejects them at admission).
-            log_name = (
-                f"{os.path.basename(process.metadata.namespace)}"
-                f"_{os.path.basename(process.metadata.name)}.log"
-            )
-            process.metadata.annotations[self.LOG_ANNOTATION] = os.path.join(
-                self._log_dir, log_name
+            process.metadata.annotations[self.LOG_ANNOTATION] = self._log_path(
+                process.metadata
             )
         stored = self._store.create(process)
+        self.launch_existing(stored)
+
+    def launch_existing(self, stored: Process) -> None:
+        """Launch + monitor a Process that already exists in the store —
+        the seam the per-host agent uses (it observes creations made by the
+        controller instead of making them). No-op if this backend already
+        tracks the key (watch replays deliver duplicates)."""
+        if self._log_dir and self.LOG_ANNOTATION not in stored.metadata.annotations:
+            path = self._log_path(stored.metadata)
+            stored.metadata.annotations[self.LOG_ANNOTATION] = path
+            self._annotate_log_path(stored, path)
+        stale = _NO_CHILD
         with self._lock:
-            self._children[stored.key()] = None  # reserve before thread start
+            entry = self._children.get(stored.key())
+            if entry is not None:
+                if entry[0] == stored.metadata.uid:
+                    return  # already launching/launched (watch-replay dup)
+                # A previous incarnation still occupies the name: its store
+                # object is gone (a new uid exists), so reap it and proceed.
+                stale = self._children.pop(stored.key())[1]
+                if stale is None:
+                    self._tombstones.add(entry[0])
+            self._children[stored.key()] = (stored.metadata.uid, None)  # reserve
+        if stale not in (None, _NO_CHILD):
+            self._terminate(stale)
         thread = threading.Thread(
             target=self._launch_and_monitor, args=(stored,), daemon=True,
             name=f"procmon-{stored.metadata.name}",
         )
         thread.start()
 
-    def delete_process(self, namespace: str, name: str) -> None:
-        key = f"{namespace}/{name}"
+    def _annotate_log_path(self, process: Process, path: str) -> None:
+        """Persist the log-path annotation on an agent-launched process so
+        the dashboard's logs endpoint finds it (optimistic retry)."""
+        meta = process.metadata
+        while True:
+            try:
+                cur = self._store.get(KIND_PROCESS, meta.namespace, meta.name)
+            except NotFoundError:
+                return
+            if cur.metadata.uid != meta.uid:
+                return
+            cur.metadata.annotations[self.LOG_ANNOTATION] = path
+            try:
+                self._store.update(cur, check_version=True)
+                return
+            except ConflictError:
+                continue
+            except NotFoundError:
+                return
+
+    def tracks(self, namespace: str, name: str) -> bool:
+        """True when this backend is supervising (or launching) ns/name."""
         with self._lock:
-            child = self._children.pop(key, _NO_CHILD)
-            if child is None:
-                # Launch in flight: tombstone it; the monitor reaps on arrival.
-                self._tombstones.add(key)
+            return f"{namespace}/{name}" in self._children
+
+    def kill_local(self, namespace: str, name: str) -> None:
+        """Terminate the local child for ns/name without touching the store
+        (the store object is already gone when the agent observes DELETED)."""
+        key = f"{namespace}/{name}"
+        child = _NO_CHILD
+        with self._lock:
+            entry = self._children.pop(key, None)
+            if entry is not None:
+                child = entry[1]
+                if child is None:
+                    # Launch in flight: tombstone THIS incarnation's uid; the
+                    # monitor reaps on arrival. A same-name recreate gets a
+                    # new uid and is unaffected.
+                    self._tombstones.add(entry[0])
         if child not in (None, _NO_CHILD):
             self._terminate(child)
+
+    def delete_process(self, namespace: str, name: str) -> None:
+        self.kill_local(namespace, name)
         try:
             self._store.delete(KIND_PROCESS, namespace, name)
         except NotFoundError:
@@ -182,8 +245,15 @@ class LocalProcessControl(ProcessControl):
             if log_file:
                 log_file.close()  # child holds its own descriptor now
 
+    def _pop_if_mine(self, key: str, uid) -> None:
+        """Drop this incarnation's entry; never a successor's reservation."""
+        entry = self._children.get(key)
+        if entry is not None and entry[0] == uid:
+            self._children.pop(key)
+
     def _launch_and_monitor(self, process: Process) -> None:
         key = process.key()
+        uid = process.metadata.uid
         env = dict(os.environ) if self._inherit_env else {}
         # Identity first, then controller-provided env (controller wins on
         # conflicts — it may override e.g. the entrypoint for a debug run).
@@ -196,24 +266,24 @@ class LocalProcessControl(ProcessControl):
             # Covers both a failed log-file open and a failed exec: the
             # process must be reported FAILED, never left Pending forever.
             with self._lock:
-                self._children.pop(key, None)
-                self._tombstones.discard(key)
+                self._pop_if_mine(key, uid)
+                self._tombstones.discard(uid)
             self._patch_status(process, ProcessPhase.FAILED, exit_code=127, message=str(exc))
             return
         with self._lock:
-            doomed = key in self._tombstones or self._shutting_down
+            doomed = uid in self._tombstones or self._shutting_down
             if doomed:
-                self._tombstones.discard(key)
-                self._children.pop(key, None)
+                self._tombstones.discard(uid)
+                self._pop_if_mine(key, uid)
             else:
-                self._children[key] = child
+                self._children[key] = (uid, child)
         if doomed:  # deleted while launch was in flight: reap, don't report
             self._terminate(child)
             return
         self._patch_status(process, ProcessPhase.RUNNING, pid=child.pid)
         code = child.wait()
         with self._lock:
-            self._children.pop(key, None)
+            self._pop_if_mine(key, uid)
         oom = _was_oom_killed(code)
         phase = ProcessPhase.SUCCEEDED if code == 0 else ProcessPhase.FAILED
         self._patch_status(process, phase, exit_code=code, oom_killed=oom)
@@ -260,7 +330,7 @@ class LocalProcessControl(ProcessControl):
         """Terminate all children (operator teardown)."""
         with self._lock:
             self._shutting_down = True
-            children = [c for c in self._children.values() if c is not None]
+            children = [e[1] for e in self._children.values() if e[1] is not None]
             self._children.clear()
         for child in children:
             if child.poll() is None:

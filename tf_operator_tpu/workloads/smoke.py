@@ -49,6 +49,14 @@ def main(ctx: JobContext) -> None:
 
     import math
 
+    sleep_s = float(ctx.workload.get("sleep_s", 0))
+    if sleep_s:
+        # Fault-injection hook: keep the gang alive so tests can kill a
+        # host/process mid-run (chaos + node-lost scenarios).
+        import time
+
+        time.sleep(sleep_s)
+
     total = float(checksum(make_ones(), make_ones()))
     expected = float(n_dev) * dim**3
     # fp32 accumulation is inexact for large dims; a relative tolerance

@@ -55,7 +55,10 @@ def run_trial(
         client.create(job)
         done = client.wait_for_job(ns, name, timeout=timeout)
         phase = done.status.phase().value
-        assert phase == "Done", f"job finished {phase}: {done.status.message}"
+        conds = "; ".join(
+            f"{c.type.value}({c.reason}): {c.message}" for c in done.status.conditions
+        )
+        assert phase == "Done", f"job finished {phase} [{conds}]"
 
     with suite.timed_case(f"trial{trial}-events-oracle"):
         want = expected_replicas(job)
