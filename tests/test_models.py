@@ -177,6 +177,48 @@ def test_mlm_loss_trains_bidirectional_encoder():
     assert losses[-1] < losses[0], losses
 
 
+def test_bn_fused_stats_matches_two_pass_variance():
+    """bn_fused_stats=True (one-pass E[x]/E[x²] statistics, the TPU-fast
+    path) must agree with the textbook mean-then-var formulation — same
+    forward output and same running-stat update, within f32 tolerance."""
+    from tf_operator_tpu.models.resnet import _batch_norm
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 6, 6, 16), jnp.float32) * 3.0 + 1.5
+    p = {"scale": jnp.linspace(0.5, 2.0, 16), "bias": jnp.linspace(-1.0, 1.0, 16)}
+    s = {"mean": jnp.zeros((16,)), "var": jnp.ones((16,))}
+    y_fused, s_fused = _batch_norm(x, p, s, train=True, fused_stats=True)
+    y_exact, s_exact = _batch_norm(x, p, s, train=True, fused_stats=False)
+    assert np.allclose(np.asarray(y_fused), np.asarray(y_exact), rtol=1e-4, atol=1e-4)
+    assert np.allclose(np.asarray(s_fused["mean"]), np.asarray(s_exact["mean"]), rtol=1e-5)
+    assert np.allclose(np.asarray(s_fused["var"]), np.asarray(s_exact["var"]), rtol=1e-4)
+    # The production path is bf16 activations (cfg.dtype): the fused form
+    # reduces bf16 with f32 accumulation — including a nasty large-mean /
+    # small-variance channel where E[x²]-E[x]² cancellation would show up.
+    xb = x.astype(jnp.bfloat16)
+    xb = xb.at[..., 0].set(jnp.bfloat16(40.0) + xb[..., 0] * jnp.bfloat16(0.1))
+    yb_fused, sb_fused = _batch_norm(xb, p, s, train=True, fused_stats=True)
+    yb_exact, sb_exact = _batch_norm(xb, p, s, train=True, fused_stats=False)
+    assert yb_fused.dtype == jnp.bfloat16
+    # Near-centered channels (the real BN regime — conv outputs): outputs
+    # agree. Channel 0 is excluded from the y comparison: with |mean|≈40
+    # the folded bf16 affine (x·a at magnitude ~66, ulp 0.25) quantizes a/b
+    # differently between the two stats paths in BOTH variants — that is
+    # the documented in_act_dtype precision tradeoff, not a fused-stats
+    # defect.
+    assert np.allclose(
+        np.asarray(yb_fused[..., 1:], dtype=np.float32),
+        np.asarray(yb_exact[..., 1:], dtype=np.float32),
+        rtol=0.05, atol=0.05,
+    )
+    # The cancellation-sensitive quantity is the variance itself: on the
+    # large-mean channel E[x²]-E[x]² must still match the two-pass var.
+    assert np.allclose(
+        np.asarray(sb_fused["var"]), np.asarray(sb_exact["var"]), rtol=0.02, atol=1e-3
+    )
+    # the offset channel kept a sane, non-degenerate variance
+    assert np.asarray(sb_fused["var"])[0] > 0.0
+
+
 def test_trainer_resnet_with_bn_state():
     mesh = build_mesh({"dp": 8})
     cfg = ResNetConfig(stage_sizes=(1, 1), widths=(8, 16), num_classes=10, dtype=jnp.float32)
