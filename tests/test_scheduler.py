@@ -84,16 +84,45 @@ class TestReadiness:
 
 
 class TestPlacement:
-    def test_round_robin_over_requested_hosts(self):
+    def test_rank_keyed_round_robin_over_requested_hosts(self):
         store = Store()
         store.create(host("h1"))
         store.create(host("h2"))
         s = GangScheduler(store)
         procs = [proc(f"p{i}", chips=4) for i in range(4)]
-        placement = s.place_gang(job(num_hosts=2, workers=4), procs)
+        ranks = {f"p{i}": i for i in range(4)}
+        placement = s.place_gang(job(num_hosts=2, workers=4), procs, ranks=ranks)
         nodes = [placement[f"p{i}"].metadata.name for i in range(4)]
         assert sorted(set(nodes)) == ["h1", "h2"]
-        assert nodes[0] != nodes[1] and nodes[0] == nodes[2]  # round-robin
+        # slot = rank % num_hosts: ranks 0,2 share a host; 1,3 the other
+        assert nodes[0] != nodes[1] and nodes[0] == nodes[2] and nodes[1] == nodes[3]
+
+    def test_partial_recreate_keeps_slot_pinned_to_live_members_host(self):
+        """Recreating only rank 1 of a 2-host gang must keep rank 0's host
+        pinned and place rank 1 on the OTHER host — not co-locate them."""
+        store = Store()
+        store.create(host("h1"))
+        store.create(host("h2"))
+        s = GangScheduler(store)
+        placement = s.place_gang(
+            job(num_hosts=2, workers=2),
+            [proc("w1", chips=4)],
+            ranks={"w1": 1},
+            bound_slots={0: "h2"},  # rank 0 lives on h2
+        )
+        assert placement["w1"].metadata.name == "h1"
+
+    def test_pinned_slot_to_unschedulable_host_fails_atomically(self):
+        store = Store()
+        store.create(host("h1"))
+        s = GangScheduler(store)
+        with pytest.raises(SchedulingError, match="not\\s+schedulable"):
+            s.place_gang(
+                job(num_hosts=2, workers=2),
+                [proc("w1", chips=4)],
+                ranks={"w1": 1},
+                bound_slots={0: "h-gone"},
+            )
 
     def test_atomic_failure_when_too_few_hosts(self):
         store = Store()
@@ -108,8 +137,11 @@ class TestPlacement:
         store.create(host("h1", chips=8))
         s = GangScheduler(store)
         procs = [proc(f"p{i}", chips=4) for i in range(3)]
-        with pytest.raises(SchedulingError, match="lacks"):
-            s.place_gang(job(num_hosts=1, workers=3), procs)
+        with pytest.raises(SchedulingError, match="lacks capacity"):
+            s.place_gang(
+                job(num_hosts=1, workers=3), procs,
+                ranks={f"p{i}": i for i in range(3)},
+            )
 
     def test_existing_processes_consume_capacity(self):
         store = Store()
@@ -137,7 +169,7 @@ class TestPlacement:
         store = Store()
         store.create(host("h1", chips=64, max_processes=1))
         s = GangScheduler(store)
-        with pytest.raises(SchedulingError, match="max_processes"):
+        with pytest.raises(SchedulingError, match="capacity"):
             s.place_gang(job(num_hosts=1, workers=2),
                          [proc("p0", chips=1), proc("p1", chips=1)])
 

@@ -105,7 +105,7 @@ def test_ci_pipeline_parses_and_substitutes():
         assert expected in names
     assert pipeline["stages"][-1].get("always"), "teardown must always run"
     for stage in pipeline["stages"]:
-        stage["run"].format(port=1234, artifacts="/tmp/x")  # no KeyError
+        stage["run"].format(port=1234, port2=1235, artifacts="/tmp/x")  # no KeyError
 
 
 def test_build_image_dry_run_stages_context(tmp_path, capsys, monkeypatch):

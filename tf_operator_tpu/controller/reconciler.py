@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from tf_operator_tpu.api import set_defaults, validate_job
 from tf_operator_tpu.api.types import (
     KIND_ENDPOINT,
+    KIND_HOST,
     KIND_PROCESS,
     KIND_TPUJOB,
     LABEL_GROUP,
@@ -343,13 +344,23 @@ class TPUJobController:
         agent stopped heartbeating is marked Failed (exit 137, NodeLost) so
         the normal retry machinery — gang restart for retryable exits —
         takes over. The kubelet-gone analogue of the reference's
-        pod-status-driven detection (SURVEY.md §5 failure detection)."""
+        pod-status-driven detection (SURVEY.md §5 failure detection). A
+        binding to a host whose Host OBJECT is gone entirely (admin
+        drain/delete) counts as lost too, after the same TTL grace —
+        otherwise such processes would sit Pending/Running forever with no
+        agent to drive them and no heartbeat to miss."""
         lost = {h.metadata.name for h in self.scheduler.lost_hosts()}
-        if not lost:
-            return processes
+        known = {h.metadata.name for h in self.store.list(KIND_HOST)}
+        now = time.time()
         out: List[Process] = []
         for p in processes:
-            if p.spec.node_name in lost and not p.is_finished():
+            node = p.spec.node_name
+            node_lost = node in lost or (
+                node
+                and node not in known
+                and now - p.metadata.creation_timestamp > self.scheduler.heartbeat_ttl
+            )
+            if node_lost and not p.is_finished():
                 updated = declare_lost(
                     self.store, p, f"host {p.spec.node_name} lost"
                 )
@@ -426,18 +437,14 @@ class TPUJobController:
         job.metadata.annotations.pop(ANNOTATION_PORT, None)
         port = self.port_allocator()
         job.metadata.annotations[ANNOTATION_PORT] = str(port)
+
         # Persist on the stored object so the allocation survives restarts.
-        while True:
-            try:
-                fresh = self.store.get(KIND_TPUJOB, job.metadata.namespace, job.metadata.name)
-            except NotFoundError:
-                break
+        def stamp(fresh):
             fresh.metadata.annotations[ANNOTATION_PORT] = str(port)
-            try:
-                self.store.update(fresh, check_version=True)
-                break
-            except ConflictError:
-                continue
+
+        self.store.update_with_retry(
+            KIND_TPUJOB, job.metadata.namespace, job.metadata.name, stamp
+        )
         return port
 
     # ---- the reconcile core ---------------------------------------------
@@ -560,7 +567,7 @@ class TPUJobController:
         # -- create missing gang members ---------------------------------
         missing = [r for r in gang + evaluators if (r[0].value, r[1]) not in observed]
         if missing:
-            self._create_processes(job, missing, exp_key)
+            self._create_processes(job, missing, exp_key, observed)
 
         # -- running condition -------------------------------------------
         gang_running = gang and all(
@@ -640,7 +647,11 @@ class TPUJobController:
         return rs.restart_policy if rs and rs.restart_policy else RestartPolicy.EXIT_CODE
 
     def _create_processes(
-        self, job: TPUJob, roles: List[Tuple[ReplicaType, int]], exp_key: str
+        self,
+        job: TPUJob,
+        roles: List[Tuple[ReplicaType, int]],
+        exp_key: str,
+        observed: Optional[Dict[Tuple[str, int], Process]] = None,
     ) -> None:
         gang = self._gang_roles(job)
         num_processes = len(gang)
@@ -718,9 +729,26 @@ class TPUJobController:
         # single-host mode is negligible).
         placement: Dict[str, Any] = {}
         with self._sched_lock:
-            if self.scheduler.managed():
+            managed = self.scheduler.managed()
+            if managed:
+                # Rank-keyed placement: a member's host slot is its gang
+                # rank mod num_hosts, and slots already holding LIVE bound
+                # members stay pinned to those hosts — a partial recreate
+                # keeps every member's topology position.
+                ranks = {
+                    self._process_name(job, r[0], r[1]): i
+                    for i, r in enumerate(gang)
+                }
+                bound_slots: Dict[int, str] = {}
+                want_hosts = max(1, job.spec.topology.num_hosts)
+                for i, r in enumerate(gang):
+                    live = (observed or {}).get((r[0].value, r[1]))
+                    if live is not None and not live.is_finished() and live.spec.node_name:
+                        bound_slots[i % want_hosts] = live.spec.node_name
                 try:
-                    placement = self.scheduler.place_gang(job, procs)
+                    placement = self.scheduler.place_gang(
+                        job, procs, ranks=ranks, bound_slots=bound_slots
+                    )
                 except SchedulingError as exc:
                     self.recorder.warning(
                         job, ev.REASON_FAILED_SCHEDULING, str(exc)
@@ -757,6 +785,21 @@ class TPUJobController:
                         if p.metadata.name == chief_name:
                             chief_host = self.host_resolver(p)
                             break
+            if chief_host is None and managed:
+                # Partial recreate with no Endpoint and a chief that already
+                # exists elsewhere: resolve through the chief's node binding
+                # — defaulting to loopback here would point the recreated
+                # members' coordinator address at themselves.
+                try:
+                    cp = self.store.get(
+                        KIND_PROCESS, job.metadata.namespace, chief_name
+                    )
+                    if cp.spec.node_name:
+                        chief_host = self.store.get(
+                            KIND_HOST, "default", cp.spec.node_name
+                        ).spec.address
+                except NotFoundError:
+                    pass
             if chief_host is None:
                 chief_host = "127.0.0.1"
             for p in procs:
@@ -875,21 +918,15 @@ class TPUJobController:
 
     def _clear_rendezvous(self, job: TPUJob) -> None:
         job.metadata.annotations.pop(ANNOTATION_PORT, None)
-        while True:
-            try:
-                fresh = self.store.get(
-                    KIND_TPUJOB, job.metadata.namespace, job.metadata.name
-                )
-            except NotFoundError:
-                break
+
+        def drop(fresh):
             if ANNOTATION_PORT not in fresh.metadata.annotations:
-                break
+                return False
             fresh.metadata.annotations.pop(ANNOTATION_PORT, None)
-            try:
-                self.store.update(fresh, check_version=True)
-                break
-            except ConflictError:
-                continue
+
+        self.store.update_with_retry(
+            KIND_TPUJOB, job.metadata.namespace, job.metadata.name, drop
+        )
         try:
             self.store.delete(
                 KIND_ENDPOINT, job.metadata.namespace,
@@ -917,17 +954,13 @@ class TPUJobController:
         last_reconcile_time heartbeat is excluded from the change check —
         stamping it every sync would otherwise make every write produce a
         MODIFIED event that re-enqueues the job: a hot loop."""
-        while True:
-            try:
-                fresh = self.store.get(KIND_TPUJOB, job.metadata.namespace, job.metadata.name)
-            except NotFoundError:
-                return
+        def mutate(fresh):
             if (
                 _status_equal_ignoring_heartbeat(fresh.status, job.status)
                 and _annotations_except_port(fresh.metadata.annotations)
                 == _annotations_except_port(job.metadata.annotations)
             ):
-                return  # no change — avoid a MODIFIED->enqueue->sync loop
+                return False  # no change — avoid a MODIFIED->enqueue->sync loop
             # restart_count is monotonic: a sync that started from a stale
             # informer snapshot must never roll back restarts recorded by
             # a sync that raced ahead of the cache.
@@ -941,13 +974,10 @@ class TPUJobController:
             fresh.metadata.annotations.update(
                 _annotations_except_port(job.metadata.annotations)
             )
-            try:
-                self.store.update(fresh, check_version=True)
-                return
-            except ConflictError:
-                continue
-            except NotFoundError:
-                return
+
+        self.store.update_with_retry(
+            KIND_TPUJOB, job.metadata.namespace, job.metadata.name, mutate
+        )
 
 
 def _failed(p: Optional[Process]) -> bool:

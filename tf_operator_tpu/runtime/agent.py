@@ -33,8 +33,6 @@ from tf_operator_tpu.runtime.objects import (
 from tf_operator_tpu.runtime.process_backend import LocalProcessControl
 from tf_operator_tpu.runtime.store import (
     AlreadyExistsError,
-    ConflictError,
-    NotFoundError,
     Store,
     WatchEventType,
 )
@@ -109,57 +107,43 @@ class HostAgent:
                 return
             except AlreadyExistsError:
                 pass
+
             # Re-registration after restart: adopt, refresh spec + Ready.
-            # If the object vanishes mid-adoption (admin drain racing a
-            # restart) fall through and retry the create — an unhandled
-            # NotFoundError here would kill the heartbeat thread and
-            # permanently mark this host lost.
-            try:
-                while True:
-                    cur = self.store.get(KIND_HOST, "default", self.name)
-                    cur.spec = self.spec
-                    cur.status.phase = HostPhase.READY
-                    cur.status.heartbeat_time = time.time()
-                    cur.status.message = "agent re-registered"
-                    try:
-                        self.store.update(cur, check_version=True)
-                        return
-                    except ConflictError:
-                        continue
-            except NotFoundError:
-                continue
+            def adopt(cur):
+                cur.spec = self.spec
+                cur.status.phase = HostPhase.READY
+                cur.status.heartbeat_time = time.time()
+                cur.status.message = "agent re-registered"
+
+            if self.store.update_with_retry(KIND_HOST, "default", self.name, adopt):
+                return
+            # Object vanished mid-adoption (admin drain racing a restart):
+            # loop and retry the create.
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_interval):
+            # The heartbeat thread must survive ANY error: if it died while
+            # the watch loop kept launching, the host would be declared
+            # NodeLost and every healthy process on it failed and fenced.
             try:
                 self._touch_heartbeat()
-            except NotFoundError:
-                # Host object deleted (drained by an admin): re-register.
-                self._register()
+            except Exception:
+                log.exception("agent %s: heartbeat failed; retrying", self.name)
 
     def _touch_heartbeat(self) -> None:
-        while True:
-            cur = self.store.get(KIND_HOST, "default", self.name)
+        def touch(cur):
             cur.status.heartbeat_time = time.time()
-            try:
-                self.store.update(cur, check_version=True)
-                return
-            except ConflictError:
-                continue
+
+        if self.store.update_with_retry(KIND_HOST, "default", self.name, touch) is None:
+            # Host object deleted (drained by an admin): re-register.
+            self._register()
 
     def _set_phase(self, phase: HostPhase, message: str) -> None:
-        try:
-            while True:
-                cur = self.store.get(KIND_HOST, "default", self.name)
-                cur.status.phase = phase
-                cur.status.message = message
-                try:
-                    self.store.update(cur, check_version=True)
-                    return
-                except ConflictError:
-                    continue
-        except NotFoundError:
-            pass
+        def mutate(cur):
+            cur.status.phase = phase
+            cur.status.message = message
+
+        self.store.update_with_retry(KIND_HOST, "default", self.name, mutate)
 
     # -- process lifecycle ------------------------------------------------
 

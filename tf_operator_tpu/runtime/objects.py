@@ -162,27 +162,18 @@ def declare_lost(store, process: "Process", message: str) -> Optional["Process"]
     concurrent terminal status (e.g. the real supervisor reporting SUCCEEDED)
     always wins over the inference. Returns the updated Process, or None if
     it was already finished / gone / a different incarnation."""
-    from tf_operator_tpu.runtime.store import ConflictError, NotFoundError
-
     meta = process.metadata
-    while True:
-        try:
-            cur = store.get(KIND_PROCESS, meta.namespace, meta.name)
-        except NotFoundError:
-            return None
+
+    def mutate(cur):
         if cur.metadata.uid != meta.uid or cur.is_finished():
-            return None
+            return False
         cur.status.phase = ProcessPhase.FAILED
         cur.status.exit_code = 137  # SIGKILL-class: retryable
         cur.status.finish_time = time.time()
         cur.status.message = message
         cur.status.node_lost = True
-        try:
-            return store.update(cur, check_version=True)
-        except ConflictError:
-            continue
-        except NotFoundError:
-            return None
+
+    return store.update_with_retry(KIND_PROCESS, meta.namespace, meta.name, mutate)
 
 
 class EventType(str, enum.Enum):

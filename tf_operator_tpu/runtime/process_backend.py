@@ -171,21 +171,13 @@ class LocalProcessControl(ProcessControl):
         """Persist the log-path annotation on an agent-launched process so
         the dashboard's logs endpoint finds it (optimistic retry)."""
         meta = process.metadata
-        while True:
-            try:
-                cur = self._store.get(KIND_PROCESS, meta.namespace, meta.name)
-            except NotFoundError:
-                return
+
+        def mutate(cur):
             if cur.metadata.uid != meta.uid:
-                return
+                return False
             cur.metadata.annotations[self.LOG_ANNOTATION] = path
-            try:
-                self._store.update(cur, check_version=True)
-                return
-            except ConflictError:
-                continue
-            except NotFoundError:
-                return
+
+        self._store.update_with_retry(KIND_PROCESS, meta.namespace, meta.name, mutate)
 
     def tracks(self, namespace: str, name: str) -> bool:
         """True when this backend is supervising (or launching) ns/name."""
@@ -298,16 +290,13 @@ class LocalProcessControl(ProcessControl):
         message: str = "",
     ) -> None:
         meta = process.metadata
-        # Optimistic-concurrency loop: only status fields are ours; concurrent
+
+        # Optimistic-concurrency write: only status fields are ours; concurrent
         # spec/label writers must not be clobbered (apiserver status-subresource
         # contract the reference's CRD updates rely on).
-        while True:
-            try:
-                cur = self._store.get(KIND_PROCESS, meta.namespace, meta.name)
-            except NotFoundError:
-                return  # deleted under us — nothing to report
+        def mutate(cur):
             if cur.metadata.uid != meta.uid:
-                return  # a new incarnation took the name; don't clobber it
+                return False  # a new incarnation took the name; don't clobber
             cur.status.phase = phase
             if pid is not None:
                 cur.status.pid = pid
@@ -318,13 +307,8 @@ class LocalProcessControl(ProcessControl):
                 cur.status.oom_killed = oom_killed
             if message:
                 cur.status.message = message
-            try:
-                self._store.update(cur, check_version=True)
-                return
-            except ConflictError:
-                continue  # re-read and reapply
-            except NotFoundError:
-                return
+
+        self._store.update_with_retry(KIND_PROCESS, meta.namespace, meta.name, mutate)
 
     def shutdown(self) -> None:
         """Terminate all children (operator teardown)."""

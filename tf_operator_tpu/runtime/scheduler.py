@@ -105,45 +105,88 @@ class GangScheduler:
     # -- placement --------------------------------------------------------
 
     def place_gang(
-        self, job: TPUJob, procs: List[Process], now: Optional[float] = None
+        self,
+        job: TPUJob,
+        procs: List[Process],
+        now: Optional[float] = None,
+        ranks: Optional[Dict[str, int]] = None,
+        bound_slots: Optional[Dict[int, str]] = None,
     ) -> Dict[str, Host]:
         """Atomically choose a Host for every process in ``procs``.
 
         Returns {process_name: Host}. Placement always uses exactly
         ``max(1, job.spec.topology.num_hosts)`` hosts — the slice shape is
-        part of the job's contract (rendezvous ranks map onto hosts), so
-        the scheduler never silently spreads a gang over more hosts than
-        requested. Raises SchedulingError when the gang cannot be fully
-        placed on that many hosts — the caller must create nothing then.
+        part of the job's contract, and a member's host SLOT is its gang
+        rank modulo num_hosts (mirroring how TPU runtime ranks map onto
+        hosts) so a partially-recreated member keeps the same topology
+        position it had. ``ranks`` maps process name → gang rank (members
+        missing from it — evaluators — pack anywhere with capacity);
+        ``bound_slots`` maps slot → host name for LIVE members of the gang,
+        pinning those slots to their existing hosts. Raises SchedulingError
+        when the gang cannot be fully placed — the caller must create
+        nothing in that case.
         """
         want_hosts = max(1, job.spec.topology.num_hosts)
         states = self._states(job.spec.topology.slice_type, now)
-        if len(states) < want_hosts:
-            raise SchedulingError(
-                f"need {want_hosts} ready host(s) for slice "
-                f"{job.spec.topology.slice_type or '(any)'}, have {len(states)}"
-            )
-        chosen = states[:want_hosts]
-        # Round-robin members over the chosen hosts in replica order —
-        # process i lands on host i % want_hosts, mirroring how TPU runtime
-        # ranks map onto hosts (process_id // local_chips).
+        by_name = {s.host.metadata.name: s for s in states}
+
+        # Slot → host assignment. Slots pinned by live members keep their
+        # host (it must still be schedulable); remaining slots take the
+        # most-free Ready hosts not already holding a slot.
+        slot_host: Dict[int, _HostState] = {}
+        for slot, host_name in (bound_slots or {}).items():
+            s = by_name.get(host_name)
+            if s is None:
+                raise SchedulingError(
+                    f"host {host_name} (holding live gang members) is not "
+                    "schedulable"
+                )
+            slot_host[slot % want_hosts] = s
+        taken = {s.host.metadata.name for s in slot_host.values()}
+        spare = [s for s in states if s.host.metadata.name not in taken]
+        for slot in range(want_hosts):
+            if slot not in slot_host:
+                if not spare:
+                    raise SchedulingError(
+                        f"need {want_hosts} ready host(s) for slice "
+                        f"{job.spec.topology.slice_type or '(any)'}, have "
+                        f"{len(states)}"
+                    )
+                slot_host[slot] = spare.pop(0)
+
         placement: Dict[str, Host] = {}
-        free = [s.free_chips for s in chosen]
-        counts = [s.procs for s in chosen]
+        free = {s.host.metadata.name: s.free_chips for s in states}
+        counts = {s.host.metadata.name: s.procs for s in states}
+
+        def fits(state: _HostState, need: int) -> bool:
+            cap = state.host.spec.max_processes
+            return free[state.host.metadata.name] >= need and not (
+                cap and counts[state.host.metadata.name] >= cap
+            )
+
         for i, proc in enumerate(procs):
-            hi = i % want_hosts
             need = max(proc.spec.chips, 0)
-            if free[hi] < need:
-                raise SchedulingError(
-                    f"host {chosen[hi].host.metadata.name} lacks {need} free "
-                    f"chip(s) for {proc.metadata.name} ({free[hi]} free)"
+            rank = (ranks or {}).get(proc.metadata.name)
+            if rank is not None:
+                state = slot_host[rank % want_hosts]
+                if not fits(state, need):
+                    raise SchedulingError(
+                        f"host {state.host.metadata.name} lacks capacity for "
+                        f"{proc.metadata.name} ({free[state.host.metadata.name]}"
+                        f" chip(s) free)"
+                    )
+            else:
+                # Rankless members (evaluators): first slot host with room.
+                state = next(
+                    (slot_host[s] for s in range(want_hosts) if fits(slot_host[s], need)),
+                    None,
                 )
-            cap = chosen[hi].host.spec.max_processes
-            if cap and counts[hi] >= cap:
-                raise SchedulingError(
-                    f"host {chosen[hi].host.metadata.name} at max_processes={cap}"
-                )
-            free[hi] -= need
-            counts[hi] += 1
-            placement[proc.metadata.name] = chosen[hi].host
+                if state is None:
+                    raise SchedulingError(
+                        f"no host has capacity for {proc.metadata.name} "
+                        f"({need} chip(s))"
+                    )
+            free[state.host.metadata.name] -= need
+            counts[state.host.metadata.name] += 1
+            placement[proc.metadata.name] = state.host
         return placement

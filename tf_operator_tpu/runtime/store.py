@@ -130,6 +130,31 @@ class Store:
             self._notify(WatchEventType.MODIFIED, stored)
             return out
 
+    def update_with_retry(
+        self, kind: str, namespace: str, name: str, mutate: Any
+    ) -> Optional[Any]:
+        """Optimistic read-modify-write: get → ``mutate(obj)`` →
+        versioned update, retrying on ConflictError. ``mutate`` edits the
+        object in place and returns False to abort (e.g. the precondition
+        no longer holds — already finished, different incarnation).
+        Returns the updated object, or None when aborted or the object is
+        gone. The one blessed shape for every status/heartbeat/annotation
+        writer — hand-rolled copies of this loop have each grown their own
+        NotFound/Conflict edge-case bugs."""
+        while True:
+            try:
+                obj = self.get(kind, namespace, name)
+            except NotFoundError:
+                return None
+            if mutate(obj) is False:
+                return None
+            try:
+                return self.update(obj, check_version=True)
+            except ConflictError:
+                continue
+            except NotFoundError:
+                return None
+
     def delete(self, kind: str, namespace: str, name: str) -> Any:
         with self._lock:
             k = _key(kind, namespace, name)

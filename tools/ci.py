@@ -50,7 +50,7 @@ def main(argv=None) -> int:
     with open(args.pipeline) as f:
         pipeline = yaml.safe_load(f)
     os.makedirs(args.artifacts, exist_ok=True)
-    subs = {"port": free_port(), "artifacts": args.artifacts}
+    subs = {"port": free_port(), "port2": free_port(), "artifacts": args.artifacts}
 
     failed = None
     results = []

@@ -60,6 +60,11 @@ def up(args) -> int:
     ]
     if args.chaos_level:
         cmd += ["--chaos-level", str(args.chaos_level)]
+    if args.local_agents:
+        cmd += [
+            "--local-agents", str(args.local_agents),
+            "--agent-chips", str(args.agent_chips),
+        ]
     child = subprocess.Popen(
         cmd, stdout=log, stderr=subprocess.STDOUT, start_new_session=True,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -138,6 +143,11 @@ def main(argv=None) -> int:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--backend", choices=("native", "local"), default="native")
     p.add_argument("--chaos-level", type=int, default=0)
+    p.add_argument("--local-agents", type=int, default=0,
+                   help="run N in-process host agents (multi-host mode: gang "
+                        "scheduler + per-host launch on one machine)")
+    p.add_argument("--agent-chips", type=int, default=8,
+                   help="chip capacity each local agent advertises")
     args = p.parse_args(argv)
     return {"up": up, "status": status, "down": down}[args.command](args)
 
