@@ -1,0 +1,26 @@
+"""No-op workload: exits 0 immediately, no JAX import.
+
+The control-plane load-test payload (tools/genjob.py --wait): measures the
+operator's reconcile throughput at the reference's O(100)-concurrent-jobs
+design scale (tf_job_design_doc.md:24-26) without paying 2xN JAX process
+startups — the data plane is exercised by the smoke/mnist/lm workloads.
+
+workload config keys: sleep_s (hold the gang alive), exit_code (fault
+injection: nonzero exercises the restart/backoff machinery at scale).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from tf_operator_tpu.rendezvous.context import JobContext
+
+
+def main(ctx: JobContext) -> None:
+    sleep_s = float(ctx.workload.get("sleep_s", 0))
+    if sleep_s:
+        time.sleep(sleep_s)
+    code = int(ctx.workload.get("exit_code", 0))
+    if code:
+        sys.exit(code)

@@ -69,6 +69,14 @@ def main(argv=None) -> int:
     p.add_argument("--out-dir", default=None, help="write one JSON spec per job")
     p.add_argument("--submit", action="store_true", help="submit to the operator")
     p.add_argument("--server", default="http://127.0.0.1:8080")
+    p.add_argument("--wait", action="store_true",
+                   help="after --submit, wait for every job to reach a "
+                        "terminal state and print a JSON load report "
+                        "(jobs/min, success count) — the controller-scale "
+                        "oracle for the reference's O(100)-job design target")
+    p.add_argument("--timeout", type=float, default=900.0)
+    p.add_argument("--cleanup", action="store_true",
+                   help="delete the generated jobs after the report")
     args = p.parse_args(argv)
 
     jobs = [
@@ -88,12 +96,61 @@ def main(argv=None) -> int:
         print(f"wrote {len(jobs)} specs to {args.out_dir}")
 
     if args.submit:
+        import time
+
         from tf_operator_tpu.dashboard.client import TPUJobClient
 
         client = TPUJobClient(args.server)
+        t0 = time.perf_counter()
         for job in jobs:
             client.create(job)
-        print(f"submitted {len(jobs)} jobs to {args.server}")
+        submit_s = time.perf_counter() - t0
+        print(f"submitted {len(jobs)} jobs to {args.server} in {submit_s:.2f}s")
+
+        if args.wait:
+            terminal = {"Done", "Failed"}
+            pending = {j.metadata.name for j in jobs}
+            done: dict = {}
+            deadline = time.time() + args.timeout
+            while pending and time.time() < deadline:
+                # One LIST per round (not a GET per job): polling must not
+                # load the very server whose throughput is being measured,
+                # and one transient HTTP error must not abort the test.
+                try:
+                    listed = client.list("default")
+                except Exception:
+                    time.sleep(0.5)
+                    continue
+                for j in listed:
+                    name = j.metadata.name
+                    if name in pending:
+                        phase = j.status.phase().value
+                        if phase in terminal:
+                            done[name] = phase
+                            pending.discard(name)
+                if pending:
+                    time.sleep(0.5)
+            wall_s = time.perf_counter() - t0
+            succeeded = sum(1 for v in done.values() if v == "Done")
+            print(json.dumps({
+                "metric": "controller_jobs_per_min",
+                "value": round(len(done) / wall_s * 60.0, 1),
+                "unit": "jobs/min",
+                "jobs": len(jobs),
+                "succeeded": succeeded,
+                "failed": len(done) - succeeded,
+                "unfinished": len(pending),
+                "submit_s": round(submit_s, 2),
+                "wall_s": round(wall_s, 2),
+            }))
+            if args.cleanup:
+                for job in jobs:
+                    try:
+                        client.delete("default", job.metadata.name)
+                    except Exception:
+                        pass
+            if pending or succeeded != len(jobs):
+                return 1
     elif not args.out_dir:
         for job in jobs:
             print(json.dumps(_to_jsonable(job.to_dict())))
