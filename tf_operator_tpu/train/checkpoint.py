@@ -334,14 +334,15 @@ class WorkloadCheckpointer:
         if self.manager is not None:
             self.manager.save(self._step, state)
 
-    def run_loop(self, trainer, key, batch, steps: int):
+    def run_loop(self, trainer, key, batch, steps: int, on_step=None):
         """The one warmup+timed train loop shared by workloads.
 
         restore-or-init → warmup step (compile boundary) → ``steps -
         start_step`` timed steps with periodic NaN-gated saves → finiteness
         guard → final save. Returns ``(state, loss, timed, step_s)`` where
         ``step_s`` is None when no timed steps remained. Callers must check
-        :meth:`is_complete` first."""
+        :meth:`is_complete` first. ``on_step(global_step)`` fires after
+        every advance — the fault-injection / progress-reporting seam."""
         import math
         import time
 
@@ -352,10 +353,14 @@ class WorkloadCheckpointer:
         state, m = trainer.step(state, batch)
         self.advance(state, loss=m["loss"])
         host_fetch(m["loss"])  # compile boundary
+        if on_step is not None:
+            on_step(self._step)
         t0 = time.perf_counter()
         for _ in range(timed):
             state, m = trainer.step(state, batch)
             self.advance(state, loss=m["loss"])
+            if on_step is not None:
+                on_step(self._step)
         loss = float(m["loss"])
         step_s = (time.perf_counter() - t0) / timed if timed else None
         if not math.isfinite(loss):

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import logging
 
-from tf_operator_tpu.rendezvous.context import JobContext
+from tf_operator_tpu.rendezvous.context import JobContext, RetryableFailure
 
 log = logging.getLogger("tpujob.lm")
 
@@ -72,8 +72,26 @@ def main(ctx: JobContext) -> None:
         jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab),
         trainer.batch_sharding,
     )
+
+    # Fault injection (workload keys fail_at_step + fail_marker): die
+    # RETRYABLY once at the given global step — the restart-based-recovery
+    # e2e: the gang restarts and the next incarnation must resume from the
+    # latest checkpoint, not step 0. The marker file makes it once-only.
+    fail_at = int(wl.get("fail_at_step", 0))
+    marker = wl.get("fail_marker")
+
+    def on_step(step: int) -> None:
+        if fail_at and marker and step >= fail_at:
+            import os
+
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                log.warning("fault injection: requesting retry at step %d", step)
+                # routed by the harness to the user-retryable exit code
+                raise RetryableFailure(f"fault injection at step {step}")
+
     state, loss, timed, step_s = ckpt.run_loop(
-        trainer, jax.random.PRNGKey(0), tokens, steps
+        trainer, jax.random.PRNGKey(0), tokens, steps, on_step=on_step
     )
     if step_s is not None:
         n_chips = mesh.devices.size
