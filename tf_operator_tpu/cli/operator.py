@@ -158,7 +158,9 @@ def main(argv=None) -> int:
         store, backend, resync_period=args.resync_period,
         controller_config=controller_config,
     )
-    dashboard = DashboardServer(store, host=args.host, port=args.port)
+    dashboard = DashboardServer(
+        store, host=args.host, port=args.port, metrics=controller.metrics
+    )
     chaos = ChaosMonkey(store, args.chaos_level, args.chaos_interval)
 
     # Multi-host mode on one machine: per-host agents launch their bound

@@ -1,0 +1,115 @@
+"""Controller telemetry: Prometheus-text /metrics for the operator.
+
+The reference registers client-go reflector/workqueue metrics via blank
+imports but exposes no endpoint and no custom metrics
+(cmd/tf-operator/main.go:26-27; SURVEY.md §5 "tracing/profiling: none").
+This is the first-class version: counters maintained by the reconciler,
+plus store/queue-derived gauges computed at scrape time, rendered in the
+Prometheus text exposition format at ``GET /metrics`` on the dashboard
+server.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+from tf_operator_tpu.api.types import KIND_HOST, KIND_PROCESS, KIND_TPUJOB
+
+
+class ControllerMetrics:
+    """Thread-safe counter registry + scrape-time gauge renderer."""
+
+    COUNTER_HELP = {
+        "tpujob_syncs_total": "Reconcile sync attempts.",
+        "tpujob_sync_errors_total": "Reconcile syncs that raised (requeued).",
+        "tpujob_gang_restarts_total": "Gang restarts executed.",
+        "tpujob_processes_created_total": "Child processes created.",
+        "tpujob_processes_deleted_total": "Child processes deleted.",
+        "tpujob_node_lost_total": "Processes declared lost (host/agent gone).",
+    }
+
+    def __init__(self, store=None, queue=None) -> None:
+        self.store = store
+        self.queue = queue
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {k: 0.0 for k in self.COUNTER_HELP}
+        self._sync_seconds_sum = 0.0
+        self._sync_seconds_count = 0
+
+    # -- writers (reconciler) ---------------------------------------------
+
+    def inc(self, name: str, n: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + n
+
+    def observe_sync(self, seconds: float, error: bool) -> None:
+        with self._lock:
+            self._counters["tpujob_syncs_total"] += 1
+            if error:
+                self._counters["tpujob_sync_errors_total"] += 1
+            self._sync_seconds_sum += seconds
+            self._sync_seconds_count += 1
+
+    # -- scrape -----------------------------------------------------------
+
+    def render(self) -> str:
+        out: List[str] = []
+        with self._lock:
+            counters = dict(self._counters)
+            s_sum, s_count = self._sync_seconds_sum, self._sync_seconds_count
+        # .17g: %g's 6 significant digits would freeze a counter past ~1e6
+        # (consecutive increments render identically and rate() reads 0).
+        for name, value in sorted(counters.items()):
+            help_text = self.COUNTER_HELP.get(name, name)
+            out.append(f"# HELP {name} {help_text}")
+            out.append(f"# TYPE {name} counter")
+            out.append(f"{name} {value:.17g}")
+        out.append("# HELP tpujob_sync_duration_seconds Reconcile sync wall time.")
+        out.append("# TYPE tpujob_sync_duration_seconds summary")
+        out.append(f"tpujob_sync_duration_seconds_sum {s_sum:.17g}")
+        out.append(f"tpujob_sync_duration_seconds_count {s_count}")
+
+        if self.queue is not None:
+            out.append("# HELP tpujob_workqueue_depth Keys waiting in the workqueue.")
+            out.append("# TYPE tpujob_workqueue_depth gauge")
+            out.append(f"tpujob_workqueue_depth {self.queue.depth()}")
+
+        if self.store is not None:
+            out.extend(self._store_gauges())
+        return "\n".join(out) + "\n"
+
+    def _store_gauges(self) -> List[str]:
+        out: List[str] = []
+        jobs: Dict[str, int] = {}
+        for j in self.store.list(KIND_TPUJOB):
+            phase = _job_phase(j)
+            jobs[phase] = jobs.get(phase, 0) + 1
+        out.append("# HELP tpujob_jobs Jobs in the store by phase.")
+        out.append("# TYPE tpujob_jobs gauge")
+        for phase, n in sorted(jobs.items()):
+            out.append(f'tpujob_jobs{{phase="{phase}"}} {n}')
+
+        procs: Dict[str, int] = {}
+        for p in self.store.list(KIND_PROCESS):
+            procs[p.status.phase.value] = procs.get(p.status.phase.value, 0) + 1
+        out.append("# HELP tpujob_processes Processes in the store by phase.")
+        out.append("# TYPE tpujob_processes gauge")
+        for phase, n in sorted(procs.items()):
+            out.append(f'tpujob_processes{{phase="{phase}"}} {n}')
+
+        hosts = self.store.list(KIND_HOST)
+        if hosts:
+            ready = sum(1 for h in hosts if h.status.phase.value == "Ready")
+            out.append("# HELP tpujob_hosts Registered hosts.")
+            out.append("# TYPE tpujob_hosts gauge")
+            out.append(f'tpujob_hosts{{ready="true"}} {ready}')
+            out.append(f'tpujob_hosts{{ready="false"}} {len(hosts) - ready}')
+        return out
+
+
+def _job_phase(job) -> str:
+    try:
+        return job.status.phase().value
+    except Exception:
+        return "Unknown"

@@ -60,6 +60,7 @@ from tf_operator_tpu.controller import events as ev
 from tf_operator_tpu.controller.events import EventRecorder
 from tf_operator_tpu.controller.expectations import ControllerExpectations
 from tf_operator_tpu.controller.informer import Informer
+from tf_operator_tpu.controller.metrics import ControllerMetrics
 from tf_operator_tpu.controller.status import (
     has_condition,
     initialize_replica_statuses,
@@ -140,6 +141,7 @@ class TPUJobController:
 
         self.queue = RateLimitingQueue()
         self.expectations = ControllerExpectations()
+        self.metrics = ControllerMetrics(store=store, queue=self.queue)
         # Gang-atomic placement onto registered Hosts (runtime/scheduler.py);
         # with no Hosts the scheduler reports unmanaged and the controller
         # launches through process_control exactly as before. The lock
@@ -250,15 +252,19 @@ class TPUJobController:
         key = self.queue.get()
         if key is None:
             return False
+        t0 = time.perf_counter()
+        error = False
         try:
             self.sync_job(key)
         except Exception:
+            error = True
             log.exception("sync failed for %s; requeueing", key)
             self.queue.add_rate_limited(key)
         else:
             self.queue.forget(key)
         finally:
             self.queue.done(key)
+            self.metrics.observe_sync(time.perf_counter() - t0, error)
         return True
 
     # ---- the sync -------------------------------------------------------
@@ -366,6 +372,7 @@ class TPUJobController:
                 )
                 if updated is not None:
                     p = updated
+                    self.metrics.inc("tpujob_node_lost_total")
                     self.recorder.warning(
                         job, ev.REASON_NODE_LOST,
                         f"{p.metadata.name}: host {p.spec.node_name} "
@@ -633,11 +640,13 @@ class TPUJobController:
                     KIND_PROCESS, process.metadata.namespace, process.metadata.name
                 )
             except NotFoundError:
-                pass
+                return  # already gone — nothing was deleted; don't count it
+            self.metrics.inc("tpujob_processes_deleted_total")
         else:
             self.process_control.delete_process(
                 process.metadata.namespace, process.metadata.name
             )
+            self.metrics.inc("tpujob_processes_deleted_total")
 
     def _policy_for(self, job: TPUJob, process: Process) -> RestartPolicy:
         try:
@@ -820,6 +829,7 @@ class TPUJobController:
                         self.expectations.creation_failed(exp_key)
                     else:
                         created += 1
+                        self.metrics.inc("tpujob_processes_created_total")
                         self.recorder.normal(
                             job, ev.REASON_SUCCESSFUL_CREATE,
                             f"created process {proc.metadata.name}"
@@ -882,6 +892,7 @@ class TPUJobController:
         # restart_count was freshened against the store by _reconcile just
         # before the backoff_limit check; only the increment happens here.
         job.status.restart_count += 1
+        self.metrics.inc("tpujob_gang_restarts_total")
         set_condition(
             job.status,
             new_condition(

@@ -87,6 +87,11 @@ class RateLimitingQueue:
 
     # -- core dedup queue -------------------------------------------------
 
+    def depth(self) -> int:
+        """Keys waiting to be popped (telemetry gauge)."""
+        with self._cond:
+            return len(self._queue)
+
     def add(self, item: Hashable) -> None:
         with self._cond:
             if self._shutdown or item in self._dirty:

@@ -46,6 +46,7 @@ _LOGS_RE = re.compile(r"^/api/process/([^/]+)/([^/]+)/logs$")
 class _Handler(BaseHTTPRequestHandler):
     server_version = "tpujob-dashboard/0.1"
     store: Store = None  # set by server factory
+    metrics = None  # ControllerMetrics, set by server factory when wired
 
     # silence default request logging
     def log_message(self, fmt, *args):
@@ -79,6 +80,16 @@ class _Handler(BaseHTTPRequestHandler):
 
         if path == "/healthz":
             return self._json(200, {"ok": True})
+        if path == "/metrics":
+            if self.metrics is None:
+                return self._error(404, "metrics not wired (no controller)")
+            body = self.metrics.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if path in ("/", "/ui"):
             body = _UI_HTML.encode()
             self.send_response(200)
@@ -187,8 +198,12 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class DashboardServer:
-    def __init__(self, store: Store, host: str = "127.0.0.1", port: int = 8080) -> None:
-        handler = type("BoundHandler", (_Handler,), {"store": store})
+    def __init__(
+        self, store: Store, host: str = "127.0.0.1", port: int = 8080, metrics=None
+    ) -> None:
+        handler = type(
+            "BoundHandler", (_Handler,), {"store": store, "metrics": metrics}
+        )
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self._thread: Optional[threading.Thread] = None
 
