@@ -114,6 +114,44 @@ def test_mnist_data_parallel_training(rig):
     assert ok, f"conditions: {[(c.type.value, c.reason, c.message) for c in st.conditions]}"
 
 
+def test_ring_attention_context_parallel_gang(rig):
+    """Long-context through the FULL stack: a 2-process gang rendezvouses,
+    builds a cp-axis mesh spanning the processes, and trains the LM with
+    ring attention — sequence blocks rotating between processes via
+    ppermute over gloo — to Succeeded. The operator analogue of the
+    in-process ring tests (tests/test_parallel.py)."""
+    store = rig
+    job = TPUJob(
+        metadata=ObjectMeta(name="ring-cp"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=2,
+                    template=ProcessTemplate(
+                        entrypoint="tf_operator_tpu.workloads.lm:main",
+                        env=dict(DATAPLANE_ENV),
+                    ),
+                )
+            },
+        ),
+    )
+    job.spec.topology.mesh_axes = {"cp": 2}
+    job.spec.workload = {
+        "preset": "tiny",
+        "attn": "ring",
+        "steps": 3,
+        "batch_size": 4,
+        "seq_len": 64,
+    }
+    store.create(job)
+    ok = wait_for(
+        lambda: has_condition(job_status(store, "ring-cp"), ConditionType.SUCCEEDED),
+        timeout=240,
+    )
+    st = job_status(store, "ring-cp")
+    assert ok, f"conditions: {[(c.type.value, c.reason, c.message) for c in st.conditions]}"
+
+
 def test_checkpoint_resume_across_gang_restart(tmp_path):
     """Restart-based recovery, end-to-end (SURVEY.md §5 checkpoint/resume):
     an LM training job checkpoints every 2 steps, dies RETRYABLY (138) at

@@ -190,7 +190,7 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
         # shards over dp/fsdp and heads over tp with no collectives. A
         # sequence-sharded (cp) mesh needs ring attention instead.
         if mesh is not None and mesh.devices.size > 1:
-            from jax.experimental.shard_map import shard_map
+            from jax import shard_map
             from jax.sharding import PartitionSpec as P
 
             batch = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
@@ -201,7 +201,7 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
                 mesh=mesh,
                 in_specs=(spec, spec, spec),
                 out_specs=spec,
-                check_rep=False,
+                check_vma=False,
             )
             return fn(q, k, v)
         return flash_attention(q, k, v, causal=cfg.causal)
