@@ -1,0 +1,282 @@
+"""RemoteStore: the Store surface over the operator's generic object API.
+
+The multi-machine seam (docs/design.md §8): these tests run a real
+DashboardServer over a real Store and drive it through RemoteStore —
+same exception types, same watch replay contract — ending with the
+headline: a HostAgent connected ONLY via HTTP launches a gang submitted
+to the operator (the reference's clientset↔apiserver split, live)."""
+
+import threading
+import time
+
+import pytest
+
+from conftest import wait_for
+from tf_operator_tpu.api.types import (
+    ConditionType,
+    KIND_HOST,
+    KIND_PROCESS,
+    ObjectMeta,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    TopologySpec,
+    TPUJob,
+    TPUJobSpec,
+)
+from tf_operator_tpu.controller import TPUJobController
+from tf_operator_tpu.controller.status import has_condition
+from tf_operator_tpu.dashboard import DashboardServer
+from tf_operator_tpu.runtime import (
+    AlreadyExistsError,
+    ConflictError,
+    FakeProcessControl,
+    HostAgent,
+    LocalProcessControl,
+    NotFoundError,
+    Store,
+    WatchEventType,
+)
+from tf_operator_tpu.runtime.objects import (
+    Endpoint,
+    EndpointAddress,
+    Event,
+    EventType,
+    Host,
+    HostSpec,
+    Process,
+    ProcessPhase,
+    ProcessSpec,
+)
+from tf_operator_tpu.runtime.remote_store import RemoteStore
+
+
+@pytest.fixture
+def remote():
+    store = Store()
+    server = DashboardServer(store, port=0)
+    server.start()
+    yield store, RemoteStore(server.url)
+    server.stop()
+
+
+def test_process_crud_roundtrip(remote):
+    _, rs = remote
+    p = Process(
+        metadata=ObjectMeta(name="p1", labels={"a": "b"}),
+        spec=ProcessSpec(job_name="j", replica_type="Worker", replica_index=1,
+                         entrypoint="m:f", env={"K": "V"}, chips=2, node_name="h1"),
+    )
+    created = rs.create(p)
+    assert created.metadata.uid and created.metadata.resource_version
+    got = rs.get(KIND_PROCESS, "default", "p1")
+    assert got.spec.env == {"K": "V"} and got.spec.node_name == "h1"
+    assert got.status.phase is ProcessPhase.PENDING
+    got.status.phase = ProcessPhase.RUNNING
+    updated = rs.update(got, check_version=True)
+    assert updated.status.phase is ProcessPhase.RUNNING
+    assert [o.metadata.name for o in rs.list(KIND_PROCESS, namespace="default")] == ["p1"]
+    assert rs.list(KIND_PROCESS, namespace="default", label_selector={"a": "b"})
+    assert not rs.list(KIND_PROCESS, namespace="default", label_selector={"a": "x"})
+    rs.delete(KIND_PROCESS, "default", "p1")
+    with pytest.raises(NotFoundError):
+        rs.get(KIND_PROCESS, "default", "p1")
+
+
+def test_every_kind_round_trips(remote):
+    _, rs = remote
+    objs = [
+        Host(metadata=ObjectMeta(name="h1"), spec=HostSpec(address="10.0.0.9", total_chips=4)),
+        Endpoint(metadata=ObjectMeta(name="e1"), address=EndpointAddress("10.0.0.9", 1234)),
+        Event(metadata=ObjectMeta(name="ev1"), type=EventType.WARNING,
+              reason="R", message="M", involved_name="j", count=3, timestamp=1.0),
+        TPUJob(
+            metadata=ObjectMeta(name="j1"),
+            spec=TPUJobSpec(
+                replica_specs={
+                    ReplicaType.WORKER: ReplicaSpec(
+                        replicas=2, template=ProcessTemplate(entrypoint="m:f")
+                    )
+                },
+                topology=TopologySpec(num_hosts=2, chips_per_host=4),
+            ),
+        ),
+    ]
+    for o in objs:
+        rs.create(o)
+    h = rs.get(KIND_HOST, "default", "h1")
+    assert h.spec.address == "10.0.0.9" and h.spec.total_chips == 4
+    e = rs.get("Endpoint", "default", "e1")
+    assert (e.address.host, e.address.port) == ("10.0.0.9", 1234)
+    ev = rs.get("Event", "default", "ev1")
+    assert ev.type is EventType.WARNING and ev.count == 3
+    j = rs.get("TPUJob", "default", "j1")
+    assert j.spec.topology.num_hosts == 2
+    assert j.spec.replica_specs[ReplicaType.WORKER].replicas == 2
+
+
+def test_error_types_match_store(remote):
+    _, rs = remote
+    h = Host(metadata=ObjectMeta(name="dup"))
+    rs.create(h)
+    with pytest.raises(AlreadyExistsError):
+        rs.create(h)
+    stale = rs.get(KIND_HOST, "default", "dup")
+    rs.update(stale)  # bumps version server-side
+    with pytest.raises(ConflictError):
+        rs.update(stale, check_version=True)
+    with pytest.raises(NotFoundError):
+        rs.delete(KIND_HOST, "default", "ghost")
+
+
+def test_update_with_retry_over_the_wire(remote):
+    _, rs = remote
+    rs.create(Host(metadata=ObjectMeta(name="h2")))
+
+    def touch(cur):
+        cur.status.heartbeat_time = 42.0
+
+    out = rs.update_with_retry(KIND_HOST, "default", "h2", touch)
+    assert out is not None and out.status.heartbeat_time == 42.0
+    assert rs.update_with_retry(KIND_HOST, "default", "nope", touch) is None
+
+
+def test_watch_replays_then_streams(remote):
+    store, rs = remote
+    store.create(Process(metadata=ObjectMeta(name="pre"), spec=ProcessSpec(job_name="j")))
+    w = rs.watch(kinds=[KIND_PROCESS])
+    seen = []
+    seen_ctl = []
+    done = threading.Event()
+
+    def consume():
+        for ev in w:
+            if ev.obj is None:
+                # REPLAY_START / SYNCED control events frame the replay
+                seen_ctl.append(ev.type)
+                continue
+            seen.append((ev.type, ev.obj.metadata.name))
+            if len(seen) >= 3:
+                done.set()
+                return
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    # replay of "pre" arrives first; then live create + delete
+    time.sleep(0.3)
+    store.create(Process(metadata=ObjectMeta(name="live"), spec=ProcessSpec(job_name="j")))
+    store.delete(KIND_PROCESS, "default", "live")
+    assert done.wait(10), seen
+    w.stop()
+    t.join(timeout=5)
+    assert seen[0] == (WatchEventType.ADDED, "pre")
+    assert (WatchEventType.ADDED, "live") in seen
+    assert (WatchEventType.DELETED, "live") in seen
+    # replay framing: REPLAY_START first, SYNCED right after the replay
+    assert seen_ctl[0] is WatchEventType.REPLAY_START
+    assert WatchEventType.SYNCED in seen_ctl
+
+
+def test_reconnect_sweep_reaps_deletions_missed_while_disconnected():
+    """Watch replays on reconnect never include DELETIONS that happened in
+    the gap: the SYNCED reconcile must reap children the replay didn't
+    mention, or an orphan keeps holding chips forever."""
+    import socket
+    import sys as _sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    store = Store()
+    server = DashboardServer(store, port=port)
+    server.start()
+    rs = RemoteStore(f"http://127.0.0.1:{port}")
+    backend = LocalProcessControl(
+        rs, command_builder=lambda p: [_sys.executable, "-c", "import time; time.sleep(60)"]
+    )
+    agent = HostAgent(rs, "h-sweep", total_chips=4, heartbeat_interval=0.3,
+                      backend=backend)
+    agent.start()
+    try:
+        store.create(
+            Process(
+                metadata=ObjectMeta(name="orphan-child"),
+                spec=ProcessSpec(job_name="j", node_name="h-sweep", entrypoint="m:f"),
+            )
+        )
+        assert wait_for(lambda: backend.tracks("default", "orphan-child"), timeout=15)
+        # sever the agent's connection; delete the binding while it's gone
+        server.stop()
+        store.delete(KIND_PROCESS, "default", "orphan-child")
+        # operator comes back on the same port; the agent's watch
+        # reconnects, replays (without the deleted process), and SYNCED
+        # triggers the sweep
+        server2 = DashboardServer(store, port=port)
+        server2.start()
+        try:
+            assert wait_for(
+                lambda: not backend.tracks("default", "orphan-child"), timeout=30
+            )
+        finally:
+            agent.stop()
+            server2.stop()
+    except BaseException:
+        agent.stop()
+        raise
+
+
+def test_remote_agent_runs_gang_over_http():
+    """The multi-machine split, live: controller + store + HTTP server in
+    one 'operator'; a HostAgent connected ONLY through RemoteStore (as it
+    would be from another machine) registers, gets the gang bound to it,
+    launches through its own backend, and the job Succeeds. The
+    controller's own process_control is a fake — a launch there would mean
+    the split leaked."""
+    store = Store()
+    fake = FakeProcessControl()
+    ctl = TPUJobController(store, fake, resync_period=0.5)
+    server = DashboardServer(store, port=0)
+    server.start()
+    ctl.run(workers=2)
+    remote_store = RemoteStore(server.url)
+    agent = HostAgent(
+        remote_store, "remote-h1", total_chips=4, heartbeat_interval=0.5,
+        backend=LocalProcessControl(remote_store),
+    )
+    agent.start()
+    try:
+        job = TPUJob(
+            metadata=ObjectMeta(name="over-http"),
+            spec=TPUJobSpec(
+                replica_specs={
+                    ReplicaType.WORKER: ReplicaSpec(
+                        replicas=2,
+                        template=ProcessTemplate(
+                            entrypoint="tf_operator_tpu.workloads.noop:main",
+                            chips_per_process=1,
+                        ),
+                    )
+                },
+                topology=TopologySpec(num_hosts=1, chips_per_host=4),
+            ),
+        )
+        remote_store.create(job)
+
+        def succeeded():
+            j = store.get("TPUJob", "default", "over-http")
+            return has_condition(j.status, ConditionType.SUCCEEDED)
+
+        assert wait_for(succeeded, timeout=60), str(
+            store.get("TPUJob", "default", "over-http").status
+        )
+        # every process ran on the remote agent's host, none through the fake
+        assert fake.created == []
+        nodes = {
+            p.spec.node_name
+            for p in store.list(KIND_PROCESS, namespace="default")
+        }
+        assert nodes == {"remote-h1"}
+    finally:
+        agent.stop()
+        ctl.stop()
+        server.stop()

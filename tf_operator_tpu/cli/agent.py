@@ -1,0 +1,108 @@
+"""Standalone host-agent daemon: run one per machine against a remote
+operator.
+
+The multi-machine deployment shape (docs/design.md §8): the operator
+(controller + store + REST API) runs on one host; each TPU host runs
+
+    python -m tf_operator_tpu.cli.agent --server http://operator:8080 \
+        --name host-3 --address 10.0.0.3 --chips 4 [--slice-type v5e-8]
+
+The agent registers its Host object through the generic object API,
+heartbeats it, watches for Process bindings to its name, and launches
+them with the local or native backend — the kubelet half of the
+controller/kubelet split, over the wire.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from tf_operator_tpu.runtime.agent import HostAgent
+from tf_operator_tpu.runtime.remote_store import RemoteStore
+
+log = logging.getLogger("tpujob.agent-daemon")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpujob-agent", description="per-host launcher daemon"
+    )
+    p.add_argument("--server", required=True,
+                   help="operator base URL, e.g. http://10.0.0.1:8080")
+    p.add_argument("--name", required=True, help="unique host name")
+    p.add_argument("--address", default="127.0.0.1",
+                   help="this host's address reachable by gang peers")
+    p.add_argument("--chips", type=int, default=0, help="TPU chips on this host")
+    p.add_argument("--slice-type", default="", help="slice family, e.g. v5e-8")
+    p.add_argument("--max-processes", type=int, default=0)
+    p.add_argument("--heartbeat-interval", type=float, default=3.0)
+    p.add_argument("--backend", choices=("native", "local"), default="native")
+    p.add_argument("--log-dir", default=None,
+                   help="capture launched processes' stdout/stderr here")
+    p.add_argument("--json-log-format", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format=(
+            '{"ts":"%(asctime)s","level":"%(levelname)s","msg":"%(message)s"}'
+            if args.json_log_format
+            else "%(asctime)s %(name)s [%(levelname)s] %(message)s"
+        ),
+    )
+    store = RemoteStore(args.server)
+    if args.backend == "native":
+        from tf_operator_tpu.runtime.native import NativeBuildError
+        from tf_operator_tpu.runtime.process_backend import (
+            LocalProcessControl,
+            NativeProcessControl,
+        )
+
+        try:
+            backend = NativeProcessControl(store, log_dir=args.log_dir)
+        except (NativeBuildError, OSError) as exc:
+            log.warning("native supervisor unavailable (%s); using local", exc)
+            backend = LocalProcessControl(store, log_dir=args.log_dir)
+    else:
+        from tf_operator_tpu.runtime.process_backend import LocalProcessControl
+
+        backend = LocalProcessControl(store, log_dir=args.log_dir)
+
+    agent = HostAgent(
+        store,
+        args.name,
+        address=args.address,
+        total_chips=args.chips,
+        slice_type=args.slice_type,
+        max_processes=args.max_processes,
+        backend=backend,
+        heartbeat_interval=args.heartbeat_interval,
+    )
+    stop = threading.Event()
+
+    def shutdown(*_):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+    agent.start()
+    log.info(
+        "agent %s up: server=%s chips=%d backend=%s",
+        args.name, args.server, args.chips, type(backend).__name__,
+    )
+    stop.wait()
+    log.info("agent %s draining", args.name)
+    agent.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -13,6 +13,17 @@ Routes (reference: dashboard/backend/handler/api_handler.go:74-113):
   job list/detail with processes+logs+events, create form, events view —
   the reference React frontend's JobList/JobDetail/CreateJob surface
 - GET    /healthz                         — liveness
+- GET    /metrics                         — Prometheus text (when wired)
+
+Generic object API (the remote-store seam; clients: runtime/remote_store.py):
+
+- GET    /api/v1/{kind}?namespace=        — list raw objects of a kind
+- POST   /api/v1/{kind}                   — create (body: serialized object)
+- GET    /api/v1/{kind}/{ns}/{name}       — get
+- PUT    /api/v1/{kind}/{ns}/{name}?check_version=1 — update (409 on stale)
+- DELETE /api/v1/{kind}/{ns}/{name}       — delete
+- GET    /api/v1/watch?kinds=A,B          — JSON-lines stream of watch
+  events (existing objects replayed as ADDED first — list+watch contract)
 """
 
 from __future__ import annotations
@@ -35,12 +46,20 @@ from tf_operator_tpu.api.types import (
 from tf_operator_tpu.api import set_defaults, validate_job, ValidationError
 from tf_operator_tpu.api.types import _to_jsonable
 from tf_operator_tpu.runtime.process_backend import LocalProcessControl
-from tf_operator_tpu.runtime.store import AlreadyExistsError, NotFoundError, Store
+from tf_operator_tpu.runtime.serialize import KNOWN_KINDS, from_doc, to_doc
+from tf_operator_tpu.runtime.store import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    Store,
+)
 
 from tf_operator_tpu.dashboard.ui import UI_HTML as _UI_HTML
 
 _JOB_RE = re.compile(r"^/api/tpujob/([^/]+)/([^/]+)$")
 _LOGS_RE = re.compile(r"^/api/process/([^/]+)/([^/]+)/logs$")
+_OBJ_KIND_RE = re.compile(r"^/api/v1/([A-Za-z]+)$")
+_OBJ_RE = re.compile(r"^/api/v1/([A-Za-z]+)/([^/]+)/([^/]+)$")
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -130,6 +149,40 @@ class _Handler(BaseHTTPRequestHandler):
                 },
             )
 
+        if path == "/api/v1/watch":
+            kinds = [k for k in (q.get("kinds", [""])[0]).split(",") if k]
+            bad = [k for k in kinds if k not in KNOWN_KINDS]
+            if bad:
+                return self._error(400, f"unknown kinds {bad}")
+            return self._stream_watch(kinds or None)
+
+        m = _OBJ_KIND_RE.match(path)
+        if m:
+            kind = m.group(1)
+            if kind not in KNOWN_KINDS:
+                return self._error(404, f"unknown kind {kind}")
+            # ?label=k=v (repeatable): server-side selector so remote
+            # consumers don't transfer the whole collection to filter it.
+            selector = {}
+            for pair in q.get("label", []):
+                k, sep, v = pair.partition("=")
+                if sep:
+                    selector[k] = v
+            items = self.store.list(
+                kind, namespace=ns, label_selector=selector or None
+            )
+            return self._json(200, {"items": [to_doc(o) for o in items]})
+
+        m = _OBJ_RE.match(path)
+        if m:
+            kind, ons, name = m.groups()
+            if kind not in KNOWN_KINDS:
+                return self._error(404, f"unknown kind {kind}")
+            try:
+                return self._json(200, to_doc(self.store.get(kind, ons, name)))
+            except NotFoundError:
+                return self._error(404, f"{kind} {ons}/{name} not found")
+
         m = _LOGS_RE.match(path)
         if m:
             ns, name = m.groups()
@@ -160,11 +213,111 @@ class _Handler(BaseHTTPRequestHandler):
 
         self._error(404, f"no route {path}")
 
-    # -- POST / DELETE -----------------------------------------------------
+    def _stream_watch(self, kinds) -> None:
+        """Chunk the store's watch stream as JSON lines until the client
+        disconnects. Existing objects replay as ADDED first (the store's
+        list+watch contract), so a reconnecting agent reconverges.
+
+        The watch is registered with the server so stop() can end it:
+        otherwise server_close()'s handler-thread join would block forever
+        on a stream whose client is idle."""
+        with self._watch_lock:
+            if self._watch_closed.is_set():
+                return self._error(503, "server shutting down")
+            w = self.store.watch(kinds=kinds)
+            # Replay boundary: everything queued at watch creation is the
+            # existing-object replay; a SYNCED marker after it lets remote
+            # consumers reconcile away objects deleted while they were
+            # disconnected (deletions are never replayed).
+            replay_n = w.queue.qsize()
+            self._active_watches.add(w)
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+            sent = 0
+            if replay_n == 0:
+                self.wfile.write(b'{"type": "SYNCED"}\n')
+                self.wfile.flush()
+            # Poll with a timeout instead of blocking forever: an idle
+            # period writes a PING, so a silently-dead client (power loss,
+            # no FIN) fails the write and this handler+watch get reaped
+            # instead of leaking until the next real event.
+            while True:
+                try:
+                    ev = w.queue.get(timeout=15.0)
+                except Exception:
+                    self.wfile.write(b'{"type": "PING"}\n')
+                    self.wfile.flush()
+                    continue
+                if ev is None:
+                    break  # watch stopped
+                line = json.dumps(
+                    {"type": ev.type.value, "kind": ev.obj.kind, "object": to_doc(ev.obj)}
+                )
+                self.wfile.write(line.encode() + b"\n")
+                sent += 1
+                if sent == replay_n:
+                    self.wfile.write(b'{"type": "SYNCED"}\n')
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client went away
+        finally:
+            w.stop()
+            with self._watch_lock:
+                self._active_watches.discard(w)
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(length) or b"{}")
+
+    # -- POST / PUT / DELETE ----------------------------------------------
+
+    def do_PUT(self):  # noqa: N802
+        url = urlparse(self.path)
+        m = _OBJ_RE.match(url.path)
+        if not m:
+            return self._error(404, "PUT only at /api/v1/{kind}/{ns}/{name}")
+        kind, ns, name = m.groups()
+        if kind not in KNOWN_KINDS:
+            return self._error(404, f"unknown kind {kind}")
+        check = parse_qs(url.query).get("check_version", ["0"])[0] == "1"
+        try:
+            obj = from_doc(kind, self._read_body())
+        except (ValueError, KeyError, TypeError) as exc:
+            return self._error(400, f"invalid {kind}: {exc}")
+        if (obj.metadata.namespace, obj.metadata.name) != (ns, name):
+            return self._error(400, "body identity does not match URL")
+        try:
+            return self._json(200, to_doc(self.store.update(obj, check_version=check)))
+        except NotFoundError:
+            return self._error(404, f"{kind} {ns}/{name} not found")
+        except ConflictError as exc:
+            return self._json(409, {"error": str(exc), "code": "conflict"})
 
     def do_POST(self):  # noqa: N802
-        if urlparse(self.path).path != "/api/tpujob":
-            return self._error(404, "POST only at /api/tpujob")
+        path = urlparse(self.path).path
+        m = _OBJ_KIND_RE.match(path)
+        if m:
+            kind = m.group(1)
+            if kind not in KNOWN_KINDS:
+                return self._error(404, f"unknown kind {kind}")
+            try:
+                obj = from_doc(kind, self._read_body())
+                if kind == KIND_TPUJOB:
+                    # The generic path must not be a validation bypass:
+                    # same defaulting + admission as the /api/tpujob route.
+                    set_defaults(obj)
+                    validate_job(obj)
+            except (ValueError, ValidationError, KeyError, TypeError) as exc:
+                return self._error(400, f"invalid {kind}: {exc}")
+            try:
+                return self._json(201, to_doc(self.store.create(obj)))
+            except AlreadyExistsError as exc:
+                return self._json(409, {"error": str(exc), "code": "already_exists"})
+        if path != "/api/tpujob":
+            return self._error(404, "POST only at /api/tpujob or /api/v1/{kind}")
         length = int(self.headers.get("Content-Length", 0))
         try:
             data = json.loads(self.rfile.read(length) or b"{}")
@@ -186,9 +339,20 @@ class _Handler(BaseHTTPRequestHandler):
         self._json(201, self._job_payload(created))
 
     def do_DELETE(self):  # noqa: N802
-        m = _JOB_RE.match(urlparse(self.path).path)
+        path = urlparse(self.path).path
+        m = _OBJ_RE.match(path)
+        if m:
+            kind, ns, name = m.groups()
+            if kind not in KNOWN_KINDS:
+                return self._error(404, f"unknown kind {kind}")
+            try:
+                self.store.delete(kind, ns, name)
+            except NotFoundError:
+                return self._error(404, f"{kind} {ns}/{name} not found")
+            return self._json(200, {"deleted": f"{kind}/{ns}/{name}"})
+        m = _JOB_RE.match(path)
         if not m:
-            return self._error(404, "DELETE only at /api/tpujob/{ns}/{name}")
+            return self._error(404, "DELETE at /api/tpujob/{ns}/{name} or /api/v1/{kind}/{ns}/{name}")
         ns, name = m.groups()
         try:
             self.store.delete(KIND_TPUJOB, ns, name)
@@ -201,8 +365,18 @@ class DashboardServer:
     def __init__(
         self, store: Store, host: str = "127.0.0.1", port: int = 8080, metrics=None
     ) -> None:
+        self._watches: set = set()
+        self._watch_closed = threading.Event()
         handler = type(
-            "BoundHandler", (_Handler,), {"store": store, "metrics": metrics}
+            "BoundHandler",
+            (_Handler,),
+            {
+                "store": store,
+                "metrics": metrics,
+                "_active_watches": self._watches,
+                "_watch_lock": threading.Lock(),
+                "_watch_closed": self._watch_closed,
+            },
         )
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self._thread: Optional[threading.Thread] = None
@@ -223,6 +397,14 @@ class DashboardServer:
         self._thread.start()
 
     def stop(self) -> None:
+        # End live watch streams first: server_close() joins handler
+        # threads, and a stream whose client is idle never unblocks on
+        # its own (the sentinel from Watch.stop() does). The closed flag
+        # forecloses the register-after-snapshot race: registration under
+        # the same lock refuses once set.
+        self._watch_closed.set()
+        for w in list(self._watches):
+            w.stop()
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread:

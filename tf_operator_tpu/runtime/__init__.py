@@ -36,6 +36,10 @@ from tf_operator_tpu.runtime.objects import (  # noqa: F401
     ProcessStatus,
 )
 from tf_operator_tpu.runtime.agent import HostAgent  # noqa: F401
+from tf_operator_tpu.runtime.remote_store import (  # noqa: F401
+    RemoteStore,
+    RemoteStoreError,
+)
 from tf_operator_tpu.runtime.scheduler import (  # noqa: F401
     GangScheduler,
     SchedulingError,

@@ -68,6 +68,9 @@ class HostAgent:
         self._stop = threading.Event()
         self._threads: list = []
         self._watch = None
+        # Keys of bindings seen during the current watch replay (between
+        # REPLAY_START and SYNCED); None outside a replay window.
+        self._replay_seen: Optional[set] = None
 
     # -- lifecycle --------------------------------------------------------
 
@@ -83,11 +86,18 @@ class HostAgent:
         t2.start()
 
     def stop(self) -> None:
-        """Graceful drain: mark NotReady, stop launching, kill children."""
+        """Graceful drain: mark NotReady, stop launching, kill children.
+
+        The NotReady write is best-effort: over a RemoteStore with the
+        operator unreachable it would raise, and children MUST still be
+        killed — an exception here would orphan every training process."""
         self._stop.set()
         if self._watch is not None:
             self._watch.stop()
-        self._set_phase(HostPhase.NOT_READY, "agent stopped")
+        try:
+            self._set_phase(HostPhase.NOT_READY, "agent stopped", transient_timeout=5.0)
+        except Exception as exc:
+            log.warning("agent %s: could not mark NotReady (%s)", self.name, exc)
         self.backend.shutdown()
         for t in self._threads:
             t.join(timeout=5)
@@ -138,12 +148,19 @@ class HostAgent:
             # Host object deleted (drained by an admin): re-register.
             self._register()
 
-    def _set_phase(self, phase: HostPhase, message: str) -> None:
+    def _set_phase(
+        self, phase: HostPhase, message: str, transient_timeout=None
+    ) -> None:
+        from tf_operator_tpu.runtime.store import update_with_retry_loop
+
         def mutate(cur):
             cur.status.phase = phase
             cur.status.message = message
 
-        self.store.update_with_retry(KIND_HOST, "default", self.name, mutate)
+        update_with_retry_loop(
+            self.store, KIND_HOST, "default", self.name, mutate,
+            transient_timeout=transient_timeout,
+        )
 
     # -- process lifecycle ------------------------------------------------
 
@@ -164,13 +181,34 @@ class HostAgent:
                 # with NodeLost detection masked by the fresh heartbeat.
                 log.exception(
                     "agent %s: error handling %s for %s; continuing",
-                    self.name, ev.type.value, ev.obj.metadata.name,
+                    self.name, ev.type.value,
+                    ev.obj.metadata.name if ev.obj is not None else "-",
                 )
 
     def _handle_event(self, ev) -> None:
+        # Remote-watch control events: a reconnect replays existing
+        # objects but NEVER deletions that happened while disconnected —
+        # on SYNCED, any child this agent still supervises that the
+        # replay didn't mention is an orphan to kill (the kubelet resync).
+        if ev.type is WatchEventType.REPLAY_START:
+            self._replay_seen = set()
+            return
+        if ev.type is WatchEventType.SYNCED:
+            if self._replay_seen is not None:
+                for key in self.backend.tracked_keys() - self._replay_seen:
+                    ns, _, name = key.partition("/")
+                    log.warning(
+                        "agent %s: reaping %s (absent from watch replay)",
+                        self.name, key,
+                    )
+                    self.backend.kill_local(ns, name)
+            self._replay_seen = None
+            return
         proc = ev.obj
         if not self._mine(proc):
             return
+        if self._replay_seen is not None:
+            self._replay_seen.add(proc.metadata.key())
         if ev.type is WatchEventType.DELETED:
             self.backend.kill_local(proc.metadata.namespace, proc.metadata.name)
         elif ev.type is WatchEventType.ADDED:

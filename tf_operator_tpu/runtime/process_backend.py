@@ -184,6 +184,12 @@ class LocalProcessControl(ProcessControl):
         with self._lock:
             return f"{namespace}/{name}" in self._children
 
+    def tracked_keys(self) -> set:
+        """Keys ("ns/name") of every supervised/launching child — the
+        agent's resync sweep diffs these against a watch replay."""
+        with self._lock:
+            return set(self._children)
+
     def kill_local(self, namespace: str, name: str) -> None:
         """Terminate the local child for ns/name without touching the store
         (the store object is already gone when the agent observes DELETED)."""

@@ -1,0 +1,238 @@
+"""RemoteStore: the Store interface over the operator's generic object API.
+
+The piece that takes the runtime multi-machine: a HostAgent (or any other
+store consumer) on a different host points at the operator's HTTP server
+and uses the same create/get/update/delete/list/watch surface as the
+in-process Store — the analogue of the reference's generated clientsets
+talking to the apiserver (pkg/client/**), with watches as an ndjson
+stream. Raises the SAME exception types as Store (NotFoundError,
+AlreadyExistsError, ConflictError), so callers cannot tell the
+difference; ``update_with_retry`` therefore works unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, Iterable, List, Optional
+
+from tf_operator_tpu.runtime.serialize import from_doc, to_doc
+from tf_operator_tpu.runtime.store import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    TransientStoreError,
+    WatchEvent,
+    WatchEventType,
+    update_with_retry_loop,
+)
+
+log = logging.getLogger("tpujob.remote_store")
+
+
+class RemoteStoreError(TransientStoreError):
+    """Transport/server failure that is not an object-level condition.
+    Subclasses TransientStoreError: shared retry loops wait it out."""
+
+
+class RemoteWatch:
+    """Iterable of WatchEvents from the server's ndjson stream.
+
+    Auto-reconnects on connection loss: the server replays existing
+    objects as ADDED on every (re)connect — the list+watch contract —
+    and consumers (agents, informers) are already replay-tolerant.
+
+    Uses a raw HTTPConnection (not urllib) so ``stop()`` can
+    ``shutdown()`` the socket: closing a buffered response from another
+    thread deadlocks on the reader lock the blocked consumer holds."""
+
+    def __init__(self, base: str, kinds, connect_timeout: float = 10.0) -> None:
+        u = urllib.parse.urlsplit(base)
+        self._host = u.hostname
+        self._port = u.port or (443 if u.scheme == "https" else 80)
+        self._tls = u.scheme == "https"
+        self.kinds = tuple(kinds) if kinds else None
+        self._connect_timeout = connect_timeout
+        self._stopped = threading.Event()
+        self._sock = None
+        self._lock = threading.Lock()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        with self._lock:
+            sock = self._sock
+            self._sock = None
+        if sock is not None:
+            # shutdown (not close): unblocks a reader mid-recv without
+            # touching the buffered response object the consumer thread
+            # holds the lock on.
+            import socket as _socket
+
+            try:
+                sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    def _connect(self):
+        import http.client
+
+        conn_cls = http.client.HTTPSConnection if self._tls else http.client.HTTPConnection
+        conn = conn_cls(self._host, self._port, timeout=self._connect_timeout)
+        q = f"?kinds={','.join(self.kinds)}" if self.kinds else ""
+        conn.request("GET", "/api/v1/watch" + q)
+        # Grab the socket BEFORE getresponse(): a close-delimited response
+        # detaches conn.sock, but the socket object stays valid for
+        # settimeout/shutdown (the response reads through its own dup'd
+        # file wrapper).
+        sock = conn.sock
+        resp = conn.getresponse()
+        if resp.status != 200:
+            body = resp.read(200)
+            conn.close()
+            raise OSError(f"watch HTTP {resp.status}: {body!r}")
+        # The stream is silent between events: drop the connect timeout so
+        # a quiet cluster doesn't look like a dead connection.
+        sock.settimeout(None)
+        return sock, resp
+
+    def __iter__(self):
+        import http.client
+
+        while not self._stopped.is_set():
+            try:
+                sock, resp = self._connect()
+            except (OSError, http.client.HTTPException) as exc:
+                if self._stopped.is_set():
+                    return
+                log.warning("watch connect failed (%s); retrying", exc)
+                if self._stopped.wait(1.0):
+                    return
+                continue
+            with self._lock:
+                if self._stopped.is_set():
+                    resp.close()
+                    return
+                self._sock = sock
+            # Control event: a fresh replay is beginning. Consumers reset
+            # their per-connection seen-set; on SYNCED they reconcile
+            # (deletions during a disconnect are never replayed).
+            yield WatchEvent(WatchEventType.REPLAY_START, None)
+            try:
+                for raw in resp:
+                    if self._stopped.is_set():
+                        return
+                    if not raw.strip():
+                        continue
+                    d = json.loads(raw)
+                    etype = WatchEventType(d["type"])
+                    if etype is WatchEventType.SYNCED:
+                        yield WatchEvent(etype, None)
+                        continue
+                    yield WatchEvent(etype, from_doc(d["kind"], d["object"]))
+            except (OSError, ValueError, http.client.HTTPException) as exc:
+                if self._stopped.is_set():
+                    return
+                log.warning("watch stream dropped (%s); reconnecting", exc)
+            finally:
+                with self._lock:
+                    if self._sock is sock:
+                        self._sock = None
+                try:
+                    resp.close()
+                except Exception:
+                    pass
+
+
+class RemoteStore:
+    """Store-compatible client over HTTP (see module docstring)."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0) -> None:
+        self.base = base_url.rstrip("/")
+        self.timeout = timeout
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _request(self, method: str, path: str, payload: Optional[dict] = None) -> dict:
+        body = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            self.base + path,
+            data=body,
+            method=method,
+            headers={"Content-Type": "application/json"} if body else {},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as exc:
+            detail = {}
+            try:
+                detail = json.loads(exc.read() or b"{}")
+            except ValueError:
+                pass
+            msg = detail.get("error", str(exc))
+            if exc.code == 404:
+                raise NotFoundError(msg) from None
+            if exc.code == 409:
+                if detail.get("code") == "already_exists":
+                    raise AlreadyExistsError(msg) from None
+                raise ConflictError(msg) from None
+            raise RemoteStoreError(f"{method} {path}: HTTP {exc.code}: {msg}") from None
+        except OSError as exc:
+            raise RemoteStoreError(f"{method} {path}: {exc}") from None
+
+    # -- Store surface ----------------------------------------------------
+
+    @staticmethod
+    def _obj_path(kind: str, namespace: str, name: str) -> str:
+        qt = lambda s: urllib.parse.quote(s, safe="")  # noqa: E731
+        return f"/api/v1/{qt(kind)}/{qt(namespace)}/{qt(name)}"
+
+    def create(self, obj: Any) -> Any:
+        doc = self._request("POST", f"/api/v1/{obj.kind}", to_doc(obj))
+        return from_doc(obj.kind, doc)
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        return from_doc(kind, self._request("GET", self._obj_path(kind, namespace, name)))
+
+    def update(self, obj: Any, check_version: bool = False) -> Any:
+        meta = obj.metadata
+        q = "?check_version=1" if check_version else ""
+        doc = self._request(
+            "PUT", self._obj_path(obj.kind, meta.namespace, meta.name) + q, to_doc(obj)
+        )
+        return from_doc(obj.kind, doc)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._request("DELETE", self._obj_path(kind, namespace, name))
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Any]:
+        params = []
+        if namespace:
+            params.append(("namespace", namespace))
+        for k, v in (label_selector or {}).items():
+            params.append(("label", f"{k}={v}"))  # filtered server-side
+        q = "?" + urllib.parse.urlencode(params) if params else ""
+        return [
+            from_doc(kind, d)
+            for d in self._request("GET", f"/api/v1/{kind}{q}")["items"]
+        ]
+
+    def watch(self, kinds: Optional[Iterable[str]] = None) -> RemoteWatch:
+        # Connect phase uses self.timeout; the established stream clears
+        # its socket timeout (a watch is long-lived and silent between
+        # events).
+        return RemoteWatch(self.base, kinds, connect_timeout=self.timeout)
+
+    def update_with_retry(self, kind: str, namespace: str, name: str, mutate: Any):
+        """Same contract as Store.update_with_retry, over the wire —
+        the one shared loop, which also waits out transport failures."""
+        return update_with_retry_loop(self, kind, namespace, name, mutate)

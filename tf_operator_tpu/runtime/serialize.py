@@ -1,0 +1,98 @@
+"""Wire (de)serialization for every store object kind.
+
+The seam that lets the store be served over HTTP (dashboard server's
+generic object API) and consumed by a RemoteStore on another machine —
+the reference's equivalent is the apiserver's JSON encoding of typed
+objects plus the generated clientsets (pkg/client/**). Encode reuses the
+generic dataclass walker (`api.types._to_jsonable`); decode is explicit
+per kind because enums and nested dataclasses must be rebuilt typed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from tf_operator_tpu.api.types import (
+    KIND_ENDPOINT,
+    KIND_EVENT,
+    KIND_HOST,
+    KIND_PROCESS,
+    KIND_TPUJOB,
+    ObjectMeta,
+    TPUJob,
+    _to_jsonable,
+)
+from tf_operator_tpu.runtime.objects import (
+    Endpoint,
+    EndpointAddress,
+    Event,
+    EventType,
+    Host,
+    HostPhase,
+    HostSpec,
+    HostStatus,
+    Process,
+    ProcessPhase,
+    ProcessSpec,
+    ProcessStatus,
+)
+
+
+def to_doc(obj: Any) -> Dict[str, Any]:
+    """Typed store object -> JSON-ready dict (kind field included)."""
+    return _to_jsonable(obj)
+
+
+def _meta(doc: Dict[str, Any]) -> ObjectMeta:
+    return ObjectMeta(**doc.get("metadata", {}))
+
+
+def _process_from_doc(doc: Dict[str, Any]) -> Process:
+    spec = ProcessSpec(**doc.get("spec", {}))
+    st = dict(doc.get("status", {}))
+    if "phase" in st:
+        st["phase"] = ProcessPhase(st["phase"])
+    return Process(metadata=_meta(doc), spec=spec, status=ProcessStatus(**st))
+
+
+def _host_from_doc(doc: Dict[str, Any]) -> Host:
+    st = dict(doc.get("status", {}))
+    if "phase" in st:
+        st["phase"] = HostPhase(st["phase"])
+    return Host(
+        metadata=_meta(doc),
+        spec=HostSpec(**doc.get("spec", {})),
+        status=HostStatus(**st),
+    )
+
+
+def _endpoint_from_doc(doc: Dict[str, Any]) -> Endpoint:
+    return Endpoint(
+        metadata=_meta(doc),
+        address=EndpointAddress(**doc.get("address", {})),
+        target_process=doc.get("target_process", ""),
+    )
+
+
+def _event_from_doc(doc: Dict[str, Any]) -> Event:
+    d = {k: v for k, v in doc.items() if k not in ("metadata", "kind")}
+    if "type" in d:
+        d["type"] = EventType(d["type"])
+    return Event(metadata=_meta(doc), **d)
+
+
+_DECODERS = {
+    KIND_PROCESS: _process_from_doc,
+    KIND_HOST: _host_from_doc,
+    KIND_ENDPOINT: _endpoint_from_doc,
+    KIND_EVENT: _event_from_doc,
+    KIND_TPUJOB: lambda doc: TPUJob.from_dict(doc),
+}
+
+
+def from_doc(kind: str, doc: Dict[str, Any]) -> Any:
+    """JSON dict -> typed store object. Raises KeyError on unknown kind."""
+    return _DECODERS[kind](doc)
+
+
+KNOWN_KINDS = tuple(_DECODERS)

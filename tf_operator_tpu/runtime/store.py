@@ -35,10 +35,26 @@ class ConflictError(ValueError):
     """Stale update: object changed since the caller read it (apiserver 409)."""
 
 
+class TransientStoreError(RuntimeError):
+    """The store is temporarily unreachable (remote transport failure).
+
+    The in-process Store never raises it; RemoteStore's transport errors
+    subclass it so shared retry loops can wait out an operator restart
+    instead of killing their caller (e.g. a monitor thread holding an
+    exit code that must eventually be reported)."""
+
+
 class WatchEventType(str, enum.Enum):
     ADDED = "ADDED"
     MODIFIED = "MODIFIED"
     DELETED = "DELETED"
+    # Remote-watch control events (the in-process store never emits them):
+    # REPLAY_START opens each (re)connection's replay, SYNCED closes it —
+    # consumers reconcile local state against the replayed set on SYNCED,
+    # because deletions that happened while disconnected are never
+    # replayed (obj is None for both).
+    REPLAY_START = "REPLAY_START"
+    SYNCED = "SYNCED"
 
 
 @dataclass
@@ -141,19 +157,7 @@ class Store:
         gone. The one blessed shape for every status/heartbeat/annotation
         writer — hand-rolled copies of this loop have each grown their own
         NotFound/Conflict edge-case bugs."""
-        while True:
-            try:
-                obj = self.get(kind, namespace, name)
-            except NotFoundError:
-                return None
-            if mutate(obj) is False:
-                return None
-            try:
-                return self.update(obj, check_version=True)
-            except ConflictError:
-                continue
-            except NotFoundError:
-                return None
+        return update_with_retry_loop(self, kind, namespace, name, mutate)
 
     def delete(self, kind: str, namespace: str, name: str) -> Any:
         with self._lock:
@@ -214,3 +218,47 @@ class Store:
 
 def _labels_match(labels: Dict[str, str], selector: Dict[str, str]) -> bool:
     return all(labels.get(k) == v for k, v in selector.items())
+
+
+def update_with_retry_loop(
+    store: Any, kind: str, namespace: str, name: str, mutate: Any,
+    transient_backoff: float = 1.0,
+    transient_timeout: Optional[float] = None,
+) -> Optional[Any]:
+    """The shared optimistic-write loop behind Store.update_with_retry AND
+    RemoteStore.update_with_retry (one implementation, not two copies).
+    Conflict → re-read and reapply; NotFound → None; TransientStoreError
+    (remote transport down) → wait and retry: a status writer must outlast
+    an operator restart, not die holding an unreported exit code. With
+    ``transient_timeout`` set, transient failures re-raise after that many
+    seconds (for shutdown paths that must not block forever)."""
+    import logging
+
+    log_ = logging.getLogger("tpujob.store")
+    deadline = None if transient_timeout is None else time.time() + transient_timeout
+
+    def transient(exc: TransientStoreError) -> None:
+        if deadline is not None and time.time() >= deadline:
+            raise exc
+        log_.warning("store unreachable (%s); retrying %s/%s", exc, namespace, name)
+        time.sleep(transient_backoff)
+
+    while True:
+        try:
+            obj = store.get(kind, namespace, name)
+        except NotFoundError:
+            return None
+        except TransientStoreError as exc:
+            transient(exc)
+            continue
+        if mutate(obj) is False:
+            return None
+        try:
+            return store.update(obj, check_version=True)
+        except ConflictError:
+            continue
+        except NotFoundError:
+            return None
+        except TransientStoreError as exc:
+            transient(exc)
+            continue
