@@ -53,10 +53,16 @@ def bench_lm(model: str) -> None:
     seq = int(os.environ.get("BENCH_SEQ", "512" if on_tpu else "128"))
     steps = int(os.environ.get("BENCH_STEPS", "30" if on_tpu else "4"))
     attn = os.environ.get("BENCH_ATTN", "flash" if on_tpu else "dense")
-    # Remat ON measures better here despite the recompute: it frees enough
-    # HBM for 2x the batch (b=32 w/ remat: 36.8% MFU vs b=16 w/o: 34.3% on
-    # v5e — without remat b=32 OOMs at 21G/15.75G). BENCH_REMAT=0 to disable.
-    remat = os.environ.get("BENCH_REMAT", "1") == "1"
+    # Remat matters: full remat frees enough HBM for 2x the batch (b=32
+    # w/ remat: 36.5% MFU vs b=16 w/o: 34.0% on v5e — no-remat b=32 OOMs
+    # even with the fused loss, 19.2G/15.75G). "dots" (selective
+    # checkpointing) measured *worse* than full remat here (35.8%) — the
+    # +3.6G of saved dot outputs cost more in scheduling than the saved
+    # recompute, so full remat stays the default. BENCH_REMAT=1|0|full|none|dots.
+    remat_env = os.environ.get("BENCH_REMAT", "1")
+    remat = {"1": True, "0": False, "full": True, "none": False}.get(
+        remat_env, remat_env
+    )
 
     cfg = preset(name, max_seq=seq, attn_impl=attn, remat=remat)
     mesh = build_mesh({"dp": n_chips})

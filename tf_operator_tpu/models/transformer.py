@@ -42,9 +42,16 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
-    remat: bool = True
+    # True/"full": save only layer inputs, recompute everything (min HBM,
+    # +2ND FLOPs). "dots": selective checkpointing — save matmul outputs,
+    # recompute just the elementwise chain (near-6ND at moderate HBM).
+    # False/"none": no remat (max HBM).
+    remat: Any = True
     attn_impl: str = "dense"  # "dense" | "ring" | "flash" (Pallas kernel)
     cp_axis: str = "cp"
+    # Blockwise fused loss (ops/fused_cross_entropy): logits never hit HBM
+    # as a [b,t,vocab] f32 array. Same math as the unfused path.
+    fused_xent: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -234,19 +241,30 @@ def _layer(x, layer_params, cfg: TransformerConfig, mesh):
     return x
 
 
-def transformer_forward(params, tokens, cfg: TransformerConfig, mesh=None):
-    """tokens: [b, t] int32 -> logits [b, t, vocab] (f32)."""
+def transformer_hidden(params, tokens, cfg: TransformerConfig, mesh=None):
+    """tokens: [b, t] int32 -> final-norm hidden states [b, t, d] (cfg.dtype)."""
     x = params["embed"].astype(cfg.dtype)[tokens]
 
     layer_fn = partial(_layer, cfg=cfg, mesh=mesh)
-    if cfg.remat:
+    if cfg.remat in (True, "full"):
         layer_fn = jax.checkpoint(layer_fn)
+    elif cfg.remat == "dots":
+        layer_fn = jax.checkpoint(
+            layer_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+    elif cfg.remat not in (False, None, "none"):
+        raise ValueError(f"unknown remat mode {cfg.remat!r}")
 
     def scan_body(x, layer_params):
         return layer_fn(x, layer_params), None
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
-    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return _rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def transformer_forward(params, tokens, cfg: TransformerConfig, mesh=None):
+    """tokens: [b, t] int32 -> logits [b, t, vocab] (f32)."""
+    x = transformer_hidden(params, tokens, cfg, mesh)
     # tied output head: embed^T
     return (x @ params["embed"].astype(cfg.dtype).T).astype(jnp.float32)
 
@@ -260,6 +278,14 @@ def lm_loss(params, tokens, cfg: TransformerConfig, mesh=None, key=None, mask_ra
     MASK_TOKEN and only those positions contribute to the loss (training on
     unmasked inputs would be degenerate identity reconstruction)."""
     if cfg.causal:
+        if cfg.fused_xent:
+            from tf_operator_tpu.ops.fused_cross_entropy import fused_cross_entropy
+
+            h = transformer_hidden(params, tokens, cfg, mesh)[:, :-1]
+            b, t, d = h.shape
+            return fused_cross_entropy(
+                h.reshape(b * t, d), params["embed"], tokens[:, 1:].reshape(b * t)
+            )
         logits = transformer_forward(params, tokens, cfg, mesh)
         targets = tokens[:, 1:]
         logits = logits[:, :-1]
@@ -270,6 +296,17 @@ def lm_loss(params, tokens, cfg: TransformerConfig, mesh=None, key=None, mask_ra
         key = jax.random.PRNGKey(0)
     mask = jax.random.bernoulli(key, mask_rate, tokens.shape)
     inputs = jnp.where(mask, MASK_TOKEN, tokens)
+    if cfg.fused_xent:
+        from tf_operator_tpu.ops.fused_cross_entropy import fused_cross_entropy
+
+        h = transformer_hidden(params, inputs, cfg, mesh)
+        b, t, d = h.shape
+        return fused_cross_entropy(
+            h.reshape(b * t, d),
+            params["embed"],
+            tokens.reshape(b * t),
+            weights=mask.reshape(b * t),
+        )
     logits = transformer_forward(params, inputs, cfg, mesh)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, tokens[..., None], axis=-1)[..., 0]

@@ -8,3 +8,4 @@ hand-written kernel while everything else rides XLA fusion.
 """
 
 from tf_operator_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from tf_operator_tpu.ops.fused_cross_entropy import fused_cross_entropy  # noqa: F401

@@ -1,0 +1,126 @@
+"""Fused (blockwise) softmax cross-entropy over a tied vocab projection.
+
+The naive LM loss materializes f32 logits ``[batch*seq, vocab]`` in HBM
+(BERT-base at b=32/s=512: 2.0 GB), then log_softmax re-reads and re-writes
+them, the gather reads them again, and autodiff stores log-probs as a
+residual for the backward — on a bandwidth-bound chip those passes cost
+more than the head matmul itself. This op never materializes the logits:
+the hidden states are processed in row (token) blocks, each block computes
+its ``[rows, vocab]`` logits tile on the MXU with f32 accumulation,
+reduces it to a log-sum-exp and the target logit immediately, and the
+backward pass recomputes the tile (flash-attention-style) to form
+``softmax - onehot`` on the fly. Residuals are just the per-token LSE —
+O(batch*seq) instead of O(batch*seq*vocab).
+
+The reference operator has no numerics at all (SURVEY.md §2 — it
+configures TensorFlow's runtime); this is part of the TPU data-plane layer
+that replaces what TF shipped pre-compiled. Same-math unfused path =
+``models.transformer.lm_loss`` with ``fused_xent=False``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def fused_cross_entropy(
+    x: jax.Array,
+    embed: jax.Array,
+    targets: jax.Array,
+    weights: Optional[jax.Array] = None,
+    *,
+    row_block: int = 1024,
+) -> jax.Array:
+    """Mean softmax cross-entropy of ``x @ embed.T`` against ``targets``.
+
+    Args:
+      x: [n, d] hidden states (bf16 or f32). Differentiated.
+      embed: [vocab, d] tied projection table (f32 params). Differentiated.
+      targets: [n] int32 class ids. Not differentiated.
+      weights: optional [n] per-token weights (e.g. an MLM mask); the loss
+        is ``sum(w * xent) / max(sum(w), 1)`` — with weights omitted this
+        is the plain mean, matching the unfused path exactly.
+      row_block: tokens per block; each block's logit tile is
+        ``[row_block, vocab]`` f32 and lives only inside the block.
+
+    Returns: scalar f32 loss.
+    """
+    n, d = x.shape
+    if n == 0:
+        raise ValueError(
+            "fused_cross_entropy needs at least one row (n=0; causal lm_loss "
+            "with seq_len=1 produces an empty target set)"
+        )
+    if weights is None:
+        weights = jnp.ones((n,), jnp.float32)
+    weights = weights.astype(jnp.float32)
+
+    r = min(row_block, _round_up(n, 8))
+    n_pad = _round_up(n, r)
+    if n_pad != n:
+        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+        targets = jnp.pad(targets, (0, n_pad - n))
+        weights = jnp.pad(weights, (0, n_pad - n))  # pad rows weigh zero
+    nb = n_pad // r
+
+    # targets/weights ride the closure: non-differentiated, trace-constant
+    # structure. Only (x, embed) are custom_vjp primals.
+    @jax.custom_vjp
+    def weighted_xent_sum(x, embed):
+        return _fwd(x, embed)[0]
+
+    def _fwd(x, embed):
+        et = embed.astype(x.dtype)  # one cast, reused by every block
+        cols = jnp.arange(embed.shape[0], dtype=targets.dtype)
+
+        def block(loss_sum, inp):
+            x_c, t_c, w_c = inp
+            logits = jnp.dot(x_c, et.T, preferred_element_type=jnp.float32)
+            m = jnp.max(logits, axis=-1)
+            lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1))
+            # target logit via a fused compare+select reduction over the tile
+            # (a take_along_axis gather here costs a real gather op per block)
+            onehot = t_c[:, None] == cols[None, :]
+            tgt = jnp.sum(jnp.where(onehot, logits, 0.0), axis=-1)
+            return loss_sum + jnp.sum(w_c * (lse - tgt)), lse
+
+        xs = (x.reshape(nb, r, d), targets.reshape(nb, r), weights.reshape(nb, r))
+        loss_sum, lse = jax.lax.scan(block, jnp.float32(0.0), xs)
+        return loss_sum, (x, embed, lse)
+
+    def _bwd(res, g):
+        x, embed, lse = res
+        et = embed.astype(x.dtype)
+        coef = (g * weights).reshape(nb, r)
+
+        cols = jnp.arange(embed.shape[0], dtype=targets.dtype)
+
+        def block(d_embed, inp):
+            x_c, t_c, c_c, lse_c = inp
+            logits = jnp.dot(x_c, et.T, preferred_element_type=jnp.float32)
+            p = jnp.exp(logits - lse_c[:, None])  # softmax, recomputed
+            # minus onehot(target), as fused select (not a scatter)
+            p = jnp.where(t_c[:, None] == cols[None, :], p - 1.0, p)
+            pc = (p * c_c[:, None]).astype(x.dtype)
+            dx_c = jnp.dot(pc, et, preferred_element_type=jnp.float32)
+            d_embed = d_embed + jnp.dot(pc.T, x_c, preferred_element_type=jnp.float32)
+            return d_embed, dx_c
+
+        xs = (x.reshape(nb, r, d), targets.reshape(nb, r), coef, lse)
+        d_embed, dx = jax.lax.scan(block, jnp.zeros(embed.shape, jnp.float32), xs)
+        # dx matches the (padded) primal x; autodiff of the outer jnp.pad
+        # slices the pad rows back off for the caller.
+        dx = dx.reshape(n_pad, d).astype(x.dtype)
+        return dx, d_embed.astype(embed.dtype)
+
+    weighted_xent_sum.defvjp(_fwd, _bwd)
+
+    denom = jnp.maximum(jnp.sum(weights), 1.0)
+    return weighted_xent_sum(x, embed) / denom

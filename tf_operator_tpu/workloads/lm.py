@@ -21,7 +21,7 @@ log = logging.getLogger("tpujob.lm")
 
 _CFG_FIELDS = {
     "vocab", "d_model", "n_layers", "n_heads", "n_kv_heads", "d_ff",
-    "max_seq", "causal", "remat",
+    "max_seq", "causal", "remat", "fused_xent",
 }
 
 
