@@ -280,3 +280,99 @@ def test_remote_agent_runs_gang_over_http():
         agent.stop()
         ctl.stop()
         server.stop()
+
+
+def test_idle_watch_survives_pings_without_reconnecting():
+    """The server writes {"type": "PING"} keep-alives on an idle stream;
+    the client must swallow them, NOT treat them as a dropped stream (a
+    reconnect would re-list the world every ping interval)."""
+    store = Store()
+    server = DashboardServer(store, port=0, watch_ping_interval=0.2)
+    server.start()
+    try:
+        rs = RemoteStore(server.url)
+        w = rs.watch(kinds=[KIND_PROCESS])
+        events = []
+        t = threading.Thread(target=lambda: events.extend(w), daemon=True)
+        t.start()
+        time.sleep(1.5)  # several ping intervals of idleness
+        store.create(
+            Process(metadata=ObjectMeta(name="after-idle"), spec=ProcessSpec(job_name="j"))
+        )
+        assert wait_for(
+            lambda: any(
+                e.obj is not None and e.obj.metadata.name == "after-idle"
+                for e in events
+            ),
+            timeout=10,
+        ), events
+        # exactly one connection: one REPLAY_START, no reconnect churn
+        replays = [e for e in events if e.type is WatchEventType.REPLAY_START]
+        assert len(replays) == 1, events
+        w.stop()
+        t.join(timeout=5)
+    finally:
+        server.stop()
+
+
+def test_names_with_reserved_characters_round_trip(remote):
+    """RemoteStore percent-encodes path segments; the server must decode
+    them — get/update/delete on a name with a space and a slash."""
+    store, rs = remote
+    for name in ("host a", "with/slash", "pct%20name"):
+        rs.create(Host(metadata=ObjectMeta(name=name), spec=HostSpec(total_chips=1)))
+        got = rs.get(KIND_HOST, "default", name)
+        assert got.metadata.name == name
+
+        def touch(cur):
+            cur.status.message = "seen"
+
+        assert rs.update_with_retry(KIND_HOST, "default", name, touch) is not None
+        assert store.get(KIND_HOST, "default", name).status.message == "seen"
+        rs.delete(KIND_HOST, "default", name)
+        with pytest.raises(NotFoundError):
+            rs.get(KIND_HOST, "default", name)
+
+
+def test_agent_register_waits_out_transient_store_errors():
+    """An agent daemon starting while the operator is down must retry
+    registration, not crash (the operator-reboot-races-agent-reboot case)."""
+    from tf_operator_tpu.runtime.store import TransientStoreError
+
+    store = Store()
+    failures = {"n": 2}
+
+    class FlakyStore:
+        def __getattr__(self, attr):
+            return getattr(store, attr)
+
+        def create(self, obj):
+            if failures["n"] > 0:
+                failures["n"] -= 1
+                raise TransientStoreError("operator unreachable")
+            return store.create(obj)
+
+    agent = HostAgent(
+        FlakyStore(), name="flaky-h1", total_chips=1, backend=FakeProcessControl(),
+        heartbeat_interval=0.1,
+    )
+    agent.start()
+    try:
+        assert wait_for(
+            lambda: store.get(KIND_HOST, "default", "flaky-h1").status.phase.value
+            == "Ready"
+            if _exists(store, KIND_HOST, "flaky-h1")
+            else False,
+            timeout=10,
+        )
+        assert failures["n"] == 0
+    finally:
+        agent.stop()
+
+
+def _exists(store, kind, name, namespace="default"):
+    try:
+        store.get(kind, namespace, name)
+        return True
+    except NotFoundError:
+        return False

@@ -33,7 +33,7 @@ import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, unquote, urlparse
 
 from tf_operator_tpu.api.types import (
     KIND_ENDPOINT,
@@ -66,6 +66,7 @@ class _Handler(BaseHTTPRequestHandler):
     server_version = "tpujob-dashboard/0.1"
     store: Store = None  # set by server factory
     metrics = None  # ControllerMetrics, set by server factory when wired
+    watch_ping_interval: float = 15.0  # idle keep-alive period on watches
 
     # silence default request logging
     def log_message(self, fmt, *args):
@@ -129,7 +130,9 @@ class _Handler(BaseHTTPRequestHandler):
 
         m = _JOB_RE.match(path)
         if m:
-            ns, name = m.groups()
+            # Path segments arrive percent-encoded (RemoteStore quotes
+            # them); decode before they become store keys.
+            ns, name = map(unquote, m.groups())
             try:
                 job = self.store.get(KIND_TPUJOB, ns, name)
             except NotFoundError:
@@ -175,7 +178,7 @@ class _Handler(BaseHTTPRequestHandler):
 
         m = _OBJ_RE.match(path)
         if m:
-            kind, ons, name = m.groups()
+            kind, ons, name = map(unquote, m.groups())
             if kind not in KNOWN_KINDS:
                 return self._error(404, f"unknown kind {kind}")
             try:
@@ -185,7 +188,7 @@ class _Handler(BaseHTTPRequestHandler):
 
         m = _LOGS_RE.match(path)
         if m:
-            ns, name = m.groups()
+            ns, name = map(unquote, m.groups())
             try:
                 proc = self.store.get(KIND_PROCESS, ns, name)
             except NotFoundError:
@@ -246,7 +249,7 @@ class _Handler(BaseHTTPRequestHandler):
             # instead of leaking until the next real event.
             while True:
                 try:
-                    ev = w.queue.get(timeout=15.0)
+                    ev = w.queue.get(timeout=self.watch_ping_interval)
                 except Exception:
                     self.wfile.write(b'{"type": "PING"}\n')
                     self.wfile.flush()
@@ -279,7 +282,7 @@ class _Handler(BaseHTTPRequestHandler):
         m = _OBJ_RE.match(url.path)
         if not m:
             return self._error(404, "PUT only at /api/v1/{kind}/{ns}/{name}")
-        kind, ns, name = m.groups()
+        kind, ns, name = map(unquote, m.groups())
         if kind not in KNOWN_KINDS:
             return self._error(404, f"unknown kind {kind}")
         check = parse_qs(url.query).get("check_version", ["0"])[0] == "1"
@@ -342,7 +345,7 @@ class _Handler(BaseHTTPRequestHandler):
         path = urlparse(self.path).path
         m = _OBJ_RE.match(path)
         if m:
-            kind, ns, name = m.groups()
+            kind, ns, name = map(unquote, m.groups())
             if kind not in KNOWN_KINDS:
                 return self._error(404, f"unknown kind {kind}")
             try:
@@ -353,7 +356,7 @@ class _Handler(BaseHTTPRequestHandler):
         m = _JOB_RE.match(path)
         if not m:
             return self._error(404, "DELETE at /api/tpujob/{ns}/{name} or /api/v1/{kind}/{ns}/{name}")
-        ns, name = m.groups()
+        ns, name = map(unquote, m.groups())
         try:
             self.store.delete(KIND_TPUJOB, ns, name)
         except NotFoundError:
@@ -363,7 +366,12 @@ class _Handler(BaseHTTPRequestHandler):
 
 class DashboardServer:
     def __init__(
-        self, store: Store, host: str = "127.0.0.1", port: int = 8080, metrics=None
+        self,
+        store: Store,
+        host: str = "127.0.0.1",
+        port: int = 8080,
+        metrics=None,
+        watch_ping_interval: float = 15.0,
     ) -> None:
         self._watches: set = set()
         self._watch_closed = threading.Event()
@@ -373,6 +381,7 @@ class DashboardServer:
             {
                 "store": store,
                 "metrics": metrics,
+                "watch_ping_interval": watch_ping_interval,
                 "_active_watches": self._watches,
                 "_watch_lock": threading.Lock(),
                 "_watch_closed": self._watch_closed,

@@ -34,6 +34,7 @@ from tf_operator_tpu.runtime.process_backend import LocalProcessControl
 from tf_operator_tpu.runtime.store import (
     AlreadyExistsError,
     Store,
+    TransientStoreError,
     WatchEventType,
 )
 
@@ -117,6 +118,15 @@ class HostAgent:
                 return
             except AlreadyExistsError:
                 pass
+            except TransientStoreError as exc:
+                # Operator momentarily unreachable (restart, network blip):
+                # an agent daemon must wait it out, not die at startup.
+                log.warning(
+                    "agent %s: register failed (%s); retrying", self.name, exc
+                )
+                if self._stop.wait(1.0):
+                    return
+                continue
 
             # Re-registration after restart: adopt, refresh spec + Ready.
             def adopt(cur):

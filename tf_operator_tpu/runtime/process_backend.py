@@ -39,6 +39,10 @@ class ProcessControl:
     def delete_process(self, namespace: str, name: str) -> None:
         raise NotImplementedError
 
+    def shutdown(self) -> None:
+        """Release backend resources; no-op for backends without any
+        (agents call this unconditionally on stop)."""
+
 
 class FakeProcessControl(ProcessControl):
     """Records intended actions; optionally injects errors.

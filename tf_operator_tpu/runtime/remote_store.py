@@ -128,6 +128,8 @@ class RemoteWatch:
                     if not raw.strip():
                         continue
                     d = json.loads(raw)
+                    if d["type"] == "PING":
+                        continue  # server keep-alive on an idle stream
                     etype = WatchEventType(d["type"])
                     if etype is WatchEventType.SYNCED:
                         yield WatchEvent(etype, None)
