@@ -78,24 +78,52 @@ def bench_lm(model: str) -> None:
         logical_axes=transformer_logical_axes(cfg),
         config=TrainerConfig(optimizer="adamw", learning_rate=1e-4),
     )
+    # BENCH_DATA=stream: feed every step a fresh host batch through the
+    # prefetching DeviceLoader instead of one resident device batch —
+    # stream ≈ fixed is the proof the input pipeline stays off the step's
+    # critical path.
+    stream = os.environ.get("BENCH_DATA", "fixed") == "stream"
+    loader = None
+    if stream:
+        # Built BEFORE t_submit: synthetic-data generation must not skew
+        # the submit→first-step comparison against fixed mode.
+        from tf_operator_tpu.train.data import DeviceLoader, SyntheticTokens
+
+        loader = DeviceLoader(
+            SyntheticTokens(batch, n=4 * batch, seq_len=seq, vocab=cfg.vocab),
+            trainer.batch_sharding,
+        )
+
+        def pull():
+            return next(loader)["tokens"]
+
     t_submit = time.perf_counter()
     state = trainer.init(jax.random.PRNGKey(0))
-    tokens = jax.device_put(
-        jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab),
-        trainer.batch_sharding,
-    )
-    state, metrics = trainer.step(state, tokens)
-    _ = float(metrics["loss"])  # host fetch: the only real sync on a tunneled TPU
-    first_step_s = time.perf_counter() - t_submit
-    for _ in range(2):
-        state, metrics = trainer.step(state, tokens)
-    _ = float(metrics["loss"])
+    if not stream:
+        tokens = jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab),
+            trainer.batch_sharding,
+        )
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = trainer.step(state, tokens)
-    _ = float(metrics["loss"])
-    step_s = (time.perf_counter() - t0) / steps
+        def pull():
+            return tokens
+
+    try:
+        state, metrics = trainer.step(state, pull())
+        _ = float(metrics["loss"])  # host fetch: the only real sync on a tunneled TPU
+        first_step_s = time.perf_counter() - t_submit
+        for _ in range(2):
+            state, metrics = trainer.step(state, pull())
+        _ = float(metrics["loss"])
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = trainer.step(state, pull())
+        _ = float(metrics["loss"])
+        step_s = (time.perf_counter() - t0) / steps
+    finally:
+        if loader is not None:
+            loader.close()
 
     params = cfg.n_params()
     tokens_per_step = batch * seq
@@ -177,36 +205,64 @@ def main() -> None:
         init_fn=init_fn,
         config=TrainerConfig(optimizer="sgd", learning_rate=0.1, grad_clip=None),
     )
+    # BENCH_DATA=stream: fresh host batches through the prefetching
+    # DeviceLoader (77 MB/step at b=128/224²) — stream ≈ fixed proves the
+    # input pipeline overlaps the step instead of serializing on it.
+    stream = os.environ.get("BENCH_DATA", "fixed") == "stream"
+    loader = None
+    if stream:
+        # Built BEFORE t_submit (data generation isn't submit latency).
+        from tf_operator_tpu.train.data import DeviceLoader, SyntheticImages
+
+        loader = DeviceLoader(
+            SyntheticImages(
+                batch, n=4 * batch, image_size=image_size,
+                num_classes=cfg.num_classes,
+            ),
+            trainer.batch_sharding,
+        )
+
+        def pull():
+            b = next(loader)
+            return b["image"], b["label"]
+
     t_submit = time.perf_counter()
     state = trainer.init(jax.random.PRNGKey(0))
 
-    images = jax.device_put(
-        jax.random.normal(jax.random.PRNGKey(1), (batch, image_size, image_size, 3)),
-        trainer.batch_sharding,
-    )
-    labels = jax.device_put(
-        jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, cfg.num_classes),
-        trainer.batch_sharding,
-    )
-    data = (images, labels)
+    if not stream:
+        images = jax.device_put(
+            jax.random.normal(jax.random.PRNGKey(1), (batch, image_size, image_size, 3)),
+            trainer.batch_sharding,
+        )
+        labels = jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, cfg.num_classes),
+            trainer.batch_sharding,
+        )
 
-    # Warmup (compile + stabilize). float() forces a host fetch — plain
-    # block_until_ready does not synchronize through the remote TPU tunnel.
-    state, metrics = trainer.step(state, data)
-    _ = float(metrics["loss"])
-    first_step_s = time.perf_counter() - t_submit
-    for _ in range(warmup):
-        state, metrics = trainer.step(state, data)
-    _ = float(metrics["loss"])
+        def pull():
+            return images, labels
 
-    # Timed region: steps dispatched back-to-back (donation chains them on
-    # device), ONE sync at the end — per-step host syncs would serialize on
-    # tunnel RTT and measure latency, not throughput.
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = trainer.step(state, data)
-    _ = float(metrics["loss"])
-    step_s = (time.perf_counter() - t0) / steps
+    try:
+        # Warmup (compile + stabilize). float() forces a host fetch — plain
+        # block_until_ready does not synchronize through the remote TPU tunnel.
+        state, metrics = trainer.step(state, pull())
+        _ = float(metrics["loss"])
+        first_step_s = time.perf_counter() - t_submit
+        for _ in range(warmup):
+            state, metrics = trainer.step(state, pull())
+        _ = float(metrics["loss"])
+
+        # Timed region: steps dispatched back-to-back (donation chains them
+        # on device), ONE sync at the end — per-step host syncs would
+        # serialize on tunnel RTT and measure latency, not throughput.
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = trainer.step(state, pull())
+        _ = float(metrics["loss"])
+        step_s = (time.perf_counter() - t0) / steps
+    finally:
+        if loader is not None:
+            loader.close()
     images_per_sec = batch / step_s
     images_per_sec_per_chip = images_per_sec / n_chips
     fwd_flops = cfg.flops_per_image(image_size)

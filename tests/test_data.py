@@ -1,0 +1,175 @@
+"""Input pipeline: datasets, device prefetch, sharding, trainer integration."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tf_operator_tpu.parallel import build_mesh
+from tf_operator_tpu.train import (
+    ArrayDataset,
+    DeviceLoader,
+    SyntheticImages,
+    SyntheticTokens,
+    Trainer,
+    TrainerConfig,
+)
+
+# ---- datasets ------------------------------------------------------------
+
+
+def test_array_dataset_batches_and_epoch_determinism():
+    ds = ArrayDataset(
+        {"x": np.arange(20, dtype=np.float32), "y": np.arange(20, dtype=np.int32)},
+        batch_size=8,
+    )
+    assert len(ds) == 2  # ragged tail dropped
+    a = [b["x"].tolist() for b in ds.epoch(0)]
+    b = [b["x"].tolist() for b in ds.epoch(0)]
+    c = [b["x"].tolist() for b in ds.epoch(1)]
+    assert a == b  # same epoch index -> same order
+    assert a != c  # different epoch -> reshuffled
+    # batches keep x/y aligned
+    for batch in ds.epoch(3):
+        np.testing.assert_array_equal(batch["x"].astype(np.int32), batch["y"])
+
+
+def test_array_dataset_validation():
+    with pytest.raises(ValueError, match="leading dim"):
+        ArrayDataset({"x": np.zeros(4), "y": np.zeros(5)}, batch_size=2)
+    with pytest.raises(ValueError, match="batch_size"):
+        ArrayDataset({"x": np.zeros(4)}, batch_size=8)
+
+
+def test_synthetic_shapes():
+    img = next(iter(SyntheticImages(4, n=16, image_size=8, num_classes=10)))
+    assert img["image"].shape == (4, 8, 8, 3)
+    assert img["label"].shape == (4,)
+    assert img["label"].max() < 10
+    tok = next(iter(SyntheticTokens(2, n=8, seq_len=16, vocab=100)))
+    assert tok["tokens"].shape == (2, 16)
+
+
+# ---- device loader -------------------------------------------------------
+
+
+def test_loader_yields_sharded_device_batches():
+    mesh = build_mesh({"dp": 8})
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P("dp"))
+    ds = ArrayDataset({"x": np.arange(64, dtype=np.float32)}, batch_size=8,
+                      shuffle=False)
+    with DeviceLoader(ds.epoch(0), sharding) as loader:
+        batches = list(loader)
+    assert len(batches) == 8
+    assert all(isinstance(b["x"], jax.Array) for b in batches)
+    assert batches[0]["x"].sharding.is_equivalent_to(sharding, 1)
+    np.testing.assert_array_equal(
+        np.asarray(batches[0]["x"]), np.arange(8, dtype=np.float32)
+    )
+
+
+def test_loader_prefetches_ahead():
+    """The stager keeps `prefetch` batches staged while the consumer sits
+    on the first one."""
+    mesh = build_mesh({"dp": 8})
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P("dp"))
+    pulled = []
+
+    def slow_source():
+        for i in range(6):
+            pulled.append(i)
+            yield {"x": np.full((8,), i, dtype=np.float32)}
+
+    loader = DeviceLoader(slow_source(), sharding, prefetch=2)
+    first = next(loader)
+    # stager should run ahead without the consumer pulling more:
+    deadline = time.time() + 5
+    while len(pulled) < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    assert len(pulled) >= 3, pulled  # first + 2 prefetched
+    assert float(np.asarray(first["x"])[0]) == 0.0
+    loader.close()
+
+
+def test_loader_propagates_source_errors():
+    mesh = build_mesh({"dp": 8})
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def bad_source():
+        yield {"x": np.zeros(8, np.float32)}
+        raise RuntimeError("disk on fire")
+
+    loader = DeviceLoader(bad_source(), NamedSharding(mesh, P("dp")))
+    next(loader)
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        next(loader)
+
+
+def test_loader_close_unblocks_stager():
+    mesh = build_mesh({"dp": 8})
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def endless():
+        while True:
+            yield {"x": np.zeros(8, np.float32)}
+
+    loader = DeviceLoader(endless(), NamedSharding(mesh, P("dp")), prefetch=1)
+    next(loader)
+    loader.close()
+    assert not loader._thread.is_alive()
+
+
+def test_loader_pytree_of_shardings():
+    mesh = build_mesh({"dp": 8})
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shardings = {
+        "x": NamedSharding(mesh, P("dp")),
+        "y": NamedSharding(mesh, P()),  # replicated
+    }
+    ds = ArrayDataset(
+        {"x": np.zeros((16, 4), np.float32), "y": np.zeros((16,), np.int32)},
+        batch_size=8,
+    )
+    with DeviceLoader(ds.epoch(0), shardings) as loader:
+        b = next(loader)
+    assert b["x"].sharding.is_equivalent_to(shardings["x"], 2)
+    assert b["y"].sharding.is_equivalent_to(shardings["y"], 1)
+
+
+# ---- end to end with the Trainer ----------------------------------------
+
+
+def test_trainer_streams_batches_from_loader():
+    """Linear-regression training fed by the prefetching loader over the
+    8-device dp mesh: loss goes down, proving batches arrive sharded and
+    in order."""
+    mesh = build_mesh({"dp": 8})
+    rng = np.random.default_rng(0)
+    w_true = rng.standard_normal((4,)).astype(np.float32)
+    x = rng.standard_normal((256, 4)).astype(np.float32)
+    y = x @ w_true
+    ds = ArrayDataset({"x": x, "y": y}, batch_size=32)
+
+    trainer = Trainer(
+        mesh,
+        loss_fn=lambda p, batch, extra: jnp.mean(
+            (batch["x"] @ p["w"] - batch["y"]) ** 2
+        ),
+        init_fn=lambda k: {"w": jnp.zeros((4,), jnp.float32)},
+        config=TrainerConfig(optimizer="sgd", learning_rate=0.1, grad_clip=None),
+    )
+    state = trainer.init(jax.random.PRNGKey(0))
+    losses = []
+    with DeviceLoader(ds, trainer.batch_sharding) as loader:
+        for _, batch in zip(range(24), loader):
+            state, metrics = trainer.step(state, batch)
+            losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.1, losses

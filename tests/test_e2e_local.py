@@ -241,3 +241,40 @@ def test_bad_entrypoint_is_permanent_failure(rig):
     assert ok, f"conditions: {[(c.type.value, c.reason) for c in st.conditions]}"
     # harness exit 2 => permanent, no restart loop
     assert st.restart_count == 0
+
+
+def test_lm_training_streams_through_device_loader(rig):
+    """The production input-pipeline shape end-to-end: a 2-process gang
+    trains the LM with host batches flowing through the prefetching
+    DeviceLoader (data="stream") instead of one resident device batch.
+    In multi-process mode each process stages only its local slice
+    (make_array_from_process_local_data)."""
+    store = rig
+    job = TPUJob(
+        metadata=ObjectMeta(name="lm-stream"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=2,
+                    template=ProcessTemplate(
+                        entrypoint="tf_operator_tpu.workloads.lm:main",
+                        env=dict(DATAPLANE_ENV),
+                    ),
+                )
+            },
+        ),
+    )
+    job.spec.workload = {
+        "preset": "tiny",
+        "steps": 4,
+        "batch_size": 4,
+        "seq_len": 32,
+        "data": "stream",
+    }
+    store.create(job)
+    ok = wait_for(
+        lambda: has_condition(job_status(store, "lm-stream"), ConditionType.SUCCEEDED),
+        timeout=240,
+    )
+    st = job_status(store, "lm-stream")
+    assert ok, f"conditions: {[(c.type.value, c.reason, c.message) for c in st.conditions]}"

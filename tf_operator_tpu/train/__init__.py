@@ -17,3 +17,9 @@ from tf_operator_tpu.train.metrics import (  # noqa: F401
     mfu,
     peak_flops_per_chip,
 )
+from tf_operator_tpu.train.data import (  # noqa: F401
+    ArrayDataset,
+    DeviceLoader,
+    SyntheticImages,
+    SyntheticTokens,
+)

@@ -342,22 +342,28 @@ class WorkloadCheckpointer:
         guard → final save. Returns ``(state, loss, timed, step_s)`` where
         ``step_s`` is None when no timed steps remained. Callers must check
         :meth:`is_complete` first. ``on_step(global_step)`` fires after
-        every advance — the fault-injection / progress-reporting seam."""
+        every advance — the fault-injection / progress-reporting seam.
+
+        ``batch`` is either one fixed batch (re-trained every step: the
+        benchmarking shape) or a batch *iterator* — e.g. a
+        ``train.data.DeviceLoader`` — pulled once per step. All batches
+        must share one shape/dtype structure (jit compiles once)."""
         import math
         import time
 
         from tf_operator_tpu.train.metrics import host_fetch
 
+        pull = (lambda: next(batch)) if hasattr(batch, "__next__") else (lambda: batch)
         state = self.restore_or_init(trainer, key)
         timed = self.timed_steps(steps)
-        state, m = trainer.step(state, batch)
+        state, m = trainer.step(state, pull())
         self.advance(state, loss=m["loss"])
         host_fetch(m["loss"])  # compile boundary
         if on_step is not None:
             on_step(self._step)
         t0 = time.perf_counter()
         for _ in range(timed):
-            state, m = trainer.step(state, batch)
+            state, m = trainer.step(state, pull())
             self.advance(state, loss=m["loss"])
             if on_step is not None:
                 on_step(self._step)

@@ -1,0 +1,259 @@
+"""Input pipeline: host-side datasets with device prefetch.
+
+The reference delegates data loading entirely to user containers (SURVEY.md
+§5: the operator never touches tensors; `tf.data` came with TensorFlow).
+A complete TPU framework has to supply the analogue itself: if the host
+hands the device one batch at a time synchronously, every step eats a
+host→HBM transfer on its critical path. ``DeviceLoader`` pipelines that
+away — a background thread stages the next batches onto the device (with
+the job's batch sharding) while the current step runs, so steps dequeue
+device-resident arrays. This is the jit-era equivalent of TPU infeed /
+`tf.data` prefetch-to-device.
+
+Multi-host: each process stages only its addressable shard
+(`jax.make_array_from_process_local_data`), so a dp=16 job moves 1/16th
+of the global batch per host — the loader contract is "every process
+iterates the same dataset structure; each sees its local slice".
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+__all__ = [
+    "SyntheticImages",
+    "SyntheticTokens",
+    "ArrayDataset",
+    "DeviceLoader",
+    "local_loader",
+]
+
+
+class ArrayDataset:
+    """Finite in-memory dataset: yields dict batches sliced from arrays.
+
+    arrays: pytree-of-ndarray with a common leading (example) dim.
+    Deterministic order per epoch index (reshuffled by ``seed + epoch``),
+    dropping the ragged tail batch (static shapes — XLA recompiles on any
+    shape change, SURVEY §6 submit→first-step budget)."""
+
+    def __init__(self, arrays: Any, batch_size: int, *, shuffle: bool = True,
+                 seed: int = 0) -> None:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(arrays)
+        if not leaves:
+            raise ValueError("ArrayDataset needs at least one array")
+        n = leaves[0].shape[0]
+        for leaf in leaves:
+            if leaf.shape[0] != n:
+                raise ValueError("all arrays must share the leading dim")
+        if batch_size > n:
+            raise ValueError(f"batch_size {batch_size} > dataset size {n}")
+        self.arrays = arrays
+        self.n = n
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return self.n // self.batch_size
+
+    def epoch(self, epoch: int = 0) -> Iterator[Any]:
+        import jax
+
+        order = np.arange(self.n)
+        if self.shuffle:
+            np.random.default_rng(self.seed + epoch).shuffle(order)
+        for i in range(len(self)):
+            idx = order[i * self.batch_size : (i + 1) * self.batch_size]
+            yield jax.tree_util.tree_map(lambda a: a[idx], self.arrays)
+
+    def __iter__(self) -> Iterator[Any]:
+        epoch = 0
+        while True:  # repeat forever; the consumer bounds steps
+            yield from self.epoch(epoch)
+            epoch += 1
+
+
+class SyntheticImages(ArrayDataset):
+    """Deterministic fake image-classification data (ImageNet-shaped by
+    default) — the benchmarking stand-in the BASELINE configs train on."""
+
+    def __init__(self, batch_size: int, *, n: int = 1024, image_size: int = 224,
+                 channels: int = 3, num_classes: int = 1000, seed: int = 0) -> None:
+        rng = np.random.default_rng(seed)
+        super().__init__(
+            {
+                "image": rng.standard_normal(
+                    (n, image_size, image_size, channels), dtype=np.float32
+                ),
+                "label": rng.integers(0, num_classes, (n,), dtype=np.int32),
+            },
+            batch_size,
+            seed=seed,
+        )
+
+
+class SyntheticTokens(ArrayDataset):
+    """Deterministic fake LM token data."""
+
+    def __init__(self, batch_size: int, *, n: int = 2048, seq_len: int = 512,
+                 vocab: int = 32000, seed: int = 0) -> None:
+        rng = np.random.default_rng(seed)
+        super().__init__(
+            {"tokens": rng.integers(0, vocab, (n, seq_len), dtype=np.int32)},
+            batch_size,
+            seed=seed,
+        )
+
+
+def local_loader(
+    dataset_cls: Callable[..., "ArrayDataset"],
+    global_batch: int,
+    sharding: Any,
+    *,
+    min_examples: int = 32,
+    prefetch: int = 2,
+    **dataset_kw: Any,
+) -> "DeviceLoader":
+    """The multi-host stream contract in one place: split ``global_batch``
+    across processes (must divide), seed the synthetic dataset by rank so
+    shards carry distinct data, and wrap it in a prefetching DeviceLoader.
+    Used by the lm/resnet workloads' ``data: "stream"`` paths."""
+    import jax
+
+    n_proc = jax.process_count()
+    if global_batch % n_proc:
+        raise ValueError(
+            f"batch_size {global_batch} not divisible by {n_proc} processes"
+        )
+    local = global_batch // n_proc
+    ds = dataset_cls(
+        local,
+        n=max(2 * local, min_examples),
+        seed=jax.process_index(),
+        **dataset_kw,
+    )
+    return DeviceLoader(ds, sharding, prefetch=prefetch)
+
+
+class DeviceLoader:
+    """Wraps a host batch iterable; yields device-resident sharded batches.
+
+    A daemon thread pulls host batches, shards them onto the mesh, and
+    keeps up to ``prefetch`` staged ahead of the consumer — transfer for
+    step N+1 overlaps compute for step N. ``sharding`` is typically
+    ``trainer.batch_sharding``; a pytree batch may also map to a pytree
+    of shardings (dict batches get the one sharding on every leaf).
+
+    Iteration ends when the source iterator does (pass a bounded iterable
+    for epochs; ArrayDataset repeats forever). ``close()`` (or `with`)
+    stops the stager; the thread also exits if the consumer drops the
+    loader. Errors in the source re-raise at the consumer's next pull."""
+
+    _END = object()
+
+    def __init__(
+        self,
+        source: Iterable[Any],
+        sharding: Any,
+        *,
+        prefetch: int = 2,
+        put: Optional[Callable[[Any, Any], Any]] = None,
+    ) -> None:
+        if prefetch < 1:
+            raise ValueError("prefetch must be >= 1")
+        self.sharding = sharding
+        self._put = put or self._default_put
+        self._q: "queue.Queue" = queue.Queue(maxsize=prefetch)
+        self._stop = threading.Event()
+        self._err: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._stage, args=(iter(source),), name="device-loader", daemon=True
+        )
+        self._thread.start()
+
+    def _default_put(self, batch: Any, sharding: Any) -> Any:
+        import jax
+
+        if isinstance(sharding, jax.sharding.Sharding):
+            shardings = jax.tree_util.tree_map(lambda _: sharding, batch)
+        else:  # a pytree of shardings matching the batch structure
+            shardings = sharding
+        if jax.process_count() > 1:
+            # Each process holds its local slice of the global batch;
+            # assemble the logically-global arrays from local data.
+            return jax.tree_util.tree_map(
+                lambda a, s: jax.make_array_from_process_local_data(s, a),
+                batch,
+                shardings,
+            )
+        return jax.device_put(batch, shardings)
+
+    def _stage(self, it: Iterator[Any]) -> None:
+        try:
+            for batch in it:
+                if self._stop.is_set():
+                    return
+                staged = self._put(batch, self.sharding)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(staged, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+            self._enqueue_end()
+        except BaseException as exc:  # surfaced to the consumer
+            self._err = exc
+            self._enqueue_end()
+
+    def _enqueue_end(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._q.put(self._END, timeout=0.2)
+                return
+            except queue.Full:
+                continue
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        while True:
+            try:
+                item = self._q.get(timeout=0.2)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive() and self._q.empty():
+                    item = self._END
+                    break
+        if item is self._END:
+            self._stop.set()
+            if self._err is not None:
+                err, self._err = self._err, None
+                raise err
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        # drain so a blocked stager can observe the stop flag
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "DeviceLoader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

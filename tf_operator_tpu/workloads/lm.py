@@ -7,8 +7,9 @@ the sharded Trainer on synthetic tokens, logs tokens/sec and MFU.
 workload config keys: preset ("tiny"|"gpt-small"|"bert-base"|"llama2-7b"|
 "llama2-13b"), steps, batch_size, seq_len, lr, attn ("dense"|"ring"|"flash"),
 checkpoint_dir, checkpoint_every (steps between saves; restart-based
-recovery resumes from the latest checkpoint), plus any TransformerConfig
-field as an override (e.g. n_layers).
+recovery resumes from the latest checkpoint), data ("fixed" resident
+batch | "stream" through the prefetching DeviceLoader), plus any
+TransformerConfig field as an override (e.g. n_layers).
 """
 
 from __future__ import annotations
@@ -68,10 +69,22 @@ def main(ctx: JobContext) -> None:
     if ckpt.is_complete(steps):
         log.info("already complete (budget %d); nothing to do", steps)
         return
-    tokens = jax.device_put(
-        jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab),
-        trainer.batch_sharding,
-    )
+    loader = None
+    if wl.get("data", "fixed") == "stream":
+        from tf_operator_tpu.train.data import SyntheticTokens, local_loader
+
+        # batch_size is GLOBAL; local_loader splits it across processes
+        # with rank-distinct data and prefetches onto the mesh.
+        loader = local_loader(
+            SyntheticTokens, batch, trainer.batch_sharding,
+            seq_len=seq, vocab=cfg.vocab,
+        )
+        tokens = (b["tokens"] for b in loader)
+    else:
+        tokens = jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab),
+            trainer.batch_sharding,
+        )
 
     # Fault injection (workload keys fail_at_step + fail_marker): die
     # RETRYABLY once at the given global step — the restart-based-recovery
@@ -90,9 +103,13 @@ def main(ctx: JobContext) -> None:
                 # routed by the harness to the user-retryable exit code
                 raise RetryableFailure(f"fault injection at step {step}")
 
-    state, loss, timed, step_s = ckpt.run_loop(
-        trainer, jax.random.PRNGKey(0), tokens, steps, on_step=on_step
-    )
+    try:
+        state, loss, timed, step_s = ckpt.run_loop(
+            trainer, jax.random.PRNGKey(0), tokens, steps, on_step=on_step
+        )
+    finally:
+        if loader is not None:
+            loader.close()
     if step_s is not None:
         n_chips = mesh.devices.size
         flops = transformer_train_flops(cfg.n_params(), batch * seq)

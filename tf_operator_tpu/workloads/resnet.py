@@ -5,7 +5,10 @@ entrypoint: joins the gang, builds the mesh, trains ResNet on synthetic
 ImageNet-shaped data with the sharded Trainer, logs step time and MFU.
 
 workload config keys: steps, batch_size, image_size, num_classes, lr,
-variant ("resnet50"|"resnet18"), checkpoint_dir, checkpoint_every.
+variant ("resnet50"|"resnet18"), checkpoint_dir, checkpoint_every,
+data ("fixed": one resident device batch, the benchmarking shape;
+"stream": host batches through the prefetching DeviceLoader — the
+production input-pipeline shape).
 """
 
 from __future__ import annotations
@@ -59,18 +62,34 @@ def main(ctx: JobContext) -> None:
     if ckpt.is_complete(steps):
         log.info("already complete (budget %d); nothing to do", steps)
         return
-    images = jax.device_put(
-        jax.random.normal(jax.random.PRNGKey(1), (batch, image_size, image_size, 3)),
-        trainer.batch_sharding,
-    )
-    labels = jax.device_put(
-        jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, classes),
-        trainer.batch_sharding,
-    )
-    data = (images, labels)
-    state, loss, timed, step_s = ckpt.run_loop(
-        trainer, jax.random.PRNGKey(0), data, steps
-    )
+    loader = None
+    if wl.get("data", "fixed") == "stream":
+        from tf_operator_tpu.train.data import SyntheticImages, local_loader
+
+        # batch_size is GLOBAL; local_loader splits it across processes
+        # with rank-distinct data and prefetches onto the mesh.
+        loader = local_loader(
+            SyntheticImages, batch, trainer.batch_sharding,
+            min_examples=64, image_size=image_size, num_classes=classes,
+        )
+        data = ((b["image"], b["label"]) for b in loader)
+    else:
+        images = jax.device_put(
+            jax.random.normal(jax.random.PRNGKey(1), (batch, image_size, image_size, 3)),
+            trainer.batch_sharding,
+        )
+        labels = jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, classes),
+            trainer.batch_sharding,
+        )
+        data = (images, labels)
+    try:
+        state, loss, timed, step_s = ckpt.run_loop(
+            trainer, jax.random.PRNGKey(0), data, steps
+        )
+    finally:
+        if loader is not None:
+            loader.close()
     if step_s is not None:
         n_chips = mesh.devices.size
         flops = resnet_train_flops(cfg.flops_per_image(image_size), batch)
