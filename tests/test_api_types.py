@@ -76,3 +76,12 @@ def test_deepcopy_isolation():
 def test_restart_policy_values():
     # The four policies of v1alpha2/types.go:79-92 must all exist.
     assert {p.value for p in RestartPolicy} == {"Always", "OnFailure", "Never", "ExitCode"}
+
+
+def test_roundtrip_dcn_mesh_axes():
+    job = make_job()
+    job.spec.topology.mesh_axes = {"dp": 2, "tp": 4}
+    job.spec.topology.dcn_mesh_axes = {"dp": 2}
+    restored = TPUJob.from_dict(job.to_dict())
+    assert restored.spec.topology.dcn_mesh_axes == {"dp": 2}
+    assert restored == job

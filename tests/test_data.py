@@ -173,3 +173,21 @@ def test_trainer_streams_batches_from_loader():
             state, metrics = trainer.step(state, batch)
             losses.append(float(metrics["loss"]))
     assert losses[-1] < losses[0] * 0.1, losses
+
+
+def test_loader_skip_fast_forwards_host_side():
+    mesh = build_mesh({"dp": 8})
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P("dp"))
+    ds = ArrayDataset({"x": np.arange(64, dtype=np.float32)}, batch_size=8,
+                      shuffle=False)
+    with DeviceLoader(ds.epoch(0), sharding, skip=3) as loader:
+        batches = list(loader)
+    assert len(batches) == 5  # 8 batches - 3 skipped
+    np.testing.assert_array_equal(
+        np.asarray(batches[0]["x"]), np.arange(24, 32, dtype=np.float32)
+    )
+    # skipping past the end just yields an empty stream
+    with DeviceLoader(ds.epoch(0), sharding, skip=100) as loader:
+        assert list(loader) == []

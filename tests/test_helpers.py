@@ -169,3 +169,22 @@ class TestInjectionIntoProcesses:
         ctl.sync_job(created.key())
         env = control.created[0].spec.env
         assert env["LD_LIBRARY_PATH"] == "/opt/tpu/lib:/my/deps"
+
+
+def test_job_context_carries_dcn_mesh_axes():
+    """ENV round trip for the multi-slice mesh declaration (SURVEY §5
+    cross-slice contract): reconciler-injected JSON -> JobContext fields."""
+    import json
+
+    from tf_operator_tpu.rendezvous.context import JobContext
+    from tf_operator_tpu.rendezvous.env import ENV_DCN_MESH_AXES, ENV_MESH_AXES
+
+    ctx = JobContext.from_env(
+        {
+            ENV_MESH_AXES: json.dumps({"dp": 2, "tp": 4}),
+            ENV_DCN_MESH_AXES: json.dumps({"dp": 2}),
+        }
+    )
+    assert ctx.mesh_axes == {"dp": 2, "tp": 4}
+    assert ctx.dcn_mesh_axes == {"dp": 2}
+    assert JobContext.from_env({}).dcn_mesh_axes == {}

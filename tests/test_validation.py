@@ -84,3 +84,37 @@ def test_negative_replicas_rejected():
     s.replica_specs[ReplicaType.WORKER].replicas = 0
     with pytest.raises(ValidationError, match=">= 1"):
         validate_spec(s)
+
+
+def test_dcn_mesh_axes_validated():
+    s = good_spec()
+    # ici 2x4 * dcn dp=2 = 16 != 8 chips
+    s.topology.dcn_mesh_axes = {"dp": 2}
+    with pytest.raises(ValidationError, match="multiply"):
+        validate_spec(s)
+    # consistent: 2 hosts of 8 chips, ici covers one slice, dcn spans hosts
+    s.topology.num_hosts = 2
+    validate_spec(s)
+
+
+def test_dcn_mesh_axes_reject_ici_only_axes():
+    s = good_spec()
+    s.topology.num_hosts = 2
+    s.topology.dcn_mesh_axes = {"tp": 2}
+    with pytest.raises(ValidationError, match="must stay on ICI"):
+        validate_spec(s)
+
+
+def test_dcn_mesh_axes_reject_bad_size():
+    s = good_spec()
+    s.topology.dcn_mesh_axes = {"dp": 0}
+    with pytest.raises(ValidationError, match="must be >= 1"):
+        validate_spec(s)
+
+
+def test_dcn_mesh_axes_require_explicit_mesh_axes():
+    s = good_spec()
+    s.topology.mesh_axes = {}
+    s.topology.dcn_mesh_axes = {"dp": 2}
+    with pytest.raises(ValidationError, match="requires explicit mesh_axes"):
+        validate_spec(s)

@@ -185,6 +185,11 @@ class TopologySpec:
     # Logical mesh axis sizes over the slice's devices, e.g.
     # {"dp": 2, "fsdp": 2, "tp": 2}. Empty ⇒ pure DP over all chips.
     mesh_axes: Dict[str, int] = field(default_factory=dict)
+    # Multi-slice (cross-DCN) factors per axis: each named axis's total
+    # size becomes mesh_axes[a] * dcn_mesh_axes[a], with the DCN factor as
+    # the axis's outer block (parallel.mesh.build_hybrid_mesh). Keep DCN
+    # factors on dp/pp — tp/cp collectives must stay on ICI.
+    dcn_mesh_axes: Dict[str, int] = field(default_factory=dict)
 
     def total_chips(self) -> int:
         return self.num_hosts * self.chips_per_host

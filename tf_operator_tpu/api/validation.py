@@ -79,15 +79,37 @@ def _validate_topology(spec: TPUJobSpec) -> None:
         raise ValidationError("spec.topology.num_hosts must be >= 1")
     if topo.chips_per_host < 0:
         raise ValidationError("spec.topology.chips_per_host must be >= 0")
+    if topo.dcn_mesh_axes and not topo.mesh_axes:
+        # The "empty mesh_axes => pure DP over all chips" default cannot be
+        # combined with DCN factors (build_hybrid_mesh would default every
+        # ICI axis to 1); require the per-slice mesh to be explicit.
+        raise ValidationError(
+            "spec.topology.dcn_mesh_axes requires explicit mesh_axes "
+            "(the per-slice ICI mesh)"
+        )
+    for axis, size in topo.dcn_mesh_axes.items():
+        if size < 1:
+            raise ValidationError(
+                f"spec.topology.dcn_mesh_axes[{axis!r}] must be >= 1"
+            )
+        if axis in ("tp", "cp"):
+            raise ValidationError(
+                f"spec.topology.dcn_mesh_axes[{axis!r}]: tensor/context axes "
+                "must stay on ICI (put DCN factors on dp/fsdp/pp)"
+            )
     if topo.mesh_axes:
         for axis, size in topo.mesh_axes.items():
             if size < 1:
                 raise ValidationError(f"spec.topology.mesh_axes[{axis!r}] must be >= 1")
         if topo.chips_per_host:
-            mesh_size = math.prod(topo.mesh_axes.values())
+            # With dcn factors, mesh_axes describe the per-slice (ICI) mesh
+            # and the product of both must cover the full topology.
+            mesh_size = math.prod(topo.mesh_axes.values()) * math.prod(
+                topo.dcn_mesh_axes.values() or [1]
+            )
             total = topo.total_chips()
             if mesh_size != total:
                 raise ValidationError(
-                    f"mesh axes {topo.mesh_axes} multiply to {mesh_size} "
-                    f"but topology has {total} chips"
+                    f"mesh axes {topo.mesh_axes} x dcn {topo.dcn_mesh_axes or {}} "
+                    f"multiply to {mesh_size} but topology has {total} chips"
                 )

@@ -72,6 +72,7 @@ from tf_operator_tpu.controller.status import (
 from tf_operator_tpu.controller.workqueue import RateLimitingQueue
 from tf_operator_tpu.rendezvous.env import (
     ENV_COORDINATOR_ADDRESS,
+    ENV_DCN_MESH_AXES,
     ENV_MESH_AXES,
     ENV_NUM_PROCESSES,
     ENV_PROCESS_ID,
@@ -707,6 +708,8 @@ class TPUJobController:
                     ENV_WORKLOAD: json.dumps(job.spec.workload),
                 }
             )
+            if job.spec.topology.dcn_mesh_axes:
+                env[ENV_DCN_MESH_AXES] = json.dumps(job.spec.topology.dcn_mesh_axes)
             chips = rs.template.chips_per_process or job.spec.topology.chips_per_host
             procs.append(
                 Process(

@@ -20,6 +20,7 @@ from tf_operator_tpu.parallel.mesh import (  # noqa: F401
     AXIS_PIPELINE,
     AXIS_TENSOR,
     MeshSpec,
+    build_hybrid_mesh,
     build_mesh,
 )
 from tf_operator_tpu.parallel.sharding import (  # noqa: F401

@@ -92,3 +92,71 @@ def build_mesh(
     names = tuple(a for a, _ in ordered)
     sizes = tuple(s for _, s in ordered)
     return Mesh(devs.reshape(sizes), names)
+
+
+def build_hybrid_mesh(
+    ici_axes: Dict[str, int],
+    dcn_axes: Dict[str, int],
+    devices: Optional[Sequence] = None,
+):
+    """Multi-slice mesh: ``dcn_axes`` span slices (data-center network),
+    ``ici_axes`` stay within a slice (the fast fabric).
+
+    Each logical axis's total size is ``ici * dcn`` for that name (either
+    side defaulting to 1), and the DCN factor is the OUTER (slower-moving)
+    block of the axis — so e.g. ``ici_axes={"dp": 4, "tp": 4},
+    dcn_axes={"dp": 2}`` on 2 slices of 16 chips gives dp=8 where only the
+    outermost dp hop crosses DCN and all tp collectives ride ICI. This is
+    the SURVEY §5 cross-slice contract: intra-slice needs zero config;
+    cross-slice rides DCN and must carry only gradient/AllReduce-class
+    traffic (put dcn factors on dp/pp, never tp/cp).
+
+    On TPU, devices carry ``slice_index`` and placement delegates to
+    jax.experimental.mesh_utils.create_hybrid_device_mesh; elsewhere (the
+    CPU test mesh) contiguous equal blocks of the device list stand in for
+    slices.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    union = dict(dcn_axes)
+    union.update({a: s for a, s in ici_axes.items()})
+    if not union:
+        raise ValueError("hybrid mesh needs at least one axis")
+    names = tuple(a for a, _ in MeshSpec({a: 1 for a in union}).ordered())
+    ici_shape = [int(ici_axes.get(a, 1)) for a in names]
+    dcn_shape = [int(dcn_axes.get(a, 1)) for a in names]
+    per_slice = math.prod(ici_shape)
+    n_slices = math.prod(dcn_shape)
+    if per_slice * n_slices != len(devs):
+        raise ValueError(
+            f"hybrid mesh ici{dict(zip(names, ici_shape))} x "
+            f"dcn{dict(zip(names, dcn_shape))} needs {per_slice * n_slices} "
+            f"devices, have {len(devs)}"
+        )
+
+    slice_ids = {getattr(d, "slice_index", None) for d in devs}
+    has_slice_info = None not in slice_ids
+    if has_slice_info and (len(slice_ids) > 1 or n_slices > 1):
+        if len(slice_ids) != n_slices:
+            # Never fall back silently: a contiguous-block layout here
+            # would put ICI axes across physical slices (tp/cp over DCN).
+            raise ValueError(
+                f"devices span {len(slice_ids)} slices but dcn axes "
+                f"{dict(zip(names, dcn_shape))} declare {n_slices}"
+            )
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_hybrid_device_mesh(ici_shape, dcn_shape, devs)
+    else:
+        # No slice topology info: contiguous blocks as slices. Shape
+        # [d0..dk, i0..ik] -> interleave to [(d0,i0), (d1,i1), ...] so the
+        # dcn factor is the outer block of each logical axis.
+        k = len(names)
+        a = np.asarray(devs).reshape(tuple(dcn_shape) + tuple(ici_shape))
+        perm = [j for i in range(k) for j in (i, i + k)]
+        arr = a.transpose(perm).reshape(
+            [dcn_shape[i] * ici_shape[i] for i in range(k)]
+        )
+    return Mesh(arr, names)

@@ -17,6 +17,7 @@ from typing import Any, Dict
 from tf_operator_tpu.rendezvous.env import (
     ENV_CHIPS,
     ENV_COORDINATOR_ADDRESS,
+    ENV_DCN_MESH_AXES,
     ENV_ENTRYPOINT,
     ENV_JOB_NAME,
     ENV_MESH_AXES,
@@ -45,6 +46,7 @@ class JobContext:
     num_processes: int = 1
     coordinator_address: str = ""
     mesh_axes: Dict[str, int] = field(default_factory=dict)
+    dcn_mesh_axes: Dict[str, int] = field(default_factory=dict)
     workload: Dict[str, Any] = field(default_factory=dict)
     chips: int = 0
     port: int = 0  # rendezvous port (nonzero on the coordinator process)
@@ -62,6 +64,7 @@ class JobContext:
             num_processes=int(e.get(ENV_NUM_PROCESSES, "1") or 1),
             coordinator_address=e.get(ENV_COORDINATOR_ADDRESS, ""),
             mesh_axes=json.loads(e.get(ENV_MESH_AXES, "{}") or "{}"),
+            dcn_mesh_axes=json.loads(e.get(ENV_DCN_MESH_AXES, "{}") or "{}"),
             workload=json.loads(e.get(ENV_WORKLOAD, "{}") or "{}"),
             chips=int(e.get(ENV_CHIPS, "0") or 0),
             port=int(e.get(ENV_PORT, "0") or 0),
@@ -91,11 +94,16 @@ class JobContext:
     def build_mesh(self):
         """Build the jax.sharding.Mesh declared by the job topology over the
         global device set. Empty mesh_axes ⇒ one data-parallel axis over all
-        devices."""
+        devices. With dcn_mesh_axes set, builds a hybrid multi-slice mesh
+        (DCN factors outermost per axis — parallel.mesh.build_hybrid_mesh)."""
         import jax
         import numpy as np
         from jax.sharding import Mesh
 
+        if self.dcn_mesh_axes:
+            from tf_operator_tpu.parallel.mesh import build_hybrid_mesh
+
+            return build_hybrid_mesh(self.mesh_axes, self.dcn_mesh_axes)
         devices = np.asarray(jax.devices())
         axes = self.mesh_axes or {"dp": devices.size}
         names = tuple(axes.keys())

@@ -23,6 +23,7 @@ Rendezvous (computed by the controller, consumed by
 - ``TPUJOB_NUM_PROCESSES``       — total process count in the gang
 - ``TPUJOB_PROCESS_ID``          — this process's rank
 - ``TPUJOB_MESH_AXES``           — JSON {"axis": size, ...} logical mesh
+- ``TPUJOB_DCN_MESH_AXES``       — JSON per-axis cross-slice (DCN) factors
 - ``TPUJOB_WORKLOAD``            — JSON passthrough of spec.workload
 """
 
@@ -45,6 +46,7 @@ ENV_COORDINATOR_ADDRESS = "TPUJOB_COORDINATOR_ADDRESS"
 ENV_NUM_PROCESSES = "TPUJOB_NUM_PROCESSES"
 ENV_PROCESS_ID = "TPUJOB_PROCESS_ID"
 ENV_MESH_AXES = "TPUJOB_MESH_AXES"
+ENV_DCN_MESH_AXES = "TPUJOB_DCN_MESH_AXES"
 ENV_WORKLOAD = "TPUJOB_WORKLOAD"
 
 

@@ -293,6 +293,14 @@ class WorkloadCheckpointer:
             log.info("resumed from checkpoint at step %d", self.start_step)
         return state
 
+    def resume_step(self) -> int:
+        """Latest checkpointed step (0 if none) WITHOUT restoring — lets
+        stream-data workloads skip already-consumed batches (DeviceLoader
+        ``skip``) before entering run_loop."""
+        if self.manager is not None:
+            return self.manager.latest_step() or 0
+        return 0
+
     def is_complete(self, steps: int) -> bool:
         """True when a previous run already trained past the step budget
         (the +1 accounts for the warmup step, which also trains). Peeks at
@@ -347,7 +355,10 @@ class WorkloadCheckpointer:
         ``batch`` is either one fixed batch (re-trained every step: the
         benchmarking shape) or a batch *iterator* — e.g. a
         ``train.data.DeviceLoader`` — pulled once per step. All batches
-        must share one shape/dtype structure (jit compiles once)."""
+        must share one shape/dtype structure (jit compiles once). On
+        restart-based recovery an iterator starts over unless the caller
+        fast-forwards it (``DeviceLoader(skip=resume_step())``) — without
+        that, a resumed run re-trains the stream's leading batches."""
         import math
         import time
 

@@ -119,11 +119,14 @@ def local_loader(
     *,
     min_examples: int = 32,
     prefetch: int = 2,
+    skip: int = 0,
     **dataset_kw: Any,
 ) -> "DeviceLoader":
     """The multi-host stream contract in one place: split ``global_batch``
     across processes (must divide), seed the synthetic dataset by rank so
     shards carry distinct data, and wrap it in a prefetching DeviceLoader.
+    ``skip`` fast-forwards past batches a previous incarnation already
+    trained on (pass the resumed step count on restart-based recovery).
     Used by the lm/resnet workloads' ``data: "stream"`` paths."""
     import jax
 
@@ -139,7 +142,7 @@ def local_loader(
         seed=jax.process_index(),
         **dataset_kw,
     )
-    return DeviceLoader(ds, sharding, prefetch=prefetch)
+    return DeviceLoader(ds, sharding, prefetch=prefetch, skip=skip)
 
 
 class DeviceLoader:
@@ -164,11 +167,15 @@ class DeviceLoader:
         sharding: Any,
         *,
         prefetch: int = 2,
+        skip: int = 0,
         put: Optional[Callable[[Any, Any], Any]] = None,
     ) -> None:
         if prefetch < 1:
             raise ValueError("prefetch must be >= 1")
+        if skip < 0:
+            raise ValueError("skip must be >= 0")
         self.sharding = sharding
+        self._skip = skip
         self._put = put or self._default_put
         self._q: "queue.Queue" = queue.Queue(maxsize=prefetch)
         self._stop = threading.Event()
@@ -197,6 +204,15 @@ class DeviceLoader:
 
     def _stage(self, it: Iterator[Any]) -> None:
         try:
+            # Restart fast-forward: drop already-consumed batches on the
+            # host (no staging cost) so a resumed job continues the stream
+            # where the previous incarnation left off.
+            try:
+                for _ in range(self._skip):
+                    next(it)
+            except StopIteration:
+                self._enqueue_end()
+                return
             for batch in it:
                 if self._stop.is_set():
                     return

@@ -74,10 +74,11 @@ def main(ctx: JobContext) -> None:
         from tf_operator_tpu.train.data import SyntheticTokens, local_loader
 
         # batch_size is GLOBAL; local_loader splits it across processes
-        # with rank-distinct data and prefetches onto the mesh.
+        # with rank-distinct data and prefetches onto the mesh. skip= keeps
+        # a resumed incarnation from replaying batches steps 0..k consumed.
         loader = local_loader(
             SyntheticTokens, batch, trainer.batch_sharding,
-            seq_len=seq, vocab=cfg.vocab,
+            seq_len=seq, vocab=cfg.vocab, skip=ckpt.resume_step(),
         )
         tokens = (b["tokens"] for b in loader)
     else:

@@ -67,10 +67,12 @@ def main(ctx: JobContext) -> None:
         from tf_operator_tpu.train.data import SyntheticImages, local_loader
 
         # batch_size is GLOBAL; local_loader splits it across processes
-        # with rank-distinct data and prefetches onto the mesh.
+        # with rank-distinct data and prefetches onto the mesh. skip= keeps
+        # a resumed incarnation from replaying batches steps 0..k consumed.
         loader = local_loader(
             SyntheticImages, batch, trainer.batch_sharding,
             min_examples=64, image_size=image_size, num_classes=classes,
+            skip=ckpt.resume_step(),
         )
         data = ((b["image"], b["label"]) for b in loader)
     else:
