@@ -218,3 +218,48 @@ def test_workload_checkpointer_is_complete_peeks_without_restore(tmp_path):
     fresh = WorkloadCheckpointer(wl)  # new incarnation, nothing restored
     assert fresh.is_complete(5)  # 6 >= 5 + 1 (warmup step)
     assert not fresh.is_complete(10)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_reader_sees_external_saves_after_reload(tmp_path, sharded_state, backend):
+    """The evaluator pattern: a READER manager constructed before any
+    checkpoint exists must see another manager's saves after reload()
+    (the orbax backend caches its step list at construction)."""
+    mesh, trainer, state, tokens = sharded_state
+    root = tmp_path / backend
+    reader = CheckpointManager(root, backend=backend, readonly=True)
+    writer = CheckpointManager(root, backend=backend)
+    writer.save(2, _clone(state))
+    reader.reload()
+    assert reader.latest_step() == 2
+    writer.save(4, _clone(state))
+    reader.reload()
+    assert reader.latest_step() == 4
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_restore_params_only(tmp_path, sharded_state, backend):
+    mesh, trainer, state, tokens = sharded_state
+    mgr = CheckpointManager(tmp_path / backend, backend=backend)
+    mgr.save(3, _clone(state))
+    params = mgr.restore_params(trainer.state_template().params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(state.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_readonly_manager_refuses_save_and_preserves_tmp_dirs(tmp_path, sharded_state):
+    mesh, trainer, state, tokens = sharded_state
+    root = tmp_path / "ro"
+    root.mkdir()
+    # a live writer's in-flight tmp dir must survive a readonly reader
+    live_tmp = root / ".tmp_step_9_12345"
+    live_tmp.mkdir()
+    ro = CheckpointManager(root, backend="npy", readonly=True)
+    assert live_tmp.exists()
+    with pytest.raises(RuntimeError, match="readonly"):
+        ro.save(1, _clone(state))
+    # a writable manager still sweeps it
+    CheckpointManager(root, backend="npy")
+    assert not live_tmp.exists()

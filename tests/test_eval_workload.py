@@ -1,0 +1,126 @@
+"""Evaluator workload: scores checkpoints from a trainer's directory."""
+
+import logging
+
+import jax
+import pytest
+
+from tf_operator_tpu.rendezvous.context import JobContext
+from tf_operator_tpu.train.checkpoint import CheckpointManager
+from tf_operator_tpu.workloads import eval as eval_wl
+
+
+def _save_checkpoints(tmp_path, steps):
+    """Train the tiny LM for real and save a checkpoint at each step."""
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import (
+        init_transformer, lm_loss, preset, transformer_logical_axes,
+    )
+    from tf_operator_tpu.parallel import build_mesh
+    from tf_operator_tpu.train import Trainer, TrainerConfig
+
+    cfg = preset("tiny", dtype=jnp.float32)
+    mesh = build_mesh({"dp": jax.device_count()})
+    trainer = Trainer(
+        mesh,
+        loss_fn=lambda p, tok, extra: lm_loss(p, tok, cfg, mesh=mesh),
+        init_fn=lambda k: init_transformer(k, cfg),
+        logical_axes=transformer_logical_axes(cfg),
+        config=TrainerConfig(optimizer="adamw", learning_rate=1e-3),
+    )
+    state = trainer.init(jax.random.PRNGKey(0))
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab),
+        trainer.batch_sharding,
+    )
+    manager = CheckpointManager(str(tmp_path))
+    for s in range(1, max(steps) + 1):
+        state, _ = trainer.step(state, tokens)
+        if s in steps:
+            manager.save(s, state)
+    return manager
+
+
+def test_eval_requires_checkpoint_dir():
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        eval_wl.main(JobContext(workload={}))
+
+
+def test_eval_scores_latest_checkpoint(tmp_path, caplog):
+    _save_checkpoints(tmp_path, steps={2, 4})
+    ctx = JobContext(
+        replica_type="Evaluator",
+        workload={
+            "preset": "tiny",
+            "checkpoint_dir": str(tmp_path),
+            "train_steps": 4,
+            "eval_batch_size": 4,
+            "eval_seq_len": 32,
+            "eval_batches": 2,
+            "poll_interval_s": 0.05,
+            "max_wait_s": 30,
+        },
+    )
+    with caplog.at_level(logging.INFO, logger="tpujob.eval"):
+        eval_wl.main(ctx)
+    assert any("checkpoint step=4" in r.getMessage() for r in caplog.records)
+    assert any("eval done" in r.getMessage() for r in caplog.records)
+
+
+def test_eval_times_out_without_checkpoints(tmp_path):
+    ctx = JobContext(
+        workload={
+            "preset": "tiny",
+            "checkpoint_dir": str(tmp_path / "empty"),
+            "poll_interval_s": 0.05,
+            "max_wait_s": 0.3,
+        }
+    )
+    with pytest.raises(TimeoutError, match="no new checkpoint"):
+        eval_wl.main(ctx)
+
+
+def test_eval_concurrent_with_live_writer(tmp_path):
+    """The staleness case the e2e cannot time deterministically: the
+    evaluator starts on an EMPTY directory (its manager caches nothing)
+    and a trainer saves checkpoints while it polls — reload() must make
+    the external saves visible, and the report must appear."""
+    import json
+    import threading
+
+    report = str(tmp_path / "report.json")
+    ctx = JobContext(
+        replica_type="Evaluator",
+        workload={
+            "preset": "tiny",
+            "checkpoint_dir": str(tmp_path),
+            "train_steps": 4,
+            "eval_batch_size": 4,
+            "eval_seq_len": 32,
+            "eval_batches": 1,
+            "poll_interval_s": 0.05,
+            "max_wait_s": 60,
+            "eval_report": report,
+        },
+    )
+    err = []
+
+    def run_eval():
+        try:
+            eval_wl.main(ctx)
+        except BaseException as e:  # surfaced after join
+            err.append(e)
+
+    t = threading.Thread(target=run_eval, daemon=True)
+    t.start()
+    import time
+
+    time.sleep(0.5)  # evaluator is up and polling the empty dir
+    _save_checkpoints(tmp_path, steps={2, 4})
+    t.join(timeout=120)
+    assert not t.is_alive(), "evaluator did not finish"
+    assert not err, err
+    with open(report) as f:
+        scored = json.load(f)
+    assert any(int(s) >= 4 for s in scored)

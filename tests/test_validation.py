@@ -118,3 +118,15 @@ def test_dcn_mesh_axes_require_explicit_mesh_axes():
     s.topology.dcn_mesh_axes = {"dp": 2}
     with pytest.raises(ValidationError, match="requires explicit mesh_axes"):
         validate_spec(s)
+
+
+def test_evaluator_only_job_rejected():
+    s = TPUJobSpec(
+        replica_specs={
+            ReplicaType.EVALUATOR: ReplicaSpec(
+                replicas=1, template=ProcessTemplate(entrypoint="m.mod:fn")
+            )
+        }
+    )
+    with pytest.raises(ValidationError, match="no chief"):
+        validate_spec(s)

@@ -42,6 +42,17 @@ def validate_job(job: TPUJob) -> None:
 def validate_spec(spec: TPUJobSpec) -> None:
     if not spec.replica_specs:
         raise ValidationError("spec.replica_specs must not be empty")
+    if not (
+        ReplicaType.COORDINATOR in spec.replica_specs
+        or ReplicaType.WORKER in spec.replica_specs
+    ):
+        # Job state is chief-driven (coordinator, else worker-0 —
+        # controller_status.go:39-120 semantics); a job with neither would
+        # sit in Created forever, so reject it at admission.
+        raise ValidationError(
+            "spec.replica_specs needs a Coordinator or Worker replica "
+            "(job completion is chief-driven; Evaluator-only jobs have no chief)"
+        )
 
     for rtype, rs in spec.replica_specs.items():
         if not isinstance(rtype, ReplicaType):

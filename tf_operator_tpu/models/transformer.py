@@ -316,3 +316,24 @@ def lm_loss(params, tokens, cfg: TransformerConfig, mesh=None, key=None, mask_ra
 
 def preset(name: str, **overrides) -> TransformerConfig:
     return replace(PRESETS[name], **overrides)
+
+
+# Workload-dict keys accepted as TransformerConfig overrides. ONE set for
+# every role reading the shared spec.workload (trainer lm.py, evaluator
+# eval.py) — duplicated sets would let the roles build different configs
+# from the same dict and fail at checkpoint restore.
+CONFIG_OVERRIDE_FIELDS = frozenset(
+    {
+        "vocab", "d_model", "n_layers", "n_heads", "n_kv_heads", "d_ff",
+        "max_seq", "causal", "remat", "fused_xent",
+    }
+)
+
+
+def preset_from_workload(workload: Dict[str, Any]) -> TransformerConfig:
+    """TransformerConfig from a TPUJob workload dict: ``preset`` plus any
+    CONFIG_OVERRIDE_FIELDS, with ``attn`` mapping to ``attn_impl``."""
+    overrides = {k: workload[k] for k in CONFIG_OVERRIDE_FIELDS if k in workload}
+    if workload.get("attn") in ("ring", "flash", "dense"):
+        overrides["attn_impl"] = workload["attn"]
+    return preset(workload.get("preset", "tiny"), **overrides)

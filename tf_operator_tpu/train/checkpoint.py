@@ -77,9 +77,19 @@ class CheckpointManager:
         backend: "auto" (orbax if importable), "orbax", or "npy".
     """
 
-    def __init__(self, directory: str, keep: int = 3, backend: str = "auto") -> None:
+    def __init__(
+        self,
+        directory: str,
+        keep: int = 3,
+        backend: str = "auto",
+        readonly: bool = False,
+    ) -> None:
+        """``readonly=True`` is for consumers of someone else's checkpoint
+        directory (evaluators): saves are refused and the npy orphan sweep
+        is skipped — a live writer may legitimately own a .tmp dir."""
         self.directory = os.path.abspath(str(directory))
         self.keep = int(keep)
+        self.readonly = bool(readonly)
         os.makedirs(self.directory, exist_ok=True)
         if backend == "auto":
             try:
@@ -90,11 +100,12 @@ class CheckpointManager:
                 backend = "npy"
         self.backend = backend
         self._ocp_mgr = None
-        if backend == "npy":
+        if backend == "npy" and not self.readonly:
             # Sweep partial-save orphans: a crash mid-_npy_save leaves a
             # .tmp_step_* dir that a restarted process (new PID) would
             # otherwise never clean. The npy backend is single-process
-            # (enforced in _npy_save), so nothing live can own these.
+            # (enforced in _npy_save), so nothing live can own these —
+            # except when we are a readonly reader of a live writer's dir.
             for name in os.listdir(self.directory):
                 if name.startswith(".tmp_step_"):
                     shutil.rmtree(os.path.join(self.directory, name), ignore_errors=True)
@@ -125,11 +136,22 @@ class CheckpointManager:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
+    def reload(self) -> None:
+        """Re-scan the directory for checkpoints written by ANOTHER
+        process. The orbax manager caches its step list at construction,
+        so a polling reader (the evaluator) must reload before
+        latest_step() or it never sees new saves; npy scans the
+        filesystem every call and needs nothing."""
+        if self._ocp_mgr is not None:
+            self._ocp_mgr.reload()
+
     # ---- save -----------------------------------------------------------
 
     def save(self, step: int, state: Any) -> bool:
         """Save ``state`` (TrainState or pytree) at ``step``. Returns True
         if written (False when this step already exists)."""
+        if self.readonly:
+            raise RuntimeError("CheckpointManager is readonly; refusing to save")
         step = int(step)
         tree = _to_tree(state)
         if self._ocp_mgr is not None:
@@ -206,7 +228,44 @@ class CheckpointManager:
             return _from_tree(restored, template)
         return _from_tree(self._npy_restore(int(step), tmpl_tree), template)
 
-    def _npy_restore(self, step: int, tmpl_tree: Any) -> Any:
+    def restore_params(self, template_params: Any, step: Optional[int] = None) -> Any:
+        """Restore ONLY the params subtree of a TrainState checkpoint —
+        what an evaluator needs. Skips the optimizer moments (2 extra
+        param-sized trees under adamw), so restore I/O and device memory
+        are ~1/3 of a full-state restore."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        wrapped = {"params": template_params}
+        if self._ocp_mgr is not None:
+            abstract = _abstractify(wrapped)
+            # Ephemeral manager: an instance that has done a StandardSave
+            # pins its handler registry to the Standard handler and then
+            # rejects PyTreeRestore args (and vice versa) — a fresh
+            # instance resolves the handler from the restore args.
+            mgr = self._ocp.CheckpointManager(self.directory)
+            try:
+                restored = mgr.restore(
+                    int(step),
+                    args=self._ocp.args.PyTreeRestore(
+                        item=abstract,
+                        # explicit restore_args: without them PyTreeRestore
+                        # lays arrays out with the sharding recorded at save
+                        # time, not the template's (evaluator mesh != trainer
+                        # mesh is the normal case)
+                        restore_args=self._ocp.checkpoint_utils.construct_restore_args(
+                            abstract
+                        ),
+                        partial_restore=True,
+                    ),
+                )
+            finally:
+                mgr.close()
+            return restored["params"]
+        return self._npy_restore(int(step), wrapped, subtree="params")["params"]
+
+    def _npy_restore(self, step: int, tmpl_tree: Any, subtree: Optional[str] = None) -> Any:
         import jax
         import numpy as np
 
@@ -216,11 +275,16 @@ class CheckpointManager:
             raise FileNotFoundError(f"no checkpoint at step {step} under {self.directory}")
         with open(manifest_path) as f:
             manifest = json.load(f)
+        records = manifest["leaves"]
+        if subtree is not None:
+            # Partial restore: only the saved leaves under this top-level
+            # key (their leaf_{index}.npy files carry the full-tree index).
+            records = [r for r in records if r["path"].startswith(f"['{subtree}']")]
         paths, treedef = jax.tree_util.tree_flatten_with_path(tmpl_tree)
-        saved_paths = [leaf["path"] for leaf in manifest["leaves"]]
+        saved_paths = [leaf["path"] for leaf in records]
         tmpl_paths = [jax.tree_util.keystr(p) for p, _ in paths]
         if saved_paths != tmpl_paths:
-            # Pairing saved leaf_{i} files with template leaves is by
+            # Pairing saved leaf files with template leaves is by
             # flatten order; a structure drift (optimizer/model config
             # changed between save and restore) would silently load
             # weights into the wrong slots.
@@ -230,9 +294,8 @@ class CheckpointManager:
                 f"template (differing leaves: {sorted(missing)[:6] or 'order'})"
             )
         arrays = []
-        for i, (path, tmpl_leaf) in enumerate(paths):
-            arr = np.load(os.path.join(d, f"leaf_{i}.npy"))
-            rec = manifest["leaves"][i]
+        for (path, tmpl_leaf), rec in zip(paths, records):
+            arr = np.load(os.path.join(d, f"leaf_{rec['index']}.npy"))
             if "shape" in rec:
                 # Path equality alone misses same-structure config drift
                 # (d_model or dtype changed between save and restore) —

@@ -1,0 +1,132 @@
+"""Evaluator workload: score checkpoints as training produces them.
+
+The reference defines the Evaluator replica role but gives it no behavior
+— it is just a pod excluded from the cluster spec
+(/root/reference/pkg/apis/tensorflow/v1alpha2/types.go:105-112,
+controller_tensorflow.go:91-95); what an evaluator *does* lives in user
+code. Here it is library code: run as the Evaluator replica of an LM
+TPUJob (or as a standalone job) pointed at the trainer's
+``checkpoint_dir``; it polls for new checkpoints, restores the params onto
+its own mesh, and logs eval loss per checkpoint step. The evaluator is
+excluded from the training gang, so it needs no rendezvous with the
+trainers — the checkpoint directory IS the interface, exactly the
+coupling the reference's design doc prescribes for the data plane.
+
+workload config keys: preset (+ TransformerConfig overrides, as lm.py),
+checkpoint_dir (required), eval_batch_size, eval_seq_len, eval_batches,
+poll_interval_s, train_steps (stop once a checkpoint >= this step is
+scored; otherwise score the first checkpoint seen and every newer one
+until then), max_wait_s (give up if nothing new appears), eval_report
+(path: per-checkpoint losses written as JSON — the scored artifact other
+tooling and the e2e oracle read).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+from tf_operator_tpu.rendezvous.context import JobContext
+
+log = logging.getLogger("tpujob.eval")
+
+
+def main(ctx: JobContext) -> None:
+    # Evaluators are outside the gang: single-process jax, no rendezvous.
+    import jax
+
+    from tf_operator_tpu.models.transformer import (
+        init_transformer,
+        lm_loss,
+        preset_from_workload,
+        transformer_logical_axes,
+    )
+    from tf_operator_tpu.parallel import build_mesh
+    from tf_operator_tpu.train.checkpoint import CheckpointManager
+    from tf_operator_tpu.train.trainer import Trainer, TrainerConfig
+
+    wl = ctx.workload
+    ckpt_dir = wl.get("checkpoint_dir")
+    if not ckpt_dir:
+        raise ValueError("eval workload requires workload.checkpoint_dir")
+    cfg = preset_from_workload(wl)
+    batch = int(wl.get("eval_batch_size", 8))
+    seq = int(wl.get("eval_seq_len", min(cfg.max_seq, 512)))
+    n_batches = max(1, int(wl.get("eval_batches", 4)))
+    poll_s = float(wl.get("poll_interval_s", 2.0))
+    train_steps = int(wl.get("train_steps", 0))
+    max_wait_s = float(wl.get("max_wait_s", 600.0))
+
+    # dp must divide the eval batch; gcd keeps any batch size valid on any
+    # device count (spare devices idle — eval is cheap and off the gang).
+    import math
+
+    dp = math.gcd(batch, jax.device_count())
+    mesh = build_mesh({"dp": dp}, devices=jax.devices()[:dp])
+    trainer = Trainer(
+        mesh,
+        loss_fn=lambda p, tok, extra: lm_loss(p, tok, cfg, mesh=mesh),
+        init_fn=lambda k: init_transformer(k, cfg),
+        logical_axes=transformer_logical_axes(cfg),
+        config=TrainerConfig(),
+    )
+    # readonly: never sweep a live trainer's tmp dirs, never save.
+    manager = CheckpointManager(ckpt_dir, readonly=True)
+    report_path = wl.get("eval_report")
+
+    # Held-out batches: a seed stream disjoint from the trainers' (they
+    # seed data by process rank; 10_000+ is reserved for eval).
+    eval_batches = [
+        jax.device_put(
+            jax.random.randint(
+                jax.random.PRNGKey(10_000 + i), (batch, seq), 0, cfg.vocab
+            ),
+            trainer.batch_sharding,
+        )
+        for i in range(n_batches)
+    ]
+
+    eval_fn = jax.jit(lambda params, tok: lm_loss(params, tok, cfg, mesh=mesh))
+
+    def write_report(scored):
+        if not report_path:
+            return
+        tmp = report_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({str(k): v for k, v in sorted(scored.items())}, f)
+        os.replace(tmp, report_path)  # atomic: readers never see a partial file
+
+    scored: dict = {}
+    deadline = time.time() + max_wait_s
+    while True:
+        # The orbax manager caches its step list at construction; reload()
+        # re-scans so the trainers' new saves become visible.
+        manager.reload()
+        step = manager.latest_step()
+        if step is not None and step not in scored:
+            params = manager.restore_params(
+                trainer.state_template().params, step=step
+            )
+            losses = [float(eval_fn(params, tok)) for tok in eval_batches]
+            scored[step] = sum(losses) / len(losses)
+            log.info(
+                "eval: checkpoint step=%d loss=%.4f (%d batches of %dx%d)",
+                step, scored[step], n_batches, batch, seq,
+            )
+            write_report(scored)
+            deadline = time.time() + max_wait_s  # progress resets the clock
+            if train_steps and step >= train_steps:
+                break
+            if not train_steps:
+                break  # one-shot mode: score the latest and exit
+        if time.time() > deadline:
+            raise TimeoutError(
+                f"no new checkpoint under {ckpt_dir} within {max_wait_s}s "
+                f"(scored: {sorted(scored)})"
+            )
+        time.sleep(poll_s)
+
+    best = min(scored.values())
+    log.info("eval done: %d checkpoints scored, best loss %.4f", len(scored), best)

@@ -20,12 +20,6 @@ from tf_operator_tpu.rendezvous.context import JobContext, RetryableFailure
 
 log = logging.getLogger("tpujob.lm")
 
-_CFG_FIELDS = {
-    "vocab", "d_model", "n_layers", "n_heads", "n_kv_heads", "d_ff",
-    "max_seq", "causal", "remat", "fused_xent",
-}
-
-
 def main(ctx: JobContext) -> None:
     ctx.initialize_distributed()
 
@@ -34,7 +28,7 @@ def main(ctx: JobContext) -> None:
     from tf_operator_tpu.models.transformer import (
         init_transformer,
         lm_loss,
-        preset,
+        preset_from_workload,
         transformer_logical_axes,
     )
     from tf_operator_tpu.train.metrics import mfu, transformer_train_flops
@@ -44,10 +38,7 @@ def main(ctx: JobContext) -> None:
     steps = max(2, int(wl.get("steps", 10)))
     batch = int(wl.get("batch_size", 8))
     seq = int(wl.get("seq_len", 512))
-    overrides = {k: wl[k] for k in _CFG_FIELDS if k in wl}
-    if wl.get("attn") in ("ring", "flash", "dense"):
-        overrides["attn_impl"] = wl["attn"]
-    cfg = preset(wl.get("preset", "tiny"), **overrides)
+    cfg = preset_from_workload(wl)
     mesh = ctx.build_mesh()
 
     def loss_fn(params, tokens, extra):
