@@ -127,7 +127,8 @@ def bench_lm(model: str) -> None:
 
     params = cfg.n_params()
     tokens_per_step = batch * seq
-    flops = transformer_train_flops(params, tokens_per_step)
+    # active params: for top-1 MoE only one expert's FLOPs count per token
+    flops = transformer_train_flops(cfg.n_active_params(), tokens_per_step)
     achieved = mfu(flops, step_s, n_chips)
     print(
         json.dumps(

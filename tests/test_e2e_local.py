@@ -396,3 +396,38 @@ def test_eval_scoring_job_over_existing_checkpoints(rig, tmp_path):
     assert ok, f"conditions: {[(c.type.value, c.reason, c.message) for c in st.conditions]}"
     with open(report) as f:
         assert "2" in json.load(f)
+
+
+def test_moe_expert_parallel_gang(rig):
+    """Expert parallelism through the FULL stack: a 2-process gang builds
+    an ep-axis mesh spanning the processes and trains the MoE transformer
+    — expert dispatch all-to-alls crossing process boundaries via gloo."""
+    store = rig
+    job = TPUJob(
+        metadata=ObjectMeta(name="moe-ep"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=2,
+                    template=ProcessTemplate(
+                        entrypoint="tf_operator_tpu.workloads.lm:main",
+                        env=dict(DATAPLANE_ENV),
+                    ),
+                )
+            },
+        ),
+    )
+    job.spec.topology.mesh_axes = {"ep": 2}
+    job.spec.workload = {
+        "preset": "tiny-moe",
+        "steps": 3,
+        "batch_size": 4,
+        "seq_len": 32,
+    }
+    store.create(job)
+    ok = wait_for(
+        lambda: has_condition(job_status(store, "moe-ep"), ConditionType.SUCCEEDED),
+        timeout=240,
+    )
+    st = job_status(store, "moe-ep")
+    assert ok, f"conditions: {[(c.type.value, c.reason, c.message) for c in st.conditions]}"

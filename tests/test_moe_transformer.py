@@ -1,0 +1,145 @@
+"""MoE transformer: Switch-style expert MLP as a model-family variant
+(routing math in parallel/moe.py; here its integration into the
+transformer — params, logical axes, layer body, trainer, ep sharding)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tf_operator_tpu.models.transformer import (
+    init_transformer,
+    lm_loss,
+    preset,
+    transformer_forward,
+    transformer_logical_axes,
+)
+from tf_operator_tpu.parallel import build_mesh
+from tf_operator_tpu.train import Trainer, TrainerConfig
+
+
+def tokens(batch=4, seq=32, vocab=256, seed=0):
+    return jax.random.randint(jax.random.PRNGKey(seed), (batch, seq), 0, vocab)
+
+
+def test_moe_forward_shape_and_finite():
+    cfg = preset("tiny-moe", dtype=jnp.float32)
+    params = init_transformer(jax.random.PRNGKey(0), cfg)
+    logits = transformer_forward(params, tokens(), cfg)
+    assert logits.shape == (4, 32, cfg.vocab)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_moe_param_and_axes_trees_match():
+    cfg = preset("tiny-moe", dtype=jnp.float32)
+    params = init_transformer(jax.random.PRNGKey(0), cfg)
+    axes = transformer_logical_axes(cfg)
+    checked = jax.tree_util.tree_map(
+        lambda p, a: p.ndim == len(a), params, axes,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            isinstance(e, (str, type(None))) for e in x
+        ),
+    )
+    assert all(jax.tree_util.tree_leaves(checked))
+    assert params["layers"]["w_gate"].shape == (2, 4, 64, 128)  # [L, E, d, f]
+
+
+def test_single_expert_matches_dense_mlp():
+    """n_experts=1 with capacity >= tokens is mathematically the dense
+    model (softmax over one expert = weight 1.0, nothing dropped): exact
+    layer-parity check of the whole forward."""
+    dense_cfg = preset("tiny", dtype=jnp.float32, remat=False)
+    moe_cfg = preset(
+        "tiny", dtype=jnp.float32, remat=False, n_experts=1, capacity_factor=1.0
+    )
+    moe_params = init_transformer(jax.random.PRNGKey(0), moe_cfg)
+    # dense params = expert 0's weights (drop the router, squeeze E dim)
+    dense_params = jax.tree_util.tree_map(lambda a: a, moe_params)
+    layers = dict(dense_params["layers"])
+    layers.pop("w_router")
+    for k in ("w_gate", "w_up", "w_down"):
+        layers[k] = layers[k][:, 0]
+    dense_params["layers"] = layers
+
+    tok = tokens()
+    got = transformer_forward(moe_params, tok, moe_cfg)
+    want = transformer_forward(dense_params, tok, dense_cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_moe_n_params_accounting():
+    cfg = preset("tiny-moe")
+    dense = preset("tiny")
+    assert cfg.n_params() > dense.n_params()
+    assert cfg.n_active_params() < cfg.n_params()
+    # active ≈ dense + routers
+    routers = cfg.n_layers * cfg.d_model * cfg.n_experts
+    assert cfg.n_active_params() == dense.n_params() + routers
+
+
+def test_moe_trains_over_ep_mesh():
+    """Sharded training with experts over ep and batch over dp: the
+    all-to-all dispatch path through the full Trainer."""
+    cfg = preset("tiny-moe", dtype=jnp.float32)
+    mesh = build_mesh({"dp": 2, "ep": 4})
+    trainer = Trainer(
+        mesh,
+        loss_fn=lambda p, tok, extra: lm_loss(p, tok, cfg, mesh=mesh),
+        init_fn=lambda k: init_transformer(k, cfg),
+        logical_axes=transformer_logical_axes(cfg),
+        config=TrainerConfig(optimizer="adamw", learning_rate=1e-3),
+    )
+    state = trainer.init(jax.random.PRNGKey(0))
+    # expert weights must actually shard over ep
+    w_gate = state.params["layers"]["w_gate"]
+    assert "ep" in {
+        ax for axes in w_gate.sharding.spec if axes for ax in (
+            axes if isinstance(axes, tuple) else (axes,)
+        )
+    }
+    tok = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab),
+        trainer.batch_sharding,
+    )
+    losses = []
+    for _ in range(4):
+        state, metrics = trainer.step(state, tok)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_moe_via_workload_config():
+    from tf_operator_tpu.models.transformer import preset_from_workload
+
+    cfg = preset_from_workload({"preset": "tiny", "n_experts": 4})
+    assert cfg.n_experts == 4
+
+
+def test_dropped_tokens_leave_residual_untouched():
+    """Switch rule in the model: a capacity-dropped token's layer output
+    must be x + attention only — NOT x + attention + rms_norm(x) (the bug
+    mode where moe passthrough leaks the normed hidden into the residual).
+    With zero expert+router weights and capacity for only some tokens,
+    every token — kept (expert output 0) or dropped — must match a model
+    whose MoE contributes nothing."""
+    cfg = preset(
+        "tiny", dtype=jnp.float32, remat=False, n_experts=1, capacity_factor=1e-9
+    )
+    params = init_transformer(jax.random.PRNGKey(0), cfg)
+    zeroed = dict(params)
+    layers = dict(params["layers"])
+    for k in ("w_router", "w_gate", "w_up", "w_down"):
+        layers[k] = jnp.zeros_like(layers[k])
+    zeroed["layers"] = layers
+
+    tok = tokens()
+    got = transformer_forward(zeroed, tok, cfg)
+
+    # reference: same weights with capacity covering every token — all kept,
+    # expert output 0, so MoE contributes exactly 0 everywhere
+    cfg_all = preset(
+        "tiny", dtype=jnp.float32, remat=False, n_experts=1, capacity_factor=10.0
+    )
+    want = transformer_forward(zeroed, tok, cfg_all)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6)

@@ -268,3 +268,20 @@ def test_hybrid_mesh_slice_count_mismatch_raises():
     devs = [FakeDev(i, i // 2) for i in range(8)]  # 4 slices of 2
     with pytest.raises(ValueError, match="span 4 slices"):
         build_hybrid_mesh({"tp": 4}, {"dp": 2}, devices=devs)
+
+
+def test_moe_capacity_drop_zero_mode():
+    """dropped="zero": overflowed tokens contribute NOTHING (the residual
+    -stream contract the transformer's MoE MLP uses) — with zero-weight
+    experts every output row is exactly 0, kept and dropped alike."""
+    n_experts, d, tokens = 8, 4, 16
+    mesh = build_mesh({"ep": 8})
+    x = jax.random.normal(jax.random.PRNGKey(7), (tokens, d))
+    gate_logits = jnp.zeros((tokens, n_experts)).at[:, 0].set(100.0)
+    w = jnp.zeros((n_experts, d, d))
+
+    out = moe_apply(
+        x, gate_logits, w, lambda p, t: t @ p, mesh,
+        capacity_factor=0.01, dropped="zero",
+    )
+    np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-6)

@@ -52,6 +52,13 @@ class TransformerConfig:
     # Blockwise fused loss (ops/fused_cross_entropy): logits never hit HBM
     # as a [b,t,vocab] f32 array. Same math as the unfused path.
     fused_xent: bool = True
+    # Mixture-of-experts MLP (Switch-style top-1, parallel.moe): 0 = dense.
+    # Experts shard over the ep mesh axis (all-to-all dispatch); without an
+    # ep axis all experts run on every device (the routing math is
+    # identical, so one config tests on CPU and scales on a pod).
+    n_experts: int = 0
+    capacity_factor: float = 2.0
+    ep_axis: str = "ep"
 
     @property
     def head_dim(self) -> int:
@@ -61,8 +68,20 @@ class TransformerConfig:
         """Parameter count (for MFU accounting)."""
         d, f, v, L = self.d_model, self.d_ff, self.vocab, self.n_layers
         kv = self.n_kv_heads * self.head_dim
-        per_layer = d * d + 2 * d * kv + d * d + 3 * d * f + 2 * d  # qkv+o+swiglu+norms
+        mlp = 3 * d * f
+        if self.n_experts:
+            mlp = self.n_experts * mlp + d * self.n_experts  # experts + router
+        per_layer = d * d + 2 * d * kv + d * d + mlp + 2 * d  # qkv+o+mlp+norms
         return v * d + L * per_layer + d  # embed + layers + final norm
+
+    def n_active_params(self) -> int:
+        """Params touched per token (= n_params for dense; top-1 MoE
+        activates one expert) — the right N for 6ND FLOP accounting."""
+        if not self.n_experts:
+            return self.n_params()
+        d, f, L = self.d_model, self.d_ff, self.n_layers
+        inactive = (self.n_experts - 1) * 3 * d * f
+        return self.n_params() - L * inactive
 
 
 PRESETS: Dict[str, TransformerConfig] = {
@@ -71,9 +90,19 @@ PRESETS: Dict[str, TransformerConfig] = {
         vocab=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=128,
         max_seq=128, remat=False,
     ),
+    "tiny-moe": TransformerConfig(
+        vocab=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=128,
+        max_seq=128, remat=False, n_experts=4,
+    ),
     "gpt-small": TransformerConfig(
         vocab=50257, d_model=768, n_layers=12, n_heads=12, n_kv_heads=12, d_ff=3072,
         max_seq=1024,
+    ),
+    # Mixtral-class sparse config (8 experts, top-1 routing): total params
+    # ~8x the dense MLP stack, active params per token ~ the dense model.
+    "moe-small": TransformerConfig(
+        vocab=32000, d_model=768, n_layers=12, n_heads=12, n_kv_heads=12, d_ff=3072,
+        max_seq=1024, n_experts=8,
     ),
     # BERT-base as bidirectional encoder (MLM-style head)
     "bert-base": TransformerConfig(
@@ -111,42 +140,72 @@ def init_transformer(key, cfg: TransformerConfig) -> Dict[str, Any]:
     def dense_init(k, fan_in, *shape):
         return jax.random.normal(k, shape, jnp.float32) * (fan_in**-0.5)
 
-    ks = jax.random.split(k_layers, 7)
+    ks = jax.random.split(k_layers, 8)
+    layers = {
+        "attn_norm": jnp.ones((L, d), jnp.float32),
+        "wq": dense_init(ks[0], d, L, d, nh * hd),
+        "wk": dense_init(ks[1], d, L, d, nkv * hd),
+        "wv": dense_init(ks[2], d, L, d, nkv * hd),
+        "wo": dense_init(ks[3], nh * hd, L, nh * hd, d),
+        "mlp_norm": jnp.ones((L, d), jnp.float32),
+    }
+    if cfg.n_experts:
+        E = cfg.n_experts
+        layers.update(
+            {
+                "w_router": dense_init(ks[7], d, L, d, E),
+                "w_gate": dense_init(ks[4], d, L, E, d, f),
+                "w_up": dense_init(ks[5], d, L, E, d, f),
+                "w_down": dense_init(ks[6], f, L, E, f, d),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "w_gate": dense_init(ks[4], d, L, d, f),
+                "w_up": dense_init(ks[5], d, L, d, f),
+                "w_down": dense_init(ks[6], f, L, f, d),
+            }
+        )
     params = {
         "embed": jax.random.normal(k_embed, (cfg.vocab, d), jnp.float32) * 0.02,
         "final_norm": jnp.ones((d,), jnp.float32),
-        "layers": {
-            "attn_norm": jnp.ones((L, d), jnp.float32),
-            "wq": dense_init(ks[0], d, L, d, nh * hd),
-            "wk": dense_init(ks[1], d, L, d, nkv * hd),
-            "wv": dense_init(ks[2], d, L, d, nkv * hd),
-            "wo": dense_init(ks[3], nh * hd, L, nh * hd, d),
-            "mlp_norm": jnp.ones((L, d), jnp.float32),
-            "w_gate": dense_init(ks[4], d, L, d, f),
-            "w_up": dense_init(ks[5], d, L, d, f),
-            "w_down": dense_init(ks[6], f, L, f, d),
-        },
+        "layers": layers,
     }
     return params
 
 
 def transformer_logical_axes(cfg: TransformerConfig) -> Dict[str, Any]:
     """Logical axis names per param leaf (same tree structure as params)."""
-    del cfg
+    layers = {
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv_heads"),
+        "wv": ("layers", "embed", "kv_heads"),
+        "wo": ("layers", "heads", "embed"),
+        "mlp_norm": ("layers", "embed"),
+    }
+    if cfg.n_experts:
+        layers.update(
+            {
+                "w_router": ("layers", "embed", "expert"),
+                "w_gate": ("layers", "expert", "embed", "mlp"),
+                "w_up": ("layers", "expert", "embed", "mlp"),
+                "w_down": ("layers", "expert", "mlp", "embed"),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "w_gate": ("layers", "embed", "mlp"),
+                "w_up": ("layers", "embed", "mlp"),
+                "w_down": ("layers", "mlp", "embed"),
+            }
+        )
     return {
         "embed": ("vocab", "embed"),
         "final_norm": ("embed",),
-        "layers": {
-            "attn_norm": ("layers", "embed"),
-            "wq": ("layers", "embed", "heads"),
-            "wk": ("layers", "embed", "kv_heads"),
-            "wv": ("layers", "embed", "kv_heads"),
-            "wo": ("layers", "heads", "embed"),
-            "mlp_norm": ("layers", "embed"),
-            "w_gate": ("layers", "embed", "mlp"),
-            "w_up": ("layers", "embed", "mlp"),
-            "w_down": ("layers", "mlp", "embed"),
-        },
+        "layers": layers,
     }
 
 
@@ -235,10 +294,47 @@ def _layer(x, layer_params, cfg: TransformerConfig, mesh):
     x = x + attn @ layer_params["wo"].astype(x.dtype)
 
     h = _rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps)
+    if cfg.n_experts:
+        x = x + _moe_mlp(h, layer_params, cfg, mesh)
+        return x
     gate = jax.nn.silu(h @ layer_params["w_gate"].astype(x.dtype))
     up = h @ layer_params["w_up"].astype(x.dtype)
     x = x + (gate * up) @ layer_params["w_down"].astype(x.dtype)
     return x
+
+
+def _moe_mlp(h, layer_params, cfg: TransformerConfig, mesh):
+    """Switch-style top-1 expert MLP: router -> all-to-all dispatch over
+    the ep axis (parallel.moe) -> per-expert SwiGLU -> weighted combine."""
+    from tf_operator_tpu.parallel.moe import moe_apply
+
+    b, t, d = h.shape
+    flat = h.reshape(b * t, d)
+    gate_logits = flat @ layer_params["w_router"].astype(h.dtype)
+
+    def expert_fn(wp, toks):
+        gate = jax.nn.silu(toks @ wp["w_gate"].astype(toks.dtype))
+        up = toks @ wp["w_up"].astype(toks.dtype)
+        return (gate * up) @ wp["w_down"].astype(toks.dtype)
+
+    expert_params = {
+        "w_gate": layer_params["w_gate"],
+        "w_up": layer_params["w_up"],
+        "w_down": layer_params["w_down"],
+    }
+    out = moe_apply(
+        flat,
+        gate_logits,
+        expert_params,
+        expert_fn,
+        mesh,
+        axis_name=cfg.ep_axis,
+        capacity_factor=cfg.capacity_factor,
+        # the result feeds a residual add: a capacity-dropped token's MLP
+        # must contribute 0, not its own input again
+        dropped="zero",
+    )
+    return out.reshape(b, t, d)
 
 
 def transformer_hidden(params, tokens, cfg: TransformerConfig, mesh=None):
@@ -325,7 +421,8 @@ def preset(name: str, **overrides) -> TransformerConfig:
 CONFIG_OVERRIDE_FIELDS = frozenset(
     {
         "vocab", "d_model", "n_layers", "n_heads", "n_kv_heads", "d_ff",
-        "max_seq", "causal", "remat", "fused_xent",
+        "max_seq", "causal", "remat", "fused_xent", "n_experts",
+        "capacity_factor",
     }
 )
 

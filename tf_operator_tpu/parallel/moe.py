@@ -3,8 +3,9 @@
 Experts are sharded over the ``ep`` mesh axis; tokens are routed by a gating
 network, dispatched to their expert's device with ``all_to_all`` (ragged
 traffic rides ICI), processed, and combined back weighted by the gate
-probability. Capacity-factor dropping keeps shapes static for XLA (tokens
-over capacity are passed through unchanged).
+probability. Capacity-factor dropping keeps shapes static for XLA; what a
+dropped token yields is the caller's choice (``dropped=`` — passthrough
+for standalone use, zero when feeding a residual stream).
 
 New TPU-native surface (reference has no MoE support, SURVEY.md §2.3).
 """
@@ -21,18 +22,15 @@ from jax.sharding import PartitionSpec as P
 from tf_operator_tpu.parallel.collectives import axis_size
 
 
-def _moe_local(x, gate_logits, expert_params, expert_fn, axis_name: str, capacity: int):
-    """Per-device body. x: [tokens_local, d]; gate_logits: [tokens_local, E];
-    expert_params: this device's experts (leading dim E_local)."""
-    n_shards = axis_size(axis_name)
-    tokens, d = x.shape
-    n_experts = gate_logits.shape[-1]
-    experts_per_shard = n_experts // n_shards
-
+def _route(x, gate_logits, capacity: int):
+    """Top-1 routing bookkeeping shared by the sharded and single-device
+    paths. Returns (dispatch [T,E,C], keep [T], gate_weight [T],
+    inbox [E,C,d])."""
     gate_probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
     expert_idx = jnp.argmax(gate_probs, axis=-1)  # [tokens]
     gate_weight = jnp.take_along_axis(gate_probs, expert_idx[:, None], axis=-1)[:, 0]
 
+    n_experts = gate_logits.shape[-1]
     # Position of each token within its expert's queue; beyond capacity drops.
     onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.int32)  # [T, E]
     pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1) * onehot  # [T, E]
@@ -48,6 +46,52 @@ def _moe_local(x, gate_logits, expert_params, expert_fn, axis_name: str, capacit
     )  # [T, E, C]
     # Expert inboxes from local tokens: [E, C, d]
     inbox = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    return dispatch, keep, gate_weight, inbox
+
+
+def _dropped_value(x, dropped: str):
+    """What capacity-dropped tokens contribute: their input unchanged
+    ("passthrough" — moe_apply as a standalone transform) or nothing
+    ("zero" — moe_apply as the MLP branch of a residual stream, the
+    Switch-Transformer rule: an overflowed token's MLP contributes 0)."""
+    if dropped == "passthrough":
+        return x.astype(jnp.float32)
+    if dropped == "zero":
+        return jnp.zeros_like(x, jnp.float32)
+    raise ValueError(f"unknown dropped mode {dropped!r}")
+
+
+def _moe_single(x, gate_logits, expert_params, expert_fn, capacity: int, dropped: str):
+    """All experts on one device: same routing math, no collectives — the
+    fallback when the mesh has no ep axis (or no mesh at all)."""
+    tokens, d = x.shape
+    n_experts = gate_logits.shape[-1]
+    dispatch, keep, gate_weight, inbox = _route(x, gate_logits, capacity)
+
+    def run_expert(e, acc):
+        params_e = jax.tree_util.tree_map(lambda a: a[e], expert_params)
+        out = expert_fn(params_e, inbox[e].astype(x.dtype)).astype(jnp.float32)
+        return acc.at[e].set(out)
+
+    outbox = jnp.zeros((n_experts, capacity, d), jnp.float32)
+    outbox = jax.lax.fori_loop(0, n_experts, run_expert, outbox)
+    combined = jnp.einsum("tec,ecd->td", dispatch, outbox)
+    out = jnp.where(
+        keep[:, None], combined * gate_weight[:, None], _dropped_value(x, dropped)
+    )
+    return out.astype(x.dtype)
+
+
+def _moe_local(x, gate_logits, expert_params, expert_fn, axis_name: str, capacity: int,
+               dropped: str):
+    """Per-device body. x: [tokens_local, d]; gate_logits: [tokens_local, E];
+    expert_params: this device's experts (leading dim E_local)."""
+    n_shards = axis_size(axis_name)
+    tokens, d = x.shape
+    n_experts = gate_logits.shape[-1]
+    experts_per_shard = n_experts // n_shards
+
+    dispatch, keep, gate_weight, inbox = _route(x, gate_logits, capacity)
 
     # all_to_all: regroup so each shard holds inboxes for ITS experts from
     # every shard: [E, C, d] -> [E_local * n_shards, C, d] where the leading
@@ -71,10 +115,10 @@ def _moe_local(x, gate_logits, expert_params, expert_fn, axis_name: str, capacit
     outbox = jax.lax.all_to_all(outbox, axis_name, split_axis=0, concat_axis=0, tiled=False)
     outbox = outbox.reshape(n_experts, capacity, d)
 
-    # Combine: weight by gate prob; dropped tokens pass through unchanged.
+    # Combine: weight by gate prob; dropped tokens per the dropped mode.
     combined = jnp.einsum("tec,ecd->td", dispatch, outbox)
     out = jnp.where(
-        keep[:, None], combined * gate_weight[:, None], x.astype(jnp.float32)
+        keep[:, None], combined * gate_weight[:, None], _dropped_value(x, dropped)
     )
     return out.astype(x.dtype)
 
@@ -87,6 +131,7 @@ def moe_apply(
     mesh,
     axis_name: str = "ep",
     capacity_factor: float = 2.0,
+    dropped: str = "passthrough",
 ):
     """Top-1 MoE layer with experts sharded over ``axis_name``.
 
@@ -94,21 +139,31 @@ def moe_apply(
     routes its own token slice and the all_to_all exchanges (token-shard ×
     expert-shard) traffic, so every expert processes distinct tokens from
     every source shard. expert_params: pytree with leading dim n_experts.
+    ``dropped`` picks what capacity-overflowed tokens yield: their input
+    ("passthrough", standalone-transform default) or 0 ("zero" — required
+    when the caller adds the result to a residual stream, else a dropped
+    token gains its own input twice).
     """
     from jax import shard_map
 
     n_experts = gate_logits.shape[-1]
+    tokens = x.shape[0]
+    if mesh is None or axis_name not in getattr(mesh, "axis_names", ()) or (
+        mesh.shape[axis_name] == 1
+    ):
+        capacity = max(1, int(capacity_factor * tokens / n_experts))
+        return _moe_single(x, gate_logits, expert_params, expert_fn, capacity, dropped)
     ep = mesh.shape[axis_name]
     if n_experts % ep:
         raise ValueError(f"{n_experts} experts not divisible by ep={ep}")
-    tokens = x.shape[0]
     if tokens % ep:
         raise ValueError(f"{tokens} tokens not divisible by ep={ep}")
     capacity = max(1, int(capacity_factor * (tokens // ep) / n_experts))
 
     param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), expert_params)
     fn = shard_map(
-        partial(_moe_local, expert_fn=expert_fn, axis_name=axis_name, capacity=capacity),
+        partial(_moe_local, expert_fn=expert_fn, axis_name=axis_name, capacity=capacity,
+                dropped=dropped),
         mesh=mesh,
         in_specs=(P(axis_name), P(axis_name), param_specs),
         out_specs=P(axis_name),

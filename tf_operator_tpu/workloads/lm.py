@@ -104,7 +104,8 @@ def main(ctx: JobContext) -> None:
             loader.close()
     if step_s is not None:
         n_chips = mesh.devices.size
-        flops = transformer_train_flops(cfg.n_params(), batch * seq)
+        # active params: for top-1 MoE only one expert's FLOPs count per token
+        flops = transformer_train_flops(cfg.n_active_params(), batch * seq)
         log.info(
             "lm done: preset=%s loss=%.4f step=%.2fms tok/s=%.0f mfu=%.3f (%d chips)",
             wl.get("preset", "tiny"), loss, step_s * 1e3, batch * seq / step_s,
