@@ -116,11 +116,16 @@ def bench_lm(model: str) -> None:
             state, metrics = trainer.step(state, pull())
         _ = float(metrics["loss"])
 
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = trainer.step(state, pull())
-        _ = float(metrics["loss"])
-        step_s = (time.perf_counter() - t0) / steps
+        from tf_operator_tpu.train.profile import profile_ctx
+
+        # profile_ctx OUTSIDE the timing: trace start/stop and xplane
+        # serialization must not deflate the reported step time
+        with profile_ctx(os.environ.get("BENCH_PROFILE")):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, metrics = trainer.step(state, pull())
+            _ = float(metrics["loss"])
+            step_s = (time.perf_counter() - t0) / steps
     finally:
         if loader is not None:
             loader.close()
@@ -256,11 +261,16 @@ def main() -> None:
         # Timed region: steps dispatched back-to-back (donation chains them
         # on device), ONE sync at the end — per-step host syncs would
         # serialize on tunnel RTT and measure latency, not throughput.
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = trainer.step(state, pull())
-        _ = float(metrics["loss"])
-        step_s = (time.perf_counter() - t0) / steps
+        from tf_operator_tpu.train.profile import profile_ctx
+
+        # profile_ctx OUTSIDE the timing: trace start/stop and xplane
+        # serialization must not deflate the reported step time
+        with profile_ctx(os.environ.get("BENCH_PROFILE")):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, metrics = trainer.step(state, pull())
+            _ = float(metrics["loss"])
+            step_s = (time.perf_counter() - t0) / steps
     finally:
         if loader is not None:
             loader.close()

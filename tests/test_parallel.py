@@ -285,3 +285,25 @@ def test_moe_capacity_drop_zero_mode():
         capacity_factor=0.01, dropped="zero",
     )
     np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-6)
+
+
+def test_moe_dp_x_ep_mesh_shards_tokens_over_both():
+    """On a dp x ep mesh the token dim shards over (dp, ep): each dp
+    replica runs its own ep-wide all_to_all on its own token slice (no
+    all-gather of the global batch). Parity vs dense routing proves the
+    per-replica dispatch is still exact."""
+    n_experts, d, tokens = 4, 16, 64
+    mesh = build_mesh({"dp": 2, "ep": 4})
+    x = jax.random.normal(jax.random.PRNGKey(4), (tokens, d))
+    gate_logits = jax.random.normal(jax.random.PRNGKey(5), (tokens, n_experts))
+    w = jax.random.normal(jax.random.PRNGKey(6), (n_experts, d, d)) / np.sqrt(d)
+
+    out = moe_apply(
+        x, gate_logits, w, lambda p, t: t @ p, mesh,
+        capacity_factor=float(n_experts),
+    )
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
+    ref = jnp.einsum("td,tdo->to", x, w[idx]) * gate[:, None]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)

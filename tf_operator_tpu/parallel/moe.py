@@ -12,6 +12,7 @@ New TPU-native surface (reference has no MoE support, SURVEY.md §2.3).
 
 from __future__ import annotations
 
+import math
 from functools import partial
 from typing import Callable
 
@@ -132,13 +133,18 @@ def moe_apply(
     axis_name: str = "ep",
     capacity_factor: float = 2.0,
     dropped: str = "passthrough",
+    batch_axes: tuple = ("dp", "fsdp"),
 ):
     """Top-1 MoE layer with experts sharded over ``axis_name``.
 
-    x: [tokens, d] with tokens sharded over ``axis_name`` — each ep shard
-    routes its own token slice and the all_to_all exchanges (token-shard ×
-    expert-shard) traffic, so every expert processes distinct tokens from
-    every source shard. expert_params: pytree with leading dim n_experts.
+    x: [tokens, d]; the token dim shards over (batch_axes… , ep) — data
+    replicas keep their own token slices (each dp group runs its own
+    ep-wide all_to_all; without this, every dp replica would all-gather
+    and re-route the full global batch) and within a replica each ep
+    shard routes its slice, the all_to_all exchanging (token-shard ×
+    expert-shard) traffic so every expert processes distinct tokens from
+    every source shard. expert_params: pytree with leading dim n_experts
+    (sharded over ep, replicated over the batch axes).
     ``dropped`` picks what capacity-overflowed tokens yield: their input
     ("passthrough", standalone-transform default) or 0 ("zero" — required
     when the caller adds the result to a residual stream, else a dropped
@@ -154,19 +160,25 @@ def moe_apply(
         capacity = max(1, int(capacity_factor * tokens / n_experts))
         return _moe_single(x, gate_logits, expert_params, expert_fn, capacity, dropped)
     ep = mesh.shape[axis_name]
+    data_axes = tuple(a for a in batch_axes if a in mesh.axis_names)
+    n_data = math.prod(mesh.shape[a] for a in data_axes) if data_axes else 1
     if n_experts % ep:
         raise ValueError(f"{n_experts} experts not divisible by ep={ep}")
-    if tokens % ep:
-        raise ValueError(f"{tokens} tokens not divisible by ep={ep}")
-    capacity = max(1, int(capacity_factor * (tokens // ep) / n_experts))
+    if tokens % (ep * n_data):
+        raise ValueError(
+            f"{tokens} tokens not divisible by ep={ep} x data={n_data}"
+        )
+    local_tokens = tokens // (ep * n_data)
+    capacity = max(1, int(capacity_factor * local_tokens / n_experts))
 
+    token_spec = P((*data_axes, axis_name))
     param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), expert_params)
     fn = shard_map(
         partial(_moe_local, expert_fn=expert_fn, axis_name=axis_name, capacity=capacity,
                 dropped=dropped),
         mesh=mesh,
-        in_specs=(P(axis_name), P(axis_name), param_specs),
-        out_specs=P(axis_name),
+        in_specs=(token_spec, token_spec, param_specs),
+        out_specs=token_spec,
         check_vma=False,
     )
     return fn(x, gate_logits, expert_params)

@@ -23,3 +23,4 @@ from tf_operator_tpu.train.data import (  # noqa: F401
     SyntheticImages,
     SyntheticTokens,
 )
+from tf_operator_tpu.train.profile import profile_ctx  # noqa: F401

@@ -4,12 +4,15 @@ Covers the BASELINE.json BERT-base and Llama-2 configs: joins the gang,
 builds the declared mesh (dp/fsdp/tp/cp), trains a transformer preset with
 the sharded Trainer on synthetic tokens, logs tokens/sec and MFU.
 
-workload config keys: preset ("tiny"|"gpt-small"|"bert-base"|"llama2-7b"|
-"llama2-13b"), steps, batch_size, seq_len, lr, attn ("dense"|"ring"|"flash"),
+workload config keys: preset ("tiny"|"tiny-moe"|"gpt-small"|"moe-small"|
+"bert-base"|"llama2-7b"|"llama2-13b"), steps, batch_size, seq_len, lr,
+attn ("dense"|"ring"|"flash"), profile_dir (capture an XLA trace),
 checkpoint_dir, checkpoint_every (steps between saves; restart-based
 recovery resumes from the latest checkpoint), data ("fixed" resident
 batch | "stream" through the prefetching DeviceLoader), plus any
-TransformerConfig field as an override (e.g. n_layers).
+TransformerConfig field as an override (e.g. n_layers, n_experts,
+capacity_factor — MoE presets route through parallel.moe over the ep
+mesh axis).
 """
 
 from __future__ import annotations
@@ -17,8 +20,10 @@ from __future__ import annotations
 import logging
 
 from tf_operator_tpu.rendezvous.context import JobContext, RetryableFailure
+from tf_operator_tpu.train.profile import profile_ctx
 
 log = logging.getLogger("tpujob.lm")
+
 
 def main(ctx: JobContext) -> None:
     ctx.initialize_distributed()
@@ -96,9 +101,10 @@ def main(ctx: JobContext) -> None:
                 raise RetryableFailure(f"fault injection at step {step}")
 
     try:
-        state, loss, timed, step_s = ckpt.run_loop(
-            trainer, jax.random.PRNGKey(0), tokens, steps, on_step=on_step
-        )
+        with profile_ctx(wl.get("profile_dir")):
+            state, loss, timed, step_s = ckpt.run_loop(
+                trainer, jax.random.PRNGKey(0), tokens, steps, on_step=on_step
+            )
     finally:
         if loader is not None:
             loader.close()

@@ -8,7 +8,7 @@ workload config keys: steps, batch_size, image_size, num_classes, lr,
 variant ("resnet50"|"resnet18"), checkpoint_dir, checkpoint_every,
 data ("fixed": one resident device batch, the benchmarking shape;
 "stream": host batches through the prefetching DeviceLoader — the
-production input-pipeline shape).
+production input-pipeline shape), profile_dir (capture an XLA trace).
 """
 
 from __future__ import annotations
@@ -16,6 +16,7 @@ from __future__ import annotations
 import logging
 
 from tf_operator_tpu.rendezvous.context import JobContext
+from tf_operator_tpu.train.profile import profile_ctx
 
 log = logging.getLogger("tpujob.resnet")
 
@@ -86,9 +87,10 @@ def main(ctx: JobContext) -> None:
         )
         data = (images, labels)
     try:
-        state, loss, timed, step_s = ckpt.run_loop(
-            trainer, jax.random.PRNGKey(0), data, steps
-        )
+        with profile_ctx(wl.get("profile_dir")):
+            state, loss, timed, step_s = ckpt.run_loop(
+                trainer, jax.random.PRNGKey(0), data, steps
+            )
     finally:
         if loader is not None:
             loader.close()
