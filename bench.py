@@ -26,6 +26,35 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
+def run_timed_steps(trainer, state, pull, steps: int, stream: bool):
+    """The one timed-region protocol both benches share: optional device
+    loop (BENCH_DEVICE_LOOP=K: K steps per compiled call, 0 disables; the
+    K-step program compiles OUTSIDE the timed region), profiler capture
+    outside the timing, one host fetch at the end. Returns
+    (state, metrics, steps_run, step_s)."""
+    import time
+
+    from tf_operator_tpu.train.profile import profile_ctx
+
+    k = int(os.environ.get("BENCH_DEVICE_LOOP", "10"))
+    device_loop = k > 1 and not stream
+    if device_loop:
+        state, metrics = trainer.multi_step(state, pull(), k)
+        _ = float(metrics["loss"])
+        steps = max(1, steps // k) * k
+    with profile_ctx(os.environ.get("BENCH_PROFILE")):
+        t0 = time.perf_counter()
+        if device_loop:
+            for _ in range(steps // k):
+                state, metrics = trainer.multi_step(state, pull(), k)
+        else:
+            for _ in range(steps):
+                state, metrics = trainer.step(state, pull())
+        _ = float(metrics["loss"])
+        step_s = (time.perf_counter() - t0) / steps
+    return state, metrics, steps, step_s
+
+
 def bench_lm(model: str) -> None:
     """Transformer pretraining throughput (BASELINE.json BERT/Llama configs)."""
     from tf_operator_tpu.train.compile_cache import enable as enable_compile_cache
@@ -116,16 +145,9 @@ def bench_lm(model: str) -> None:
             state, metrics = trainer.step(state, pull())
         _ = float(metrics["loss"])
 
-        from tf_operator_tpu.train.profile import profile_ctx
-
-        # profile_ctx OUTSIDE the timing: trace start/stop and xplane
-        # serialization must not deflate the reported step time
-        with profile_ctx(os.environ.get("BENCH_PROFILE")):
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                state, metrics = trainer.step(state, pull())
-            _ = float(metrics["loss"])
-            step_s = (time.perf_counter() - t0) / steps
+        state, metrics, steps, step_s = run_timed_steps(
+            trainer, state, pull, steps, stream
+        )
     finally:
         if loader is not None:
             loader.close()
@@ -261,16 +283,9 @@ def main() -> None:
         # Timed region: steps dispatched back-to-back (donation chains them
         # on device), ONE sync at the end — per-step host syncs would
         # serialize on tunnel RTT and measure latency, not throughput.
-        from tf_operator_tpu.train.profile import profile_ctx
-
-        # profile_ctx OUTSIDE the timing: trace start/stop and xplane
-        # serialization must not deflate the reported step time
-        with profile_ctx(os.environ.get("BENCH_PROFILE")):
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                state, metrics = trainer.step(state, pull())
-            _ = float(metrics["loss"])
-            step_s = (time.perf_counter() - t0) / steps
+        state, metrics, steps, step_s = run_timed_steps(
+            trainer, state, pull, steps, stream
+        )
     finally:
         if loader is not None:
             loader.close()

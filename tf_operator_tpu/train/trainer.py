@@ -116,6 +116,7 @@ class Trainer:
 
         self._init_jit = None
         self._step_jit = None
+        self._multi_jit: Dict[Any, Any] = {}
 
     # ---- init -----------------------------------------------------------
 
@@ -225,17 +226,68 @@ class Trainer:
         )
         return TrainState(params, opt_state, step, extra), {"loss": loss}
 
-    def _build_step(self):
-        def go(params, opt_state, step, extra, batch):
-            def wrapped(p):
-                out = self.loss_fn(p, batch, extra)
-                if isinstance(out, tuple):
-                    return out
-                return out, extra
+    def _step_body(self, params, opt_state, step, extra, batch):
+        def wrapped(p):
+            out = self.loss_fn(p, batch, extra)
+            if isinstance(out, tuple):
+                return out
+            return out, extra
 
-            (loss, new_extra), grads = jax.value_and_grad(wrapped, has_aux=True)(params)
-            updates, opt_state = self.tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, step + 1, new_extra, loss
+        (loss, new_extra), grads = jax.value_and_grad(wrapped, has_aux=True)(params)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, step + 1, new_extra, loss
+
+    def _build_step(self):
+        return jax.jit(self._step_body, donate_argnums=(0, 1, 3))
+
+    # ---- multi-step (device loop) ---------------------------------------
+
+    def multi_step(
+        self, state: TrainState, batch, n_steps: int, stacked: bool = False
+    ) -> tuple:
+        """Run ``n_steps`` train steps inside ONE compiled call — a
+        ``lax.scan`` over the step body, so per-step host dispatch (and on
+        a remote/tunneled TPU, per-execution round trips) disappears from
+        the step time. ``batch`` is one batch trained repeatedly
+        (``stacked=False``, the benchmarking shape) or, with
+        ``stacked=True``, a pytree with a leading [n_steps] dim — one
+        slice per step, e.g. ``n_steps`` loader batches stacked.
+        Returns ``(state, {"loss": last, "losses": [n_steps]})``.
+        Compiles once per (n_steps, stacked) pair."""
+        if stacked:
+            for a in jax.tree_util.tree_leaves(batch):
+                if a.shape[0] != n_steps:
+                    raise ValueError(
+                        f"stacked batch leading dim {a.shape[0]} != n_steps {n_steps}"
+                    )
+        key = (int(n_steps), bool(stacked))
+        if self._multi_jit.get(key) is None:
+            self._multi_jit[key] = self._build_multi_step(n_steps, stacked)
+        params, opt_state, step, extra, losses = self._multi_jit[key](
+            state.params, state.opt_state, state.step, state.extra, batch
+        )
+        return (
+            TrainState(params, opt_state, step, extra),
+            {"loss": losses[-1], "losses": losses},
+        )
+
+    def _build_multi_step(self, n_steps: int, stacked: bool):
+        def go(params, opt_state, step, extra, batch):
+            def body(carry, xs):
+                params, opt_state, step, extra = carry
+                b = xs if stacked else batch
+                params, opt_state, step, extra, loss = self._step_body(
+                    params, opt_state, step, extra, b
+                )
+                return (params, opt_state, step, extra), loss
+
+            (params, opt_state, step, extra), losses = jax.lax.scan(
+                body,
+                (params, opt_state, step, extra),
+                batch if stacked else None,
+                length=None if stacked else n_steps,
+            )
+            return params, opt_state, step, extra, losses
 
         return jax.jit(go, donate_argnums=(0, 1, 3))
