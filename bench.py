@@ -36,20 +36,20 @@ def run_timed_steps(trainer, state, pull, steps: int, stream: bool):
 
     from tf_operator_tpu.train.profile import profile_ctx
 
-    k = int(os.environ.get("BENCH_DEVICE_LOOP", "10"))
+    k = min(int(os.environ.get("BENCH_DEVICE_LOOP", "10")), steps)
     device_loop = k > 1 and not stream
+    full, rem = divmod(steps, k) if device_loop else (0, steps)
     if device_loop:
+        # compile the K-step program OUTSIDE the timed region (the
+        # single-step program is already warm from the caller's warmup)
         state, metrics = trainer.multi_step(state, pull(), k)
         _ = float(metrics["loss"])
-        steps = max(1, steps // k) * k
     with profile_ctx(os.environ.get("BENCH_PROFILE")):
         t0 = time.perf_counter()
-        if device_loop:
-            for _ in range(steps // k):
-                state, metrics = trainer.multi_step(state, pull(), k)
-        else:
-            for _ in range(steps):
-                state, metrics = trainer.step(state, pull())
+        for _ in range(full):
+            state, metrics = trainer.multi_step(state, pull(), k)
+        for _ in range(rem):  # BENCH_STEPS is honored exactly
+            state, metrics = trainer.step(state, pull())
         _ = float(metrics["loss"])
         step_s = (time.perf_counter() - t0) / steps
     return state, metrics, steps, step_s

@@ -263,3 +263,74 @@ def test_readonly_manager_refuses_save_and_preserves_tmp_dirs(tmp_path, sharded_
     # a writable manager still sweeps it
     CheckpointManager(root, backend="npy")
     assert not live_tmp.exists()
+
+
+def test_run_loop_device_loop_matches_per_step(tmp_path):
+    """run_loop with device_loop=K: same trajectory, same checkpoints —
+    chunks clip to save boundaries so no periodic save is skipped."""
+    from tf_operator_tpu.train.checkpoint import WorkloadCheckpointer
+
+    mesh = build_mesh({"dp": 2, "tp": 4})
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 256)
+
+    def run(device_loop, sub):
+        trainer, cfg = _tiny_trainer(mesh)
+        wl = {"checkpoint_dir": str(tmp_path / sub), "checkpoint_every": 2}
+        ckpt = WorkloadCheckpointer(wl)
+        tok = jax.device_put(tokens, trainer.batch_sharding)
+        state, loss, timed, _ = ckpt.run_loop(
+            trainer, jax.random.PRNGKey(0), tok, 7, device_loop=device_loop
+        )
+        return state, loss, ckpt
+
+    s1, loss1, ckpt1 = run(1, "per-step")
+    s2, loss2, ckpt2 = run(3, "chunked")
+    np.testing.assert_allclose(loss1, loss2, rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s1.params), jax.tree_util.tree_leaves(s2.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+    # identical save points (incl. the boundary-clipped ones and the final)
+    assert ckpt1.manager.all_steps() == ckpt2.manager.all_steps()
+
+
+def test_run_loop_device_loop_stacks_iterator_batches(tmp_path):
+    """device_loop over a loader: K pulls stack into one [K, ...] chunk."""
+    from tf_operator_tpu.train.checkpoint import WorkloadCheckpointer
+    from tf_operator_tpu.train.data import ArrayDataset, DeviceLoader
+
+    mesh = build_mesh({"dp": 2, "tp": 4})
+    trainer, cfg = _tiny_trainer(mesh)
+    ds = ArrayDataset(
+        {"t": np.random.default_rng(0).integers(0, 256, (64, 32), dtype=np.int32)},
+        batch_size=4, shuffle=False,
+    )
+    ckpt = WorkloadCheckpointer({})
+    with DeviceLoader(ds, trainer.batch_sharding) as loader:
+        it = (b["t"] for b in loader)
+        state, loss, timed, _ = ckpt.run_loop(
+            trainer, jax.random.PRNGKey(0), it, 6, device_loop=4
+        )
+    # 7 total steps trained: 1 warmup + 4-step warmup chunk + 2 timed
+    assert timed == 2 and int(state.step) == 7
+    assert np.isfinite(loss)
+
+
+def test_run_loop_device_loop_bigger_than_budget_keeps_telemetry(tmp_path):
+    """device_loop >= remaining budget: the warmup must not swallow every
+    step — at least one chunk stays in the timed region so step_s (the
+    workloads' tokens/sec / MFU divisor) is still reported."""
+    from tf_operator_tpu.train.checkpoint import WorkloadCheckpointer
+
+    mesh = build_mesh({"dp": 2, "tp": 4})
+    trainer, cfg = _tiny_trainer(mesh)
+    tok = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 256),
+        trainer.batch_sharding,
+    )
+    ckpt = WorkloadCheckpointer({})
+    state, loss, timed, step_s = ckpt.run_loop(
+        trainer, jax.random.PRNGKey(0), tok, 10, device_loop=10
+    )
+    assert int(state.step) == 11  # warmup + 10
+    assert timed >= 1 and step_s is not None

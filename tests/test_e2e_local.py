@@ -423,6 +423,7 @@ def test_moe_expert_parallel_gang(rig):
         "steps": 3,
         "batch_size": 4,
         "seq_len": 32,
+        "device_loop": 2,  # K-steps-per-call through the operator path too
     }
     store.create(job)
     ok = wait_for(

@@ -380,8 +380,11 @@ class WorkloadCheckpointer:
         0 means throughput numbers would be meaningless — don't log them."""
         return max(0, steps - self.start_step)
 
-    def advance(self, state, loss=None) -> None:
-        """Call once per trainer.step; saves when a periodic save is due.
+    def advance(self, state, loss=None, n: int = 1) -> None:
+        """Call once per trainer.step (or once per ``n``-step device-loop
+        chunk); saves when a periodic save is due. Chunked callers must
+        align chunks to save boundaries (run_loop does) — a chunk that
+        jumps OVER a boundary would silently skip that save.
 
         Pass the step's loss so a diverged state is never checkpointed —
         saving NaN params would make them the latest checkpoint and poison
@@ -390,7 +393,7 @@ class WorkloadCheckpointer:
         hot loop stays sync-free."""
         import math
 
-        self._step += 1
+        self._step += n
         if self.manager is not None and self.every and self._step % self.every == 0:
             if loss is not None and not math.isfinite(float(loss)):
                 raise AssertionError(
@@ -405,13 +408,16 @@ class WorkloadCheckpointer:
         if self.manager is not None:
             self.manager.save(self._step, state)
 
-    def run_loop(self, trainer, key, batch, steps: int, on_step=None):
+    def run_loop(self, trainer, key, batch, steps: int, on_step=None,
+                 device_loop: int = 1):
         """The one warmup+timed train loop shared by workloads.
 
         restore-or-init → warmup step (compile boundary) → ``steps -
         start_step`` timed steps with periodic NaN-gated saves → finiteness
         guard → final save. Returns ``(state, loss, timed, step_s)`` where
-        ``step_s`` is None when no timed steps remained. Callers must check
+        ``timed`` counts only the steps inside the timed region (warmup —
+        including the device-loop warmup chunk — trains but is excluded)
+        and ``step_s`` is None when no timed steps remained. Callers must check
         :meth:`is_complete` first. ``on_step(global_step)`` fires after
         every advance — the fault-injection / progress-reporting seam.
 
@@ -421,26 +427,96 @@ class WorkloadCheckpointer:
         must share one shape/dtype structure (jit compiles once). On
         restart-based recovery an iterator starts over unless the caller
         fast-forwards it (``DeviceLoader(skip=resume_step())``) — without
-        that, a resumed run re-trains the stream's leading batches."""
+        that, a resumed run re-trains the stream's leading batches.
+
+        ``device_loop=K`` runs up to K steps per compiled call
+        (``Trainer.multi_step``), chunks clipped to checkpoint boundaries
+        so no periodic save is skipped; iterator batches are stacked K at
+        a time (single-process only — multi-host global arrays cannot be
+        stacked outside jit, so streams fall back to per-step there).
+        ``on_step`` then fires once per chunk (with the post-chunk global
+        step), so fault-injection / progress hooks see chunk
+        granularity."""
         import math
         import time
 
         from tf_operator_tpu.train.metrics import host_fetch
 
-        pull = (lambda: next(batch)) if hasattr(batch, "__next__") else (lambda: batch)
-        state = self.restore_or_init(trainer, key)
-        timed = self.timed_steps(steps)
-        state, m = trainer.step(state, pull())
-        self.advance(state, loss=m["loss"])
-        host_fetch(m["loss"])  # compile boundary
-        if on_step is not None:
-            on_step(self._step)
-        t0 = time.perf_counter()
-        for _ in range(timed):
-            state, m = trainer.step(state, pull())
-            self.advance(state, loss=m["loss"])
+        is_iter = hasattr(batch, "__next__")
+        pull = (lambda: next(batch)) if is_iter else (lambda: batch)
+        device_loop = max(1, int(device_loop))
+        if device_loop > 1 and is_iter:
+            import jax
+
+            if jax.process_count() > 1:
+                # multi-host stream batches are non-fully-addressable
+                # global arrays; jnp.stack on them crashes outside jit
+                log.warning(
+                    "device_loop=%d ignored for stream data on %d processes "
+                    "(chunk stacking needs fully-addressable batches)",
+                    device_loop, jax.process_count(),
+                )
+                device_loop = 1
+
+        def pull_chunk(k: int):
+            if not is_iter:
+                return batch, False
+            if k == 1:
+                return next(batch), False
+            import jax
+            import jax.numpy as jnp
+
+            slices = [next(batch) for _ in range(k)]
+            return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *slices), True
+
+        def chunk_size(remaining: int) -> int:
+            k = min(device_loop, remaining)
+            if self.manager is not None and self.every:
+                # clip to the next save boundary so advance() never jumps
+                # one (without a manager there is nothing to save — don't
+                # forfeit dispatch amortization for a no-op)
+                to_boundary = self.every - (self._step % self.every)
+                k = min(k, to_boundary)
+            return max(1, k)
+
+        def run_chunk(state, remaining: int):
+            k = chunk_size(remaining)
+            if k == 1:
+                state, m = trainer.step(state, pull())
+            else:
+                chunk, stacked = pull_chunk(k)
+                state, m = trainer.multi_step(state, chunk, k, stacked=stacked)
+            self.advance(state, loss=m["loss"], n=k)
             if on_step is not None:
                 on_step(self._step)
+            return state, m, k
+
+        state = self.restore_or_init(trainer, key)
+        remaining = self.timed_steps(steps)
+        # warmup (compile boundary): the single-step program, then — when
+        # device-looping — one chunk of each distinct upcoming chunk size,
+        # so the boundary-clipped AND steady-state programs both compile
+        # outside the timed region. Stops before exhausting the budget
+        # (at least one chunk stays timed); a novel tail size can still
+        # compile in-region, but a tail is by construction small.
+        state, m = trainer.step(state, pull())
+        self.advance(state, loss=m["loss"])
+        if on_step is not None:
+            on_step(self._step)
+        warmed: set = set()
+        while device_loop > 1 and remaining > 0:
+            k_next = chunk_size(remaining)
+            if k_next <= 1 or k_next in warmed or remaining <= k_next:
+                break
+            warmed.add(k_next)
+            state, m, k = run_chunk(state, remaining)
+            remaining -= k
+        host_fetch(m["loss"])
+        timed = remaining
+        t0 = time.perf_counter()
+        while remaining > 0:
+            state, m, k = run_chunk(state, remaining)
+            remaining -= k
         loss = float(m["loss"])
         step_s = (time.perf_counter() - t0) / timed if timed else None
         if not math.isfinite(loss):

@@ -7,6 +7,7 @@ the sharded Trainer on synthetic tokens, logs tokens/sec and MFU.
 workload config keys: preset ("tiny"|"tiny-moe"|"gpt-small"|"moe-small"|
 "bert-base"|"llama2-7b"|"llama2-13b"), steps, batch_size, seq_len, lr,
 attn ("dense"|"ring"|"flash"), profile_dir (capture an XLA trace),
+device_loop (K steps per compiled call — lax.scan device loop),
 checkpoint_dir, checkpoint_every (steps between saves; restart-based
 recovery resumes from the latest checkpoint), data ("fixed" resident
 batch | "stream" through the prefetching DeviceLoader), plus any
@@ -103,7 +104,8 @@ def main(ctx: JobContext) -> None:
     try:
         with profile_ctx(wl.get("profile_dir")):
             state, loss, timed, step_s = ckpt.run_loop(
-                trainer, jax.random.PRNGKey(0), tokens, steps, on_step=on_step
+                trainer, jax.random.PRNGKey(0), tokens, steps, on_step=on_step,
+                device_loop=int(wl.get("device_loop", 1)),
             )
     finally:
         if loader is not None:

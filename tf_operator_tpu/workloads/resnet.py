@@ -8,7 +8,8 @@ workload config keys: steps, batch_size, image_size, num_classes, lr,
 variant ("resnet50"|"resnet18"), checkpoint_dir, checkpoint_every,
 data ("fixed": one resident device batch, the benchmarking shape;
 "stream": host batches through the prefetching DeviceLoader — the
-production input-pipeline shape), profile_dir (capture an XLA trace).
+production input-pipeline shape), profile_dir (capture an XLA trace),
+device_loop (K steps per compiled call — lax.scan device loop).
 """
 
 from __future__ import annotations
@@ -89,7 +90,8 @@ def main(ctx: JobContext) -> None:
     try:
         with profile_ctx(wl.get("profile_dir")):
             state, loss, timed, step_s = ckpt.run_loop(
-                trainer, jax.random.PRNGKey(0), data, steps
+                trainer, jax.random.PRNGKey(0), data, steps,
+                device_loop=int(wl.get("device_loop", 1)),
             )
     finally:
         if loader is not None:
