@@ -143,3 +143,26 @@ def test_dropped_tokens_leave_residual_untouched():
     )
     want = transformer_forward(zeroed, tok, cfg_all)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6)
+
+
+def test_top2_moe_trains():
+    cfg = preset("tiny-moe", dtype=jnp.float32, moe_top_k=2)
+    assert cfg.n_active_params() > preset("tiny-moe").n_active_params()
+    mesh = build_mesh({"dp": 2, "ep": 4})
+    trainer = Trainer(
+        mesh,
+        loss_fn=lambda p, tok, extra: lm_loss(p, tok, cfg, mesh=mesh),
+        init_fn=lambda k: init_transformer(k, cfg),
+        logical_axes=transformer_logical_axes(cfg),
+        config=TrainerConfig(optimizer="adamw", learning_rate=1e-3),
+    )
+    state = trainer.init(jax.random.PRNGKey(0))
+    tok = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab),
+        trainer.batch_sharding,
+    )
+    losses = []
+    for _ in range(3):
+        state, metrics = trainer.step(state, tok)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]

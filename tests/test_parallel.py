@@ -307,3 +307,71 @@ def test_moe_dp_x_ep_mesh_shards_tokens_over_both():
     gate = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
     ref = jnp.einsum("td,tdo->to", x, w[idx]) * gate[:, None]
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_moe_top2_matches_dense_routing():
+    """k_top=2 with generous capacity: each token's output is the sum of
+    its two highest-gated experts weighted by RENORMALIZED gate probs."""
+    n_experts, d, tokens = 4, 16, 32
+    mesh = build_mesh({"dp": 2, "ep": 4})
+    x = jax.random.normal(jax.random.PRNGKey(4), (tokens, d))
+    gate_logits = jax.random.normal(jax.random.PRNGKey(5), (tokens, n_experts))
+    w = jax.random.normal(jax.random.PRNGKey(6), (n_experts, d, d)) / np.sqrt(d)
+
+    out = moe_apply(
+        x, gate_logits, w, lambda p, t: t @ p, mesh,
+        capacity_factor=float(n_experts), k_top=2,
+    )
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    top_p, top_i = jax.lax.top_k(probs, 2)
+    top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+    ref = sum(
+        jnp.einsum("td,tdo->to", x, w[top_i[:, j]]) * top_p[:, j, None]
+        for j in range(2)
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_moe_top2_partial_drop_renormalizes_survivors():
+    """passthrough mode, k_top=2, capacity 1: a token whose hot choice
+    overflowed but whose other choice survived gets the survivor at FULL
+    renormalized weight (not a silently attenuated fraction); a token
+    with both choices dropped passes through unchanged."""
+    n_experts, d = 4, 4
+    mesh = build_mesh({"ep": 2}, devices=jax.devices()[:2])  # 2 experts/shard
+    # identical 4-token pattern on each of the 2 shards (8 local = 4/shard)
+    # t0 -> (e0, e1)   both kept (first claimant of each queue)
+    # t1 -> (e0, e2)   e0 full -> only e2 survives (the partial-drop case)
+    # t2 -> (e3, e0)   e0 full -> only e3 survives
+    # t3 -> (e3, e1)   both full -> fully dropped -> passthrough
+    pat = jnp.array([
+        [5.0, 4.0, 0.0, 0.0],
+        [5.0, 0.0, 4.0, 0.0],
+        [0.0, 4.0, 0.0, 5.0],
+        [0.0, 4.0, 0.0, 5.0],
+    ])
+    gate_logits = jnp.concatenate([pat, pat], axis=0)  # [8, 4]
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, d))
+    scales = jnp.array([2.0, -1.0, 3.0, 0.5])
+    w = jnp.einsum("e,ij->eij", scales, jnp.eye(d))  # expert e = scale_e * I
+
+    out = moe_apply(
+        x, gate_logits, w, lambda p, t: t @ p, mesh,
+        capacity_factor=1e-9, k_top=2,  # capacity floors at 1 per expert
+    )
+    out = np.asarray(out)
+    xn = np.asarray(x)
+    for shard in (0, 4):
+        # t1: only e2 survived; renormalized weight must be 1.0 -> 3*x
+        np.testing.assert_allclose(out[shard + 1], 3.0 * xn[shard + 1], rtol=1e-4)
+        # t2: only e3 survived -> 0.5*x at full weight
+        np.testing.assert_allclose(out[shard + 2], 0.5 * xn[shard + 2], rtol=1e-4)
+        # t3: fully dropped -> passthrough
+        np.testing.assert_allclose(out[shard + 3], xn[shard + 3], rtol=1e-4)
+
+
+def test_config_rejects_bad_top_k():
+    from tf_operator_tpu.models.transformer import preset
+
+    with pytest.raises(ValueError, match="moe_top_k"):
+        preset("tiny-moe", moe_top_k=8)

@@ -52,13 +52,23 @@ class TransformerConfig:
     # Blockwise fused loss (ops/fused_cross_entropy): logits never hit HBM
     # as a [b,t,vocab] f32 array. Same math as the unfused path.
     fused_xent: bool = True
-    # Mixture-of-experts MLP (Switch-style top-1, parallel.moe): 0 = dense.
+    # Mixture-of-experts MLP (parallel.moe): 0 = dense. moe_top_k=1 is
+    # Switch-style; 2 is Mixtral-style (renormalized gate weights).
     # Experts shard over the ep mesh axis (all-to-all dispatch); without an
     # ep axis all experts run on every device (the routing math is
     # identical, so one config tests on CPU and scales on a pod).
     n_experts: int = 0
+    moe_top_k: int = 1
     capacity_factor: float = 2.0
     ep_axis: str = "ep"
+
+    def __post_init__(self):
+        if self.n_experts and not (1 <= self.moe_top_k <= self.n_experts):
+            raise ValueError(
+                f"moe_top_k={self.moe_top_k} must be in [1, n_experts="
+                f"{self.n_experts}] (it silently corrupts FLOP accounting "
+                "and fails inside lax.top_k otherwise)"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -75,12 +85,12 @@ class TransformerConfig:
         return v * d + L * per_layer + d  # embed + layers + final norm
 
     def n_active_params(self) -> int:
-        """Params touched per token (= n_params for dense; top-1 MoE
-        activates one expert) — the right N for 6ND FLOP accounting."""
+        """Params touched per token (= n_params for dense; top-k MoE
+        activates k experts) — the right N for 6ND FLOP accounting."""
         if not self.n_experts:
             return self.n_params()
         d, f, L = self.d_model, self.d_ff, self.n_layers
-        inactive = (self.n_experts - 1) * 3 * d * f
+        inactive = (self.n_experts - self.moe_top_k) * 3 * d * f
         return self.n_params() - L * inactive
 
 
@@ -304,8 +314,9 @@ def _layer(x, layer_params, cfg: TransformerConfig, mesh):
 
 
 def _moe_mlp(h, layer_params, cfg: TransformerConfig, mesh):
-    """Switch-style top-1 expert MLP: router -> all-to-all dispatch over
-    the ep axis (parallel.moe) -> per-expert SwiGLU -> weighted combine."""
+    """Top-k expert MLP (k = cfg.moe_top_k: 1 Switch / 2 Mixtral-style):
+    router -> all-to-all dispatch over the ep axis (parallel.moe) ->
+    per-expert SwiGLU -> gate-weighted combine."""
     from tf_operator_tpu.parallel.moe import moe_apply
 
     b, t, d = h.shape
@@ -333,6 +344,7 @@ def _moe_mlp(h, layer_params, cfg: TransformerConfig, mesh):
         # the result feeds a residual add: a capacity-dropped token's MLP
         # must contribute 0, not its own input again
         dropped="zero",
+        k_top=cfg.moe_top_k,
     )
     return out.reshape(b, t, d)
 
@@ -422,7 +434,7 @@ CONFIG_OVERRIDE_FIELDS = frozenset(
     {
         "vocab", "d_model", "n_layers", "n_heads", "n_kv_heads", "d_ff",
         "max_seq", "causal", "remat", "fused_xent", "n_experts",
-        "capacity_factor",
+        "moe_top_k", "capacity_factor",
     }
 )
 

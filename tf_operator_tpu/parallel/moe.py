@@ -1,4 +1,7 @@
-"""Expert parallelism: Switch-style top-1 MoE with all-to-all dispatch.
+"""Expert parallelism: top-k MoE with all-to-all dispatch.
+
+k_top=1 is Switch-style routing; k_top=2 is Mixtral-style (each token's
+two highest-gated experts, gate weights renormalized over the chosen).
 
 Experts are sharded over the ``ep`` mesh axis; tokens are routed by a gating
 network, dispatched to their expert's device with ``all_to_all`` (ragged
@@ -23,31 +26,46 @@ from jax.sharding import PartitionSpec as P
 from tf_operator_tpu.parallel.collectives import axis_size
 
 
-def _route(x, gate_logits, capacity: int):
-    """Top-1 routing bookkeeping shared by the sharded and single-device
-    paths. Returns (dispatch [T,E,C], keep [T], gate_weight [T],
-    inbox [E,C,d])."""
+def _route(x, gate_logits, capacity: int, k_top: int = 1, dropped: str = "passthrough"):
+    """Top-k routing bookkeeping shared by the sharded and single-device
+    paths. Each token goes to its ``k_top`` highest-gated experts; with
+    k_top > 1 the chosen gate probs are renormalized to sum to 1 (the
+    Mixtral rule). Queue slots are claimed in token order per expert.
+
+    Partial capacity drops (k_top > 1, some but not all choices
+    overflow): in "zero" mode the dropped choice simply contributes 0
+    (the Switch training convention — drops are an efficiency artifact,
+    not a reweighting); in "passthrough" mode weights renormalize over
+    the SURVIVING choices so the output stays a full-strength convex mix
+    rather than a silently attenuated one.
+
+    Returns (dispatch_w [T,E,C] — combine weights, keep_any [T] — token
+    has >= 1 surviving choice, inbox [E,C,d])."""
     gate_probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
-    expert_idx = jnp.argmax(gate_probs, axis=-1)  # [tokens]
-    gate_weight = jnp.take_along_axis(gate_probs, expert_idx[:, None], axis=-1)[:, 0]
-
     n_experts = gate_logits.shape[-1]
-    # Position of each token within its expert's queue; beyond capacity drops.
-    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.int32)  # [T, E]
-    pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1) * onehot  # [T, E]
-    pos = jnp.sum(pos_in_expert, axis=-1)  # [T]
-    keep = pos < capacity
+    top_p, top_i = jax.lax.top_k(gate_probs, k_top)  # [T, k]
+    if k_top > 1:
+        top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
 
-    # dispatch[t, e, c] = 1 if token t goes to expert e at slot c
-    pos_onehot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # [T, C]
-    dispatch = (
-        onehot.astype(jnp.float32)[:, :, None]
-        * keep.astype(jnp.float32)[:, None, None]
-        * pos_onehot[:, None, :]
-    )  # [T, E, C]
+    # assign[t, e] = 1 if e is one of t's choices; w[t, e] = its gate weight
+    choice_onehot = jax.nn.one_hot(top_i, n_experts, dtype=jnp.float32)  # [T,k,E]
+    assign = jnp.sum(choice_onehot, axis=1)  # [T, E] (0/1: top_k is distinct)
+    w = jnp.einsum("tke,tk->te", choice_onehot, top_p)  # [T, E]
+
+    # Position of each (token, choice) within its expert's queue; beyond
+    # capacity that choice drops.
+    pos = (jnp.cumsum(assign, axis=0) - 1.0) * assign  # [T, E]
+    kept = assign * (pos < capacity)  # [T, E]
+    if k_top > 1 and dropped == "passthrough":
+        surviving = jnp.sum(w * kept, axis=-1, keepdims=True)
+        w = jnp.where(surviving > 0, w * kept / jnp.maximum(surviving, 1e-20), w)
+    pos_onehot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+    dispatch = kept[:, :, None] * pos_onehot  # [T, E, C] 0/1
+    dispatch_w = dispatch * w[:, :, None]  # combine side carries gate weights
+    keep_any = jnp.sum(kept, axis=-1) > 0
     # Expert inboxes from local tokens: [E, C, d]
     inbox = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
-    return dispatch, keep, gate_weight, inbox
+    return dispatch_w, keep_any, inbox
 
 
 def _dropped_value(x, dropped: str):
@@ -62,12 +80,13 @@ def _dropped_value(x, dropped: str):
     raise ValueError(f"unknown dropped mode {dropped!r}")
 
 
-def _moe_single(x, gate_logits, expert_params, expert_fn, capacity: int, dropped: str):
+def _moe_single(x, gate_logits, expert_params, expert_fn, capacity: int, dropped: str,
+                k_top: int = 1):
     """All experts on one device: same routing math, no collectives — the
     fallback when the mesh has no ep axis (or no mesh at all)."""
     tokens, d = x.shape
     n_experts = gate_logits.shape[-1]
-    dispatch, keep, gate_weight, inbox = _route(x, gate_logits, capacity)
+    dispatch_w, keep_any, inbox = _route(x, gate_logits, capacity, k_top, dropped)
 
     def run_expert(e, acc):
         params_e = jax.tree_util.tree_map(lambda a: a[e], expert_params)
@@ -76,15 +95,13 @@ def _moe_single(x, gate_logits, expert_params, expert_fn, capacity: int, dropped
 
     outbox = jnp.zeros((n_experts, capacity, d), jnp.float32)
     outbox = jax.lax.fori_loop(0, n_experts, run_expert, outbox)
-    combined = jnp.einsum("tec,ecd->td", dispatch, outbox)
-    out = jnp.where(
-        keep[:, None], combined * gate_weight[:, None], _dropped_value(x, dropped)
-    )
+    combined = jnp.einsum("tec,ecd->td", dispatch_w, outbox)
+    out = jnp.where(keep_any[:, None], combined, _dropped_value(x, dropped))
     return out.astype(x.dtype)
 
 
 def _moe_local(x, gate_logits, expert_params, expert_fn, axis_name: str, capacity: int,
-               dropped: str):
+               dropped: str, k_top: int = 1):
     """Per-device body. x: [tokens_local, d]; gate_logits: [tokens_local, E];
     expert_params: this device's experts (leading dim E_local)."""
     n_shards = axis_size(axis_name)
@@ -92,7 +109,7 @@ def _moe_local(x, gate_logits, expert_params, expert_fn, axis_name: str, capacit
     n_experts = gate_logits.shape[-1]
     experts_per_shard = n_experts // n_shards
 
-    dispatch, keep, gate_weight, inbox = _route(x, gate_logits, capacity)
+    dispatch_w, keep_any, inbox = _route(x, gate_logits, capacity, k_top, dropped)
 
     # all_to_all: regroup so each shard holds inboxes for ITS experts from
     # every shard: [E, C, d] -> [E_local * n_shards, C, d] where the leading
@@ -117,10 +134,8 @@ def _moe_local(x, gate_logits, expert_params, expert_fn, axis_name: str, capacit
     outbox = outbox.reshape(n_experts, capacity, d)
 
     # Combine: weight by gate prob; dropped tokens per the dropped mode.
-    combined = jnp.einsum("tec,ecd->td", dispatch, outbox)
-    out = jnp.where(
-        keep[:, None], combined * gate_weight[:, None], _dropped_value(x, dropped)
-    )
+    combined = jnp.einsum("tec,ecd->td", dispatch_w, outbox)
+    out = jnp.where(keep_any[:, None], combined, _dropped_value(x, dropped))
     return out.astype(x.dtype)
 
 
@@ -134,8 +149,12 @@ def moe_apply(
     capacity_factor: float = 2.0,
     dropped: str = "passthrough",
     batch_axes: tuple = ("dp", "fsdp"),
+    k_top: int = 1,
 ):
-    """Top-1 MoE layer with experts sharded over ``axis_name``.
+    """Top-k MoE layer with experts sharded over ``axis_name``
+    (``k_top=1`` — Switch; ``k_top=2`` — Mixtral-style with renormalized
+    gate weights; capacity scales with k_top: total slot demand is
+    k_top x tokens).
 
     x: [tokens, d]; the token dim shards over (batch_axes… , ep) — data
     replicas keep their own token slices (each dp group runs its own
@@ -157,8 +176,10 @@ def moe_apply(
     if mesh is None or axis_name not in getattr(mesh, "axis_names", ()) or (
         mesh.shape[axis_name] == 1
     ):
-        capacity = max(1, int(capacity_factor * tokens / n_experts))
-        return _moe_single(x, gate_logits, expert_params, expert_fn, capacity, dropped)
+        capacity = max(1, int(capacity_factor * k_top * tokens / n_experts))
+        return _moe_single(
+            x, gate_logits, expert_params, expert_fn, capacity, dropped, k_top
+        )
     ep = mesh.shape[axis_name]
     data_axes = tuple(a for a in batch_axes if a in mesh.axis_names)
     n_data = math.prod(mesh.shape[a] for a in data_axes) if data_axes else 1
@@ -169,13 +190,13 @@ def moe_apply(
             f"{tokens} tokens not divisible by ep={ep} x data={n_data}"
         )
     local_tokens = tokens // (ep * n_data)
-    capacity = max(1, int(capacity_factor * local_tokens / n_experts))
+    capacity = max(1, int(capacity_factor * k_top * local_tokens / n_experts))
 
     token_spec = P((*data_axes, axis_name))
     param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), expert_params)
     fn = shard_map(
         partial(_moe_local, expert_fn=expert_fn, axis_name=axis_name, capacity=capacity,
-                dropped=dropped),
+                dropped=dropped, k_top=k_top),
         mesh=mesh,
         in_specs=(token_spec, token_spec, param_specs),
         out_specs=token_spec,
