@@ -130,3 +130,23 @@ def test_evaluator_only_job_rejected():
     )
     with pytest.raises(ValidationError, match="no chief"):
         validate_spec(s)
+
+
+def test_every_example_spec_passes_admission():
+    """examples/ are the user-facing contract: every shipped spec must
+    parse (both API generations) and pass defaulting + validation."""
+    import glob
+    import json
+    import os
+
+    from tf_operator_tpu.api import set_defaults, validate_job
+    from tf_operator_tpu.api.v1alpha1 import parse_job
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    specs = sorted(glob.glob(os.path.join(root, "examples", "*.json")))
+    assert len(specs) >= 9
+    for path in specs:
+        with open(path) as f:
+            job = parse_job(json.load(f))
+        set_defaults(job)
+        validate_job(job)
