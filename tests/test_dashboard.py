@@ -169,3 +169,17 @@ def test_metrics_endpoint_counts_real_work(stack):
     assert "tpujob_workqueue_depth" in vals
     # store gauge: the job we created shows up under some phase
     assert any(k.startswith('tpujob_jobs{phase="') for k in vals)
+
+
+def test_job_routes_reject_encoded_slash_in_name(stack):
+    """Job ns/name pairs circulate as "ns/name" string keys (workqueue,
+    expectations), so a %2F-smuggled slash in a job route must 400 —
+    while the generic tuple-keyed /api/v1 object routes stay permissive
+    (test_names_with_reserved_characters_round_trip)."""
+    _, _, server = stack
+    for path in ("/api/tpujob/default/a%2Fb", "/api/process/default/a%2Fb/logs"):
+        try:
+            urllib.request.urlopen(server.url + path)
+            raise AssertionError(f"{path} should have been rejected")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 400, path

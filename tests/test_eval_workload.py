@@ -124,3 +124,65 @@ def test_eval_concurrent_with_live_writer(tmp_path):
     with open(report) as f:
         scored = json.load(f)
     assert any(int(s) >= 4 for s in scored)
+
+
+def test_eval_scores_all_intermediate_checkpoints(tmp_path, caplog):
+    """When the trainer saves faster than eval scores, every checkpoint
+    must be scored (not just latest_step) — no gaps in eval_report."""
+    import json
+
+    _save_checkpoints(tmp_path, steps={2, 4})
+    report = tmp_path / "report.json"
+    ctx = JobContext(
+        replica_type="Evaluator",
+        workload={
+            "preset": "tiny",
+            "checkpoint_dir": str(tmp_path),
+            "train_steps": 4,
+            "eval_batch_size": 4,
+            "eval_seq_len": 32,
+            "eval_batches": 1,
+            "poll_interval_s": 0.05,
+            "max_wait_s": 30,
+            "eval_report": str(report),
+        },
+    )
+    with caplog.at_level(logging.INFO, logger="tpujob.eval"):
+        eval_wl.main(ctx)
+    scored = json.loads(report.read_text())
+    assert set(scored) == {"2", "4"}
+
+
+def test_report_eval_metrics_flows_to_job_status(monkeypatch):
+    """Evaluator → operator API → TPUJobStatus.eval_metrics → queryable by
+    tpujob get / the dashboard (VERDICT #9 done-bar)."""
+    from tf_operator_tpu.api.types import ObjectMeta, TPUJob
+    from tf_operator_tpu.dashboard import DashboardServer
+    from tf_operator_tpu.rendezvous.env import ENV_API_SERVER
+    from tf_operator_tpu.runtime import Store
+
+    store = Store()
+    server = DashboardServer(store, port=0)
+    server.start()
+    try:
+        store.create(TPUJob(metadata=ObjectMeta(name="lm")))
+        ctx = JobContext(job_name="lm", namespace="default", replica_type="Evaluator")
+
+        # No API server in env: reporting is a quiet no-op (standalone eval).
+        monkeypatch.delenv(ENV_API_SERVER, raising=False)
+        assert ctx.report_eval_metrics(2, {"loss": 3.5}) is False
+
+        monkeypatch.setenv(ENV_API_SERVER, server.url)
+        assert ctx.report_eval_metrics(2, {"loss": 3.5}) is True
+        st = store.get("TPUJob", "default", "lm").status
+        assert st.eval_metrics["step"] == 2
+        assert st.eval_metrics["metrics"] == {"loss": 3.5}
+
+        # A newer step wins; an older (replayed) report must not regress it.
+        assert ctx.report_eval_metrics(4, {"loss": 3.1}) is True
+        assert ctx.report_eval_metrics(3, {"loss": 9.9}) is False
+        st = store.get("TPUJob", "default", "lm").status
+        assert st.eval_metrics["step"] == 4
+        assert st.eval_metrics["metrics"]["loss"] == 3.1
+    finally:
+        server.stop()

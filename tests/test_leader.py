@@ -90,3 +90,116 @@ def test_renew_survives_mutex_contention(tmp_path):
     thief = FileLease(path, identity="thief", lease_duration=5.0)
     thief._write(LeaseRecord("thief", time.time(), time.time(), 5.0))
     assert not leader.renew()
+
+
+# ---------------------------------------------------------------------------
+# StoreLease: cluster-wide RunOrDie through the store's versioned CAS
+# (reference: EndpointsLock rides apiserver resourceVersion the same way,
+# cmd/tf-operator/app/server.go:109-132).
+# ---------------------------------------------------------------------------
+
+from tf_operator_tpu.controller.leader import StoreLease  # noqa: E402
+from tf_operator_tpu.runtime import Store  # noqa: E402
+
+
+def test_store_lease_single_holder():
+    store = Store()
+    a = StoreLease(store, identity="a", lease_duration=5)
+    b = StoreLease(store, identity="b", lease_duration=5)
+    assert a.try_acquire()
+    assert not b.try_acquire()
+    assert a.renew()
+    assert not b.try_acquire()  # renewal moved the version; b restarts its timer
+
+
+def test_store_lease_expired_taken_over():
+    store = Store()
+    a = StoreLease(store, identity="a", lease_duration=0.2)
+    b = StoreLease(store, identity="b", lease_duration=5)
+    assert a.try_acquire()
+    assert not b.try_acquire()  # b just observed the record: not yet expired
+    # Expiry runs on b's LOCAL clock against the RECORD's advertised
+    # duration (0.2s) — b needs the version to stand still that long.
+    assert wait_for(b.try_acquire, timeout=5)
+    assert not a.renew()  # a finds the record naming b and abdicates
+
+
+def test_store_lease_release_hands_off_immediately():
+    store = Store()
+    a = StoreLease(store, identity="a", lease_duration=30)
+    b = StoreLease(store, identity="b", lease_duration=30)
+    assert a.try_acquire()
+    assert not b.try_acquire()
+    a.release()
+    assert b.try_acquire()  # "" holder = explicitly free, no expiry wait
+
+
+def test_store_lease_create_race_one_winner():
+    """Two candidates racing the first-ever acquire: the store's
+    AlreadyExists/Conflict arbitration must yield exactly one winner."""
+    store = Store()
+    leases = [StoreLease(store, identity=f"c{i}", lease_duration=30) for i in range(8)]
+    results = [None] * len(leases)
+    barrier = threading.Barrier(len(leases))
+
+    def go(i):
+        barrier.wait()
+        results[i] = leases[i].try_acquire()
+
+    threads = [threading.Thread(target=go, args=(i,)) for i in range(len(leases))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(bool(r) for r in results) == 1
+
+
+def test_store_lease_elector_failover_over_remote_store():
+    """The VERDICT's done-bar: two controllers, one remote store, exactly
+    one active; failover inside the lease+retry envelope after the leader
+    dies (stops renewing)."""
+    from tf_operator_tpu.dashboard import DashboardServer
+    from tf_operator_tpu.runtime.remote_store import RemoteStore
+
+    store = Store()
+    server = DashboardServer(store, port=0)
+    server.start()
+    try:
+        events = []
+        stop_a, stop_b = threading.Event(), threading.Event()
+        mk = lambda ident: StoreLease(  # noqa: E731
+            RemoteStore(server.url), identity=ident,
+            lease_duration=0.6, renew_period=0.2, retry_period=0.1,
+        )
+        ea = LeaderElector(
+            mk("a"),
+            on_started_leading=lambda: events.append("a-start"),
+            on_stopped_leading=lambda: events.append("a-stop"),
+            stop_event=stop_a,
+        )
+        eb = LeaderElector(
+            mk("b"),
+            on_started_leading=lambda: events.append("b-start"),
+            on_stopped_leading=lambda: events.append("b-stop"),
+            stop_event=stop_b,
+        )
+        ea.run_in_background()
+        assert wait_for(ea.is_leader.is_set, timeout=5)
+        eb.run_in_background()
+        time.sleep(0.5)
+        assert not eb.is_leader.is_set()  # exactly one active
+
+        # Leader CRASHES (network partition from the store — no clean
+        # release): its renew must abdicate (RunOrDie) and the standby must
+        # take over once the record expires, all inside the lease + retry
+        # envelope (0.6 + 0.1 s) plus scheduling slack.
+        t0 = time.monotonic()
+        ea.lease.store.base = "http://127.0.0.1:9"  # discard port: refuses
+        assert wait_for(eb.is_leader.is_set, timeout=10)
+        assert time.monotonic() - t0 < 5.0
+        assert wait_for(lambda: "a-stop" in events, timeout=10)
+        assert events[0] == "a-start" and "b-start" in events
+        stop_a.set()
+        stop_b.set()
+    finally:
+        server.stop()

@@ -425,3 +425,25 @@ def test_event_oracle_creation_counts():
     h.sync()
     evs = [e for e in h.store.list("Event") if e.reason == "SuccessfulCreateProcess"]
     assert sum(e.count for e in evs) == 3
+
+
+def test_status_writer_preserves_eval_metrics():
+    """The reconciler's status writer must never clobber eval_metrics —
+    that field is authored by the Evaluator process through the API, and
+    the reconciler's informer snapshot will usually be stale against it."""
+    h = Harness(make_job(workers=1))
+    h.sync()  # creates gang, writes Created condition
+
+    # Evaluator reports through the API between two syncs.
+    def mutate(job):
+        job.status.eval_metrics = {"step": 7, "metrics": {"loss": 2.5}, "time": 1.0}
+
+    h.store.update_with_retry("TPUJob", "default", h.job.metadata.name, mutate)
+
+    # Next sync writes status from its (stale) cached job; the merge must
+    # keep the store's eval_metrics.
+    h.ctl.job_informer.seed([h.stored_job()])
+    h.sync()
+    st = h.stored_job().status
+    assert st.eval_metrics.get("step") == 7
+    assert st.eval_metrics["metrics"]["loss"] == 2.5

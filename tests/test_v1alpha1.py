@@ -191,3 +191,116 @@ class TestRestSurface:
             assert jobs[0].metadata.name == "old-job"
         finally:
             srv.stop()
+
+
+class TestPhaseSurface:
+    """The v1alpha1 *status* surface (v1alpha1/types.go:106-160): conditions
+    map back to the phase enum so v1alpha1-generation clients polling a
+    converted job see the reference's lifecycle."""
+
+    def _job(self):
+        return convert_v1alpha1(v1_doc())
+
+    def test_phase_transitions_creating_running_done(self):
+        from tf_operator_tpu.api.types import ConditionType, ReplicaStatus, ReplicaType
+        from tf_operator_tpu.controller.status import new_condition, set_condition
+
+        job = self._job()
+        assert to_v1alpha1(job)["status"]["phase"] == ""  # pre-reconcile
+
+        # Reconcile #1: gang created, processes not yet running.
+        set_condition(job.status, new_condition(ConditionType.CREATED, "JobCreated", ""))
+        doc = to_v1alpha1(job)
+        assert doc["status"]["phase"] == "Creating"
+        assert doc["status"]["state"] == "Running"
+
+        # Reconcile #2: every process observed RUNNING.
+        set_condition(job.status, new_condition(ConditionType.RUNNING, "JobRunning", ""))
+        job.status.replica_statuses = {
+            ReplicaType.COORDINATOR: ReplicaStatus(active=1),
+            ReplicaType.WORKER: ReplicaStatus(active=3),
+        }
+        doc = to_v1alpha1(job)
+        assert doc["status"]["phase"] == "Running"
+        assert doc["status"]["state"] == "Running"
+
+        # Terminal decided but children not yet GC'd: the reference's
+        # CleanUp window.
+        set_condition(job.status, new_condition(ConditionType.SUCCEEDED, "JobSucceeded", ""))
+        job.status.replica_statuses = {
+            ReplicaType.COORDINATOR: ReplicaStatus(succeeded=1),
+            ReplicaType.WORKER: ReplicaStatus(active=2, succeeded=1),
+        }
+        assert to_v1alpha1(job)["status"]["phase"] == "CleanUp"
+
+        # GC drained the gang: Done / Succeeded.
+        job.status.replica_statuses = {
+            ReplicaType.COORDINATOR: ReplicaStatus(succeeded=1),
+            ReplicaType.WORKER: ReplicaStatus(succeeded=3),
+        }
+        doc = to_v1alpha1(job)
+        assert doc["status"]["phase"] == "Done"
+        assert doc["status"]["state"] == "Succeeded"
+        assert doc["status"]["reason"] == "JobSucceeded"
+        states = {r["tpu_replica_type"]: r for r in doc["status"]["replica_statuses"]}
+        assert states["MASTER"]["state"] == "Succeeded"
+        assert states["WORKER"]["replicas_states"]["Succeeded"] == 3
+
+    def test_failed_phase(self):
+        from tf_operator_tpu.api.types import ConditionType
+        from tf_operator_tpu.controller.status import new_condition, set_condition
+
+        job = self._job()
+        set_condition(job.status, new_condition(ConditionType.FAILED, "JobFailed", "boom"))
+        doc = to_v1alpha1(job)
+        assert doc["status"]["phase"] == "Failed"
+        assert doc["status"]["state"] == "Failed"
+        assert doc["status"]["reason"] == "JobFailed"
+
+    def test_live_job_reports_v1alpha1_phases_end_to_end(self):
+        """A converted v1alpha1 job driven by the REAL controller: the
+        dashboard's ?api_version=v1alpha1 read surface reports phases that
+        progress monotonically through the legal order and end at Done."""
+        import json
+        import sys as _sys
+        import time
+        import urllib.request
+
+        from conftest import wait_for
+        from tf_operator_tpu.controller import TPUJobController
+        from tf_operator_tpu.dashboard import DashboardServer
+        from tf_operator_tpu.runtime import LocalProcessControl, Store
+
+        store = Store()
+        pc = LocalProcessControl(
+            store,
+            command_builder=lambda p: [_sys.executable, "-c", "import time; time.sleep(0.4)"],
+        )
+        ctl = TPUJobController(store, pc, resync_period=0.1)
+        server = DashboardServer(store, port=0)
+        server.start()
+        ctl.run(workers=2)
+        try:
+            doc = v1_doc()
+            doc["metadata"]["name"] = "phased"
+            store.create(convert_v1alpha1(doc))
+
+            order = ["", "Creating", "Running", "CleanUp", "Done"]
+            seen = []
+            url = f"{server.url}/api/tpujob/default/phased?api_version=v1alpha1"
+
+            def poll():
+                with urllib.request.urlopen(url) as resp:
+                    phase = json.load(resp)["job"]["status"]["phase"]
+                if not seen or seen[-1] != phase:
+                    seen.append(phase)
+                return phase == "Done"
+
+            assert wait_for(poll, timeout=30, interval=0.02), seen
+            ranks = [order.index(p) for p in seen]
+            assert ranks == sorted(ranks), f"phase went backwards: {seen}"
+            assert "Running" in seen and seen[-1] == "Done", seen
+        finally:
+            ctl.stop()
+            pc.shutdown()
+            server.stop()

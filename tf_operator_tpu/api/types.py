@@ -41,6 +41,7 @@ KIND_PROCESS = "Process"
 KIND_ENDPOINT = "Endpoint"
 KIND_EVENT = "Event"
 KIND_HOST = "Host"
+KIND_LEASE = "Lease"
 
 # Default port the coordinator's jax.distributed service listens on
 # (replaces the reference's TF gRPC port 2222, v1alpha1/types.go:30).
@@ -258,15 +259,30 @@ class TPUJobStatus:
     last_reconcile_time: Optional[float] = None
     # Monotonic count of gang restarts (feeds backoff_limit).
     restart_count: int = 0
+    # Latest evaluator-reported scores, written by the Evaluator replica
+    # through the API (workloads/eval.py → JobContext.report_eval_metrics):
+    # {"step": int, "metrics": {name: value}, "time": ts}. The reference
+    # surfaced replica *status* per role (controller_status.go:136-154) but
+    # gave eval *results* no queryable home; here `tpujob get` and the
+    # dashboard read them from the job object.
+    eval_metrics: Dict[str, Any] = field(default_factory=dict)
 
     def phase(self) -> JobPhase:
-        """Derived v1alpha1-style phase (v1alpha1/types.go:106-116)."""
+        """Derived v1alpha1-style phase (v1alpha1/types.go:106-116).
+
+        CleanUp is the reference's "job decided, children not yet torn
+        down" window: a terminal condition with replicas still active
+        reports CleanUp until GC empties the active counters."""
         latest: Optional[Condition] = None
         for cond in self.conditions:
             if cond.status:
                 latest = cond
         if latest is None:
             return JobPhase.NONE
+        if latest.type in (ConditionType.SUCCEEDED, ConditionType.FAILED) and any(
+            rs.active > 0 for rs in self.replica_statuses.values()
+        ):
+            return JobPhase.CLEANUP
         return {
             ConditionType.CREATED: JobPhase.CREATING,
             ConditionType.RUNNING: JobPhase.RUNNING,
@@ -370,5 +386,6 @@ def _tpujob_from_dict(data: Dict[str, Any]) -> TPUJob:
         completion_time=status_d.get("completion_time"),
         last_reconcile_time=status_d.get("last_reconcile_time"),
         restart_count=status_d.get("restart_count", 0),
+        eval_metrics=status_d.get("eval_metrics", {}) or {},
     )
     return TPUJob(metadata=meta, spec=spec, status=status, kind=data.get("kind", KIND_TPUJOB))

@@ -35,6 +35,7 @@ import copy
 from typing import Any, Dict, List
 
 from tf_operator_tpu.api.types import (
+    JobPhase,
     ObjectMeta,
     ProcessTemplate,
     ReplicaSpec,
@@ -189,12 +190,24 @@ def parse_job(data: Dict[str, Any]) -> TPUJob:
     return TPUJob.from_dict(data)
 
 
+def _v1alpha1_role(role: ReplicaType) -> str:
+    return "MASTER" if role is ReplicaType.COORDINATOR else role.value.upper()
+
+
 def to_v1alpha1(job: TPUJob) -> Dict[str, Any]:
-    """Down-convert for v1alpha1-generation clients (round-trip surface)."""
+    """Down-convert for v1alpha1-generation clients (round-trip surface).
+
+    Status maps to the v1alpha1 shape (v1alpha1/types.go:106-160): the
+    phase enum (Creating/Running/CleanUp/Failed/Done) derived from
+    conditions + active counters, a coarse ``state``
+    (Running/Succeeded/Failed), a ``reason`` from the deciding condition,
+    and per-replica ``replicas_states`` counters — so a v1alpha1
+    generation client polling a converted job sees the same lifecycle it
+    saw from the reference's v1alpha1 trainer state machine."""
     entries: List[Dict[str, Any]] = []
     for role, rs in job.spec.replica_specs.items():
         d = {
-            "replica_type": "MASTER" if role is ReplicaType.COORDINATOR else role.value.upper(),
+            "replica_type": _v1alpha1_role(role),
             "replicas": rs.replicas,
             "template": {
                 "entrypoint": rs.template.entrypoint,
@@ -212,4 +225,43 @@ def to_v1alpha1(job: TPUJob) -> Dict[str, Any]:
     out = job.to_dict()
     out["api_version"] = API_VERSION_V1ALPHA1
     out["spec"]["replica_specs"] = entries
+
+    phase = job.status.phase()
+    state = {
+        JobPhase.DONE: "Succeeded",
+        JobPhase.FAILED: "Failed",
+        JobPhase.CLEANUP: "Running",
+        JobPhase.RUNNING: "Running",
+        JobPhase.CREATING: "Running",
+        JobPhase.NONE: "",
+    }[phase]
+    reason = ""
+    for cond in job.status.conditions:
+        if cond.status:
+            reason = cond.reason or reason
+    replica_statuses = [
+        {
+            "tpu_replica_type": _v1alpha1_role(role),
+            # Counters drain as children are GC'd; a fully-drained replica
+            # set inherits the job-level state rather than claiming Running.
+            "state": (
+                "Failed" if rs.failed
+                else "Succeeded" if rs.succeeded and not rs.active
+                else "Running" if rs.active
+                else state
+            ),
+            "replicas_states": {
+                "Running": rs.active,
+                "Succeeded": rs.succeeded,
+                "Failed": rs.failed,
+            },
+        }
+        for role, rs in job.status.replica_statuses.items()
+    ]
+    out["status"] = {
+        "phase": phase.value,
+        "state": state,
+        "reason": reason,
+        "replica_statuses": replica_statuses,
+    }
     return out

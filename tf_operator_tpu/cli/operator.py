@@ -43,8 +43,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-dir", default=os.path.join(os.getcwd(), "tpujob-logs"),
                    help="directory for per-process logs")
     p.add_argument("--enable-leader-elect", action="store_true",
-                   help="file-lease leader election (reference: EndpointsLock)")
+                   help="leader election (reference: EndpointsLock): a store "
+                        "Lease when --store-server is set (cluster-wide "
+                        "RunOrDie), else a file lease (one machine)")
     p.add_argument("--lease-file", default="/tmp/tpujob-operator.lease")
+    p.add_argument("--store-server", default=None,
+                   help="connect to a remote store at URL instead of hosting "
+                        "one — HA mode: several operators on different "
+                        "machines share one store, leader-elect through it, "
+                        "and exactly one reconciles")
+    p.add_argument("--store-only", action="store_true",
+                   help="host only the store + dashboard/API (the apiserver "
+                        "analogue) with no controller — the shared substrate "
+                        "for --store-server HA operators")
     p.add_argument("--chaos-level", type=int, default=0, choices=range(0, 11),
                    help="0-10: probability/10 of killing each running process "
                         "per chaos interval (reference flag was unimplemented)")
@@ -130,11 +141,31 @@ def main(argv=None) -> int:
     setup_logging(args.json_log_format)
 
     from tf_operator_tpu.controller import TPUJobController
-    from tf_operator_tpu.controller.leader import FileLease, LeaderElector
+    from tf_operator_tpu.controller.leader import FileLease, LeaderElector, StoreLease
     from tf_operator_tpu.dashboard import DashboardServer
     from tf_operator_tpu.runtime import LocalProcessControl, NativeProcessControl, Store
 
-    store = Store()
+    if args.store_server:
+        from tf_operator_tpu.runtime.remote_store import RemoteStore
+
+        store = RemoteStore(args.store_server)
+    else:
+        store = Store()
+
+    if args.store_only:
+        # apiserver analogue: store + API only; HA operators connect via
+        # --store-server and leader-elect through a Lease in this store.
+        if args.store_server:
+            sys.exit("--store-only hosts the store; it conflicts with --store-server")
+        dashboard = DashboardServer(store, host=args.host, port=args.port)
+        stop = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+        signal.signal(signal.SIGINT, lambda *_: stop.set())
+        dashboard.start()
+        log.info("store-only mode: API listening on %s", dashboard.url)
+        stop.wait()
+        dashboard.stop()
+        return 0
     if args.backend == "native":
         from tf_operator_tpu.runtime.native import NativeBuildError
 
@@ -158,6 +189,11 @@ def main(argv=None) -> int:
         store, backend, resync_period=args.resync_period,
         controller_config=controller_config,
     )
+    # In --store-server HA mode the primary API/UI lives on the store
+    # server, but each operator still serves its own endpoint: /metrics
+    # (workqueue depth, reconcile counters) exists only in the controller
+    # process, and the UI/API routes proxy reads through the RemoteStore.
+    # --port 0 picks an ephemeral port for candidates sharing a machine.
     dashboard = DashboardServer(
         store, host=args.host, port=args.port, metrics=controller.metrics
     )
@@ -194,6 +230,9 @@ def main(argv=None) -> int:
 
     dashboard.start()
     log.info("dashboard/API listening on %s", dashboard.url)
+    # Children report results (eval scores) back through the API; in HA
+    # mode that is the shared store server, locally our own dashboard.
+    controller.api_url = args.store_server or dashboard.url
 
     def start_controller():
         controller.run(workers=args.threadiness)
@@ -210,14 +249,20 @@ def main(argv=None) -> int:
         stop.set()
 
     if args.enable_leader_elect:
+        if args.store_server:
+            lease = StoreLease(store)
+            where = f"store {args.store_server}"
+        else:
+            lease = FileLease(args.lease_file)
+            where = f"file {args.lease_file}"
         elector = LeaderElector(
-            FileLease(args.lease_file),
+            lease,
             on_started_leading=start_controller,
             on_stopped_leading=lost_leadership,
             stop_event=stop,
         )
         elector.run_in_background()
-        log.info("waiting for leadership (lease %s)", args.lease_file)
+        log.info("waiting for leadership (lease in %s)", where)
     else:
         start_controller()
 

@@ -1,11 +1,25 @@
-"""Leader election: single-active-controller HA via a file lease.
+"""Leader election: single-active-controller HA.
 
 Reference parity: the operator's EndpointsLock leader election with
 lease 15s / renew 5s / retry 3s (cmd/tf-operator/app/server.go:109-132).
-On a bare host the lock object is a lease file updated atomically
-(write-to-temp + rename); the holder renews on a background thread and
-calls ``on_stopped_leading`` if the lease is lost, at which point the
-daemon must exit (the reference's RunOrDie semantics).
+
+Two interchangeable lock objects, one elector:
+
+- ``FileLease`` — a lease file updated atomically (write-to-temp + rename),
+  serialized by a kernel flock. One machine only: RunOrDie for operators
+  sharing a filesystem.
+- ``StoreLease`` — a ``Lease`` object in the Store, mutated only through
+  versioned compare-and-swap updates (the apiserver-resourceVersion CAS
+  that EndpointsLock itself rides on). Works identically over the
+  in-process Store and ``RemoteStore`` (HTTP), so two operators on
+  *different machines* pointing at one store get real cluster-wide
+  RunOrDie. Expiry is judged on each candidate's local monotonic clock
+  (the record's version must stand still for a full lease_duration before
+  takeover — client-go's rule), so machine clock skew cannot cause a
+  false takeover.
+
+The holder renews on a background thread and calls ``on_stopped_leading``
+if the lease is lost, at which point the daemon must exit.
 """
 
 from __future__ import annotations
@@ -177,6 +191,172 @@ class _LockFile:
             finally:
                 os.close(self._fd)
                 self._fd = None
+
+
+class StoreLease:
+    """Store-backed lease with the same duck-type surface as FileLease
+    (try_acquire / renew / release / identity / periods), so LeaderElector
+    takes either.
+
+    Mutual exclusion comes from the store's optimistic concurrency: every
+    write is ``update(check_version=True)`` against the version this
+    candidate last observed, so two candidates racing a takeover produce
+    one winner and one ConflictError — no flock, no read-check-write
+    window. Over RemoteStore the same CAS rides the HTTP PUT's
+    resource_version check, giving cross-machine exclusion.
+    """
+
+    def __init__(
+        self,
+        store,
+        name: str = "operator-leader",
+        namespace: str = "system",
+        identity: Optional[str] = None,
+        lease_duration: float = LEASE_DURATION,
+        renew_period: float = RENEW_PERIOD,
+        retry_period: float = RETRY_PERIOD,
+    ) -> None:
+        self.store = store
+        self.name = name
+        self.namespace = namespace
+        self.identity = identity or f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+        self.lease_duration = lease_duration
+        self.renew_period = renew_period
+        self.retry_period = retry_period
+        # Local observation clock (client-go semantics): a foreign record is
+        # expired only once its resource_version has stood still for
+        # lease_duration of OUR monotonic time. Wall stamps in the record
+        # are observability only.
+        self._observed_rv: Optional[int] = None
+        self._observed_at: float = 0.0
+        self._observed_duration: float = lease_duration
+
+    def _observe(self, rec) -> None:
+        rv = rec.metadata.resource_version
+        self._observed_duration = rec.lease_duration or self.lease_duration
+        if rv != self._observed_rv:
+            self._observed_rv = rv
+            self._observed_at = time.monotonic()
+
+    def _record_expired(self) -> bool:
+        # The RECORD's advertised duration (client-go rule): the holder
+        # declares how long its hold is good for, observers time it locally.
+        return time.monotonic() - self._observed_at >= self._observed_duration
+
+    def try_acquire(self) -> bool:
+        from tf_operator_tpu.api.types import KIND_LEASE, ObjectMeta
+        from tf_operator_tpu.runtime.objects import Lease
+        from tf_operator_tpu.runtime.store import (
+            AlreadyExistsError,
+            ConflictError,
+            NotFoundError,
+            TransientStoreError,
+        )
+
+        now = time.time()
+        try:
+            cur = self.store.get(KIND_LEASE, self.namespace, self.name)
+        except NotFoundError:
+            rec = Lease(
+                metadata=ObjectMeta(name=self.name, namespace=self.namespace),
+                holder=self.identity,
+                acquired=now,
+                renewed=now,
+                lease_duration=self.lease_duration,
+            )
+            try:
+                out = self.store.create(rec)
+            except (AlreadyExistsError, TransientStoreError):
+                return False  # lost the create race; retry later
+            self._observe(out)
+            return True
+        except TransientStoreError:
+            return False
+        self._observe(cur)
+        held_by_me = cur.holder == self.identity
+        free = cur.holder == ""  # explicit release
+        if not (held_by_me or free or self._record_expired()):
+            return False
+        cur.acquired = cur.acquired if held_by_me else now
+        cur.holder = self.identity
+        cur.renewed = now
+        # Advertise OUR duration: rivals time expiry against the record's
+        # declared duration, so a takeover must not leave a previous
+        # holder's (possibly shorter) value in place — mixed-duration
+        # candidates would otherwise disagree about when the hold lapses.
+        cur.lease_duration = self.lease_duration
+        try:
+            out = self.store.update(cur, check_version=True)
+        except (ConflictError, NotFoundError, TransientStoreError):
+            return False  # a rival CAS'd first (or store blinked); retry later
+        self._observe(out)
+        return True
+
+    def renew(self, stop: Optional[threading.Event] = None) -> bool:
+        """Renew the held lease. Transient store unreachability is NOT lease
+        loss — keep retrying until the hold we last confirmed would itself
+        have expired in a rival's eyes (observed_at + lease_duration); only
+        a record naming someone else means the lease was genuinely taken.
+        ``stop`` aborts early so shutdown never waits out the window."""
+        from tf_operator_tpu.api.types import KIND_LEASE
+        from tf_operator_tpu.runtime.store import (
+            ConflictError,
+            NotFoundError,
+            TransientStoreError,
+        )
+
+        deadline = self._observed_at + self.lease_duration
+        while True:
+            try:
+                cur = self.store.get(KIND_LEASE, self.namespace, self.name)
+            except NotFoundError:
+                return False  # deleted out from under us: abdicate
+            except TransientStoreError:
+                cur = None
+            if cur is not None:
+                self._observe(cur)
+                if cur.holder != self.identity:
+                    return False
+                cur.renewed = time.time()
+                cur.lease_duration = self.lease_duration
+                try:
+                    out = self.store.update(cur, check_version=True)
+                    self._observe(out)
+                    return True
+                except ConflictError:
+                    continue  # re-read and re-judge ownership
+                except NotFoundError:
+                    return False
+                except TransientStoreError:
+                    pass
+            if time.monotonic() >= deadline:
+                return False
+            if stop is not None:
+                if stop.wait(0.2):
+                    return False
+            else:
+                time.sleep(0.2)
+
+    def release(self) -> None:
+        """Hand off by CAS-clearing the holder (rivals treat "" as free, so
+        a successor takes over without waiting out the lease). Conflict
+        means a successor already took it — nothing to do."""
+        from tf_operator_tpu.api.types import KIND_LEASE
+        from tf_operator_tpu.runtime.store import (
+            ConflictError,
+            NotFoundError,
+            TransientStoreError,
+        )
+
+        try:
+            cur = self.store.get(KIND_LEASE, self.namespace, self.name)
+            if cur.holder != self.identity:
+                return
+            cur.holder = ""
+            cur.renewed = time.time()
+            self.store.update(cur, check_version=True)
+        except (ConflictError, NotFoundError, TransientStoreError):
+            pass
 
 
 class LeaderElector:

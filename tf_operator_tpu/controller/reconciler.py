@@ -71,6 +71,7 @@ from tf_operator_tpu.controller.status import (
 )
 from tf_operator_tpu.controller.workqueue import RateLimitingQueue
 from tf_operator_tpu.rendezvous.env import (
+    ENV_API_SERVER,
     ENV_COORDINATOR_ADDRESS,
     ENV_DCN_MESH_AXES,
     ENV_MESH_AXES,
@@ -129,6 +130,7 @@ class TPUJobController:
         host_resolver: Callable[[Process], str] = _default_host_resolver,
         port_allocator: Callable[[], int] = _default_port_allocator,
         controller_config=None,
+        api_url: Optional[str] = None,
     ) -> None:
         self.store = store
         self.process_control = process_control
@@ -136,6 +138,10 @@ class TPUJobController:
         self.resync_period = resync_period
         self.host_resolver = host_resolver
         self.port_allocator = port_allocator
+        # Operator API base URL injected into child env (ENV_API_SERVER) so
+        # workloads can report results (eval scores) back through the API.
+        # Mutable: the daemon sets it after the dashboard binds its port.
+        self.api_url = api_url
         # Admin accelerator/runtime injection (ControllerConfig,
         # api/helpers.py; reference server.go:138-156 + helpers.go:50-104).
         self.controller_config = controller_config
@@ -292,6 +298,12 @@ class TPUJobController:
 
         if is_finished(job.status):
             self._delete_children(namespace, name, job.spec.run_policy.cleanup_policy)
+            # Keep the replica counters live through the CleanUp window:
+            # with them frozen at the terminal transition, active>0 would
+            # report phase CleanUp forever even after every child exited or
+            # was GC'd (the v1alpha1 phase surface depends on the counters
+            # draining to reach Done/Failed).
+            self._refresh_terminal_counters(job)
             return
 
         if not self.expectations.satisfied(self._exp_key(key)):
@@ -395,6 +407,37 @@ class TPUJobController:
                 self.store.delete(KIND_ENDPOINT, namespace, e.metadata.name)
             except NotFoundError:
                 pass
+
+    def _refresh_terminal_counters(self, job: TPUJob) -> None:
+        """Recompute replica counters for a FINISHED job from the children
+        still in the store (no adoption — a terminal job claims nothing),
+        so the active counts drain as children exit or are GC'd and the
+        derived phase resolves CleanUp → Done/Failed. Writes only on
+        change to keep the resync loop from churning resource versions."""
+        before = {
+            rt: (rs.active, rs.succeeded, rs.failed)
+            for rt, rs in job.status.replica_statuses.items()
+        }
+        procs = self.store.list(
+            KIND_PROCESS,
+            namespace=job.metadata.namespace,
+            label_selector=self._labels_for(job),
+        )
+        initialize_replica_statuses(job.status, job.spec.replica_specs.keys())
+        for p in procs:
+            if p.metadata.owner_uid != job.metadata.uid:
+                continue
+            try:
+                rtype = ReplicaType(p.spec.replica_type)
+            except ValueError:
+                continue
+            update_replica_status(job.status, rtype, p)
+        after = {
+            rt: (rs.active, rs.succeeded, rs.failed)
+            for rt, rs in job.status.replica_statuses.items()
+        }
+        if after != before:
+            self._write_status(job)
 
     # ---- gang layout ----------------------------------------------------
 
@@ -816,6 +859,8 @@ class TPUJobController:
                 chief_host = "127.0.0.1"
             for p in procs:
                 p.spec.env[ENV_COORDINATOR_ADDRESS] = f"{chief_host}:{port}"
+                if self.api_url:
+                    p.spec.env.setdefault(ENV_API_SERVER, self.api_url)
 
             self.expectations.expect_creations(exp_key, len(procs))
             created = 0
@@ -977,10 +1022,13 @@ class TPUJobController:
                 return False  # no change — avoid a MODIFIED->enqueue->sync loop
             # restart_count is monotonic: a sync that started from a stale
             # informer snapshot must never roll back restarts recorded by
-            # a sync that raced ahead of the cache.
+            # a sync that raced ahead of the cache. eval_metrics belongs to
+            # the evaluator's API writes — always keep the store's copy.
             count = max(fresh.status.restart_count, job.status.restart_count)
+            eval_metrics = fresh.status.eval_metrics
             fresh.status = job.status
             fresh.status.restart_count = count
+            fresh.status.eval_metrics = eval_metrics
             # The rendezvous-port annotation is managed store-side
             # (_rendezvous_port persists it, _clear_rendezvous removes it);
             # merging it from a stale cached copy here would resurrect a
@@ -1003,8 +1051,11 @@ def _annotations_except_port(annotations: Dict[str, str]) -> Dict[str, str]:
 
 
 def _status_equal_ignoring_heartbeat(a, b) -> bool:
+    """eval_metrics is excluded alongside the heartbeat: the reconciler
+    never authors it (evaluator processes write it through the API), so a
+    difference there must neither trigger a write nor be overwritten."""
     import dataclasses
 
-    return dataclasses.replace(a, last_reconcile_time=None) == dataclasses.replace(
-        b, last_reconcile_time=None
-    )
+    return dataclasses.replace(
+        a, last_reconcile_time=None, eval_metrics={}
+    ) == dataclasses.replace(b, last_reconcile_time=None, eval_metrics={})

@@ -62,6 +62,21 @@ _OBJ_KIND_RE = re.compile(r"^/api/v1/([A-Za-z]+)$")
 _OBJ_RE = re.compile(r"^/api/v1/([A-Za-z]+)/([^/]+)/([^/]+)$")
 
 
+def _decode_segments(m):
+    """Percent-decode matched path segments for the JOB routes, rejecting
+    any whose decoded form is empty or contains '/' — job namespace/name
+    pairs circulate as "ns/name" STRING keys (workqueue, expectations), so
+    a %2F-smuggled slash would make distinct jobs collide there. Returns
+    None → 400. The generic /api/v1 object routes deliberately stay
+    permissive: the store keys on (kind, ns, name) TUPLES, so slashes in
+    generic object names are unambiguous — and that round-trip is pinned
+    by test_names_with_reserved_characters_round_trip."""
+    segs = tuple(unquote(g) for g in m.groups())
+    if any(not s or "/" in s for s in segs):
+        return None
+    return segs
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "tpujob-dashboard/0.1"
     store: Store = None  # set by server factory
@@ -85,7 +100,13 @@ class _Handler(BaseHTTPRequestHandler):
     def _error(self, code: int, message: str) -> None:
         self._json(code, {"error": message})
 
-    def _job_payload(self, job: TPUJob) -> dict:
+    def _job_payload(self, job: TPUJob, api_version: str = "") -> dict:
+        if api_version == "v1alpha1":
+            # v1alpha1-generation read surface: list-shaped replica specs +
+            # the phase/state status block (v1alpha1/types.go:106-160).
+            from tf_operator_tpu.api.v1alpha1 import to_v1alpha1
+
+            return to_v1alpha1(job)
         d = job.to_dict()
         d["phase"] = job.status.phase().value
         return d
@@ -118,9 +139,14 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        # ?api_version=v1alpha1 on job reads serves the older generation's
+        # shape (list replica specs + phase/state status block).
+        api_version = q.get("api_version", [""])[0]
         if path == "/api/tpujob":
             jobs = self.store.list(KIND_TPUJOB, namespace=ns)
-            return self._json(200, {"items": [self._job_payload(j) for j in jobs]})
+            return self._json(
+                200, {"items": [self._job_payload(j, api_version) for j in jobs]}
+            )
         if path == "/api/namespaces":
             spaces = sorted({j.metadata.namespace for j in self.store.list(KIND_TPUJOB)})
             return self._json(200, {"items": spaces})
@@ -132,7 +158,10 @@ class _Handler(BaseHTTPRequestHandler):
         if m:
             # Path segments arrive percent-encoded (RemoteStore quotes
             # them); decode before they become store keys.
-            ns, name = map(unquote, m.groups())
+            segs = _decode_segments(m)
+            if segs is None:
+                return self._error(400, "invalid name in path (empty or contains '/')")
+            ns, name = segs
             try:
                 job = self.store.get(KIND_TPUJOB, ns, name)
             except NotFoundError:
@@ -146,7 +175,7 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(
                 200,
                 {
-                    "job": self._job_payload(job),
+                    "job": self._job_payload(job, api_version),
                     "processes": [_to_jsonable(p) for p in procs],
                     "endpoints": [_to_jsonable(e) for e in eps],
                 },
@@ -188,7 +217,10 @@ class _Handler(BaseHTTPRequestHandler):
 
         m = _LOGS_RE.match(path)
         if m:
-            ns, name = map(unquote, m.groups())
+            segs = _decode_segments(m)
+            if segs is None:
+                return self._error(400, "invalid name in path (empty or contains '/')")
+            ns, name = segs
             try:
                 proc = self.store.get(KIND_PROCESS, ns, name)
             except NotFoundError:
@@ -356,7 +388,10 @@ class _Handler(BaseHTTPRequestHandler):
         m = _JOB_RE.match(path)
         if not m:
             return self._error(404, "DELETE at /api/tpujob/{ns}/{name} or /api/v1/{kind}/{ns}/{name}")
-        ns, name = map(unquote, m.groups())
+        segs = _decode_segments(m)
+        if segs is None:
+            return self._error(400, "invalid name in path (empty or contains '/')")
+        ns, name = segs
         try:
             self.store.delete(KIND_TPUJOB, ns, name)
         except NotFoundError:

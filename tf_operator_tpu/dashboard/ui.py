@@ -175,6 +175,19 @@ async function viewJob(ns, name){
     el('table',null, el('thead',null, el('tr',null,
       ...['Type','Active','Succeeded','Failed'].map(h=>el('th',null,h)))), rtb)));
 
+  // Evaluator-reported scores (TPUJobStatus.eval_metrics).
+  const em = j.status.eval_metrics||{};
+  if (em.step !== undefined){
+    const etb = el('tbody');
+    for (const [k,v] of Object.entries(em.metrics||{}))
+      etb.appendChild(el('tr',null, el('td',null,k),
+        el('td',null, (typeof v==='number')? v.toFixed(4): String(v))));
+    root.appendChild(el('div',{class:'card'},
+      el('h2',null,'Eval (checkpoint step '+em.step+', '+fmtTime(em.time)+')'),
+      el('table',null, el('thead',null, el('tr',null,
+        ...['Metric','Value'].map(h=>el('th',null,h)))), etb)));
+  }
+
   const logsPre = el('pre', {class:'logs', style:'display:none'});
   const ptb = el('tbody');
   for (const p of (d.processes||[])){

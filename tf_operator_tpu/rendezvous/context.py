@@ -113,3 +113,42 @@ class JobContext:
     @property
     def is_coordinator(self) -> bool:
         return self.process_id == 0
+
+    # -- result reporting --------------------------------------------------
+
+    def report_eval_metrics(self, step: int, metrics: Dict[str, float]) -> bool:
+        """Write evaluator scores into TPUJobStatus.eval_metrics through the
+        operator API (ENV_API_SERVER, injected by the controller). The
+        write is an optimistic read-modify-write against the job object —
+        stale-version races with the reconciler's status writer retry, and
+        a newer step already reported by another evaluator wins. Best
+        effort by design: scoring must not die because the operator is
+        mid-restart (returns False when nothing was written)."""
+        from tf_operator_tpu.rendezvous.env import ENV_API_SERVER
+
+        base = os.environ.get(ENV_API_SERVER, "")
+        if not base or not self.job_name:
+            return False
+        from tf_operator_tpu.api.types import KIND_TPUJOB
+        from tf_operator_tpu.runtime.remote_store import RemoteStore
+        from tf_operator_tpu.runtime.store import update_with_retry_loop
+
+        import time as _time
+
+        def mutate(job):
+            if int(job.status.eval_metrics.get("step", -1)) > step:
+                return False  # a newer checkpoint was already scored
+            job.status.eval_metrics = {
+                "step": int(step),
+                "metrics": {str(k): float(v) for k, v in metrics.items()},
+                "time": _time.time(),
+            }
+
+        try:
+            out = update_with_retry_loop(
+                RemoteStore(base), KIND_TPUJOB, self.namespace, self.job_name,
+                mutate, transient_timeout=30.0,
+            )
+        except Exception:  # noqa: BLE001 — reporting is never fatal to eval
+            return False
+        return out is not None

@@ -48,6 +48,10 @@ ENV_PROCESS_ID = "TPUJOB_PROCESS_ID"
 ENV_MESH_AXES = "TPUJOB_MESH_AXES"
 ENV_DCN_MESH_AXES = "TPUJOB_DCN_MESH_AXES"
 ENV_WORKLOAD = "TPUJOB_WORKLOAD"
+# Operator API base URL (the store-over-HTTP surface): lets workloads
+# report results back through the API — e.g. the Evaluator replica writing
+# eval scores into TPUJobStatus.eval_metrics.
+ENV_API_SERVER = "TPUJOB_API_SERVER"
 
 
 def identity_env(spec: "ProcessSpec", namespace: str) -> Dict[str, str]:

@@ -20,6 +20,7 @@ from tf_operator_tpu.api.types import (
     KIND_ENDPOINT,
     KIND_EVENT,
     KIND_HOST,
+    KIND_LEASE,
     KIND_PROCESS,
     ObjectMeta,
 )
@@ -174,6 +175,29 @@ def declare_lost(store, process: "Process", message: str) -> Optional["Process"]
         cur.status.node_lost = True
 
     return store.update_with_retry(KIND_PROCESS, meta.namespace, meta.name, mutate)
+
+
+@dataclass
+class Lease:
+    """Leader-election lease record (coordination.k8s.io Lease analogue,
+    reference: EndpointsLock in cmd/tf-operator/app/server.go:109-132).
+
+    ``acquired``/``renewed`` are wall-clock stamps for observability ONLY —
+    expiry is decided by each candidate's *local* observation clock (the
+    record's resource_version must stand still for a full lease_duration of
+    the observer's monotonic time before takeover), the client-go rule that
+    makes the protocol immune to clock skew between machines. An empty
+    ``holder`` means explicitly released (immediately acquirable)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    holder: str = ""
+    acquired: float = 0.0
+    renewed: float = 0.0
+    lease_duration: float = 15.0
+    kind: str = KIND_LEASE
+
+    def key(self) -> str:
+        return self.metadata.key()
 
 
 class EventType(str, enum.Enum):

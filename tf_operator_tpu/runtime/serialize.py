@@ -16,6 +16,7 @@ from tf_operator_tpu.api.types import (
     KIND_ENDPOINT,
     KIND_EVENT,
     KIND_HOST,
+    KIND_LEASE,
     KIND_PROCESS,
     KIND_TPUJOB,
     ObjectMeta,
@@ -31,6 +32,7 @@ from tf_operator_tpu.runtime.objects import (
     HostPhase,
     HostSpec,
     HostStatus,
+    Lease,
     Process,
     ProcessPhase,
     ProcessSpec,
@@ -81,11 +83,17 @@ def _event_from_doc(doc: Dict[str, Any]) -> Event:
     return Event(metadata=_meta(doc), **d)
 
 
+def _lease_from_doc(doc: Dict[str, Any]) -> Lease:
+    d = {k: v for k, v in doc.items() if k not in ("metadata", "kind")}
+    return Lease(metadata=_meta(doc), **d)
+
+
 _DECODERS = {
     KIND_PROCESS: _process_from_doc,
     KIND_HOST: _host_from_doc,
     KIND_ENDPOINT: _endpoint_from_doc,
     KIND_EVENT: _event_from_doc,
+    KIND_LEASE: _lease_from_doc,
     KIND_TPUJOB: lambda doc: TPUJob.from_dict(doc),
 }
 

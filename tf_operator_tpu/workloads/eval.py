@@ -39,7 +39,7 @@ def main(ctx: JobContext) -> None:
 
     from tf_operator_tpu.models.transformer import (
         init_transformer,
-        lm_loss,
+        lm_loss_and_metrics,
         preset_from_workload,
         transformer_logical_axes,
     )
@@ -67,7 +67,8 @@ def main(ctx: JobContext) -> None:
     mesh = build_mesh({"dp": dp}, devices=jax.devices()[:dp])
     trainer = Trainer(
         mesh,
-        loss_fn=lambda p, tok, extra: lm_loss(p, tok, cfg, mesh=mesh),
+        # this Trainer only templates state for restore — eval never steps
+        loss_fn=lambda p, tok, extra: lm_loss_and_metrics(p, tok, cfg, mesh=mesh)[0],
         init_fn=lambda k: init_transformer(k, cfg),
         logical_axes=transformer_logical_axes(cfg),
         config=TrainerConfig(),
@@ -88,7 +89,14 @@ def main(ctx: JobContext) -> None:
         for i in range(n_batches)
     ]
 
-    eval_fn = jax.jit(lambda params, tok: lm_loss(params, tok, cfg, mesh=mesh))
+    # Score CROSS-ENTROPY, not the training objective: for MoE configs
+    # lm_loss includes the weighted router aux losses, which would skew
+    # eval comparisons against dense baselines or no-aux ablations.
+    eval_fn = jax.jit(
+        lambda params, tok: lm_loss_and_metrics(params, tok, cfg, mesh=mesh)[1][
+            "ce_loss"
+        ]
+    )
 
     def write_report(scored):
         if not report_path:
@@ -99,16 +107,37 @@ def main(ctx: JobContext) -> None:
         os.replace(tmp, report_path)  # atomic: readers never see a partial file
 
     scored: dict = {}
+    pruned: set = set()  # steps that vanished mid-scan (keep-N retention)
     deadline = time.time() + max_wait_s
-    while True:
+    done = False
+    while not done:
         # The orbax manager caches its step list at construction; reload()
         # re-scans so the trainers' new saves become visible.
         manager.reload()
-        step = manager.latest_step()
-        if step is not None and step not in scored:
-            params = manager.restore_params(
-                trainer.state_template().params, step=step
-            )
+        # Score EVERY unscored checkpoint, oldest first — when the trainer
+        # saves faster than eval scores, scoring only latest_step() would
+        # silently skip intermediates and leave gaps in eval_report.
+        # One-shot mode keeps its contract: score the latest and exit.
+        steps = manager.all_steps()
+        if not train_steps:
+            steps = steps[-1:]
+        for step in steps:
+            if step in scored or step in pruned:
+                continue
+            try:
+                params = manager.restore_params(
+                    trainer.state_template().params, step=step
+                )
+            except Exception as exc:  # noqa: BLE001
+                # Keep-N retention can prune an older step between our
+                # directory scan and the restore (the exact races-with-a-
+                # live-trainer scenario this loop exists for): a vanished
+                # checkpoint is a skip, not an evaluator death. The next
+                # reload() drops it from all_steps().
+                log.warning("checkpoint step=%d vanished mid-scan (%s); skipping",
+                            step, exc)
+                pruned.add(step)
+                continue
             losses = [float(eval_fn(params, tok)) for tok in eval_batches]
             scored[step] = sum(losses) / len(losses)
             log.info(
@@ -116,17 +145,23 @@ def main(ctx: JobContext) -> None:
                 step, scored[step], n_batches, batch, seq,
             )
             write_report(scored)
+            # Surface the score where it is queryable: tpujob get / the
+            # dashboard read TPUJobStatus.eval_metrics (best-effort —
+            # standalone runs without an operator just skip it).
+            ctx.report_eval_metrics(step, {"loss": scored[step]})
             deadline = time.time() + max_wait_s  # progress resets the clock
             if train_steps and step >= train_steps:
+                done = True
                 break
             if not train_steps:
-                break  # one-shot mode: score the latest and exit
-        if time.time() > deadline:
+                done = True  # one-shot mode: score the latest and exit
+        if not done and time.time() > deadline:
             raise TimeoutError(
                 f"no new checkpoint under {ckpt_dir} within {max_wait_s}s "
                 f"(scored: {sorted(scored)})"
             )
-        time.sleep(poll_s)
+        if not done:
+            time.sleep(poll_s)
 
     best = min(scored.values())
     log.info("eval done: %d checkpoints scored, best loss %.4f", len(scored), best)
