@@ -215,6 +215,17 @@ def main() -> None:
     warmup = 2
 
     cfg = ResNetConfig.resnet50()
+    # Opt-in levers (BASELINE.md "BN decomposition"): BENCH_BN_STATS_GRAD=0
+    # drops the BN stats gradient (+5 MFU pts, changed dynamics — diverges
+    # at lr 0.1 on synthetic data); BENCH_FUSED_1X1=1 routes 1x1 convs
+    # through the Pallas fused matmul+stats kernel (measured SLOWER than
+    # XLA convs — kept as the documented negative result).
+    import dataclasses
+
+    if os.environ.get("BENCH_BN_STATS_GRAD", "1") == "0":
+        cfg = dataclasses.replace(cfg, bn_stats_stop_gradient=True)
+    if os.environ.get("BENCH_FUSED_1X1", "0") == "1":
+        cfg = dataclasses.replace(cfg, fused_1x1=True)
     mesh = build_mesh({"dp": n_chips})
 
     def init_fn(key):
@@ -295,25 +306,33 @@ def main() -> None:
     train_flops = resnet_train_flops(fwd_flops, batch)
     achieved_mfu = mfu(train_flops, step_s, n_chips)
 
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_images_per_sec_per_chip",
-                "value": round(images_per_sec_per_chip, 2),
-                "unit": "images/sec/chip",
-                "vs_baseline": round(achieved_mfu / 0.50, 4),
-                "mfu": round(achieved_mfu, 4),
-                "step_time_s": round(step_s, 5),
-                "batch": batch,
-                "image_size": image_size,
-                "n_chips": n_chips,
-                "device": getattr(dev, "device_kind", dev.platform),
-                "submit_to_first_step_s": round(first_step_s, 2),
-                "compile_cache": bool(cache_dir),
-                "loss": round(float(metrics["loss"]), 4),
-            }
-        )
-    )
+    # Measured v5e ceilings (BASELINE.md "roofline decomposition", measured
+    # via tools/roofline --mode conv + the frozen-stats ablation): the
+    # conv-only (BN-free) network fwd+bwd sustains 45.3% of peak; the full
+    # step with BN statistics FROZEN (everything XLA can fuse, stats
+    # barrier removed) reaches 39.4%. vs_ceiling judges the exact-BN step
+    # against the latter — the achievable-step ceiling.
+    ceiling = float(os.environ.get("BENCH_CEILING", "0.394")) if on_tpu else None
+
+    out = {
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(images_per_sec_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(achieved_mfu / 0.50, 4),
+        "mfu": round(achieved_mfu, 4),
+        "step_time_s": round(step_s, 5),
+        "batch": batch,
+        "image_size": image_size,
+        "n_chips": n_chips,
+        "device": getattr(dev, "device_kind", dev.platform),
+        "submit_to_first_step_s": round(first_step_s, 2),
+        "compile_cache": bool(cache_dir),
+        "loss": round(float(metrics["loss"]), 4),
+    }
+    if ceiling:
+        out["ceiling_mfu"] = ceiling
+        out["vs_ceiling"] = round(achieved_mfu / ceiling, 4)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

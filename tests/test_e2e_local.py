@@ -152,6 +152,45 @@ def test_ring_attention_context_parallel_gang(rig):
     assert ok, f"conditions: {[(c.type.value, c.reason, c.message) for c in st.conditions]}"
 
 
+def test_pipeline_parallel_gang(rig):
+    """Pipeline parallelism through the FULL stack: a 2-process gang
+    rendezvouses, builds a pp-axis mesh spanning the processes, and trains
+    the transformer with its layer stack stage-partitioned across the two
+    processes (GPipe fill-drain, activations over ppermute/gloo) to
+    Succeeded — the operator analogue of the in-process pp tests."""
+    store = rig
+    job = TPUJob(
+        metadata=ObjectMeta(name="pp-gang"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=2,
+                    template=ProcessTemplate(
+                        entrypoint="tf_operator_tpu.workloads.lm:main",
+                        env=dict(DATAPLANE_ENV),
+                    ),
+                )
+            },
+        ),
+    )
+    job.spec.topology.mesh_axes = {"pp": 2}
+    job.spec.workload = {
+        "preset": "tiny",
+        "steps": 3,
+        "batch_size": 4,
+        "seq_len": 32,
+        "pp_microbatches": 2,
+        "remat": False,
+    }
+    store.create(job)
+    ok = wait_for(
+        lambda: has_condition(job_status(store, "pp-gang"), ConditionType.SUCCEEDED),
+        timeout=240,
+    )
+    st = job_status(store, "pp-gang")
+    assert ok, f"conditions: {[(c.type.value, c.reason, c.message) for c in st.conditions]}"
+
+
 def test_checkpoint_resume_across_gang_restart(tmp_path):
     """Restart-based recovery, end-to-end (SURVEY.md §5 checkpoint/resume):
     an LM training job checkpoints every 2 steps, dies RETRYABLY (138) at

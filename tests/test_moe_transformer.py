@@ -166,3 +166,224 @@ def test_top2_moe_trains():
         state, metrics = trainer.step(state, tok)
         losses.append(float(metrics["loss"]))
     assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def _train_router_ablation(moe_aux_weight, moe_zloss_weight, steps=100):
+    """Train tiny-moe from a router init skewed toward expert 0, fresh
+    random batches each step (memorizable fixed batches mask the routing
+    dynamics). Returns (expert_entropy, drop_frac) on held-out tokens."""
+    from tf_operator_tpu.models.transformer import lm_loss_and_metrics
+
+    cfg = preset(
+        "tiny-moe", dtype=jnp.float32,
+        moe_aux_weight=moe_aux_weight, moe_zloss_weight=moe_zloss_weight,
+    )
+    mesh = build_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = Trainer(
+        mesh,
+        loss_fn=lambda p, tok, e: lm_loss(p, tok, cfg, mesh=mesh),
+        init_fn=lambda k: init_transformer(k, cfg),
+        logical_axes=transformer_logical_axes(cfg),
+        config=TrainerConfig(optimizer="adamw", learning_rate=3e-3),
+    )
+    state = trainer.init(jax.random.PRNGKey(0))
+    wr = state.params["layers"]["w_router"]
+    state.params["layers"]["w_router"] = wr.at[..., 0].set(wr[..., 0] + 1.0)
+    key = jax.random.PRNGKey(1)
+    for _ in range(steps):
+        key, k2 = jax.random.split(key)
+        batch = jax.device_put(
+            jax.random.randint(k2, (8, 32), 0, cfg.vocab), trainer.batch_sharding
+        )
+        state, _ = trainer.step(state, batch)
+    held_out = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(99), (8, 32), 0, cfg.vocab),
+        trainer.batch_sharding,
+    )
+    _, m = jax.jit(lambda p, t: lm_loss_and_metrics(p, t, cfg, mesh=mesh))(
+        state.params, held_out
+    )
+    return float(m["moe_expert_entropy"]), float(m["moe_drop_frac"])
+
+
+def test_aux_losses_repair_router_imbalance_where_no_aux_collapses():
+    """The load-balance + z losses are what make MoE *trainable at
+    quality* (VERDICT #4): from an imbalanced router init, 100 training
+    steps WITH the aux losses drive expert-assignment entropy back toward
+    uniform (ln 4 ≈ 1.386) with near-zero capacity drops, while the
+    no-aux ablation stays collapsed and drops a quarter of its tokens.
+    Calibrated values (seeded, deterministic per backend; CPU test env:
+    no-aux ≈ (0.91, 0.13), aux ≈ (1.2, <0.01))."""
+    ent_no_aux, drop_no_aux = _train_router_ablation(0.0, 0.0)
+    ent_aux, drop_aux = _train_router_ablation(0.05, 1e-3)
+    assert ent_no_aux < 0.95, (ent_no_aux, drop_no_aux)
+    assert drop_no_aux > 0.08, (ent_no_aux, drop_no_aux)
+    assert ent_aux > 1.05, (ent_aux, drop_aux)
+    assert drop_aux < 0.05, (ent_aux, drop_aux)
+    assert ent_aux > ent_no_aux + 0.15
+
+
+def test_lm_loss_metrics_expose_router_stats():
+    """lm_loss_and_metrics surfaces router telemetry; the scalar lm_loss
+    includes the weighted aux terms (ablation: zero weights give pure CE)."""
+    from tf_operator_tpu.models.transformer import lm_loss_and_metrics
+
+    cfg = preset("tiny-moe", dtype=jnp.float32)
+    params = init_transformer(jax.random.PRNGKey(0), cfg)
+    toks = tokens()
+    total, m = lm_loss_and_metrics(params, toks, cfg)
+    for key in ("ce_loss", "moe_lb_loss", "moe_z_loss", "moe_expert_entropy",
+                "moe_drop_frac"):
+        assert key in m, key
+    # total = ce + weighted aux terms, all finite
+    expect = (
+        m["ce_loss"]
+        + cfg.moe_aux_weight * m["moe_lb_loss"]
+        + cfg.moe_zloss_weight * m["moe_z_loss"]
+    )
+    np.testing.assert_allclose(float(total), float(expect), rtol=1e-6)
+    # zero-weight config: scalar loss is pure CE
+    cfg0 = preset("tiny-moe", dtype=jnp.float32, moe_aux_weight=0.0,
+                  moe_zloss_weight=0.0)
+    np.testing.assert_allclose(
+        float(lm_loss(params, toks, cfg0)), float(m["ce_loss"]), rtol=1e-6
+    )
+    # near-uniform routing at init: lb_loss ~ 1, entropy near ln(E)
+    assert 0.8 < float(m["moe_lb_loss"]) < 1.3
+    assert float(m["moe_expert_entropy"]) > 1.0
+
+
+def test_moe_stats_agree_between_single_and_sharded_paths():
+    """Aggregate router stats (load, mean gate) must agree between the
+    single-device and ep-sharded paths — drop PATTERNS may differ (see
+    moe_apply docstring) but the aggregate view is layout-invariant when
+    nothing drops."""
+    from tf_operator_tpu.parallel.moe import moe_apply
+
+    n_experts, d, tok = 8, 8, 64
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (tok, d), jnp.float32)
+    gate_logits = jax.random.normal(jax.random.PRNGKey(1), (tok, n_experts))
+    w = {"w": jax.random.normal(jax.random.PRNGKey(2), (n_experts, d, d)) * 0.1}
+    expert_fn = lambda wp, t: t @ wp["w"]  # noqa: E731
+
+    _, s_single = moe_apply(
+        x, gate_logits, w, expert_fn, None,
+        capacity_factor=float(n_experts), return_stats=True,
+    )
+    mesh = build_mesh({"ep": jax.device_count()})
+    _, s_shard = moe_apply(
+        x, gate_logits, w, expert_fn, mesh,
+        capacity_factor=float(n_experts), return_stats=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(s_single["expert_load"]), np.asarray(s_shard["expert_load"]),
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(s_single["mean_gate"]), np.asarray(s_shard["mean_gate"]),
+        atol=1e-6,
+    )
+    assert float(s_single["drop_frac"]) == 0.0
+    assert float(s_shard["drop_frac"]) == 0.0
+
+
+def test_moe_lb_gradient_agrees_between_single_and_sharded_paths():
+    """The load-balance gradient must be layout-invariant: shard_map's
+    transpose of the replicated (P()) stats outputs must not rescale the
+    mean_gate cotangent — otherwise multi-chip MoE training would apply a
+    silently mis-scaled balance pressure vs the CPU-tested path."""
+    from tf_operator_tpu.parallel.moe import moe_apply
+
+    n_experts, d, tok = 8, 8, 64
+    x = jax.random.normal(jax.random.PRNGKey(0), (tok, d), jnp.float32)
+    gate_logits0 = jax.random.normal(jax.random.PRNGKey(1), (tok, n_experts))
+    w = {"w": jax.random.normal(jax.random.PRNGKey(2), (n_experts, d, d)) * 0.1}
+    expert_fn = lambda wp, t: t @ wp["w"]  # noqa: E731
+
+    def lb_loss(gate_logits, mesh):
+        _, stats = moe_apply(
+            x, gate_logits, w, expert_fn, mesh,
+            capacity_factor=float(n_experts), return_stats=True,
+        )
+        return n_experts * jnp.sum(stats["expert_load"] * stats["mean_gate"])
+
+    g_single = jax.grad(lb_loss)(gate_logits0, None)
+    mesh = build_mesh({"ep": jax.device_count()})
+    g_shard = jax.grad(lb_loss)(gate_logits0, mesh)
+    np.testing.assert_allclose(
+        np.asarray(g_single), np.asarray(g_shard), atol=1e-6
+    )
+    assert float(jnp.max(jnp.abs(g_single))) > 0  # the probe isn't vacuous
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-parallel transformer (VERDICT #5: a REAL model through
+# pipeline_apply — toy tanh retired)
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_transformer_matches_single_device_oracle():
+    """pp=4 GPipe forward of the tiny transformer == the plain scan
+    forward, exactly (same stacked-params math, f32)."""
+    from tf_operator_tpu.models.transformer import transformer_hidden
+
+    cfg_pp = preset("tiny", dtype=jnp.float32, remat=False, pp_microbatches=4)
+    cfg_1d = preset("tiny", dtype=jnp.float32, remat=False)
+    # 4 layers so pp=4 gives one layer per stage; tiny has 2 — widen it
+    cfg_pp = preset("tiny", dtype=jnp.float32, remat=False, pp_microbatches=4,
+                    n_layers=4)
+    cfg_1d = preset("tiny", dtype=jnp.float32, remat=False, n_layers=4)
+    params = init_transformer(jax.random.PRNGKey(0), cfg_pp)
+    tok = tokens(batch=8)
+    mesh = build_mesh({"pp": 4, "dp": 2})
+    got = transformer_hidden(params, tok, cfg_pp, mesh)
+    want = transformer_hidden(params, tok, cfg_1d, None)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_pipeline_transformer_trains_through_trainer():
+    """The VERDICT done-bar: a transformer TRAINS through the pipeline —
+    full Trainer over a pp x dp mesh, layer params sharded over pp
+    (logical "layers" -> pp rule), loss decreasing, gradients real."""
+    cfg = preset("tiny", dtype=jnp.float32, remat=False, n_layers=4,
+                 pp_microbatches=4)
+    mesh = build_mesh({"pp": 4, "dp": 2})
+    trainer = Trainer(
+        mesh,
+        loss_fn=lambda p, tok, e: lm_loss(p, tok, cfg, mesh=mesh),
+        init_fn=lambda k: init_transformer(k, cfg),
+        logical_axes=transformer_logical_axes(cfg),
+        config=TrainerConfig(optimizer="adamw", learning_rate=1e-3),
+    )
+    state = trainer.init(jax.random.PRNGKey(0))
+    # layer-stacked params actually shard over pp
+    wq = state.params["layers"]["wq"]
+    spec_axes = {
+        ax for axes in wq.sharding.spec if axes for ax in (
+            axes if isinstance(axes, tuple) else (axes,)
+        )
+    }
+    assert "pp" in spec_axes, wq.sharding
+    tok = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab),
+        trainer.batch_sharding,
+    )
+    losses = []
+    for _ in range(4):
+        state, metrics = trainer.step(state, tok)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_pipeline_moe_rejected_loudly():
+    from tf_operator_tpu.models.transformer import transformer_hidden
+
+    cfg = preset("tiny-moe", dtype=jnp.float32, pp_microbatches=2)
+    params = init_transformer(jax.random.PRNGKey(0), cfg)
+    mesh = build_mesh({"pp": 2, "dp": 4})
+    with pytest.raises(NotImplementedError, match="MoE"):
+        transformer_hidden(params, tokens(), cfg, mesh)

@@ -61,6 +61,25 @@ class TransformerConfig:
     moe_top_k: int = 1
     capacity_factor: float = 2.0
     ep_axis: str = "ep"
+    # Router auxiliary losses — without them top-k routing collapses onto a
+    # few experts under real training. moe_aux_weight scales the Switch
+    # load-balance loss  E * Σ_e f_e·P_e  (f_e = fraction of token-choices
+    # assigned to expert e — non-differentiable, acts as the coefficient;
+    # P_e = mean router probability — carries the gradient; uniform routing
+    # gives exactly 1.0). moe_zloss_weight scales the ST-MoE router z-loss
+    # mean(logsumexp(router_logits)²), which keeps router logits from
+    # drifting to magnitudes where softmax saturates and bf16 rounds.
+    # Both default ON for MoE configs (0.0 disables — the ablation knob).
+    moe_aux_weight: float = 0.01
+    moe_zloss_weight: float = 1e-3
+    # Pipeline parallelism (parallel.pipeline): with a pp axis in the mesh
+    # and pp_microbatches > 0, the layer stack is stage-partitioned into
+    # mesh.shape["pp"] groups of n_layers/pp contiguous layers and run as a
+    # GPipe fill-drain schedule (activations ppermute stage-to-stage);
+    # embed/norm/head stay replicated. Composes with dp (each dp group
+    # pipelines its own batch slice). 0 = no pipeline.
+    pp_microbatches: int = 0
+    pp_axis: str = "pp"
 
     def __post_init__(self):
         if self.n_experts and not (1 <= self.moe_top_k <= self.n_experts):
@@ -305,18 +324,23 @@ def _layer(x, layer_params, cfg: TransformerConfig, mesh):
 
     h = _rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps)
     if cfg.n_experts:
-        x = x + _moe_mlp(h, layer_params, cfg, mesh)
-        return x
+        moe_out, aux = _moe_mlp(h, layer_params, cfg, mesh)
+        return x + moe_out, aux
     gate = jax.nn.silu(h @ layer_params["w_gate"].astype(x.dtype))
     up = h @ layer_params["w_up"].astype(x.dtype)
     x = x + (gate * up) @ layer_params["w_down"].astype(x.dtype)
-    return x
+    return x, None
 
 
 def _moe_mlp(h, layer_params, cfg: TransformerConfig, mesh):
     """Top-k expert MLP (k = cfg.moe_top_k: 1 Switch / 2 Mixtral-style):
     router -> all-to-all dispatch over the ep axis (parallel.moe) ->
-    per-expert SwiGLU -> gate-weighted combine."""
+    per-expert SwiGLU -> gate-weighted combine.
+
+    Returns (out, aux) — aux carries the router losses (UNWEIGHTED; the
+    loss head applies cfg.moe_aux_weight / cfg.moe_zloss_weight) plus
+    observability stats: {"lb_loss", "z_loss", "expert_load" [E],
+    "drop_frac"}."""
     from tf_operator_tpu.parallel.moe import moe_apply
 
     b, t, d = h.shape
@@ -333,7 +357,7 @@ def _moe_mlp(h, layer_params, cfg: TransformerConfig, mesh):
         "w_up": layer_params["w_up"],
         "w_down": layer_params["w_down"],
     }
-    out = moe_apply(
+    out, stats = moe_apply(
         flat,
         gate_logits,
         expert_params,
@@ -345,29 +369,127 @@ def _moe_mlp(h, layer_params, cfg: TransformerConfig, mesh):
         # must contribute 0, not its own input again
         dropped="zero",
         k_top=cfg.moe_top_k,
+        return_stats=True,
     )
-    return out.reshape(b, t, d)
+    # Switch load-balance loss: E * Σ_e f_e·P_e. f_e (expert_load) comes
+    # out of the discrete top-k assignment, so it carries no gradient and
+    # acts as a per-expert coefficient on the differentiable mean gate
+    # probability — overloaded experts get their router prob pushed down.
+    lb_loss = cfg.n_experts * jnp.sum(
+        stats["expert_load"] * stats["mean_gate"]
+    )
+    # ST-MoE router z-loss: keeps router logits near the softmax's
+    # well-conditioned range.
+    z = jax.scipy.special.logsumexp(gate_logits.astype(jnp.float32), axis=-1)
+    z_loss = jnp.mean(jnp.square(z))
+    aux = {
+        "lb_loss": lb_loss,
+        "z_loss": z_loss,
+        "expert_load": stats["expert_load"],
+        "drop_frac": stats["drop_frac"],
+    }
+    return out.reshape(b, t, d), aux
 
 
-def transformer_hidden(params, tokens, cfg: TransformerConfig, mesh=None):
-    """tokens: [b, t] int32 -> final-norm hidden states [b, t, d] (cfg.dtype)."""
-    x = params["embed"].astype(cfg.dtype)[tokens]
-
-    layer_fn = partial(_layer, cfg=cfg, mesh=mesh)
+def _remat_wrap(layer_fn, cfg: TransformerConfig):
     if cfg.remat in (True, "full"):
-        layer_fn = jax.checkpoint(layer_fn)
-    elif cfg.remat == "dots":
-        layer_fn = jax.checkpoint(
+        return jax.checkpoint(layer_fn)
+    if cfg.remat == "dots":
+        return jax.checkpoint(
             layer_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
         )
-    elif cfg.remat not in (False, None, "none"):
+    if cfg.remat not in (False, None, "none"):
         raise ValueError(f"unknown remat mode {cfg.remat!r}")
+    return layer_fn
+
+
+def _use_pipeline(cfg: TransformerConfig, mesh) -> bool:
+    return bool(
+        cfg.pp_microbatches
+        and mesh is not None
+        and cfg.pp_axis in getattr(mesh, "axis_names", ())
+        and mesh.shape[cfg.pp_axis] > 1
+    )
+
+
+def transformer_hidden_pp(params, tokens, cfg: TransformerConfig, mesh):
+    """Pipeline-parallel layer stack: n_layers/pp contiguous layers per
+    stage through parallel.pipeline.pipeline_apply (GPipe fill-drain,
+    activations over ppermute). The per-stage body is itself a lax.scan
+    over the stage's layers — the same stacked-params execution the
+    single-device path uses, so the oracle comparison is exact math.
+
+    Attention/MLP within a stage run stage-local (mesh=None to _layer):
+    pp composes with dp here; tp-within-stage would need the mesh visible
+    inside shard_map and is future surface. MoE + pipeline is rejected
+    loudly rather than silently mis-sharded."""
+    from tf_operator_tpu.parallel.pipeline import pipeline_apply
+
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "MoE layers inside a pipeline stage are not supported yet — "
+            "run MoE configs with ep (+dp), or dense configs with pp"
+        )
+    n_stages = mesh.shape[cfg.pp_axis]
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pp={n_stages}"
+        )
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    layer_fn = _remat_wrap(partial(_layer, cfg=cfg, mesh=None), cfg)
+
+    def stage_fn(stage_layers, xb):
+        def body(h, lp):
+            out, _ = layer_fn(h, lp)
+            return out, None
+
+        out, _ = jax.lax.scan(body, xb, stage_layers)
+        return out
+
+    per_stage = cfg.n_layers // n_stages
+    stage_params = jax.tree_util.tree_map(
+        lambda a: a.reshape((n_stages, per_stage) + a.shape[1:]),
+        params["layers"],
+    )
+    h = pipeline_apply(
+        stage_params, x, stage_fn, mesh, cfg.pp_microbatches, cfg.pp_axis
+    )
+    return _rms_norm(h, params["final_norm"], cfg.norm_eps)
+
+
+def transformer_hidden(params, tokens, cfg: TransformerConfig, mesh=None,
+                       with_aux: bool = False):
+    """tokens: [b, t] int32 -> final-norm hidden states [b, t, d] (cfg.dtype).
+
+    ``with_aux`` also returns the MoE router aux dict (None for dense):
+    {"lb_loss", "z_loss" — mean over layers, unweighted;
+    "expert_load" [L, E], "drop_frac" [L] — per layer, for telemetry}.
+
+    With cfg.pp_microbatches set and a pp axis in the mesh, the layer
+    stack runs as a GPipe pipeline (transformer_hidden_pp)."""
+    if _use_pipeline(cfg, mesh):
+        h = transformer_hidden_pp(params, tokens, cfg, mesh)
+        return (h, None) if with_aux else h
+    x = params["embed"].astype(cfg.dtype)[tokens]
+
+    layer_fn = _remat_wrap(partial(_layer, cfg=cfg, mesh=mesh), cfg)
 
     def scan_body(x, layer_params):
-        return layer_fn(x, layer_params), None
+        return layer_fn(x, layer_params)  # (new_x, per-layer aux or None)
 
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
-    return _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    x, aux_stack = jax.lax.scan(scan_body, x, params["layers"])
+    h = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if not with_aux:
+        return h
+    if aux_stack is None:
+        return h, None
+    aux = {
+        "lb_loss": jnp.mean(aux_stack["lb_loss"]),
+        "z_loss": jnp.mean(aux_stack["z_loss"]),
+        "expert_load": aux_stack["expert_load"],  # [L, E]
+        "drop_frac": aux_stack["drop_frac"],  # [L]
+    }
+    return h, aux
 
 
 def transformer_forward(params, tokens, cfg: TransformerConfig, mesh=None):
@@ -380,46 +502,86 @@ def transformer_forward(params, tokens, cfg: TransformerConfig, mesh=None):
 MASK_TOKEN = 0
 
 
-def lm_loss(params, tokens, cfg: TransformerConfig, mesh=None, key=None, mask_rate=0.15):
+def lm_loss_and_metrics(params, tokens, cfg: TransformerConfig, mesh=None, key=None,
+                        mask_rate=0.15):
     """Causal: next-token cross entropy. Bidirectional (BERT-class): masked
     language modeling — ``mask_rate`` of positions are replaced with
     MASK_TOKEN and only those positions contribute to the loss (training on
-    unmasked inputs would be degenerate identity reconstruction)."""
+    unmasked inputs would be degenerate identity reconstruction).
+
+    Returns (total_loss, metrics). For MoE configs the total includes the
+    weighted router losses and metrics carries the router telemetry:
+    ce_loss, moe_lb_loss, moe_z_loss (unweighted), moe_expert_entropy
+    (mean over layers, nats — uniform routing = ln(E)), moe_drop_frac."""
+    def _hidden(inp):
+        return transformer_hidden(params, inp, cfg, mesh, with_aux=True)
+
     if cfg.causal:
         if cfg.fused_xent:
             from tf_operator_tpu.ops.fused_cross_entropy import fused_cross_entropy
 
-            h = transformer_hidden(params, tokens, cfg, mesh)[:, :-1]
+            h, aux = _hidden(tokens)
+            h = h[:, :-1]
             b, t, d = h.shape
-            return fused_cross_entropy(
+            ce = fused_cross_entropy(
                 h.reshape(b * t, d), params["embed"], tokens[:, 1:].reshape(b * t)
             )
-        logits = transformer_forward(params, tokens, cfg, mesh)
-        targets = tokens[:, 1:]
-        logits = logits[:, :-1]
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return -jnp.mean(ll)
-    if key is None:
-        key = jax.random.PRNGKey(0)
-    mask = jax.random.bernoulli(key, mask_rate, tokens.shape)
-    inputs = jnp.where(mask, MASK_TOKEN, tokens)
-    if cfg.fused_xent:
-        from tf_operator_tpu.ops.fused_cross_entropy import fused_cross_entropy
+        else:
+            h, aux = _hidden(tokens)
+            logits = (h @ params["embed"].astype(cfg.dtype).T).astype(jnp.float32)
+            targets = tokens[:, 1:]
+            logits = logits[:, :-1]
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+            ce = -jnp.mean(ll)
+    else:
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        mask = jax.random.bernoulli(key, mask_rate, tokens.shape)
+        inputs = jnp.where(mask, MASK_TOKEN, tokens)
+        h, aux = _hidden(inputs)
+        if cfg.fused_xent:
+            from tf_operator_tpu.ops.fused_cross_entropy import fused_cross_entropy
 
-        h = transformer_hidden(params, inputs, cfg, mesh)
-        b, t, d = h.shape
-        return fused_cross_entropy(
-            h.reshape(b * t, d),
-            params["embed"],
-            tokens.reshape(b * t),
-            weights=mask.reshape(b * t),
+            b, t, d = h.shape
+            ce = fused_cross_entropy(
+                h.reshape(b * t, d),
+                params["embed"],
+                tokens.reshape(b * t),
+                weights=mask.reshape(b * t),
+            )
+        else:
+            logits = (h @ params["embed"].astype(cfg.dtype).T).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ll = jnp.take_along_axis(logp, tokens[..., None], axis=-1)[..., 0]
+            denom = jnp.maximum(jnp.sum(mask), 1)
+            ce = -jnp.sum(ll * mask) / denom
+
+    metrics = {"ce_loss": ce}
+    total = ce
+    if aux is not None:
+        total = (
+            ce
+            + cfg.moe_aux_weight * aux["lb_loss"]
+            + cfg.moe_zloss_weight * aux["z_loss"]
         )
-    logits = transformer_forward(params, inputs, cfg, mesh)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, tokens[..., None], axis=-1)[..., 0]
-    denom = jnp.maximum(jnp.sum(mask), 1)
-    return -jnp.sum(ll * mask) / denom
+        load = aux["expert_load"]  # [L, E]
+        p = load / jnp.maximum(jnp.sum(load, axis=-1, keepdims=True), 1e-9)
+        entropy = -jnp.sum(p * jnp.log(jnp.maximum(p, 1e-9)), axis=-1)  # [L]
+        metrics.update(
+            moe_lb_loss=aux["lb_loss"],
+            moe_z_loss=aux["z_loss"],
+            moe_expert_entropy=jnp.mean(entropy),
+            moe_drop_frac=jnp.mean(aux["drop_frac"]),
+        )
+    return total, metrics
+
+
+def lm_loss(params, tokens, cfg: TransformerConfig, mesh=None, key=None, mask_rate=0.15):
+    """Scalar training loss (lm_loss_and_metrics without the telemetry);
+    includes the weighted MoE router losses for MoE configs."""
+    total, _ = lm_loss_and_metrics(params, tokens, cfg, mesh, key, mask_rate)
+    return total
 
 
 def preset(name: str, **overrides) -> TransformerConfig:
@@ -434,7 +596,8 @@ CONFIG_OVERRIDE_FIELDS = frozenset(
     {
         "vocab", "d_model", "n_layers", "n_heads", "n_kv_heads", "d_ff",
         "max_seq", "causal", "remat", "fused_xent", "n_experts",
-        "moe_top_k", "capacity_factor",
+        "moe_top_k", "capacity_factor", "moe_aux_weight", "moe_zloss_weight",
+        "pp_microbatches",
     }
 )
 

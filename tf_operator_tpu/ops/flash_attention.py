@@ -373,18 +373,33 @@ def flash_attention(
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
+    force_kernel: Optional[bool] = None,
 ):
     """Self-attention over [b, t, h, d] with softmax(q·kᵀ/√d)·v semantics.
 
     Dispatches to the Pallas kernel on TPU when shapes tile cleanly
-    (t divisible by both block sizes, blocks 8-aligned, d a multiple of
-    128); otherwise the jnp reference (identical math). Blocks default to
-    the largest divisors of t up to 512 (q) / 1024 (k) — measured optimum
-    on v5e. ``interpret=True`` forces the kernel through the Pallas
-    interpreter — the CPU test path for kernel logic."""
+    (t divisible by both block sizes, blocks 8-aligned, d a lane-friendly
+    multiple — see _use_kernel); otherwise the jnp reference (identical
+    math). Blocks default to the largest divisors of t up to 512 (q) /
+    1024 (k) — measured optimum on v5e. ``interpret=True`` forces the
+    kernel through the Pallas interpreter — the CPU test path for kernel
+    logic. ``force_kernel`` overrides the dispatch heuristic both ways
+    (tiling constraints still apply) — the measurement hook behind the
+    tools/roofline --mode attn crossover table."""
     t, d = q.shape[1], q.shape[3]
     block_q = _pick_block(t, block_q or 512)
     block_k = _pick_block(t, block_k or 1024)
-    if not _use_kernel(t, d, block_q, block_k, bool(interpret)):
+    use = _use_kernel(t, d, block_q, block_k, bool(interpret))
+    if force_kernel is not None:
+        # HARD constraints still bind (exact tiling; a compiled Pallas TPU
+        # kernel cannot run on CPU — off-TPU only the interpreter engages).
+        # The d % 128 lane HEURISTIC is deliberately overridden: the kernel
+        # is correct at any d (Mosaic pads the lane dim) — d % 128 is a
+        # performance gate, and measuring shapes on the other side of it
+        # is exactly what this hook is for (tools/roofline --mode attn).
+        use = force_kernel and not (
+            t % block_q or t % block_k or block_q % 8 or block_k % 8
+        ) and (bool(interpret) or jax.default_backend() == "tpu")
+    if not use:
         return reference_attention(q, k, v, causal=causal)
     return _flash(q, k, v, causal, block_q, block_k, bool(interpret))

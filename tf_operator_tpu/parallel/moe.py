@@ -40,7 +40,10 @@ def _route(x, gate_logits, capacity: int, k_top: int = 1, dropped: str = "passth
     rather than a silently attenuated one.
 
     Returns (dispatch_w [T,E,C] — combine weights, keep_any [T] — token
-    has >= 1 surviving choice, inbox [E,C,d])."""
+    has >= 1 surviving choice, inbox [E,C,d], stats — router
+    observability: expert_load [E] (fraction of token-choices assigned to
+    each expert), mean_gate [E] (mean router probability), drop_frac
+    (fraction of token-choices that overflowed capacity))."""
     gate_probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
     n_experts = gate_logits.shape[-1]
     top_p, top_i = jax.lax.top_k(gate_probs, k_top)  # [T, k]
@@ -65,7 +68,13 @@ def _route(x, gate_logits, capacity: int, k_top: int = 1, dropped: str = "passth
     keep_any = jnp.sum(kept, axis=-1) > 0
     # Expert inboxes from local tokens: [E, C, d]
     inbox = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
-    return dispatch_w, keep_any, inbox
+    n_choices = jnp.float32(x.shape[0] * k_top)
+    stats = {
+        "expert_load": jnp.sum(assign, axis=0) / n_choices,  # [E]
+        "mean_gate": jnp.mean(gate_probs, axis=0),  # [E]
+        "drop_frac": 1.0 - jnp.sum(kept) / n_choices,
+    }
+    return dispatch_w, keep_any, inbox, stats
 
 
 def _dropped_value(x, dropped: str):
@@ -83,10 +92,17 @@ def _dropped_value(x, dropped: str):
 def _moe_single(x, gate_logits, expert_params, expert_fn, capacity: int, dropped: str,
                 k_top: int = 1):
     """All experts on one device: same routing math, no collectives — the
-    fallback when the mesh has no ep axis (or no mesh at all)."""
+    fallback when the mesh has no ep axis (or no mesh at all).
+
+    NOTE on drop patterns: this path runs ONE global per-expert capacity
+    queue while the sharded path runs per-(data-shard x ep-shard) queues,
+    so WHICH tokens overflow differs between CPU and pod runs of the same
+    config — the routing math and aggregate load stats agree, but numeric
+    outputs are not bitwise-comparable across mesh layouts whenever any
+    tokens drop (drop_frac > 0)."""
     tokens, d = x.shape
     n_experts = gate_logits.shape[-1]
-    dispatch_w, keep_any, inbox = _route(x, gate_logits, capacity, k_top, dropped)
+    dispatch_w, keep_any, inbox, stats = _route(x, gate_logits, capacity, k_top, dropped)
 
     def run_expert(e, acc):
         params_e = jax.tree_util.tree_map(lambda a: a[e], expert_params)
@@ -97,19 +113,21 @@ def _moe_single(x, gate_logits, expert_params, expert_fn, capacity: int, dropped
     outbox = jax.lax.fori_loop(0, n_experts, run_expert, outbox)
     combined = jnp.einsum("tec,ecd->td", dispatch_w, outbox)
     out = jnp.where(keep_any[:, None], combined, _dropped_value(x, dropped))
-    return out.astype(x.dtype)
+    return out.astype(x.dtype), stats
 
 
 def _moe_local(x, gate_logits, expert_params, expert_fn, axis_name: str, capacity: int,
-               dropped: str, k_top: int = 1):
+               dropped: str, k_top: int = 1, stat_axes: tuple = ()):
     """Per-device body. x: [tokens_local, d]; gate_logits: [tokens_local, E];
-    expert_params: this device's experts (leading dim E_local)."""
+    expert_params: this device's experts (leading dim E_local).
+    ``stat_axes``: every mesh axis the token dim shards over (data axes +
+    ep) — router stats pmean over all of them to give the global view."""
     n_shards = axis_size(axis_name)
     tokens, d = x.shape
     n_experts = gate_logits.shape[-1]
     experts_per_shard = n_experts // n_shards
 
-    dispatch_w, keep_any, inbox = _route(x, gate_logits, capacity, k_top, dropped)
+    dispatch_w, keep_any, inbox, stats = _route(x, gate_logits, capacity, k_top, dropped)
 
     # all_to_all: regroup so each shard holds inboxes for ITS experts from
     # every shard: [E, C, d] -> [E_local * n_shards, C, d] where the leading
@@ -136,7 +154,11 @@ def _moe_local(x, gate_logits, expert_params, expert_fn, axis_name: str, capacit
     # Combine: weight by gate prob; dropped tokens per the dropped mode.
     combined = jnp.einsum("tec,ecd->td", dispatch_w, outbox)
     out = jnp.where(keep_any[:, None], combined, _dropped_value(x, dropped))
-    return out.astype(x.dtype)
+    # Aggregate router stats across token shards (every shard routed its
+    # own slice; the job-level view is the mean over all of them).
+    for ax in stat_axes or (axis_name,):
+        stats = jax.tree_util.tree_map(lambda s: jax.lax.pmean(s, ax), stats)
+    return out.astype(x.dtype), stats
 
 
 def moe_apply(
@@ -150,6 +172,7 @@ def moe_apply(
     dropped: str = "passthrough",
     batch_axes: tuple = ("dp", "fsdp"),
     k_top: int = 1,
+    return_stats: bool = False,
 ):
     """Top-k MoE layer with experts sharded over ``axis_name``
     (``k_top=1`` — Switch; ``k_top=2`` — Mixtral-style with renormalized
@@ -168,6 +191,14 @@ def moe_apply(
     ("passthrough", standalone-transform default) or 0 ("zero" — required
     when the caller adds the result to a residual stream, else a dropped
     token gains its own input twice).
+    ``return_stats`` also returns router observability (the seam training
+    loops and the load-balance tests read): {"expert_load": [E] fraction
+    of token-choices per expert, "mean_gate": [E] mean router probability,
+    "drop_frac": scalar} — globally averaged over token shards.
+
+    NOTE: drop PATTERNS (which specific tokens overflow) differ between
+    the single-device path (one global queue per expert) and the sharded
+    path (per-shard queues) — see _moe_single; aggregate stats agree.
     """
     from jax import shard_map
 
@@ -177,9 +208,10 @@ def moe_apply(
         mesh.shape[axis_name] == 1
     ):
         capacity = max(1, int(capacity_factor * k_top * tokens / n_experts))
-        return _moe_single(
+        out, stats = _moe_single(
             x, gate_logits, expert_params, expert_fn, capacity, dropped, k_top
         )
+        return (out, stats) if return_stats else out
     ep = mesh.shape[axis_name]
     data_axes = tuple(a for a in batch_axes if a in mesh.axis_names)
     n_data = math.prod(mesh.shape[a] for a in data_axes) if data_axes else 1
@@ -194,12 +226,14 @@ def moe_apply(
 
     token_spec = P((*data_axes, axis_name))
     param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), expert_params)
+    stat_specs = {"expert_load": P(), "mean_gate": P(), "drop_frac": P()}
     fn = shard_map(
         partial(_moe_local, expert_fn=expert_fn, axis_name=axis_name, capacity=capacity,
-                dropped=dropped, k_top=k_top),
+                dropped=dropped, k_top=k_top, stat_axes=(*data_axes, axis_name)),
         mesh=mesh,
         in_specs=(token_spec, token_spec, param_specs),
-        out_specs=token_spec,
+        out_specs=(token_spec, stat_specs),
         check_vma=False,
     )
-    return fn(x, gate_logits, expert_params)
+    out, stats = fn(x, gate_logits, expert_params)
+    return (out, stats) if return_stats else out

@@ -72,13 +72,20 @@ def pipeline_apply(
     mesh,
     n_microbatches: int,
     axis_name: str = "pp",
+    batch_axes: tuple = ("dp", "fsdp"),
 ):
     """Run ``fn(stage_params, x_mb)`` as a pipeline over ``axis_name``.
 
     stage_params: pytree whose leaves have leading dim == pp size (one slice
-    per stage). x: [batch, ...] replicated input. fn must map a microbatch
-    through ONE stage, preserving shape (classic equal-width pipeline).
-    Returns [batch, ...] outputs, replicated.
+    per stage). x: [batch, ...] input. fn must map a microbatch through ONE
+    stage, preserving shape (classic equal-width pipeline). Returns
+    [batch, ...] outputs.
+
+    Composes with data parallelism: the microbatch dim shards over any
+    ``batch_axes`` present in the mesh (each dp group runs its own
+    pipeline over its batch slice — activations ppermute within the group,
+    nothing crosses dp), while stage params shard over ``axis_name`` and
+    replicate over the data axes.
     """
     from jax import shard_map
 
@@ -88,6 +95,19 @@ def pipeline_apply(
     mb = batch // n_microbatches
     x_micro = x.reshape((n_microbatches, mb) + x.shape[1:])
 
+    data_axes = tuple(
+        a for a in batch_axes
+        if a in getattr(mesh, "axis_names", ()) and mesh.shape[a] > 1
+    )
+    n_data = 1
+    for a in data_axes:
+        n_data *= mesh.shape[a]
+    if mb % n_data:
+        raise ValueError(
+            f"microbatch size {mb} (batch {batch} / {n_microbatches} "
+            f"microbatches) not divisible by data shards {n_data}"
+        )
+    x_spec = P(None, data_axes or None)  # [n_micro, mb(sharded over dp), ...]
     param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
 
     def body(params, xm):
@@ -98,8 +118,8 @@ def pipeline_apply(
     out = shard_map(
         body,
         mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(),
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
         check_vma=False,
     )(stage_params, x_micro)
     return out.reshape((batch,) + out.shape[2:])

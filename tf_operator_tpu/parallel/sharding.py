@@ -17,6 +17,7 @@ from tf_operator_tpu.parallel.mesh import (
     AXIS_DATA,
     AXIS_EXPERT,
     AXIS_FSDP,
+    AXIS_PIPELINE,
     AXIS_TENSOR,
 )
 
@@ -65,7 +66,11 @@ DEFAULT_RULES = ShardingRules(
         "mlp": AXIS_TENSOR,
         "vocab": AXIS_TENSOR,
         "expert": AXIS_EXPERT,
-        "layers": None,
+        # Layer-stacked params shard their [n_layers] dim over pp: stage s
+        # holds the contiguous layer group it pipelines (pipeline_apply
+        # reshapes [L] -> [S, L/S]; PartitionSpec blocks are contiguous, so
+        # the resident shard IS the stage's group — no resharding).
+        "layers": AXIS_PIPELINE,
         "head_dim": None,
         "kv": None,
     }

@@ -110,6 +110,28 @@ def main(ctx: JobContext) -> None:
     finally:
         if loader is not None:
             loader.close()
+    if cfg.n_experts:
+        # Router health check on the trained params: collapsed routing
+        # (entropy << ln(E)) or heavy dropping is a silent quality bug —
+        # surface it in the training log where operators look first.
+        import math
+
+        from tf_operator_tpu.models.transformer import lm_loss_and_metrics
+
+        probe = tokens if not hasattr(tokens, "__next__") else jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(2), (batch, seq), 0, cfg.vocab),
+            trainer.batch_sharding,
+        )
+        _, m = jax.jit(
+            lambda p, tok: lm_loss_and_metrics(p, tok, cfg, mesh=mesh)
+        )(state.params, probe)
+        log.info(
+            "moe router: expert_entropy=%.3f (uniform=%.3f) drop_frac=%.3f "
+            "lb_loss=%.3f z_loss=%.4f",
+            float(m["moe_expert_entropy"]), math.log(cfg.n_experts),
+            float(m["moe_drop_frac"]), float(m["moe_lb_loss"]),
+            float(m["moe_z_loss"]),
+        )
     if step_s is not None:
         n_chips = mesh.devices.size
         # active params: for top-1 MoE only one expert's FLOPs count per token
