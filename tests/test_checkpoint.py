@@ -334,3 +334,50 @@ def test_run_loop_device_loop_bigger_than_budget_keeps_telemetry(tmp_path):
     )
     assert int(state.step) == 11  # warmup + 10
     assert timed >= 1 and step_s is not None
+
+
+def test_init_and_step_matches_init_then_step():
+    """The submit-latency fast path (one fused program) must be bitwise
+    the same math as init() followed by step()."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_operator_tpu.parallel import build_mesh
+    from tf_operator_tpu.train.trainer import Trainer, TrainerConfig
+
+    mesh = build_mesh({"dp": 1}, devices=jax.devices()[:1])
+
+    def init_fn(key):
+        return {"w": jax.random.normal(key, (8, 8), jnp.float32)}
+
+    def loss_fn(params, batch, extra):
+        del extra
+        return jnp.mean(jnp.square(batch @ params["w"]))
+
+    def mk():
+        return Trainer(
+            mesh, loss_fn=loss_fn, init_fn=init_fn,
+            config=TrainerConfig(optimizer="sgd", learning_rate=0.1),
+        )
+
+    batch = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(1), (4, 8)), mk().batch_sharding
+    )
+    key = jax.random.PRNGKey(0)
+
+    t1 = mk()
+    s_ref = t1.init(key)
+    s_ref, m_ref = t1.step(s_ref, batch)
+
+    t2 = mk()
+    s_fused, m_fused = t2.init_and_step(key, batch)
+
+    np.testing.assert_allclose(float(m_fused["loss"]), float(m_ref["loss"]), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(s_fused.params["w"]), np.asarray(s_ref.params["w"]), rtol=1e-6
+    )
+    assert int(s_fused.step) == 1
+    # and the normal step program continues from the fused state
+    s_next, m_next = t2.step(s_fused, batch)
+    assert float(m_next["loss"]) < float(m_fused["loss"])

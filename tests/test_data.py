@@ -191,3 +191,90 @@ def test_loader_skip_fast_forwards_host_side():
     # skipping past the end just yields an empty stream
     with DeviceLoader(ds.epoch(0), sharding, skip=100) as loader:
         assert list(loader) == []
+
+
+# ---------------------------------------------------------------------------
+# Disk-backed readers (VERDICT #2): idx-ubyte + tokenized memmap
+# ---------------------------------------------------------------------------
+
+from tf_operator_tpu.train.data import (  # noqa: E402
+    MnistIdxDataset,
+    TokenMemmapDataset,
+    read_idx,
+    write_idx,
+    write_token_corpus,
+)
+
+
+@pytest.mark.parametrize("suffix", ["", ".gz"])
+def test_idx_round_trip(tmp_path, suffix):
+    """The exact MNIST wire format (magic, dtype code, big-endian dims):
+    images (rank 3 ubyte) and labels (rank 1) survive a write/read."""
+    imgs = np.random.default_rng(0).integers(0, 256, (7, 5, 4), dtype=np.uint8)
+    labels = np.arange(7, dtype=np.uint8)
+    pi, pl = str(tmp_path / f"imgs{suffix}"), str(tmp_path / f"lbls{suffix}")
+    write_idx(pi, imgs)
+    write_idx(pl, labels)
+    np.testing.assert_array_equal(read_idx(pi), imgs)
+    np.testing.assert_array_equal(read_idx(pl), labels)
+
+
+def test_idx_rejects_garbage(tmp_path):
+    p = str(tmp_path / "bad")
+    with open(p, "wb") as f:
+        f.write(b"\x12\x34\x56\x78garbage")
+    with pytest.raises(ValueError, match="magic"):
+        read_idx(p)
+    # truncated payload
+    imgs = np.zeros((4, 3, 3), np.uint8)
+    p2 = str(tmp_path / "trunc")
+    write_idx(p2, imgs)
+    data = open(p2, "rb").read()
+    open(p2, "wb").write(data[:-5])
+    with pytest.raises(ValueError, match="elements"):
+        read_idx(p2)
+
+
+def test_mnist_idx_dataset_canonical_names(tmp_path):
+    rng = np.random.default_rng(1)
+    imgs = rng.integers(0, 256, (40, 8, 8), dtype=np.uint8)
+    labels = rng.integers(0, 10, (40,), dtype=np.uint8)
+    write_idx(str(tmp_path / "train-images-idx3-ubyte.gz"), imgs)
+    write_idx(str(tmp_path / "train-labels-idx1-ubyte.gz"), labels)
+    ds = MnistIdxDataset(str(tmp_path), batch_size=8, shuffle=False,
+                         process_shard=False)
+    batch = next(ds.epoch(0))
+    assert batch["image"].shape == (8, 8, 8)
+    assert batch["image"].dtype == np.float32
+    assert float(batch["image"].max()) <= 1.0
+    np.testing.assert_array_equal(batch["label"], labels[:8].astype(np.int32))
+    with pytest.raises(FileNotFoundError):
+        MnistIdxDataset(str(tmp_path), batch_size=4, split="test")
+
+
+def test_token_memmap_dataset(tmp_path):
+    """Tokenized-corpus memmap: windows tile the stream without overlap,
+    dtype comes from the sidecar, shuffling reorders windows per epoch."""
+    tokens = np.arange(1000, dtype=np.int64) % 50000
+    path = str(tmp_path / "corpus.bin")
+    write_token_corpus(path, tokens, dtype=np.uint16)
+
+    ds = TokenMemmapDataset(path, batch_size=4, seq_len=50, shuffle=False,
+                            process_shard=False)
+    assert len(ds) == 5  # 20 windows / 4 per batch
+    first = next(ds.epoch(0))["tokens"]
+    assert first.shape == (4, 50) and first.dtype == np.int32
+    np.testing.assert_array_equal(first[0], tokens[:50])
+    np.testing.assert_array_equal(first[1], tokens[50:100])
+
+    shuffled = TokenMemmapDataset(path, batch_size=4, seq_len=50, seed=3,
+                                  process_shard=False)
+    rows = next(shuffled.epoch(0))["tokens"]
+    # every row is still a contiguous aligned window of the stream
+    for row in rows:
+        start = int(row[0])
+        np.testing.assert_array_equal(row, tokens[start : start + 50])
+        assert start % 50 == 0
+
+    with pytest.raises(ValueError, match="window"):
+        TokenMemmapDataset(path, batch_size=1, seq_len=2000, process_shard=False)

@@ -253,6 +253,29 @@ function replicaBlock(rt, entry, n){
       el('textarea',{'data-f':'args',style:'min-height:3.2rem',class:'mono'}))));
   return b;
 }
+// Mesh axes as structured name x size rows (dp/tp/cp/pp/ep/fsdp — the
+// parallel.mesh vocabulary) instead of a raw JSON field.
+function meshAxisRow(name, size){
+  const r = el('span',{class:'axisrow'},
+    el('select',{'data-f':'axname'},
+      ...['dp','fsdp','tp','cp','pp','ep'].map(v=>{
+        const o = el('option',{value:v},v); if (v===name) o.selected = true; return o;})),
+    el('input',{'data-f':'axsize',type:'number',min:'1',value:String(size),style:'width:4rem'}),
+    el('button',{onclick:(e)=>{e.preventDefault(); r.remove();}},'x'));
+  return r;
+}
+
+// Known workload entrypoints -> sensible template (the reference's
+// CreateJob form hardcoded its image defaults the same way).
+const WORKLOADS = {
+  'smoke (every-device op check)': {entry:'tf_operator_tpu.workloads.smoke:main', wl:{dim:64}},
+  'mnist (idx data_dir or synthetic)': {entry:'tf_operator_tpu.workloads.mnist:main', wl:{epochs:10, batch_size:128}},
+  'lm (transformer pretrain)': {entry:'tf_operator_tpu.workloads.lm:main', wl:{preset:'tiny', steps:10, batch_size:8, seq_len:128}},
+  'resnet (image classification)': {entry:'tf_operator_tpu.workloads.resnet:main', wl:{steps:10, batch_size:32}},
+  'eval (checkpoint scorer)': {entry:'tf_operator_tpu.workloads.eval:main', wl:{preset:'tiny', checkpoint_dir:'/tmp/ckpt'}},
+  'custom': {entry:'', wl:{}},
+};
+
 function viewCreate(){
   const errBox = el('div',{class:'err'});
   const nameIn = el('input',{value:'job-'+Math.random().toString(36).slice(2,7)});
@@ -260,13 +283,21 @@ function viewCreate(){
   const sliceIn = el('input',{value:'',placeholder:'e.g. v5e-8'});
   const hostsIn = el('input',{type:'number',min:'1',value:'1',style:'width:5rem'});
   const chipsIn = el('input',{type:'number',min:'0',value:'0',style:'width:5rem'});
-  const meshIn = el('input',{value:'{}',class:'mono',style:'width:14rem'});
+  const axes = el('span');
+  const addAxis = el('button',{onclick:(e)=>{e.preventDefault();
+    axes.appendChild(meshAxisRow('dp',1));}},'+ axis');
   const wlIn = el('textarea',{style:'min-height:4rem',class:'mono'});
   wlIn.value = '{}';
   const reps = el('div');
-  reps.appendChild(replicaBlock('Worker','tf_operator_tpu.workloads.smoke:run',2));
+  reps.appendChild(replicaBlock('Worker','tf_operator_tpu.workloads.smoke:main',2));
   const addBtn = el('button',{onclick:(e)=>{e.preventDefault();
     reps.appendChild(replicaBlock('Worker','',1));}},'+ add role');
+  const wlSel = el('select',{onchange:()=>{
+    const w = WORKLOADS[wlSel.value]; if (!w) return;
+    wlIn.value = JSON.stringify(w.wl, null, 1);
+    const first = reps.querySelector('[data-f=entrypoint]');
+    if (first && w.entry) first.value = w.entry;
+  }}, ...Object.keys(WORKLOADS).map(k=>el('option',{value:k},k)));
 
   const jsonArea = el('textarea',{class:'mono'});
   function buildSpec(){
@@ -283,8 +314,16 @@ function viewCreate(){
       if (f('rp').value) spec.restart_policy = f('rp').value;
       replica_specs[f('rtype').value] = spec;
     }
-    let mesh = {}, wl = {};
-    try{ mesh = JSON.parse(meshIn.value||'{}'); }catch(e){ throw new Error('mesh axes: '+e.message); }
+    const mesh = {};
+    for (const r of axes.querySelectorAll('.axisrow')){
+      const n = r.querySelector('[data-f=axname]').value;
+      if (mesh[n] !== undefined) throw new Error('mesh axes: duplicate axis '+n);
+      const v = Number(r.querySelector('[data-f=axsize]').value);
+      if (!Number.isInteger(v) || v < 1)
+        throw new Error('mesh axes: '+n+' needs an integer size >= 1');
+      mesh[n] = v;
+    }
+    let wl = {};
     try{ wl = JSON.parse(wlIn.value||'{}'); }catch(e){ throw new Error('workload: '+e.message); }
     return {metadata:{name:nameIn.value, namespace:nsIn.value},
       spec:{replica_specs,
@@ -309,7 +348,9 @@ function viewCreate(){
         el('span',null, el('label',null,'slice type'), sliceIn),
         el('span',null, el('label',null,'hosts'), hostsIn),
         el('span',null, el('label',null,'chips/host'), chipsIn),
-        el('span',null, el('label',null,'mesh axes (JSON)'), meshIn)),
+        el('span',null, el('label',null,'mesh axes'), axes, addAxis)),
+      el('div',{class:'row'},
+        el('span',null, el('label',null,'workload'), wlSel)),
       el('label',null,'workload config (JSON, passed to every process)'), wlIn,
       reps, addBtn, el('span',null,' '),
       el('button',{onclick:(e)=>{e.preventDefault();

@@ -47,7 +47,9 @@ class TransformerConfig:
     # recompute just the elementwise chain (near-6ND at moderate HBM).
     # False/"none": no remat (max HBM).
     remat: Any = True
-    attn_impl: str = "dense"  # "dense" | "ring" | "flash" (Pallas kernel)
+    # "dense" | "flash" (Pallas kernel) | "ring" (cp ppermute ring) |
+    # "ulysses" (cp all-to-all head/seq re-shard; needs heads % cp == 0)
+    attn_impl: str = "dense"
     cp_axis: str = "cp"
     # Blockwise fused loss (ops/fused_cross_entropy): logits never hit HBM
     # as a [b,t,vocab] f32 array. Same math as the unfused path.
@@ -274,6 +276,19 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
         batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
         return ring_attention(
             q, k, v, mesh, axis_name=cfg.cp_axis, causal=cfg.causal, batch_axes=batch_axes
+        )
+    if cfg.attn_impl == "ulysses" and mesh is not None and cfg.cp_axis in mesh.axis_names:
+        # All-to-all SP (DeepSpeed-Ulysses): re-shard seq->heads once, run
+        # ordinary full-sequence attention per head shard (the flash kernel
+        # applies untouched on TPU; dense fallback elsewhere), re-shard back.
+        from tf_operator_tpu.ops.flash_attention import flash_attention
+        from tf_operator_tpu.parallel.ulysses import ulysses_attention
+
+        batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
+        return ulysses_attention(
+            q, k, v, mesh, axis_name=cfg.cp_axis, causal=cfg.causal,
+            batch_axes=batch_axes,
+            attn_fn=lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=cfg.causal),
         )
     if cfg.attn_impl == "flash":
         from tf_operator_tpu.ops.flash_attention import flash_attention
@@ -606,6 +621,6 @@ def preset_from_workload(workload: Dict[str, Any]) -> TransformerConfig:
     """TransformerConfig from a TPUJob workload dict: ``preset`` plus any
     CONFIG_OVERRIDE_FIELDS, with ``attn`` mapping to ``attn_impl``."""
     overrides = {k: workload[k] for k in CONFIG_OVERRIDE_FIELDS if k in workload}
-    if workload.get("attn") in ("ring", "flash", "dense"):
+    if workload.get("attn") in ("ring", "ulysses", "flash", "dense"):
         overrides["attn_impl"] = workload["attn"]
     return preset(workload.get("preset", "tiny"), **overrides)

@@ -20,11 +20,14 @@ above-diagonal blocks with `pl.when` (zero compute, still one grid step).
 Layout: q/k/v are [b, t, h, d] (the model layout), transposed to
 [b, h, t, d] so seq is the sublane dim and head_dim the lane dim. The
 kernel path engages on TPU when t divides into 8-aligned blocks and
-d % 128 == 0 (at d=64 the half-width MXU measured ~7% slower than XLA's
-fused dense path, so those shapes fall back). Off-TPU the entry falls
-back to a jnp reference (same math, same f32 softmax) so one model config
-runs everywhere; ``interpret=True`` forces the Pallas interpreter — the
-CPU test path for the kernel logic.
+either d % 128 == 0 (any length) or d % 64 == 0 with t >= 2048 — the
+measured END-TO-END crossover for hd=64 models (gpt-small/bert-base):
+in-model the kernel wins 1.49x at t=2048 but loses to dense at t=512
+under full remat, even though the isolated attention probe favors it at
+every length (`tools/roofline --mode attn --d 64`; BASELINE.md). Off-TPU
+the entry falls back to a jnp reference (same math, same f32 softmax) so
+one model config runs everywhere; ``interpret=True`` forces the Pallas
+interpreter — the CPU test path for the kernel logic.
 """
 
 from __future__ import annotations
@@ -47,7 +50,17 @@ def _use_kernel(t: int, d: int, block_q: int, block_k: int, interpret: bool) -> 
         return False  # clamped blocks (short t) must stay sublane-aligned
     if interpret:
         return True
-    return jax.default_backend() == "tpu" and d % 128 == 0
+    if jax.default_backend() != "tpu":
+        return False
+    if d % 128 == 0:
+        return True
+    # hd=64 (gpt-small, bert-base): the kernel serves long context —
+    # measured END-TO-END in the model it wins from t=2048 (train MFU
+    # 28.9% vs 19.4% dense, 1.49x; isolated attention 1.60x @ 2048 up to
+    # 27x @ 8192 where dense spills) but loses at t=512 under full remat
+    # (36.4% vs 38.0% — the in-model remat interaction the r1 fwd-only
+    # probe couldn't see). Gate on the measured crossover.
+    return d % 64 == 0 and t >= 2048
 
 
 def reference_attention(q, k, v, causal: bool = False):

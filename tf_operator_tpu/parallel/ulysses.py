@@ -1,0 +1,107 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence re-shard.
+
+The second long-context recipe (SURVEY.md §2.3 SP/CP row lists ring,
+blockwise, and Ulysses — the reference has none). Where ring attention
+keeps heads whole and ROTATES K/V sequence blocks around the cp ring
+(cp-1 neighbor hops per layer), Ulysses (DeepSpeed) re-shards ONCE per
+attention: an all-to-all turns [seq-sharded, all heads] into
+[full seq, head-sharded], each device runs ordinary attention on its
+head slice over the FULL sequence, and a second all-to-all restores the
+sequence sharding. Two all-to-alls total, each moving t·h·d/cp per
+device — cheaper than the ring when cp is large and heads divide evenly,
+and the inner attention is just the single-device kernel, so the Pallas
+flash path applies untouched (`attn_fn=`).
+
+Trade-off vs ring (why both exist): Ulysses needs n_heads % cp == 0 and
+materializes the full-sequence K/V per device (HBM: t·h·d/cp per tensor
+— fine until t·d/cp outgrows a head shard); ring keeps per-device memory
+at t/cp blocks and has no head-divisibility constraint, at the cost of
+cp-1 sequential ppermute steps. The transformer exposes both:
+``attn_impl="ring" | "ulysses"``.
+
+Layout contract matches ring_attention: global [batch, seq, heads,
+head_dim], sequence sharded over ``axis_name`` on entry and exit.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tf_operator_tpu.parallel.collectives import axis_size
+from tf_operator_tpu.parallel.ring_attention import reference_attention
+
+
+def _ulysses_local(q, k, v, axis_name: str, causal: bool,
+                   attn_fn: Optional[Callable]):
+    """Per-device body. q/k/v: [b, t_local, h, d] (sequence-sharded).
+
+    all_to_all over the heads dim: [b, t_local, h, d] -> concat over the
+    cp group's t blocks with h/cp local heads -> [b, t_global, h_local, d].
+    """
+    n = axis_size(axis_name)
+
+    def seq_to_heads(x):
+        # split heads into n groups, hand group i to shard i, receiving
+        # every shard's sequence block for OUR head group
+        x = jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+        return x  # [b, t_global, h/n, d]
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )  # [b, t_local, h, d]
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if attn_fn is None:
+        out = reference_attention(qg, kg, vg, causal=causal)
+    else:
+        out = attn_fn(qg, kg, vg)
+    return heads_to_seq(out.astype(q.dtype))
+
+
+def ulysses_attention(
+    q,
+    k,
+    v,
+    mesh,
+    axis_name: str = "cp",
+    causal: bool = False,
+    batch_axes: Optional[tuple] = None,
+    attn_fn: Optional[Callable] = None,
+):
+    """Exact self-attention with sequence sharded over ``axis_name`` via
+    head/sequence all-to-all re-sharding (DeepSpeed-Ulysses recipe).
+
+    q/k/v: global [batch, seq, heads, head_dim]; seq % cp == 0 and
+    heads % cp == 0 required. ``attn_fn(q, k, v)`` runs the per-device
+    full-sequence attention (defaults to the dense reference; pass the
+    Pallas flash kernel for long context — it sees ordinary unsharded
+    shapes)."""
+    from jax import shard_map
+
+    cp = mesh.shape[axis_name]
+    b, t, h, d = q.shape
+    if t % cp:
+        raise ValueError(f"seq length {t} must divide by {axis_name}={cp}")
+    if h % cp:
+        raise ValueError(
+            f"ulysses needs heads % cp == 0 (got {h} heads, cp={cp}) — "
+            "use attn_impl='ring' for head counts the cp axis cannot split"
+        )
+    spec = P(batch_axes, axis_name, None, None)
+    fn = shard_map(
+        partial(_ulysses_local, axis_name=axis_name, causal=causal,
+                attn_fn=attn_fn),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -434,6 +434,13 @@ class WorkloadCheckpointer:
         so no periodic save is skipped; iterator batches are stacked K at
         a time (single-process only — multi-host global arrays cannot be
         stacked outside jit, so streams fall back to per-step there).
+        NOTE: ``on_step`` fires once per CHUNK with the post-chunk global
+        step, so step-keyed triggers (the lm workload's ``fail_at_step``
+        fault injection) can land up to K-1 steps late and after the
+        chunk's save — chaos scenarios that need exact-step faults should
+        run with device_loop=1 (chunks are deliberately NOT clipped at
+        injection points: the loop cannot know which steps a caller's
+        callback keys on).
         ``on_step`` then fires once per chunk (with the post-chunk global
         step), so fault-injection / progress hooks see chunk
         granularity."""

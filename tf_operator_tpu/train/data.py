@@ -30,6 +30,11 @@ __all__ = [
     "ArrayDataset",
     "DeviceLoader",
     "local_loader",
+    "read_idx",
+    "write_idx",
+    "MnistIdxDataset",
+    "TokenMemmapDataset",
+    "write_token_corpus",
 ]
 
 
@@ -110,6 +115,198 @@ class SyntheticTokens(ArrayDataset):
             batch_size,
             seed=seed,
         )
+
+
+# ---------------------------------------------------------------------------
+# Disk-backed readers: MNIST idx-ubyte + tokenized-corpus memmap
+# ---------------------------------------------------------------------------
+
+_IDX_DTYPES = {
+    0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
+    0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64,
+}
+_IDX_CODES = {np.dtype(v): k for k, v in _IDX_DTYPES.items()}
+
+
+def read_idx(path: str) -> np.ndarray:
+    """Read an idx-ubyte file (the MNIST wire format the reference's
+    dist_mnist consumes via read_data_sets,
+    /root/reference/test/e2e/dist-mnist/dist_mnist.py:214-215): 2 zero
+    bytes, dtype code, ndim, big-endian uint32 dims, raw data. ``.gz``
+    paths decompress transparently (the distribution format)."""
+    import gzip
+
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        header = f.read(4)
+        if len(header) != 4 or header[0] != 0 or header[1] != 0:
+            raise ValueError(f"{path}: not an idx file (bad magic {header!r})")
+        code, ndim = header[2], header[3]
+        if code not in _IDX_DTYPES:
+            raise ValueError(f"{path}: unknown idx dtype code 0x{code:02x}")
+        dims = np.frombuffer(f.read(4 * ndim), dtype=">u4")
+        if dims.size != ndim:
+            raise ValueError(f"{path}: truncated idx header")
+        data = np.frombuffer(f.read(), dtype=np.dtype(_IDX_DTYPES[code]).newbyteorder(">"))
+        n = int(np.prod(dims)) if ndim else 0
+        if data.size != n:
+            raise ValueError(f"{path}: expected {n} elements, got {data.size}")
+        return data.reshape(tuple(int(d) for d in dims)).astype(_IDX_DTYPES[code])
+
+
+def write_idx(path: str, array: np.ndarray) -> None:
+    """Write an idx file (gzip when path ends .gz) — the test/tooling side
+    of read_idx, so fixtures carry the real wire format."""
+    import gzip
+
+    arr = np.ascontiguousarray(array)
+    code = _IDX_CODES.get(arr.dtype)
+    if code is None:
+        raise ValueError(f"unsupported idx dtype {arr.dtype}")
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "wb") as f:
+        f.write(bytes([0, 0, code, arr.ndim]))
+        f.write(np.asarray(arr.shape, dtype=">u4").tobytes())
+        f.write(arr.astype(arr.dtype.newbyteorder(">")).tobytes())
+
+
+def _find_idx(data_dir: str, names) -> str:
+    import os
+
+    for name in names:
+        for suffix in ("", ".gz"):
+            p = os.path.join(data_dir, name + suffix)
+            if os.path.exists(p):
+                return p
+    raise FileNotFoundError(
+        f"none of {list(names)} (or .gz) under {data_dir}"
+    )
+
+
+class MnistIdxDataset(ArrayDataset):
+    """Disk-backed image classification from standard idx files.
+
+    Looks for the canonical MNIST names (train-images-idx3-ubyte /
+    train-labels-idx1-ubyte, t10k-* for split="test", optionally .gz) —
+    drop the real MNIST distribution files in ``data_dir`` and this
+    trains actual MNIST, matching the reference's dist_mnist e2e. Images
+    normalize to [0, 1] f32; the per-image shape is whatever the file
+    carries (28x28 for MNIST; the e2e fixtures write real scanned-digit
+    images at 8x8).
+
+    ``process_shard``: in a multi-process gang each process takes a
+    disjoint stride of the examples (rank::nprocs), so shards carry
+    distinct real data — the reader-side analogue of what local_loader
+    does for synthetic seeds."""
+
+    def __init__(self, data_dir: str, batch_size: int, *, split: str = "train",
+                 shuffle: bool = True, seed: int = 0,
+                 process_shard: bool = True) -> None:
+        prefix = {"train": "train", "test": "t10k"}[split]
+        images = read_idx(
+            _find_idx(data_dir, (f"{prefix}-images-idx3-ubyte", f"{prefix}-images.idx3-ubyte"))
+        )
+        labels = read_idx(
+            _find_idx(data_dir, (f"{prefix}-labels-idx1-ubyte", f"{prefix}-labels.idx1-ubyte"))
+        )
+        if images.shape[0] != labels.shape[0]:
+            raise ValueError(
+                f"{data_dir}: {images.shape[0]} images vs {labels.shape[0]} labels"
+            )
+        # Dtype-derived scale, NOT per-split max: max-based scaling would
+        # normalize train and test differently whenever their brightest
+        # pixels differ, silently skewing eval accuracy.
+        scale = 255.0 if np.issubdtype(images.dtype, np.integer) else 1.0
+        x = images.astype(np.float32) / scale
+        y = labels.astype(np.int32)
+        # Pre-shard (global) example count: every process must derive the
+        # SAME steps-per-epoch from it — rank-local shard sizes differ by
+        # one when nprocs doesn't divide n, and a step count read off the
+        # local shard would deadlock the gang (one rank dispatching an
+        # SPMD step the others never join).
+        self.global_n = x.shape[0]
+        if process_shard:
+            import jax
+
+            rank, n = jax.process_index(), jax.process_count()
+            if n > 1:
+                x, y = x[rank::n], y[rank::n]
+        super().__init__({"image": x, "label": y}, batch_size,
+                         shuffle=shuffle, seed=seed)
+
+
+def write_token_corpus(path: str, tokens: np.ndarray, dtype=np.uint16) -> None:
+    """Persist a 1-D token stream as a raw little-endian memmap file plus a
+    sidecar ``path + '.meta'`` (dtype + count) so readers need no guessing."""
+    arr = np.ascontiguousarray(tokens, dtype=dtype)
+    arr.tofile(path)
+    with open(path + ".meta", "w") as f:
+        f.write(f"{np.dtype(dtype).name} {arr.size}\n")
+
+
+class TokenMemmapDataset:
+    """Tokenized-corpus reader: a flat memmapped token stream cut into
+    non-overlapping [seq_len] windows, batched — the standard pretraining
+    layout (tokenize once offline, train from the memmap; the file never
+    loads into RAM). Yields {"tokens": [batch, seq_len] int32} forever,
+    reshuffling window order per epoch.
+
+    ``process_shard``: each process reads a disjoint stride of windows
+    (rank::nprocs) for multi-host training."""
+
+    def __init__(self, path: str, batch_size: int, seq_len: int, *,
+                 dtype=None, shuffle: bool = True, seed: int = 0,
+                 process_shard: bool = True) -> None:
+        import os
+
+        if dtype is None:
+            meta = path + ".meta"
+            if os.path.exists(meta):
+                with open(meta) as f:
+                    dtype = np.dtype(f.read().split()[0])
+            else:
+                dtype = np.uint16
+        self._mm = np.memmap(path, dtype=dtype, mode="r")
+        n_windows = self._mm.size // seq_len
+        if n_windows < 1:
+            raise ValueError(
+                f"{path}: {self._mm.size} tokens < one window of {seq_len}"
+            )
+        self._windows = np.arange(n_windows)
+        if process_shard:
+            import jax
+
+            rank, n = jax.process_index(), jax.process_count()
+            if n > 1:
+                self._windows = self._windows[rank::n]
+        if batch_size > self._windows.size:
+            raise ValueError(
+                f"batch_size {batch_size} > {self._windows.size} local windows"
+            )
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.shuffle = shuffle
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return self._windows.size // self.batch_size
+
+    def epoch(self, epoch: int = 0) -> Iterator[Any]:
+        order = self._windows.copy()
+        if self.shuffle:
+            np.random.default_rng(self.seed + epoch).shuffle(order)
+        for i in range(len(self)):
+            idx = order[i * self.batch_size : (i + 1) * self.batch_size]
+            batch = np.stack(
+                [self._mm[w * self.seq_len : (w + 1) * self.seq_len] for w in idx]
+            )
+            yield {"tokens": batch.astype(np.int32)}
+
+    def __iter__(self) -> Iterator[Any]:
+        epoch = 0
+        while True:
+            yield from self.epoch(epoch)
+            epoch += 1
 
 
 def local_loader(

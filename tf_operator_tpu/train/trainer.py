@@ -216,6 +216,38 @@ class Trainer:
             return ckpt.restore(self.state_template())
         return self.init(key)
 
+    def init_and_step(self, key, batch) -> tuple:
+        """Init + FIRST train step as ONE program — the submit-latency fast
+        path. On a tunneled/remote TPU the dominant cost of submit→first-
+        step is executable upload (a persistent-cache HIT on the init
+        program alone measured 4.2 s of transfer); fusing init into the
+        first step ships one executable instead of two, delivering the
+        first loss seconds sooner. Identical math to init() followed by
+        step(); subsequent steps use the normal step program. Returns
+        (TrainState, {"loss": ...}) like step()."""
+        opt_shardings = self._opt_shardings()
+        extra_out = self._repl if self._has_extra else None
+
+        def go(key, batch):
+            out = self.init_fn(key)
+            params, extra = out if self._has_extra else (out, None)
+            return self._step_body(
+                params, self.tx.init(params), jnp.zeros((), jnp.int32), extra, batch
+            )
+
+        fused = jax.jit(
+            go,
+            out_shardings=(
+                self.param_shardings,
+                opt_shardings,
+                self._repl,
+                extra_out,
+                self._repl,
+            ),
+        )
+        params, opt_state, step, extra, loss = fused(key, batch)
+        return TrainState(params, opt_state, step, extra), {"loss": loss}
+
     # ---- step -----------------------------------------------------------
 
     def step(self, state: TrainState, batch) -> tuple:

@@ -10,7 +10,10 @@ attn ("dense"|"ring"|"flash"), profile_dir (capture an XLA trace),
 device_loop (K steps per compiled call — lax.scan device loop),
 checkpoint_dir, checkpoint_every (steps between saves; restart-based
 recovery resumes from the latest checkpoint), data ("fixed" resident
-batch | "stream" through the prefetching DeviceLoader), plus any
+batch | "stream" synthetic through the prefetching DeviceLoader |
+"memmap" + corpus=<path>: a REAL tokenized corpus in the
+train.data.write_token_corpus memmap format, window-sharded per
+process), plus any
 TransformerConfig field as an override (e.g. n_layers, n_experts,
 capacity_factor — MoE presets route through parallel.moe over the ep
 mesh axis).
@@ -67,7 +70,22 @@ def main(ctx: JobContext) -> None:
         log.info("already complete (budget %d); nothing to do", steps)
         return
     loader = None
-    if wl.get("data", "fixed") == "stream":
+    data_mode = wl.get("data", "fixed")
+    if data_mode == "memmap":
+        # REAL tokenized corpus: workload.corpus points at a memmap token
+        # stream (train.data.write_token_corpus format); each process reads
+        # a disjoint window shard through the prefetching DeviceLoader.
+        from tf_operator_tpu.train.data import DeviceLoader, TokenMemmapDataset
+
+        n_proc = jax.process_count()
+        if batch % n_proc:
+            raise ValueError(f"batch_size {batch} % {n_proc} processes != 0")
+        ds = TokenMemmapDataset(wl["corpus"], batch // n_proc, seq)
+        loader = DeviceLoader(
+            ds, trainer.batch_sharding, skip=ckpt.resume_step()
+        )
+        tokens = (b["tokens"] for b in loader)
+    elif data_mode == "stream":
         from tf_operator_tpu.train.data import SyntheticTokens, local_loader
 
         # batch_size is GLOBAL; local_loader splits it across processes
@@ -88,6 +106,10 @@ def main(ctx: JobContext) -> None:
     # RETRYABLY once at the given global step — the restart-based-recovery
     # e2e: the gang restarts and the next incarnation must resume from the
     # latest checkpoint, not step 0. The marker file makes it once-only.
+    # Granularity: with device_loop=K the on_step callback fires per CHUNK
+    # (post-chunk step), so the fault can trigger up to K-1 steps late and
+    # after that chunk's save — exact-step chaos scenarios should use
+    # device_loop=1 (see WorkloadCheckpointer.run_loop).
     fail_at = int(wl.get("fail_at_step", 0))
     marker = wl.get("fail_marker")
 

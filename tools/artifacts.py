@@ -1,0 +1,140 @@
+"""CI artifact sink: the Prow/Gubernator job-artifact contract, locally.
+
+Reference parity: ``/root/reference/py/prow.py:36-60`` computes a GCS
+output directory from JOB_NAME / BUILD_NUMBER / PULL_NUMBER per the
+kubernetes test-infra artifact layout, then copies junit + logs there and
+writes started.json / finished.json so the results UI (Gubernator) can
+render runs. This module reproduces that contract with a pluggable sink:
+
+- layout:  ``{base}/logs/{job}/{build}/``  (postsubmit)  or
+           ``{base}/pr-logs/pull/{repo}/{pull}/{job}/{build}/``  (presubmit)
+- content: ``started.json`` (timestamp, repo sha), per-stage build logs +
+           junit under ``artifacts/``, ``finished.json`` (result, passed)
+
+``LocalSink`` writes the tree to a directory; a ``gs://`` base selects
+``GcsSink``, which stages locally and uploads with gsutil when present
+(this environment has no egress, so the upload step degrades to a loud
+log line — the LAYOUT is what the contract specifies, and it is what the
+tests pin).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import time
+from typing import Optional
+
+
+def output_path(base: str, job: str, build: str,
+                pull_number: Optional[str] = None,
+                repo: str = "tf-operator-tpu") -> str:
+    """The Gubernator layout rule (prow.py get_gcs_output)."""
+    if pull_number:
+        return f"{base.rstrip('/')}/pr-logs/pull/{repo}/{pull_number}/{job}/{build}"
+    return f"{base.rstrip('/')}/logs/{job}/{build}"
+
+
+def _git_sha() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ).stdout.strip()
+    except Exception:  # noqa: BLE001 — sha is best-effort metadata
+        return ""
+
+
+class LocalSink:
+    """Artifact tree on local disk (the substrate CI runs on here)."""
+
+    def __init__(self, base: str, job: Optional[str] = None,
+                 build: Optional[str] = None,
+                 pull_number: Optional[str] = None) -> None:
+        self.job = job or os.environ.get("JOB_NAME", "tpujob-ci")
+        self.build = (build or os.environ.get("BUILD_NUMBER")
+                      or time.strftime("%Y%m%d-%H%M%S"))
+        self.pull_number = pull_number or os.environ.get("PULL_NUMBER")
+        self.root = output_path(base, self.job, self.build, self.pull_number)
+        self.artifacts_dir = os.path.join(self.root, "artifacts")
+        os.makedirs(self.artifacts_dir, exist_ok=True)
+
+    # -- lifecycle (started/finished: the Gubernator metadata contract) --
+
+    def started(self) -> None:
+        self._write_json("started.json", {
+            "timestamp": int(time.time()),
+            "repos": {"tf-operator-tpu": _git_sha() or "unknown"},
+        })
+
+    def finished(self, passed: bool, metadata: Optional[dict] = None) -> None:
+        self._write_json("finished.json", {
+            "timestamp": int(time.time()),
+            "result": "SUCCESS" if passed else "FAILURE",
+            "passed": passed,
+            "metadata": metadata or {},
+        })
+
+    # -- content ----------------------------------------------------------
+
+    def open_log(self, name: str):
+        """Writable text stream under artifacts/ (per-stage build logs)."""
+        return open(os.path.join(self.artifacts_dir, name), "w")
+
+    def add_file(self, path: str, name: Optional[str] = None) -> None:
+        if os.path.isfile(path):
+            shutil.copy2(path, os.path.join(self.artifacts_dir,
+                                            name or os.path.basename(path)))
+
+    def add_tree(self, directory: str) -> None:
+        """Copy every junit/log/json file from a working dir into the tree
+        (the copy-artifacts step)."""
+        if not os.path.isdir(directory):
+            return
+        for dirpath, _, files in os.walk(directory):
+            for f in files:
+                if f.endswith((".xml", ".log", ".txt", ".json")):
+                    rel = os.path.relpath(os.path.join(dirpath, f), directory)
+                    dst = os.path.join(self.artifacts_dir, rel)
+                    os.makedirs(os.path.dirname(dst), exist_ok=True)
+                    shutil.copy2(os.path.join(dirpath, f), dst)
+
+    def _write_json(self, name: str, payload: dict) -> None:
+        with open(os.path.join(self.root, name), "w") as f:
+            json.dump(payload, f, indent=2)
+
+
+class GcsSink(LocalSink):
+    """GCS-shaped sink: stages the identical tree locally, then uploads
+    with gsutil if available. With no egress (this environment), the
+    upload is skipped LOUDLY — the versioned tree still exists locally
+    for inspection, which is the part CI consumes here."""
+
+    def __init__(self, gs_base: str, staging_root: str = "/tmp/tpujob-gcs-staging",
+                 **kw) -> None:
+        assert gs_base.startswith("gs://")
+        self.gs_base = gs_base
+        super().__init__(os.path.join(staging_root, gs_base[len("gs://"):]), **kw)
+
+    def upload(self) -> bool:
+        # Destination carries the FULL layout path (logs/{job}/{build} or
+        # pr-logs/...): a bare `cp -r <root> gs://base` would nest only the
+        # build-number basename, landing runs outside the layout and
+        # colliding same-numbered builds across jobs.
+        dest = output_path(self.gs_base, self.job, self.build, self.pull_number)
+        gsutil = shutil.which("gsutil")
+        if not gsutil:
+            print(f"[artifacts] gsutil unavailable; tree staged at {self.root} "
+                  f"(would rsync to {dest})")
+            return False
+        r = subprocess.run([gsutil, "-m", "rsync", "-r", self.root, dest])
+        return r.returncode == 0
+
+
+def make_sink(base: str, **kw):
+    if base.startswith("gs://"):
+        return GcsSink(base, **kw)
+    return LocalSink(base, **kw)

@@ -29,20 +29,40 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def run_stage(stage: dict, subs: dict) -> int:
+def run_stage(stage: dict, subs: dict, sink=None) -> int:
     cmd = stage["run"].format(**subs)
     print(f"\n=== stage {stage['name']}: {cmd}", flush=True)
     t0 = time.perf_counter()
-    r = subprocess.run(shlex.split(cmd), cwd=REPO_ROOT)
-    print(f"=== stage {stage['name']}: exit {r.returncode} "
+    if sink is not None:
+        # tee: terminal keeps streaming, the sink archives the build log
+        # (the per-stage build-log.txt of the Gubernator layout)
+        with sink.open_log(f"build-log-{stage['name']}.txt") as logf:
+            proc = subprocess.Popen(
+                shlex.split(cmd), cwd=REPO_ROOT,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+            for line in proc.stdout:
+                sys.stdout.write(line)
+                logf.write(line)
+            proc.wait()
+            rc = proc.returncode
+    else:
+        rc = subprocess.run(shlex.split(cmd), cwd=REPO_ROOT).returncode
+    print(f"=== stage {stage['name']}: exit {rc} "
           f"({time.perf_counter() - t0:.1f}s)", flush=True)
-    return r.returncode
+    return rc
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpujob-ci")
     p.add_argument("--pipeline", default=os.path.join(REPO_ROOT, "ci", "pipeline.yaml"))
     p.add_argument("--artifacts", default="/tmp/tpujob-ci-artifacts")
+    p.add_argument("--output-base", default=os.environ.get("CI_OUTPUT_BASE"),
+                   help="artifact sink base (dir or gs://bucket/prefix): "
+                        "archives a versioned started.json/finished.json/"
+                        "artifacts tree per the Prow/Gubernator layout "
+                        "(reference py/prow.py:36-60); JOB_NAME/BUILD_NUMBER/"
+                        "PULL_NUMBER env select the path")
     args = p.parse_args(argv)
 
     import yaml
@@ -52,16 +72,38 @@ def main(argv=None) -> int:
     os.makedirs(args.artifacts, exist_ok=True)
     subs = {"port": free_port(), "port2": free_port(), "artifacts": args.artifacts}
 
+    sink = None
+    if args.output_base:
+        from tools.artifacts import make_sink
+
+        sink = make_sink(args.output_base)
+        sink.started()
+        print(f"artifact sink: {sink.root}")
+
     failed = None
     results = []
-    for stage in pipeline["stages"]:
-        if failed is not None and not stage.get("always"):
-            results.append((stage["name"], "skipped"))
-            continue
-        rc = run_stage(stage, subs)
-        results.append((stage["name"], "ok" if rc == 0 else f"FAIL({rc})"))
-        if rc != 0 and failed is None:
-            failed = stage["name"]
+    try:
+        for stage in pipeline["stages"]:
+            if failed is not None and not stage.get("always"):
+                results.append((stage["name"], "skipped"))
+                continue
+            rc = run_stage(stage, subs, sink=sink)
+            results.append((stage["name"], "ok" if rc == 0 else f"FAIL({rc})"))
+            if rc != 0 and failed is None:
+                failed = stage["name"]
+    except BaseException:
+        failed = failed or "runner-crash"
+        raise
+    finally:
+        # finished.json must exist for FAILED runs too (a crashed stage
+        # command / bad substitution would otherwise leave the tree
+        # permanently "running" — exactly the runs the contract records).
+        if sink is not None:
+            sink.add_tree(args.artifacts)  # junit + logs from the working dir
+            sink.finished(passed=failed is None,
+                          metadata={"stages": dict(results)})
+            if hasattr(sink, "upload"):
+                sink.upload()
 
     print(f"\n{pipeline.get('name', 'pipeline')} summary:")
     for name, outcome in results:
