@@ -30,6 +30,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tpujob-agent", description="per-host launcher daemon"
     )
+    from tf_operator_tpu.utils.version import add_version_flag
+
+    add_version_flag(p)
     p.add_argument("--server", required=True,
                    help="operator base URL, e.g. http://10.0.0.1:8080")
     p.add_argument("--name", required=True, help="unique host name")

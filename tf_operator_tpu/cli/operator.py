@@ -32,6 +32,9 @@ def build_parser() -> argparse.ArgumentParser:
         prog="tpujob-operator", description="TPUJob operator daemon"
     )
     # reference: options.go (v1alpha1:23-47, v2:22-48)
+    from tf_operator_tpu.utils.version import add_version_flag
+
+    add_version_flag(p)
     p.add_argument("--threadiness", type=int, default=2,
                    help="controller worker threads (reference default 2)")
     p.add_argument("--resync-period", type=float, default=15.0,

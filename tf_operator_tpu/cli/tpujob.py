@@ -26,6 +26,9 @@ DEFAULT_SERVER = os.environ.get("TPUJOB_SERVER", "http://127.0.0.1:8080")
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpujob", description="TPUJob client")
+    from tf_operator_tpu.utils.version import add_version_flag
+
+    add_version_flag(p)
     p.add_argument("--server", default=DEFAULT_SERVER, help="operator API URL")
     sub = p.add_subparsers(dest="cmd", required=True)
 

@@ -1,0 +1,52 @@
+"""Version/build-identity print (reference: pkg/version — the operator
+binaries print version + git SHA at startup; same contract here)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+from tf_operator_tpu import __version__
+
+
+def git_sha() -> str:
+    """Best-effort build SHA: env override (release artifacts bake it in)
+    then git — but only when the package actually lives in a source
+    checkout (a pip-installed copy inside someone else's repo must not
+    report THAT repo's HEAD). Empty when neither applies."""
+    sha = os.environ.get("TPUJOB_GIT_SHA")
+    if sha:
+        return sha
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if not os.path.isdir(os.path.join(root, ".git")):
+        return ""
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5, cwd=root,
+        ).stdout.strip()
+    except Exception:  # noqa: BLE001
+        return ""
+
+
+def version_string() -> str:
+    sha = git_sha()
+    return f"tf-operator-tpu {__version__}" + (f" ({sha})" if sha else "")
+
+
+def add_version_flag(parser) -> None:
+    """--version on a CLI parser, LAZILY: the git subprocess only runs when
+    the flag is actually passed (eager evaluation would tax every daemon
+    start and every test building a parser)."""
+    import argparse
+
+    class _Version(argparse.Action):
+        def __init__(self, option_strings, dest, **kw):
+            super().__init__(option_strings, dest, nargs=0, **kw)
+
+        def __call__(self, parser, namespace, values, option_string=None):
+            print(version_string())
+            parser.exit()
+
+    parser.add_argument("--version", action=_Version,
+                        help="print version + build sha and exit")
