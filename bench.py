@@ -229,8 +229,11 @@ def main() -> None:
     # XLA convs — kept as the documented negative result).
     import dataclasses
 
-    if os.environ.get("BENCH_BN_STATS_GRAD", "1") == "0":
+    sg_env = os.environ.get("BENCH_BN_STATS_GRAD", "1")
+    if sg_env == "0":
         cfg = dataclasses.replace(cfg, bn_stats_stop_gradient=True)
+    elif sg_env == "var":
+        cfg = dataclasses.replace(cfg, bn_stats_stop_gradient="var")
     if os.environ.get("BENCH_FUSED_1X1", "0") == "1":
         cfg = dataclasses.replace(cfg, fused_1x1=True)
     mesh = build_mesh({"dp": n_chips})

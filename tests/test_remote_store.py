@@ -376,3 +376,54 @@ def _exists(store, kind, name, namespace="default"):
         return True
     except NotFoundError:
         return False
+
+
+def test_informer_over_remote_watch_replay_semantics():
+    """The HA controller's informer over RemoteWatch: reconnect replay
+    must (a) re-deliver KNOWN objects as updates, never as fresh adds —
+    replay ADDs would re-fire expectations.creation_observed and let a
+    sync trust a stale cache (the DeltaFIFO rule) — and (b) synthesize
+    DELETED for objects removed while disconnected."""
+    import socket
+
+    from tf_operator_tpu.controller.informer import Informer
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    store = Store()
+    server = DashboardServer(store, port=port)
+    server.start()
+    rs = RemoteStore(f"http://127.0.0.1:{port}")
+
+    adds, updates, deletes = [], [], []
+    inf = Informer(rs, KIND_HOST)
+    inf.add_event_handler(
+        on_add=lambda o: adds.append(o.metadata.name),
+        on_update=lambda old, new: updates.append(new.metadata.name),
+        on_delete=lambda o: deletes.append(o.metadata.name),
+    )
+    store.create(Host(metadata=ObjectMeta(name="keeper"), spec=HostSpec(total_chips=1)))
+    store.create(Host(metadata=ObjectMeta(name="goner"), spec=HostSpec(total_chips=1)))
+    inf.run()
+    server2 = None
+    try:
+        assert wait_for(lambda: sorted(adds) == ["goner", "keeper"], timeout=15)
+
+        # Sever the connection; delete one object while the watch is down.
+        server.stop()
+        store.delete(KIND_HOST, "default", "goner")
+        server2 = DashboardServer(store, port=port)
+        server2.start()
+        # Reconnect replay: keeper must come back as an UPDATE (not a
+        # duplicate add), goner's absence must synthesize a delete.
+        assert wait_for(lambda: "goner" in deletes, timeout=30)
+        assert wait_for(lambda: "keeper" in updates, timeout=30)
+        assert adds.count("keeper") == 1, adds
+        assert inf.get("default", "goner") is None
+        assert inf.get("default", "keeper") is not None
+    finally:
+        inf.stop()
+        server.stop()  # no-op if already stopped
+        if server2 is not None:
+            server2.stop()

@@ -107,6 +107,12 @@ class Informer:
 
     def _loop(self) -> None:
         assert self._watch is not None
+        if hasattr(self._watch, "queue"):
+            self._loop_local()
+        else:
+            self._loop_remote()
+
+    def _loop_local(self) -> None:
         # Synced once the replayed backlog drains: either the queue empties
         # after a dispatch or the first 50ms poll comes up empty.
         import queue as _queue
@@ -123,6 +129,47 @@ class Informer:
             self._dispatch(ev)
             if self._watch.queue.empty():
                 self._synced.set()
+
+    def _loop_remote(self) -> None:
+        """RemoteWatch consumption (the HA --store-server controller): an
+        auto-reconnecting ITERABLE that brackets each (re)connect's replay
+        with REPLAY_START/SYNCED control events instead of exposing a
+        queue. On SYNCED the cache reconciles against the replayed set —
+        deletions that happened while disconnected are never replayed, so
+        anything cached but absent from the replay gets a synthetic
+        DELETED (the informer-side analogue of the agent's orphan reap)."""
+        from tf_operator_tpu.runtime.store import WatchEvent
+
+        replay_seen: Optional[set] = None
+        for ev in self._watch:
+            if ev.type is WatchEventType.REPLAY_START:
+                replay_seen = set()
+                continue
+            if ev.type is WatchEventType.SYNCED:
+                if replay_seen is not None:
+                    with self._lock:
+                        stale = [
+                            (k, obj) for k, obj in self._cache.items()
+                            if k not in replay_seen
+                        ]
+                    for _, obj in stale:
+                        self._dispatch(WatchEvent(WatchEventType.DELETED, obj))
+                replay_seen = None
+                self._synced.set()
+                continue
+            if replay_seen is not None:
+                meta = ev.obj.metadata
+                key = (meta.namespace, meta.name)
+                replay_seen.add(key)
+                # DeltaFIFO rule: a re-list ADD for an object we already
+                # cache is a MODIFIED, not a new ADDED — replay ADDs would
+                # otherwise re-fire creation_observed on the expectations
+                # cache and let a concurrent sync trust a stale view (the
+                # exact staleness the expectations machinery guards).
+                if ev.type is WatchEventType.ADDED and key in self._cache:
+                    ev = WatchEvent(WatchEventType.MODIFIED, ev.obj)
+            self._dispatch(ev)
+        self._synced.set()
 
     def _dispatch(self, ev) -> None:
         meta = ev.obj.metadata

@@ -9,28 +9,30 @@ import subprocess
 from tf_operator_tpu import __version__
 
 
-def git_sha() -> str:
-    """Best-effort build SHA: env override (release artifacts bake it in)
-    then git — but only when the package actually lives in a source
-    checkout (a pip-installed copy inside someone else's repo must not
-    report THAT repo's HEAD). Empty when neither applies."""
-    sha = os.environ.get("TPUJOB_GIT_SHA")
-    if sha:
-        return sha
-    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    if not os.path.isdir(os.path.join(root, ".git")):
-        return ""
-    try:
-        return subprocess.run(
-            ["git", "rev-parse", "--short", "HEAD"],
-            capture_output=True, text=True, timeout=5, cwd=root,
-        ).stdout.strip()
-    except Exception:  # noqa: BLE001
-        return ""
+def git_sha(length: int = 0) -> str:
+    """Best-effort build SHA — THE one implementation (release/artifact
+    tooling imports this; keep copies from diverging): env override
+    (TPUJOB_GIT_SHA — release artifacts bake it in) then git, but only
+    when the package actually lives in a source checkout (a pip-installed
+    copy inside someone else's repo must not report THAT repo's HEAD).
+    Empty when neither applies. ``length`` truncates (0 = full)."""
+    sha = os.environ.get("TPUJOB_GIT_SHA", "")
+    if not sha:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        if not os.path.exists(os.path.join(root, ".git")):
+            return ""
+        try:
+            sha = subprocess.run(
+                ["git", "rev-parse", "HEAD"],
+                capture_output=True, text=True, timeout=5, cwd=root,
+            ).stdout.strip()
+        except Exception:  # noqa: BLE001
+            return ""
+    return sha[:length] if length else sha
 
 
 def version_string() -> str:
-    sha = git_sha()
+    sha = git_sha(length=7)
     return f"tf-operator-tpu {__version__}" + (f" ({sha})" if sha else "")
 
 

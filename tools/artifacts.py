@@ -38,14 +38,12 @@ def output_path(base: str, job: str, build: str,
 
 
 def _git_sha() -> str:
-    try:
-        return subprocess.run(
-            ["git", "rev-parse", "HEAD"],
-            capture_output=True, text=True, timeout=10,
-            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        ).stdout.strip()
-    except Exception:  # noqa: BLE001 — sha is best-effort metadata
-        return ""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from tf_operator_tpu.utils.version import git_sha
+
+    return git_sha()
 
 
 class LocalSink:

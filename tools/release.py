@@ -28,14 +28,9 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def git_sha() -> str:
-    try:
-        out = subprocess.run(
-            ["git", "rev-parse", "HEAD"], cwd=REPO_ROOT,
-            capture_output=True, text=True, timeout=10,
-        )
-        return out.stdout.strip()[:12] if out.returncode == 0 else "unknown"
-    except OSError:
-        return "unknown"
+    from tf_operator_tpu.utils.version import git_sha as _sha
+
+    return _sha(length=12) or "unknown"
 
 
 def build(args) -> int:
