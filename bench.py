@@ -55,6 +55,33 @@ def run_timed_steps(trainer, state, pull, steps: int, stream: bool):
     return state, metrics, steps, step_s
 
 
+def run_first_step(trainer, pull, breakdown, t_submit):
+    """Submit-phase protocol shared by both benches: the split
+    init-then-step path by default (two programs, phase-timed), or the
+    fused single-program path under BENCH_FUSED_SUBMIT=1
+    (Trainer.init_and_step — one executable upload; measured no net win
+    through this tunnel, see BASELINE.md submit section). Returns
+    (state, metrics). float() forces a host fetch — plain
+    block_until_ready does not synchronize through the remote TPU tunnel."""
+    import jax
+
+    if os.environ.get("BENCH_FUSED_SUBMIT", "0") == "1":
+        state, metrics = trainer.init_and_step(jax.random.PRNGKey(0), pull())
+        _ = float(metrics["loss"])
+        breakdown["fused_init_first_step_s"] = round(
+            time.perf_counter() - t_submit - breakdown["stage_batch_dispatch_s"], 2
+        )
+    else:
+        t0 = time.perf_counter()
+        state = trainer.init(jax.random.PRNGKey(0))
+        breakdown["init_dispatch_s"] = round(time.perf_counter() - t0, 2)
+        t0 = time.perf_counter()
+        state, metrics = trainer.step(state, pull())
+        _ = float(metrics["loss"])
+        breakdown["first_step_s"] = round(time.perf_counter() - t0, 2)
+    return state, metrics
+
+
 def bench_lm(model: str) -> None:
     """Transformer pretraining throughput (BASELINE.json BERT/Llama configs)."""
     from tf_operator_tpu.train.compile_cache import enable as enable_compile_cache
@@ -139,14 +166,8 @@ def bench_lm(model: str) -> None:
 
     breakdown["stage_batch_dispatch_s"] = round(time.perf_counter() - t_submit, 2)
     try:
-        # Fused init+first-step program: one executable upload, not two
-        # (see the resnet path / Trainer.init_and_step).
-        state, metrics = trainer.init_and_step(jax.random.PRNGKey(0), pull())
-        _ = float(metrics["loss"])  # host fetch: the only real sync on a tunneled TPU
+        state, metrics = run_first_step(trainer, pull, breakdown, t_submit)
         first_step_s = time.perf_counter() - t_submit
-        breakdown["fused_init_first_step_s"] = round(
-            first_step_s - breakdown["stage_batch_dispatch_s"], 2
-        )
         for _ in range(2):
             state, metrics = trainer.step(state, pull())
         _ = float(metrics["loss"])
@@ -295,17 +316,8 @@ def main() -> None:
 
     breakdown["stage_batch_dispatch_s"] = round(time.perf_counter() - t_submit, 2)
     try:
-        # First step via the fused init+step program: ONE executable upload
-        # instead of two (Trainer.init_and_step — on the tunneled chip the
-        # init program's cache-hit transfer alone measured 4.2 s). float()
-        # forces a host fetch — plain block_until_ready does not
-        # synchronize through the remote TPU tunnel.
-        state, metrics = trainer.init_and_step(jax.random.PRNGKey(0), pull())
-        _ = float(metrics["loss"])
+        state, metrics = run_first_step(trainer, pull, breakdown, t_submit)
         first_step_s = time.perf_counter() - t_submit
-        breakdown["fused_init_first_step_s"] = round(
-            first_step_s - breakdown["stage_batch_dispatch_s"], 2
-        )
         for _ in range(warmup):
             state, metrics = trainer.step(state, pull())
         _ = float(metrics["loss"])

@@ -244,3 +244,14 @@ class TestMemPlan:
                       "--batch", "1", "--seq", "2048", "--hbm-gb", "16")
         assert r.returncode == 1, r.stdout + r.stderr
         assert "fits             False" in r.stdout
+
+    def test_llama2_70b_gqa_fits_v5p256(self):
+        """The GQA config at pod scale: 70B over fsdp=32 x tp=8 (256 chips)
+        must fit the v5p budget, and must NOT fit a single-host slice."""
+        r = self._run("--preset", "llama2-70b", "--mesh", "dp=1,fsdp=32,tp=8",
+                      "--batch", "32", "--seq", "4096", "--hbm-gb", "95")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "fits             True" in r.stdout
+        r2 = self._run("--preset", "llama2-70b", "--mesh", "fsdp=4",
+                       "--batch", "4", "--seq", "4096", "--hbm-gb", "95")
+        assert r2.returncode == 1, r2.stdout + r2.stderr

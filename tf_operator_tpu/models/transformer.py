@@ -148,6 +148,14 @@ PRESETS: Dict[str, TransformerConfig] = {
         vocab=32000, d_model=5120, n_layers=40, n_heads=40, n_kv_heads=40, d_ff=13824,
         max_seq=4096,
     ),
+    # The GQA member of the family (8 kv heads vs 64 query heads): the
+    # config that actually exercises grouped-query attention at scale.
+    # Memory plan validated by tests/test_tools.py::TestMemPlan (fits a
+    # v5p-256-shaped fsdp=32 x tp=8 mesh).
+    "llama2-70b": TransformerConfig(
+        vocab=32000, d_model=8192, n_layers=80, n_heads=64, n_kv_heads=8, d_ff=28672,
+        max_seq=4096,
+    ),
 }
 
 

@@ -9,14 +9,17 @@ import subprocess
 from tf_operator_tpu import __version__
 
 
-def git_sha(length: int = 0) -> str:
+def git_sha(length: int = 0, honor_env: bool = True) -> str:
     """Best-effort build SHA — THE one implementation (release/artifact
     tooling imports this; keep copies from diverging): env override
     (TPUJOB_GIT_SHA — release artifacts bake it in) then git, but only
     when the package actually lives in a source checkout (a pip-installed
     copy inside someone else's repo must not report THAT repo's HEAD).
-    Empty when neither applies. ``length`` truncates (0 = full)."""
-    sha = os.environ.get("TPUJOB_GIT_SHA", "")
+    Empty when neither applies. ``length`` truncates (0 = full);
+    ``honor_env=False`` forces the real checkout HEAD — release tooling
+    must record the commit it actually archives, never a baked-in
+    override."""
+    sha = os.environ.get("TPUJOB_GIT_SHA", "") if honor_env else ""
     if not sha:
         root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         if not os.path.exists(os.path.join(root, ".git")):

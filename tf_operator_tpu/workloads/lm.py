@@ -4,8 +4,9 @@ Covers the BASELINE.json BERT-base and Llama-2 configs: joins the gang,
 builds the declared mesh (dp/fsdp/tp/cp), trains a transformer preset with
 the sharded Trainer on synthetic tokens, logs tokens/sec and MFU.
 
-workload config keys: preset ("tiny"|"tiny-moe"|"gpt-small"|"moe-small"|
-"bert-base"|"llama2-7b"|"llama2-13b"), steps, batch_size, seq_len, lr,
+workload config keys: preset (any models.transformer.PRESETS name:
+"tiny"|"tiny-moe"|"gpt-small"|"moe-small"|"bert-base"|"llama2-7b"|
+"llama2-13b"|"llama2-70b"), steps, batch_size, seq_len, lr,
 attn ("dense"|"ring"|"flash"), profile_dir (capture an XLA trace),
 device_loop (K steps per compiled call — lax.scan device loop),
 checkpoint_dir, checkpoint_every (steps between saves; restart-based

@@ -40,7 +40,9 @@ def output_path(base: str, job: str, build: str,
 def _git_sha() -> str:
     import sys
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
     from tf_operator_tpu.utils.version import git_sha
 
     return git_sha()

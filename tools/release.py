@@ -30,7 +30,9 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def git_sha() -> str:
     from tf_operator_tpu.utils.version import git_sha as _sha
 
-    return _sha(length=12) or "unknown"
+    # honor_env=False: the manifest must name the HEAD git-archive packs,
+    # not a TPUJOB_GIT_SHA baked into the surrounding environment.
+    return _sha(length=12, honor_env=False) or "unknown"
 
 
 def build(args) -> int:
