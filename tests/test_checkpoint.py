@@ -214,10 +214,43 @@ def test_workload_checkpointer_is_complete_peeks_without_restore(tmp_path):
 
     wl = {"checkpoint_dir": str(tmp_path / "peek"), "checkpoint_every": 1}
     ckpt = WorkloadCheckpointer(wl)
-    ckpt.manager.save(6, {"x": jnp.ones((2,))})
+    # wait=True: models a COMPLETED prior incarnation (its last save is
+    # fenced by final()); an unfenced async save is legitimately invisible
+    # to a new process until committed.
+    ckpt.manager.save(6, {"x": jnp.ones((2,))}, wait=True)
     fresh = WorkloadCheckpointer(wl)  # new incarnation, nothing restored
     assert fresh.is_complete(5)  # 6 >= 5 + 1 (warmup step)
     assert not fresh.is_complete(10)
+
+
+def test_async_save_overlaps_and_fences(tmp_path, sharded_state):
+    """Async orbax semantics (r3): save() returns with the write possibly
+    still in flight; wait_until_finished commits it; the next save()
+    self-fences (at most one write in flight); a fenced save is restorable
+    by a FRESH manager (the cross-process visibility contract)."""
+    _, trainer, state, _ = sharded_state
+    mgr = CheckpointManager(tmp_path / "async", backend="orbax")
+    assert mgr.async_save
+    assert mgr.save(1, state)
+    mgr.wait_until_finished()
+    assert 1 in mgr.all_steps()
+    # second save fences the first internally, then dispatches
+    assert mgr.save(2, _clone(state), wait=True)
+    mgr.close()
+    fresh = CheckpointManager(tmp_path / "async", backend="orbax", readonly=True)
+    assert fresh.latest_step() == 2
+    restored = fresh.restore(trainer.state_template(), step=2)
+    assert int(restored.step) == int(state.step)
+
+
+def test_sync_save_opt_out(tmp_path, sharded_state):
+    """async_save=False restores the r2 blocking behavior."""
+    _, _, state, _ = sharded_state
+    mgr = CheckpointManager(tmp_path / "sync", backend="orbax", async_save=False)
+    assert mgr.save(3, state)
+    fresh = CheckpointManager(tmp_path / "sync", backend="orbax", readonly=True)
+    assert fresh.latest_step() == 3  # committed before save() returned
+    mgr.close()
 
 
 @pytest.mark.parametrize("backend", BACKENDS)
@@ -229,10 +262,13 @@ def test_reader_sees_external_saves_after_reload(tmp_path, sharded_state, backen
     root = tmp_path / backend
     reader = CheckpointManager(root, backend=backend, readonly=True)
     writer = CheckpointManager(root, backend=backend)
-    writer.save(2, _clone(state))
+    # wait=True: cross-manager visibility is committed-state only — the
+    # live evaluator polls reload() until a save commits; the test pins
+    # the discovery mechanics, not the polling.
+    writer.save(2, _clone(state), wait=True)
     reader.reload()
     assert reader.latest_step() == 2
-    writer.save(4, _clone(state))
+    writer.save(4, _clone(state), wait=True)
     reader.reload()
     assert reader.latest_step() == 4
 

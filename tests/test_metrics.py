@@ -1,0 +1,53 @@
+"""MFU accounting (train.metrics).
+
+The reference has no training telemetry; MFU is this framework's
+north-star surface (BASELINE.md). These pin the FLOP-accounting math so
+bench numbers stay comparable across rounds — especially the r3
+attention-aware formula that fixed the long-context under-report.
+"""
+
+from tf_operator_tpu.train.metrics import (
+    attention_train_flops,
+    transformer_train_flops,
+    transformer_train_flops_exact,
+)
+
+
+def test_6nd_rule():
+    assert transformer_train_flops(100, 10) == 6000.0
+
+
+def test_attention_term_palm_formula():
+    # 12 * L * t * d per token, times tokens_per_step
+    assert attention_train_flops(2, 8, 16, 4) == 12.0 * 2 * 16 * 8 * 4
+
+
+def test_exact_is_sum_of_terms():
+    n, d, L, t = 1_000_000, 64, 4, 128
+    toks = 256
+    assert transformer_train_flops_exact(n, toks, L, d, t) == (
+        transformer_train_flops(n, toks) + attention_train_flops(L, d, t, toks)
+    )
+
+
+def test_long_context_correction_magnitude():
+    """The bug the r3 fix closes: at t=8192 on gpt-small the attention term
+    ~equals the 6ND term, so 6ND-only MFU halves the true number."""
+    from tf_operator_tpu.models.transformer import PRESETS
+
+    cfg = PRESETS["gpt-small"]
+    t = 8192
+    toks = 2 * t
+    six_nd = transformer_train_flops(cfg.n_active_params(), toks)
+    exact = transformer_train_flops_exact(
+        cfg.n_active_params(), toks, cfg.n_layers, cfg.d_model, t
+    )
+    assert 1.9 < exact / six_nd < 2.1
+    # and at short context the correction is small (<10%)
+    t = 512
+    toks = 32 * t
+    six_nd = transformer_train_flops(cfg.n_active_params(), toks)
+    exact = transformer_train_flops_exact(
+        cfg.n_active_params(), toks, cfg.n_layers, cfg.d_model, t
+    )
+    assert exact / six_nd < 1.10

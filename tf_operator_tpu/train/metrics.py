@@ -100,8 +100,42 @@ class StepTimer:
 
 
 def transformer_train_flops(n_params: int, tokens_per_step: int) -> float:
-    """6ND rule: fwd 2ND + bwd 4ND."""
+    """6ND rule: fwd 2ND + bwd 4ND.
+
+    This deliberately EXCLUDES the attention score/value matmuls (they scale
+    with sequence length, not parameter count) — at t=8192 on gpt-small the
+    attention term is the same order as 6ND, so a 6ND-only MFU under-reports
+    long-context utilization by ~2x. Use transformer_train_flops_exact for
+    honest long-context accounting; report both (bench.py does)."""
     return 6.0 * n_params * tokens_per_step
+
+
+def attention_train_flops(
+    n_layers: int, d_model: int, seq_len: int, tokens_per_step: int
+) -> float:
+    """Attention matmul FLOPs (PaLM appendix-B accounting): per token the
+    QK^T and AV einsums each cost 2·t·d fwd per layer, so fwd = 4·L·t·d and
+    train (fwd+bwd = 3x fwd) = 12·L·t·d per token. Counted over the full
+    t^2 score matrix, per the PaLM convention, even for causal models —
+    a causal kernel that skips masked blocks shows up as MFU > its dense
+    counterpart, which is the honest reading (it did less wall-clock work
+    for the same model math)."""
+    return 12.0 * n_layers * seq_len * d_model * tokens_per_step
+
+
+def transformer_train_flops_exact(
+    n_params: int,
+    tokens_per_step: int,
+    n_layers: int,
+    d_model: int,
+    seq_len: int,
+) -> float:
+    """6ND plus the attention term — the exact model-FLOPs accounting for
+    long-context MFU (6ND alone halves the reported utilization at
+    t≈8k on gpt-small-class models)."""
+    return transformer_train_flops(n_params, tokens_per_step) + attention_train_flops(
+        n_layers, d_model, seq_len, tokens_per_step
+    )
 
 
 def resnet_train_flops(fwd_flops_per_image: float, images_per_step: int) -> float:

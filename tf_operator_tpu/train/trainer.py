@@ -37,6 +37,16 @@ class TrainerConfig:
     warmup_steps: int = 0
     lr_schedule: str = "constant"  # "constant" | "cosine"
     total_steps: int = 10000
+    # Microbatch gradient accumulation: >1 splits each step's batch into
+    # grad_accum equal microbatches, runs fwd+bwd per microbatch in a
+    # lax.scan, and applies ONE optimizer update with the mean gradient —
+    # the lever for configs whose global batch exceeds per-chip activation
+    # memory (trade steps-in-flight for batch; peak activation memory drops
+    # ~grad_accum-fold while the optimizer sees the same global batch).
+    # Mean-of-microbatch-means == full-batch mean for equal-size
+    # microbatches, so the loss trajectory is identical up to float
+    # reassociation (oracle-pinned in tests/test_trainer_accum.py).
+    grad_accum: int = 1
 
 
 @dataclass
@@ -259,16 +269,76 @@ class Trainer:
         return TrainState(params, opt_state, step, extra), {"loss": loss}
 
     def _step_body(self, params, opt_state, step, extra, batch):
-        def wrapped(p):
-            out = self.loss_fn(p, batch, extra)
-            if isinstance(out, tuple):
-                return out
-            return out, extra
+        if self.config.grad_accum > 1:
+            loss, new_extra, grads = self._accum_grads(params, extra, batch)
+        else:
+            def wrapped(p):
+                out = self.loss_fn(p, batch, extra)
+                if isinstance(out, tuple):
+                    return out
+                return out, extra
 
-        (loss, new_extra), grads = jax.value_and_grad(wrapped, has_aux=True)(params)
+            (loss, new_extra), grads = jax.value_and_grad(wrapped, has_aux=True)(params)
         updates, opt_state = self.tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, step + 1, new_extra, loss
+
+    def _accum_grads(self, params, extra, batch):
+        """Microbatched fwd+bwd: split the batch's leading dim into
+        ``grad_accum`` equal microbatches and scan, summing grads in f32
+        param-shaped accumulators; one mean at the end. Model ``extra``
+        (e.g. BN stats) threads sequentially through the microbatches —
+        the same semantics as training the microbatches as small steps.
+
+        The [b,...] -> [accum, b/accum, ...] reshape keeps the microbatch
+        dim under the batch sharding (constraint below) so each device
+        keeps an equal slice of every microbatch — XLA lowers it to a
+        layout change (worst case one input-sized reshard, amortized over
+        grad_accum fwd+bwd passes)."""
+        accum = self.config.grad_accum
+        micro_shard = self.rules.sharding(self.mesh, [None, "batch"])
+
+        def split(x):
+            b = x.shape[0]
+            if b % accum:
+                raise ValueError(
+                    f"batch dim {b} not divisible by grad_accum={accum}"
+                )
+            mb = x.reshape((accum, b // accum) + x.shape[1:])
+            return jax.lax.with_sharding_constraint(mb, micro_shard)
+
+        micro = jax.tree_util.tree_map(split, batch)
+
+        def wrapped(p, mb, ex):
+            out = self.loss_fn(p, mb, ex)
+            if isinstance(out, tuple):
+                return out
+            return out, ex
+
+        grad_fn = jax.value_and_grad(wrapped, has_aux=True)
+
+        def body(carry, mb):
+            gsum, loss_sum, ex = carry
+            (loss, ex), g = grad_fn(params, mb, ex)
+            # accumulate in f32 regardless of param dtype: with bf16 params
+            # and accum>=8, summing in bf16 (~8 mantissa bits) absorbs
+            # small microbatch contributions and breaks the oracle
+            gsum = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(jnp.float32), gsum, g
+            )
+            return (gsum, loss_sum + loss, ex), None
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        (gsum, loss_sum, new_extra), _ = jax.lax.scan(
+            body, (zeros, jnp.zeros((), jnp.float32), extra), micro
+        )
+        inv = 1.0 / accum
+        grads = jax.tree_util.tree_map(
+            lambda g, p: (g * inv).astype(p.dtype), gsum, params
+        )
+        return loss_sum * inv, new_extra, grads
 
     def _build_step(self):
         return jax.jit(self._step_body, donate_argnums=(0, 1, 3))

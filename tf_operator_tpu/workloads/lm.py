@@ -10,7 +10,9 @@ workload config keys: preset (any models.transformer.PRESETS name:
 attn ("dense"|"ring"|"flash"), profile_dir (capture an XLA trace),
 device_loop (K steps per compiled call — lax.scan device loop),
 checkpoint_dir, checkpoint_every (steps between saves; restart-based
-recovery resumes from the latest checkpoint), data ("fixed" resident
+recovery resumes from the latest checkpoint), grad_accum (microbatch
+gradient accumulation — same global batch in 1/N-size activation
+footprint; tools.memplan accounts for it), data ("fixed" resident
 batch | "stream" synthetic through the prefetching DeviceLoader |
 "memmap" + corpus=<path>: a REAL tokenized corpus in the
 train.data.write_token_corpus memmap format, window-sharded per
@@ -41,7 +43,11 @@ def main(ctx: JobContext) -> None:
         preset_from_workload,
         transformer_logical_axes,
     )
-    from tf_operator_tpu.train.metrics import mfu, transformer_train_flops
+    from tf_operator_tpu.train.metrics import (
+        mfu,
+        transformer_train_flops,
+        transformer_train_flops_exact,
+    )
     from tf_operator_tpu.train.trainer import Trainer, TrainerConfig
 
     wl = ctx.workload
@@ -62,6 +68,7 @@ def main(ctx: JobContext) -> None:
         logical_axes=transformer_logical_axes(cfg),
         config=TrainerConfig(
             optimizer="adamw", learning_rate=float(wl.get("lr", 3e-4)),
+            grad_accum=int(wl.get("grad_accum", 1)),
         ),
     )
     from tf_operator_tpu.train.checkpoint import WorkloadCheckpointer
@@ -157,12 +164,19 @@ def main(ctx: JobContext) -> None:
         )
     if step_s is not None:
         n_chips = mesh.devices.size
-        # active params: for top-1 MoE only one expert's FLOPs count per token
-        flops = transformer_train_flops(cfg.n_active_params(), batch * seq)
+        # active params: for top-1 MoE only one expert's FLOPs count per
+        # token; mfu_attn adds the 12·L·t·d attention term (the honest
+        # number at long context), mfu_6nd is the scaling-law-comparable one.
+        flops_6nd = transformer_train_flops(cfg.n_active_params(), batch * seq)
+        flops_exact = transformer_train_flops_exact(
+            cfg.n_active_params(), batch * seq, cfg.n_layers, cfg.d_model, seq
+        )
         log.info(
-            "lm done: preset=%s loss=%.4f step=%.2fms tok/s=%.0f mfu=%.3f (%d chips)",
+            "lm done: preset=%s loss=%.4f step=%.2fms tok/s=%.0f mfu_attn=%.3f "
+            "mfu_6nd=%.3f (%d chips)",
             wl.get("preset", "tiny"), loss, step_s * 1e3, batch * seq / step_s,
-            mfu(flops, step_s, n_chips), n_chips,
+            mfu(flops_exact, step_s, n_chips), mfu(flops_6nd, step_s, n_chips),
+            n_chips,
         )
     else:
         log.info("lm done: preset=%s loss=%.4f (no timed steps remained)",

@@ -47,8 +47,14 @@ def _parse_mesh(s: str) -> dict:
 
 
 def plan(preset_name: str, mesh_axes: dict, batch: int, seq: int,
-         remat="full", optimizer: str = "adamw", dtype_bytes: int = 2):
-    """Returns a dict of per-chip byte totals for one train step."""
+         remat="full", optimizer: str = "adamw", dtype_bytes: int = 2,
+         grad_accum: int = 1):
+    """Returns a dict of per-chip byte totals for one train step.
+
+    ``grad_accum`` > 1 (TrainerConfig.grad_accum) scales the activation
+    term by 1/accum — only one microbatch's activations are live at a
+    time inside the accumulation scan; params/optimizer/grads are
+    unchanged (the f32 grad accumulators ARE the grads term)."""
     import math
 
     n_chips = math.prod(mesh_axes.values()) or 1
@@ -112,12 +118,24 @@ def plan(preset_name: str, mesh_axes: dict, batch: int, seq: int,
     seq_shards = mesh_axes.get("cp", 1)
     tp = mesh_axes.get("tp", 1)
     local_tokens = (batch // max(1, data_shards)) * (seq // max(1, seq_shards))
+    if grad_accum > 1:
+        if batch % grad_accum:
+            raise SystemExit(
+                f"batch {batch} not divisible by grad_accum {grad_accum}"
+            )
+        local_tokens = max(1, local_tokens // grad_accum)
     d, f, L, v = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab
+    # K/V projection width: n_kv_heads * head_dim — for GQA (llama2-70b:
+    # 8 kv vs 64 q heads) the k/v activations are kv/d = 1/8 the width of
+    # q, and r3's repeat-free attention keeps them that size end to end.
+    kv = cfg.n_kv_heads * cfg.head_dim
     if cfg.remat in (True, "full"):
         saved = L * local_tokens * d * dtype_bytes
     else:  # no remat: every layer's intermediates persist to the backward
-        saved = L * local_tokens * (4 * d + 2 * f // tp) * dtype_bytes
-    working = local_tokens * (8 * d + 4 * f // tp) * dtype_bytes
+        saved = L * local_tokens * (3 * d + kv + 2 * f // tp) * dtype_bytes
+    # working set: q + attn-out + 2 residual-stream temporaries (d each),
+    # k + v (kv each), gate/up/act/down intermediates (4f/tp)
+    working = local_tokens * (6 * d + 2 * kv + 4 * f // tp) * dtype_bytes
     if cfg.fused_xent:
         head = local_tokens * d * dtype_bytes * 2  # hidden + recompute block
     else:
@@ -131,6 +149,7 @@ def plan(preset_name: str, mesh_axes: dict, batch: int, seq: int,
         "n_chips": n_chips,
         "batch": batch,
         "seq": seq,
+        "grad_accum": grad_accum,
         "remat": str(cfg.remat),
         "params_gb": params_b / 2**30,
         "optimizer_gb": opt_b / 2**30,
@@ -148,6 +167,9 @@ def main(argv=None) -> int:
     p.add_argument("--seq", type=int, default=2048)
     p.add_argument("--remat", default="full")
     p.add_argument("--optimizer", default="adamw")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="TrainerConfig.grad_accum microbatching (activations "
+                        "scale ~1/accum at the same global batch)")
     p.add_argument("--job", default=None,
                    help="read preset/mesh/batch/seq from a TPUJob JSON spec")
     p.add_argument("--hbm-gb", type=float, default=None,
@@ -163,13 +185,15 @@ def main(argv=None) -> int:
         batch = int(wl.get("batch_size", args.batch))
         seq = int(wl.get("seq_len", args.seq))
         remat = wl.get("remat", args.remat)
+        args.grad_accum = int(wl.get("grad_accum", args.grad_accum))
     else:
         if not args.preset:
             p.error("--preset or --job required")
         preset_name, mesh_axes = args.preset, _parse_mesh(args.mesh)
         batch, seq, remat = args.batch, args.seq, args.remat
 
-    out = plan(preset_name, mesh_axes, batch, seq, remat, args.optimizer)
+    out = plan(preset_name, mesh_axes, batch, seq, remat, args.optimizer,
+               grad_accum=args.grad_accum)
     for k, val in out.items():
         print(f"  {k:<16} {val if not isinstance(val, float) else f'{val:.2f}'}")
     if args.hbm_gb is not None:
