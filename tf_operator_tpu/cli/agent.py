@@ -46,6 +46,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-dir", default=None,
                    help="capture launched processes' stdout/stderr here")
     p.add_argument("--json-log-format", action="store_true")
+    p.add_argument("--auth-token-file", default=None,
+                   help="file with the cluster API secret; defaults to "
+                        "$TPUJOB_AUTH_TOKEN / $TPUJOB_AUTH_TOKEN_FILE")
     return p
 
 
@@ -59,7 +62,16 @@ def main(argv=None) -> int:
             else "%(asctime)s %(name)s [%(levelname)s] %(message)s"
         ),
     )
-    store = RemoteStore(args.server)
+    from tf_operator_tpu.utils.auth import ENV_AUTH_TOKEN, resolve_token
+
+    token = resolve_token(token_file=args.auth_token_file)
+    if token:
+        import os
+
+        # children this agent launches inherit the credential (evaluator
+        # write-back); mirrors the operator daemon's export
+        os.environ[ENV_AUTH_TOKEN] = token
+    store = RemoteStore(args.server, token=token)
     if args.backend == "native":
         from tf_operator_tpu.runtime.native import NativeBuildError
         from tf_operator_tpu.runtime.process_backend import (
@@ -99,7 +111,14 @@ def main(argv=None) -> int:
         "agent %s up: server=%s chips=%d backend=%s",
         args.name, args.server, args.chips, type(backend).__name__,
     )
-    stop.wait()
+    # Wake periodically to notice a fatal agent (permanent auth failure):
+    # a daemon that kept running with a dead watch thread would look alive
+    # while every binding to it sat Pending.
+    while not stop.wait(0.5):
+        if agent.fatal:
+            log.critical("agent %s fatal: %s", args.name, agent.fatal)
+            agent.stop()
+            return 1
     log.info("agent %s draining", args.name)
     agent.stop()
     return 0

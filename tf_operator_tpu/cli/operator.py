@@ -79,6 +79,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="process runtime: 'native' = C++ supervisor "
                         "(group kills, normalized exit codes; built on demand), "
                         "'local' = pure-Python subprocess fallback")
+    p.add_argument("--auth-token-file", default=None,
+                   help="file holding the cluster's shared API secret "
+                        "(utils.auth): this daemon requires it as a bearer "
+                        "token on mutating/API routes it serves, and presents "
+                        "it to --store-server. Defaults to $TPUJOB_AUTH_TOKEN "
+                        "/ $TPUJOB_AUTH_TOKEN_FILE; unset = open server "
+                        "(reference parity note: k8sutil.go:53-77 rode "
+                        "kubeconfig auth instead)")
     return p
 
 
@@ -148,10 +156,22 @@ def main(argv=None) -> int:
     from tf_operator_tpu.dashboard import DashboardServer
     from tf_operator_tpu.runtime import LocalProcessControl, NativeProcessControl, Store
 
+    from tf_operator_tpu.utils.auth import resolve_token
+
+    auth_token = resolve_token(token_file=args.auth_token_file)
+    if auth_token:
+        log.info("API auth enabled (bearer token)")
+        # Export to our own env: launched child processes inherit it, so
+        # workload write-backs (evaluator -> ENV_API_SERVER) authenticate
+        # without the secret ever entering job specs or the store.
+        from tf_operator_tpu.utils.auth import ENV_AUTH_TOKEN
+
+        os.environ[ENV_AUTH_TOKEN] = auth_token
+
     if args.store_server:
         from tf_operator_tpu.runtime.remote_store import RemoteStore
 
-        store = RemoteStore(args.store_server)
+        store = RemoteStore(args.store_server, token=auth_token)
     else:
         store = Store()
 
@@ -160,7 +180,9 @@ def main(argv=None) -> int:
         # --store-server and leader-elect through a Lease in this store.
         if args.store_server:
             sys.exit("--store-only hosts the store; it conflicts with --store-server")
-        dashboard = DashboardServer(store, host=args.host, port=args.port)
+        dashboard = DashboardServer(
+            store, host=args.host, port=args.port, auth_token=auth_token
+        )
         stop = threading.Event()
         signal.signal(signal.SIGTERM, lambda *_: stop.set())
         signal.signal(signal.SIGINT, lambda *_: stop.set())
@@ -198,7 +220,8 @@ def main(argv=None) -> int:
     # process, and the UI/API routes proxy reads through the RemoteStore.
     # --port 0 picks an ephemeral port for candidates sharing a machine.
     dashboard = DashboardServer(
-        store, host=args.host, port=args.port, metrics=controller.metrics
+        store, host=args.host, port=args.port, metrics=controller.metrics,
+        auth_token=auth_token,
     )
     chaos = ChaosMonkey(store, args.chaos_level, args.chaos_interval)
 

@@ -30,6 +30,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     add_version_flag(p)
     p.add_argument("--server", default=DEFAULT_SERVER, help="operator API URL")
+    p.add_argument("--auth-token-file", default=None,
+                   help="file with the cluster API secret for an "
+                        "auth-enabled operator; defaults to "
+                        "$TPUJOB_AUTH_TOKEN / $TPUJOB_AUTH_TOKEN_FILE")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     s = sub.add_parser("submit", help="create a job from a JSON spec file")
@@ -57,7 +61,11 @@ def main(argv=None) -> int:
     from tf_operator_tpu.api.types import TPUJob
     from tf_operator_tpu.dashboard.client import TPUJobApiError, TPUJobClient
 
-    client = TPUJobClient(args.server)
+    from tf_operator_tpu.utils.auth import resolve_token
+
+    client = TPUJobClient(
+        args.server, token=resolve_token(token_file=args.auth_token_file)
+    )
     try:
         if args.cmd == "submit":
             from tf_operator_tpu.api.v1alpha1 import parse_job

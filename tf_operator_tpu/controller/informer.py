@@ -39,6 +39,9 @@ class Informer:
         self._thread: Optional[threading.Thread] = None
         self._watch = None
         self._synced = threading.Event()
+        # Permanent watch failure (rejected credentials): reason string.
+        # has_synced() raises on it so cache-sync waiters fail fast.
+        self.failed: Optional[str] = None
 
     # -- registration (before run) ---------------------------------------
 
@@ -79,6 +82,10 @@ class Informer:
             return out
 
     def has_synced(self) -> bool:
+        if self.failed is not None:
+            raise RuntimeError(
+                f"informer {self.kind} watch failed permanently: {self.failed}"
+            )
         return self._synced.is_set()
 
     def seed(self, objs) -> None:
@@ -106,11 +113,22 @@ class Informer:
         self._thread.start()
 
     def _loop(self) -> None:
+        from tf_operator_tpu.runtime.remote_store import UnauthorizedError
+
         assert self._watch is not None
-        if hasattr(self._watch, "queue"):
-            self._loop_local()
-        else:
-            self._loop_remote()
+        try:
+            if hasattr(self._watch, "queue"):
+                self._loop_local()
+            else:
+                self._loop_remote()
+        except UnauthorizedError as exc:
+            # Permanent credential rejection: record it and unblock sync
+            # waiters LOUDLY (has_synced raises) rather than letting the
+            # thread die silently behind a green /healthz.
+            self.failed = str(exc)
+            log.critical("informer %s: store credentials rejected (%s)",
+                         self.kind, exc)
+            self._synced.set()
 
     def _loop_local(self) -> None:
         # Synced once the replayed backlog drains: either the queue empties

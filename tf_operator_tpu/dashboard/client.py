@@ -23,19 +23,28 @@ class TPUJobApiError(RuntimeError):
 
 
 class TPUJobClient:
-    def __init__(self, base_url: str, timeout: float = 10.0) -> None:
+    def __init__(self, base_url: str, timeout: float = 10.0,
+                 token: Optional[str] = None) -> None:
+        """``token``: bearer secret for an auth-enabled operator; defaults
+        to the ambient credential ($TPUJOB_AUTH_TOKEN / token file)."""
+        from tf_operator_tpu.utils.auth import resolve_token
+
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.token = token if token is not None else resolve_token()
 
     # -- raw ---------------------------------------------------------------
 
     def _request(self, method: str, path: str, body: Optional[dict] = None):
+        from tf_operator_tpu.utils.auth import bearer_headers
+
         data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json", **bearer_headers(self.token)}
         req = urllib.request.Request(
             self.base_url + path,
             data=data,
             method=method,
-            headers={"Content-Type": "application/json"},
+            headers=headers,
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:

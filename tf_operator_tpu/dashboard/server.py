@@ -24,6 +24,13 @@ Generic object API (the remote-store seam; clients: runtime/remote_store.py):
 - DELETE /api/v1/{kind}/{ns}/{name}       — delete
 - GET    /api/v1/watch?kinds=A,B          — JSON-lines stream of watch
   events (existing objects replayed as ADDED first — list+watch contract)
+
+Auth (utils.auth, r3): constructed with ``auth_token``, the server
+requires ``Authorization: Bearer <token>`` on every mutating route and on
+the whole /api/v1 surface (the machine seam); human read routes
+(/ui, job reads, events, logs, /metrics, /healthz) stay open. The
+reference rode Kubernetes apiserver auth instead
+(pkg/util/k8sutil/k8sutil.go:53-77).
 """
 
 from __future__ import annotations
@@ -82,12 +89,26 @@ class _Handler(BaseHTTPRequestHandler):
     store: Store = None  # set by server factory
     metrics = None  # ControllerMetrics, set by server factory when wired
     watch_ping_interval: float = 15.0  # idle keep-alive period on watches
+    auth_token: Optional[str] = None  # shared secret; None = open server
 
     # silence default request logging
     def log_message(self, fmt, *args):
         del fmt, args
 
     # -- helpers ----------------------------------------------------------
+
+    def _authorized(self) -> bool:
+        """Bearer-token check (utils.auth): mutating routes and the whole
+        /api/v1 machine surface call this; no-op when no token is
+        configured. On failure a 401 has already been written."""
+        if self.auth_token is None:
+            return True
+        from tf_operator_tpu.utils.auth import check_bearer
+
+        if check_bearer(self.headers.get("Authorization"), self.auth_token):
+            return True
+        self._error(401, "unauthorized")
+        return False
 
     def _json(self, code: int, payload) -> None:
         body = json.dumps(payload).encode()
@@ -180,6 +201,12 @@ class _Handler(BaseHTTPRequestHandler):
                     "endpoints": [_to_jsonable(e) for e in eps],
                 },
             )
+
+        # The generic object API (including the watch stream) is the
+        # machine seam — all consumers are token-capable, so the whole
+        # surface authenticates, reads included.
+        if path.startswith("/api/v1/") and not self._authorized():
+            return
 
         if path == "/api/v1/watch":
             kinds = [k for k in (q.get("kinds", [""])[0]).split(",") if k]
@@ -310,6 +337,8 @@ class _Handler(BaseHTTPRequestHandler):
     # -- POST / PUT / DELETE ----------------------------------------------
 
     def do_PUT(self):  # noqa: N802
+        if not self._authorized():
+            return
         url = urlparse(self.path)
         m = _OBJ_RE.match(url.path)
         if not m:
@@ -332,6 +361,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(409, {"error": str(exc), "code": "conflict"})
 
     def do_POST(self):  # noqa: N802
+        if not self._authorized():
+            return
         path = urlparse(self.path).path
         m = _OBJ_KIND_RE.match(path)
         if m:
@@ -374,6 +405,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._json(201, self._job_payload(created))
 
     def do_DELETE(self):  # noqa: N802
+        if not self._authorized():
+            return
         path = urlparse(self.path).path
         m = _OBJ_RE.match(path)
         if m:
@@ -407,7 +440,11 @@ class DashboardServer:
         port: int = 8080,
         metrics=None,
         watch_ping_interval: float = 15.0,
+        auth_token: Optional[str] = None,
     ) -> None:
+        """``auth_token``: shared secret (utils.auth) required on mutating
+        routes and the /api/v1 surface; None serves anonymously (tests,
+        localhost dev)."""
         self._watches: set = set()
         self._watch_closed = threading.Event()
         handler = type(
@@ -417,6 +454,7 @@ class DashboardServer:
                 "store": store,
                 "metrics": metrics,
                 "watch_ping_interval": watch_ping_interval,
+                "auth_token": auth_token,
                 "_active_watches": self._watches,
                 "_watch_lock": threading.Lock(),
                 "_watch_closed": self._watch_closed,

@@ -72,6 +72,11 @@ class HostAgent:
         # Keys of bindings seen during the current watch replay (between
         # REPLAY_START and SYNCED); None outside a replay window.
         self._replay_seen: Optional[set] = None
+        # Permanent-failure escalation (UnauthorizedError from the store):
+        # set to the reason string; heartbeats stop (Host -> NodeLost) and
+        # the daemon wrapper (cli/agent.py) exits nonzero. A dead watch
+        # thread behind a live heartbeat would mask NodeLost forever.
+        self.fatal: Optional[str] = None
 
     # -- lifecycle --------------------------------------------------------
 
@@ -178,7 +183,21 @@ class HostAgent:
         return proc.spec.node_name == self.name
 
     def _watch_loop(self) -> None:
+        from tf_operator_tpu.runtime.remote_store import UnauthorizedError
+
         assert self._watch is not None
+        try:
+            self._run_watch()
+        except UnauthorizedError as exc:
+            # Permanent: go FATAL, not blind. Stopping _stop ends the
+            # heartbeat loop too, so the Host goes NodeLost and the
+            # controller reacts instead of binding work to a deaf agent.
+            self.fatal = str(exc)
+            log.critical("agent %s: store credentials rejected; going fatal "
+                         "(%s)", self.name, exc)
+            self._stop.set()
+
+    def _run_watch(self) -> None:
         for ev in self._watch:
             if self._stop.is_set():
                 return

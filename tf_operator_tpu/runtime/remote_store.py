@@ -39,6 +39,15 @@ class RemoteStoreError(TransientStoreError):
     Subclasses TransientStoreError: shared retry loops wait it out."""
 
 
+class UnauthorizedError(Exception):
+    """The server rejected our credentials (401/403) — on any route:
+    request or watch. Deliberately NOT an OSError/TransientStoreError:
+    auth failure is permanent, and a client that retried it would run
+    blind forever while /healthz stays green. Consumers escalate: the
+    agent daemon goes fatal (heartbeat stops -> NodeLost) and exits
+    nonzero; the informer records failure instead of claiming sync."""
+
+
 class RemoteWatch:
     """Iterable of WatchEvents from the server's ndjson stream.
 
@@ -50,13 +59,15 @@ class RemoteWatch:
     ``shutdown()`` the socket: closing a buffered response from another
     thread deadlocks on the reader lock the blocked consumer holds."""
 
-    def __init__(self, base: str, kinds, connect_timeout: float = 10.0) -> None:
+    def __init__(self, base: str, kinds, connect_timeout: float = 10.0,
+                 token: Optional[str] = None) -> None:
         u = urllib.parse.urlsplit(base)
         self._host = u.hostname
         self._port = u.port or (443 if u.scheme == "https" else 80)
         self._tls = u.scheme == "https"
         self.kinds = tuple(kinds) if kinds else None
         self._connect_timeout = connect_timeout
+        self._token = token
         self._stopped = threading.Event()
         self._sock = None
         self._lock = threading.Lock()
@@ -83,13 +94,22 @@ class RemoteWatch:
         conn_cls = http.client.HTTPSConnection if self._tls else http.client.HTTPConnection
         conn = conn_cls(self._host, self._port, timeout=self._connect_timeout)
         q = f"?kinds={','.join(self.kinds)}" if self.kinds else ""
-        conn.request("GET", "/api/v1/watch" + q)
+        from tf_operator_tpu.utils.auth import bearer_headers
+
+        conn.request("GET", "/api/v1/watch" + q, headers=bearer_headers(self._token))
         # Grab the socket BEFORE getresponse(): a close-delimited response
         # detaches conn.sock, but the socket object stays valid for
         # settimeout/shutdown (the response reads through its own dup'd
         # file wrapper).
         sock = conn.sock
         resp = conn.getresponse()
+        if resp.status in (401, 403):
+            conn.close()
+            raise UnauthorizedError(
+                f"watch HTTP {resp.status}: missing/wrong bearer token "
+                "(server has auth enabled; provide TPUJOB_AUTH_TOKEN[_FILE] "
+                "or --auth-token-file)"
+            )
         if resp.status != 200:
             body = resp.read(200)
             conn.close()
@@ -152,19 +172,33 @@ class RemoteWatch:
 class RemoteStore:
     """Store-compatible client over HTTP (see module docstring)."""
 
-    def __init__(self, base_url: str, timeout: float = 10.0) -> None:
+    def __init__(self, base_url: str, timeout: float = 10.0,
+                 token: Optional[str] = None) -> None:
+        """``token``: bearer secret for an auth-enabled server. Defaults to
+        the ambient credential (``$TPUJOB_AUTH_TOKEN`` / token file via
+        utils.auth.resolve_token) so controller-launched children — e.g.
+        the evaluator's status write-back — inherit access without every
+        call site threading the secret."""
+        from tf_operator_tpu.utils.auth import resolve_token
+
         self.base = base_url.rstrip("/")
         self.timeout = timeout
+        self.token = token if token is not None else resolve_token()
 
     # -- plumbing ---------------------------------------------------------
 
     def _request(self, method: str, path: str, payload: Optional[dict] = None) -> dict:
+        from tf_operator_tpu.utils.auth import bearer_headers
+
         body = json.dumps(payload).encode() if payload is not None else None
+        headers = bearer_headers(self.token)
+        if body:
+            headers["Content-Type"] = "application/json"
         req = urllib.request.Request(
             self.base + path,
             data=body,
             method=method,
-            headers={"Content-Type": "application/json"} if body else {},
+            headers=headers,
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
@@ -182,6 +216,15 @@ class RemoteStore:
                 if detail.get("code") == "already_exists":
                     raise AlreadyExistsError(msg) from None
                 raise ConflictError(msg) from None
+            if exc.code in (401, 403):
+                # permanent, NOT transient: retry loops must not wait out a
+                # missing/rotated token forever looking "momentarily
+                # unreachable"
+                raise UnauthorizedError(
+                    f"{method} {path}: HTTP {exc.code}: missing/wrong bearer "
+                    "token (provide TPUJOB_AUTH_TOKEN[_FILE] or "
+                    "--auth-token-file)"
+                ) from None
             raise RemoteStoreError(f"{method} {path}: HTTP {exc.code}: {msg}") from None
         except OSError as exc:
             raise RemoteStoreError(f"{method} {path}: {exc}") from None
@@ -232,7 +275,9 @@ class RemoteStore:
         # Connect phase uses self.timeout; the established stream clears
         # its socket timeout (a watch is long-lived and silent between
         # events).
-        return RemoteWatch(self.base, kinds, connect_timeout=self.timeout)
+        return RemoteWatch(
+            self.base, kinds, connect_timeout=self.timeout, token=self.token
+        )
 
     def update_with_retry(self, kind: str, namespace: str, name: str, mutate: Any):
         """Same contract as Store.update_with_retry, over the wire —
