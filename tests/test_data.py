@@ -278,3 +278,77 @@ def test_token_memmap_dataset(tmp_path):
 
     with pytest.raises(ValueError, match="window"):
         TokenMemmapDataset(path, batch_size=1, seq_len=2000, process_shard=False)
+
+
+# ---------------------------------------------------------------------------
+# Augmentation (r3: the ResNet real-image recipe's host-side half)
+# ---------------------------------------------------------------------------
+
+from tf_operator_tpu.train.data import (  # noqa: E402
+    AugmentedImages,
+    augment_images,
+    prepare_classification_images,
+)
+
+
+def test_augment_images_shapes_and_content():
+    rng = np.random.default_rng(0)
+    imgs = np.arange(2 * 6 * 6 * 3, dtype=np.float32).reshape(2, 6, 6, 3)
+    out = augment_images(imgs, rng, pad=2, flip=True)
+    assert out.shape == imgs.shape and out.dtype == imgs.dtype
+    # every output pixel is either zero padding or a pixel of its own image
+    for i in range(2):
+        vals = set(out[i].ravel().tolist())
+        allowed = set(imgs[i].ravel().tolist()) | {0.0}
+        assert vals <= allowed
+
+
+def test_augment_images_identity_when_disabled():
+    rng = np.random.default_rng(0)
+    imgs = np.random.default_rng(1).standard_normal((3, 5, 5)).astype(np.float32)
+    np.testing.assert_array_equal(
+        augment_images(imgs, rng, pad=0, flip=False), imgs
+    )
+
+
+def test_augment_images_flip_only_mirrors_some():
+    rng = np.random.default_rng(0)
+    imgs = np.random.default_rng(1).standard_normal((64, 4, 4)).astype(np.float32)
+    out = augment_images(imgs, rng, pad=0, flip=True)
+    flipped = sum(
+        bool(np.array_equal(out[i], imgs[i, :, ::-1])) for i in range(64)
+    )
+    untouched = sum(bool(np.array_equal(out[i], imgs[i])) for i in range(64))
+    assert flipped + untouched == 64
+    assert 10 < flipped < 54  # ~Binomial(64, 1/2)
+
+
+def test_augmented_images_vary_across_epochs():
+    """The rng must NOT re-seed per epoch — identical crops every epoch
+    would defeat augmentation. Pinned on an UNSHUFFLED repeating dataset
+    so the underlying batches are identical between epochs and any
+    difference is the augmentation's randomness alone."""
+    arrays = {
+        "image": np.random.default_rng(1).random((8, 8, 8)).astype(np.float32),
+        "label": np.zeros((8,), np.int32),
+    }
+    base = ArrayDataset(arrays, 4, shuffle=False)
+    aug = AugmentedImages(base, pad=2, flip=False, seed=0)
+    it = iter(aug)
+    epoch_a = [next(it)["image"].copy() for _ in range(2)]
+    epoch_b = [next(it)["image"].copy() for _ in range(2)]
+    assert not all(np.array_equal(a, b) for a, b in zip(epoch_a, epoch_b))
+
+
+def test_prepare_classification_images():
+    gray = np.random.default_rng(0).random((5, 8, 8)).astype(np.float32)
+    out = prepare_classification_images(gray, 32)
+    assert out.shape == (5, 32, 32, 3)
+    # nearest-neighbor: each source pixel becomes a constant 4x4 block,
+    # identical across channels
+    np.testing.assert_array_equal(out[0, :4, :4, 0], np.full((4, 4), gray[0, 0, 0]))
+    np.testing.assert_array_equal(out[..., 0], out[..., 2])
+    rgb = np.random.default_rng(0).random((2, 16, 16, 3)).astype(np.float32)
+    assert prepare_classification_images(rgb, None).shape == (2, 16, 16, 3)
+    with pytest.raises(ValueError, match="integer multiple"):
+        prepare_classification_images(gray, 20)

@@ -308,3 +308,63 @@ def test_multi_step_matches_per_step_calls():
     assert m3["losses"].shape == (3,)
     with pytest.raises(ValueError, match="leading dim"):
         trainer.multi_step(s3, stacked, 5, stacked=True)
+
+
+def test_bn_ghost_stats_semantics():
+    """Ghost BN (r3, the barrier attack): step N normalizes with step
+    N-1's BATCH stats; state carries both the running average and the
+    one-step-stale batch stats. Step 1 must differ from exact BN (it
+    normalizes with the init identity stats), and step 2's normalization
+    must use exactly step 1's measured batch statistics."""
+    import numpy as np
+
+    from tf_operator_tpu.models.resnet import _batch_norm, _bn_params, _bn_state
+
+    x1 = jax.random.normal(jax.random.PRNGKey(0), (4, 3, 3, 8), jnp.float32) * 2 + 1
+    x2 = jax.random.normal(jax.random.PRNGKey(1), (4, 3, 3, 8), jnp.float32)
+    p = _bn_params(8)
+    s = _bn_state(8, ghost=True)
+
+    y1, s1 = _batch_norm(x1, p, s, train=True, ghost=True)
+    # step 1 normalized with the identity init (mean 0, var 1): y1 == x1
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(x1), rtol=1e-5, atol=1e-5)
+    # state now carries x1's batch stats
+    np.testing.assert_allclose(
+        np.asarray(s1["bmean"]), np.asarray(jnp.mean(x1, axis=(0, 1, 2))),
+        rtol=1e-5, atol=1e-5,
+    )
+    y2, s2 = _batch_norm(x2, p, s1, train=True, ghost=True)
+    want = (x2 - s1["bmean"]) / jnp.sqrt(s1["bvar"] + 1e-5)
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(want), rtol=1e-3, atol=1e-3)
+    # exact-BN reference for the SAME input differs (it self-normalizes)
+    y2_exact, _ = _batch_norm(x2, p, _bn_state(8), train=True)
+    assert not np.allclose(np.asarray(y2), np.asarray(y2_exact), atol=1e-3)
+
+
+def test_bn_ghost_stats_is_divergent_documented():
+    """The ghost-BN REJECTION RECEIPT (VERDICT r2 #1 lead (a)): stale-stats
+    normalization composed through depth is a divergent fixed-point
+    iteration EVEN AT FIXED PARAMS AND INPUT — layer k's pass-N stats
+    describe pass-N-1's (different) input distribution, the scale mismatch
+    multiplies through layers and residual adds, and iterates blow up
+    within ~3 passes. Pinned so the failure mode stays on record; the
+    config stays as a documented negative result (models/resnet.py)."""
+    import dataclasses
+
+    import numpy as np
+
+    from tf_operator_tpu.models.resnet import ResNetConfig, init_resnet, resnet_forward
+
+    cfg = dataclasses.replace(
+        ResNetConfig.tiny(10), bn_ghost_stats=True, dtype=jnp.float32
+    )
+    params, state = init_resnet(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 32, 32, 3))
+    mags = []
+    for _ in range(4):
+        logits, state = resnet_forward(params, state, x, cfg, train=True)
+        mags.append(float(jnp.abs(logits).max()))
+    assert np.isfinite(mags[0])
+    # the iteration is wildly unstable: iterates overshoot by orders of
+    # magnitude (then over-correct — an oscillating, non-contractive map)
+    assert max(mags) > 100 * mags[0], mags

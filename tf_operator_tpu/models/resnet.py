@@ -57,6 +57,20 @@ class ResNetConfig:
     # (BENCH_BN_STATS_GRAD=0|var); needs accuracy validation per recipe
     # before production use. Values: False (exact) | True | "var".
     bn_stats_stop_gradient: Any = False
+    # Ghost batch statistics: train-mode normalization uses the PREVIOUS
+    # step's batch stats (carried in state) while this step's stats are
+    # computed only to ship forward — the normalize affine becomes a step
+    # constant that fuses into the conv epilogue and the stats reduction
+    # leaves the critical path (the 10.8 ms barrier, BASELINE.md).
+    # DOCUMENTED NEGATIVE RESULT (r3): stale-stats normalization composed
+    # through depth is a divergent fixed-point iteration — even at FIXED
+    # params and input, layer k's stats describe the previous pass's
+    # (different) input distribution, the scale mismatch multiplies
+    # through layers/residuals, and activations blow up within ~3 steps
+    # (tests/test_models.py::test_bn_ghost_stats_is_divergent_documented;
+    # a variance floor does not save it). Kept for the receipt; do not
+    # enable for training.
+    bn_ghost_stats: bool = False
     # Run the bottleneck 1x1 convolutions (conv1/conv3/proj — ~83% of the
     # BN'd activations) through the Pallas fused matmul+stats kernel
     # (ops/fused_linear_stats): BN batch statistics accumulate in the
@@ -77,6 +91,15 @@ class ResNetConfig:
         # resnet50 is the bench target.
         return ResNetConfig((2, 2, 2, 2), (64, 128, 256, 512), num_classes)
 
+    @staticmethod
+    def tiny(num_classes: int = 10) -> "ResNetConfig":
+        """Test-scale variant (~width/4, one block per stage): the same
+        stem/BN/residual machinery at ~1/30 the FLOPs, so CPU-mesh e2e
+        tests can train the REAL-image pipeline to an accuracy gate in
+        minutes (the digits fixtures), the way `tiny` serves the
+        transformer family."""
+        return ResNetConfig((1, 1, 1, 1), (16, 32, 64, 128), num_classes)
+
     def flops_per_image(self, image_size: int = 224) -> float:
         """Approximate forward FLOPs per image (2*MACs). ResNet-50@224 ≈ 8.2e9."""
         # computed empirically below via jax cost analysis when available;
@@ -95,17 +118,24 @@ def _bn_params(c):
     return {"scale": jnp.ones((c,), jnp.float32), "bias": jnp.zeros((c,), jnp.float32)}
 
 
-def _bn_state(c):
-    return {"mean": jnp.zeros((c,), jnp.float32), "var": jnp.ones((c,), jnp.float32)}
+def _bn_state(c, ghost: bool = False):
+    s = {"mean": jnp.zeros((c,), jnp.float32), "var": jnp.ones((c,), jnp.float32)}
+    if ghost:
+        # last BATCH's stats (not the running average) — what ghost-stats
+        # normalization reads next step; init = identity-ish normalize.
+        s["bmean"] = jnp.zeros((c,), jnp.float32)
+        s["bvar"] = jnp.ones((c,), jnp.float32)
+    return s
 
 
 def init_resnet(key, cfg: ResNetConfig) -> Tuple[Dict, Dict]:
     """Returns (params, state) — state carries BN running statistics."""
     keys = iter(jax.random.split(key, 256))
+    ghost = cfg.bn_ghost_stats
     params: Dict[str, Any] = {
         "stem": {"conv": _conv_init(next(keys), 7, 7, 3, 64), "bn": _bn_params(64)}
     }
-    state: Dict[str, Any] = {"stem": _bn_state(64)}
+    state: Dict[str, Any] = {"stem": _bn_state(64, ghost)}
     cin = 64
     for si, (n_blocks, width) in enumerate(zip(cfg.stage_sizes, cfg.widths)):
         stage_p: List[Dict] = []
@@ -121,11 +151,15 @@ def init_resnet(key, cfg: ResNetConfig) -> Tuple[Dict, Dict]:
                 "conv3": _conv_init(next(keys), 1, 1, width, cout),
                 "bn3": _bn_params(cout),
             }
-            bs = {"bn1": _bn_state(width), "bn2": _bn_state(width), "bn3": _bn_state(cout)}
+            bs = {
+                "bn1": _bn_state(width, ghost),
+                "bn2": _bn_state(width, ghost),
+                "bn3": _bn_state(cout, ghost),
+            }
             if stride != 1 or cin != cout:
                 bp["proj"] = _conv_init(next(keys), 1, 1, cin, cout)
                 bp["proj_bn"] = _bn_params(cout)
-                bs["proj_bn"] = _bn_state(cout)
+                bs["proj_bn"] = _bn_state(cout, ghost)
             stage_p.append(bp)
             stage_s.append(bs)
             cin = cout
@@ -145,7 +179,7 @@ def resnet_logical_axes(params) -> Dict:
 
 
 def _batch_norm(x, p, s, train: bool, in_act_dtype: bool = True, fused_stats: bool = True,
-                stats_stop_gradient: bool = False):
+                stats_stop_gradient: bool = False, ghost: bool = False):
     """x: [b,h,w,c] activations (any float dtype). Stats in f32.
     Returns (y, new_state).
 
@@ -157,7 +191,38 @@ def _batch_norm(x, p, s, train: bool, in_act_dtype: bool = True, fused_stats: bo
     E[x] and E[x²] computed in one fused read of x (f32 accumulation);
     autodiff of this form also yields the minimal backward (sum(dy),
     sum(dy·x) reductions + one elementwise pass) — the structure a
-    hand-written BN VJP would produce."""
+    hand-written BN VJP would produce.
+
+    With ``ghost`` (cfg.bn_ghost_stats) train-mode NORMALIZES with the
+    PREVIOUS batch's statistics (s["bmean"]/s["bvar"], carried state) while
+    computing this batch's stats only to ship forward. That breaks the
+    reduce→normalize serialization on the conv output — the affine's
+    (a, b) are step constants, so XLA can fuse the normalize into the conv
+    epilogue, and the stats reduction becomes an independent consumer off
+    the critical path (the 10.8 ms v5e barrier, BASELINE.md). Semantics:
+    one-step-stale statistics, no gradient through them (they're state) —
+    accuracy must be validated per recipe (the real-data e2e path)."""
+    if train and ghost:
+        if fused_stats:
+            bmean = jnp.mean(x, axis=(0, 1, 2), dtype=jnp.float32)
+            m2 = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=(0, 1, 2))
+            bvar = jnp.maximum(m2 - jnp.square(bmean), 0.0)
+        else:
+            xf = x.astype(jnp.float32)
+            bmean = jnp.mean(xf, axis=(0, 1, 2))
+            bvar = jnp.var(xf, axis=(0, 1, 2))
+        new_s = {
+            "mean": BN_MOMENTUM * s["mean"] + (1 - BN_MOMENTUM) * bmean,
+            "var": BN_MOMENTUM * s["var"] + (1 - BN_MOMENTUM) * bvar,
+            "bmean": bmean,
+            "bvar": bvar,
+        }
+        mean, var = s["bmean"], s["bvar"]  # previous step's batch stats
+        a = jax.lax.rsqrt(var + BN_EPS) * p["scale"]
+        b = p["bias"] - mean * a
+        if in_act_dtype:
+            return x * a.astype(x.dtype) + b.astype(x.dtype), new_s
+        return (x.astype(jnp.float32) * a + b).astype(x.dtype), new_s
     if train:
         if fused_stats:
             mean = jnp.mean(x, axis=(0, 1, 2), dtype=jnp.float32)
@@ -228,18 +293,18 @@ def _stem_s2d(x, w7):
     )
 
 
-def _bottleneck(x, bp, bs, stride, train, bn_act, bn_fused, bn_sg=False):
-    y, s1 = _batch_norm(_conv(x, bp["conv1"]), bp["bn1"], bs["bn1"], train, bn_act, bn_fused, bn_sg)
+def _bottleneck(x, bp, bs, stride, train, bn_act, bn_fused, bn_sg=False, bn_ghost=False):
+    y, s1 = _batch_norm(_conv(x, bp["conv1"]), bp["bn1"], bs["bn1"], train, bn_act, bn_fused, bn_sg, bn_ghost)
     y = jax.nn.relu(y)
     y, s2 = _batch_norm(
-        _conv(y, bp["conv2"], stride), bp["bn2"], bs["bn2"], train, bn_act, bn_fused, bn_sg
+        _conv(y, bp["conv2"], stride), bp["bn2"], bs["bn2"], train, bn_act, bn_fused, bn_sg, bn_ghost
     )
     y = jax.nn.relu(y)
-    y, s3 = _batch_norm(_conv(y, bp["conv3"]), bp["bn3"], bs["bn3"], train, bn_act, bn_fused, bn_sg)
+    y, s3 = _batch_norm(_conv(y, bp["conv3"]), bp["bn3"], bs["bn3"], train, bn_act, bn_fused, bn_sg, bn_ghost)
     new_bs = {"bn1": s1, "bn2": s2, "bn3": s3}
     if "proj" in bp:
         shortcut, sp = _batch_norm(
-            _conv(x, bp["proj"], stride), bp["proj_bn"], bs["proj_bn"], train, bn_act, bn_fused, bn_sg
+            _conv(x, bp["proj"], stride), bp["proj_bn"], bs["proj_bn"], train, bn_act, bn_fused, bn_sg, bn_ghost
         )
         new_bs["proj_bn"] = sp
     else:
@@ -353,8 +418,12 @@ def resnet_forward(params, state, images, cfg: ResNetConfig, train: bool = True)
     else:
         x = _conv(x, params["stem"]["conv"], stride=2)
     bn_sg = cfg.bn_stats_stop_gradient
+    bn_ghost = cfg.bn_ghost_stats
+    if bn_ghost and cfg.fused_1x1:
+        raise ValueError("bn_ghost_stats does not compose with fused_1x1")
     x, stem_s = _batch_norm(
-        x, params["stem"]["bn"], state["stem"], train, bn_act, bn_fused, bn_sg
+        x, params["stem"]["bn"], state["stem"], train, bn_act, bn_fused, bn_sg,
+        bn_ghost,
     )
     x = jax.nn.relu(x)
     x = jax.lax.reduce_window(
@@ -374,7 +443,7 @@ def resnet_forward(params, state, images, cfg: ResNetConfig, train: bool = True)
             else:
                 x, bs = _bottleneck(
                     x, params[f"stage{si}"][bi], state[f"stage{si}"][bi], stride,
-                    train, bn_act, bn_fused, bn_sg,
+                    train, bn_act, bn_fused, bn_sg, bn_ghost,
                 )
             stage_s.append(bs)
         new_state[f"stage{si}"] = stage_s

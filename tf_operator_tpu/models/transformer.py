@@ -77,11 +77,15 @@ class TransformerConfig:
     # Pipeline parallelism (parallel.pipeline): with a pp axis in the mesh
     # and pp_microbatches > 0, the layer stack is stage-partitioned into
     # mesh.shape["pp"] groups of n_layers/pp contiguous layers and run as a
-    # GPipe fill-drain schedule (activations ppermute stage-to-stage);
+    # fill-drain pipeline (activations ppermute stage-to-stage);
     # embed/norm/head stay replicated. Composes with dp (each dp group
-    # pipelines its own batch slice). 0 = no pipeline.
+    # pipelines its own batch slice) and, r3, with tp (stage weights shard
+    # over the tp axis; _layer psums its row-parallel matmuls). 0 = no
+    # pipeline. pp_schedule: "1f1b" (explicit backward, stage-input-only
+    # residuals — the memory-disciplined default) | "gpipe" (autodiff).
     pp_microbatches: int = 0
     pp_axis: str = "pp"
+    pp_schedule: str = "1f1b"
 
     def __post_init__(self):
         if self.n_experts and not (1 <= self.moe_top_k <= self.n_experts):
@@ -273,9 +277,17 @@ def _rope(x, theta: float):
 
 
 def _attention(q, k, v, cfg: TransformerConfig, mesh):
-    """q: [b,t,nh,hd]; k/v: [b,t,nkv,hd]."""
+    """q: [b,t,nh,hd]; k/v: [b,t,nkv,hd].
+
+    GQA (nkv < nh) runs NATIVE on the dense and flash paths: no
+    [b,t,nh,hd] K/V tensor ever exists — the flash kernel indexes k/v
+    head hi//group per query head and the dense path groups the einsum
+    (ops/flash_attention.py), keeping K/V activation HBM traffic at the
+    nkv rate that is GQA's whole point at t>=4096. The cp paths (ring/
+    ulysses) still materialize repeated heads — their all-to-all/ppermute
+    layouts assume equal head counts; lifting that is future surface."""
     groups = cfg.n_heads // cfg.n_kv_heads
-    if groups > 1:  # GQA: repeat kv heads
+    if groups > 1 and cfg.attn_impl in ("ring", "ulysses"):
         k = jnp.repeat(k, groups, axis=2)
         v = jnp.repeat(v, groups, axis=2)
     if cfg.attn_impl == "ring" and mesh is not None and cfg.cp_axis in mesh.axis_names:
@@ -313,6 +325,16 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
 
             batch = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
             heads = "tp" if "tp" in mesh.axis_names else None
+            tp = mesh.shape["tp"] if heads else 1
+            if k.shape[2] % tp:
+                # kv heads don't divide tp (tiny test configs): materialize
+                # the repeat so head sharding stays legal. When nkv % tp
+                # == 0 (llama2-70b: 8 kv / tp=8) GQA stays native: the
+                # per-shard contiguous head blocks keep hi//g mapping to
+                # the right local kv head (g_local == g).
+                grp = q.shape[2] // k.shape[2]
+                k = jnp.repeat(k, grp, axis=2)
+                v = jnp.repeat(v, grp, axis=2)
             spec = P(batch, None, heads, None)
             fn = shard_map(
                 lambda q, k, v: flash_attention(q, k, v, causal=cfg.causal),
@@ -323,36 +345,74 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
             )
             return fn(q, k, v)
         return flash_attention(q, k, v, causal=cfg.causal)
-    # dense path; logits accumulated in f32 ON the MXU (bf16 inputs with a
-    # pre-rounded bf16 result would lose resolution between near-tied logits)
-    scale = cfg.head_dim**-0.5
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
-    if cfg.causal:
-        t = q.shape[1]
-        mask = jnp.tril(jnp.ones((t, t), bool))
-        s = jnp.where(mask[None, None], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    # dense path: the GQA-native grouped einsum with f32 MXU accumulation
+    # (ops/flash_attention.reference_attention — also the flash oracle, so
+    # dense and flash configs are pinned to the same math by its tests)
+    from tf_operator_tpu.ops.flash_attention import reference_attention
+
+    return reference_attention(q, k, v, causal=cfg.causal)
 
 
-def _layer(x, layer_params, cfg: TransformerConfig, mesh):
+def _layer(x, layer_params, cfg: TransformerConfig, mesh, tp_axis=None,
+           tp_manual_vjp=True):
+    """One decoder layer. ``tp_axis`` (pipeline tp-within-stage, r3):
+    weights arrive as tp-LOCAL shards (wq/wk/wv/w_gate/w_up
+    column-parallel, wo/w_down row-parallel — the Megatron split).
+
+    The tp collective convention depends on WHO differentiates
+    (``tp_manual_vjp``): under direct jax.vjp inside the 1F1B backward,
+    plain psum is silently wrong (its transpose-is-psum convention
+    inflates every cotangent behind it by tp, compounding per layer), so
+    activations route through the Megatron f/g conjugate pair
+    (collectives.tp_region_enter/exit). Under shard_map AUTODIFF (the
+    GPipe schedule), the framework hands each tp shard gy/tp for a
+    replicated output — there raw psum's transpose restores exactly the
+    full cotangent and the f/g pair would HALVE row-parallel weight
+    grads. Both pinned by test_pipeline_tp_grads_match_single_device.
+    Head counts derive from the local weight shapes, so the same body
+    serves both layouts."""
+    if tp_axis is not None:
+        from tf_operator_tpu.parallel.collectives import (
+            tp_region_enter,
+            tp_region_exit,
+        )
+
+        if tp_manual_vjp:
+            enter = lambda a: tp_region_enter(a, tp_axis)  # noqa: E731
+            leave = lambda a: tp_region_exit(a, tp_axis)  # noqa: E731
+        else:
+            enter = lambda a: a  # noqa: E731
+            leave = lambda a: jax.lax.psum(a, tp_axis)  # noqa: E731
     b, t, d = x.shape
+    hd = cfg.head_dim
+    wq = layer_params["wq"].astype(x.dtype)
+    wk = layer_params["wk"].astype(x.dtype)
+    wv = layer_params["wv"].astype(x.dtype)
     h = _rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
-    q = (h @ layer_params["wq"].astype(x.dtype)).reshape(b, t, cfg.n_heads, cfg.head_dim)
-    k = (h @ layer_params["wk"].astype(x.dtype)).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
-    v = (h @ layer_params["wv"].astype(x.dtype)).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+    if tp_axis is not None:
+        h = enter(h)
+    q = (h @ wq).reshape(b, t, wq.shape[-1] // hd, hd)
+    k = (h @ wk).reshape(b, t, wk.shape[-1] // hd, hd)
+    v = (h @ wv).reshape(b, t, wv.shape[-1] // hd, hd)
     q, k = _rope(q, cfg.rope_theta), _rope(k, cfg.rope_theta)
-    attn = _attention(q, k, v, cfg, mesh).reshape(b, t, cfg.n_heads * cfg.head_dim)
-    x = x + attn @ layer_params["wo"].astype(x.dtype)
+    attn = _attention(q, k, v, cfg, mesh).reshape(b, t, wq.shape[-1])
+    proj = attn @ layer_params["wo"].astype(x.dtype)
+    if tp_axis is not None:
+        proj = leave(proj)
+    x = x + proj
 
     h = _rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps)
     if cfg.n_experts:
         moe_out, aux = _moe_mlp(h, layer_params, cfg, mesh)
         return x + moe_out, aux
+    if tp_axis is not None:
+        h = enter(h)
     gate = jax.nn.silu(h @ layer_params["w_gate"].astype(x.dtype))
     up = h @ layer_params["w_up"].astype(x.dtype)
-    x = x + (gate * up) @ layer_params["w_down"].astype(x.dtype)
-    return x, None
+    down = (gate * up) @ layer_params["w_down"].astype(x.dtype)
+    if tp_axis is not None:
+        down = leave(down)
+    return x + down, None
 
 
 def _moe_mlp(h, layer_params, cfg: TransformerConfig, mesh):
@@ -435,17 +495,37 @@ def _use_pipeline(cfg: TransformerConfig, mesh) -> bool:
     )
 
 
+def _pp_param_specs(cfg: TransformerConfig, tp_axis: Optional[str]):
+    """PartitionSpecs for the stage-major [S, per_stage, ...] layer params:
+    stage dim over pp; with tp, the Megatron split — wq/wk/wv/w_gate/w_up
+    column-parallel (last dim over tp), wo/w_down row-parallel (first
+    weight dim over tp), norms replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    pp = cfg.pp_axis
+    col = P(pp, None, None, tp_axis)
+    row = P(pp, None, tp_axis, None)
+    return {
+        "attn_norm": P(pp, None, None),
+        "wq": col, "wk": col, "wv": col, "wo": row,
+        "mlp_norm": P(pp, None, None),
+        "w_gate": col, "w_up": col, "w_down": row,
+    }
+
+
 def transformer_hidden_pp(params, tokens, cfg: TransformerConfig, mesh):
     """Pipeline-parallel layer stack: n_layers/pp contiguous layers per
-    stage through parallel.pipeline.pipeline_apply (GPipe fill-drain,
+    stage through parallel.pipeline.pipeline_apply (fill-drain pipeline —
+    "1f1b" explicit-backward schedule by default, cfg.pp_schedule —
     activations over ppermute). The per-stage body is itself a lax.scan
     over the stage's layers — the same stacked-params execution the
     single-device path uses, so the oracle comparison is exact math.
 
-    Attention/MLP within a stage run stage-local (mesh=None to _layer):
-    pp composes with dp here; tp-within-stage would need the mesh visible
-    inside shard_map and is future surface. MoE + pipeline is rejected
-    loudly rather than silently mis-sharded."""
+    Composes with dp (each dp group pipelines its batch slice) and, r3,
+    with tp-WITHIN-STAGE: with a tp axis in the mesh, stage weights shard
+    Megatron-style (_pp_param_specs) and _layer psums its row-parallel
+    matmuls over tp. MoE + pipeline is rejected loudly rather than
+    silently mis-sharded."""
     from tf_operator_tpu.parallel.pipeline import pipeline_apply
 
     if cfg.n_experts:
@@ -458,8 +538,20 @@ def transformer_hidden_pp(params, tokens, cfg: TransformerConfig, mesh):
         raise ValueError(
             f"n_layers={cfg.n_layers} not divisible by pp={n_stages}"
         )
+    tp_axis = None
+    if "tp" in mesh.axis_names and mesh.shape["tp"] > 1:
+        tp = mesh.shape["tp"]
+        for nm, val in (("n_heads", cfg.n_heads), ("n_kv_heads", cfg.n_kv_heads),
+                        ("d_ff", cfg.d_ff)):
+            if val % tp:
+                raise ValueError(f"{nm}={val} not divisible by tp={tp}")
+        tp_axis = "tp"
     x = params["embed"].astype(cfg.dtype)[tokens]
-    layer_fn = _remat_wrap(partial(_layer, cfg=cfg, mesh=None), cfg)
+    layer_fn = _remat_wrap(
+        partial(_layer, cfg=cfg, mesh=None, tp_axis=tp_axis,
+                tp_manual_vjp=(cfg.pp_schedule == "1f1b")),
+        cfg,
+    )
 
     def stage_fn(stage_layers, xb):
         def body(h, lp):
@@ -475,7 +567,9 @@ def transformer_hidden_pp(params, tokens, cfg: TransformerConfig, mesh):
         params["layers"],
     )
     h = pipeline_apply(
-        stage_params, x, stage_fn, mesh, cfg.pp_microbatches, cfg.pp_axis
+        stage_params, x, stage_fn, mesh, cfg.pp_microbatches, cfg.pp_axis,
+        schedule=cfg.pp_schedule,
+        param_specs=_pp_param_specs(cfg, tp_axis) if tp_axis else None,
     )
     return _rms_norm(h, params["final_norm"], cfg.norm_eps)
 

@@ -65,15 +65,31 @@ def _use_kernel(t: int, d: int, block_q: int, block_k: int, interpret: bool) -> 
 
 def reference_attention(q, k, v, causal: bool = False):
     """Dense attention, f32 softmax — the correctness oracle and the
-    off-TPU fallback (same contract as the kernel path)."""
-    d = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
-    s = s * (d**-0.5)
+    off-TPU fallback (same contract as the kernel path). GQA-native: k/v
+    may carry fewer heads than q (h % h_kv == 0); the grouped einsum
+    keeps the group dim in the contraction instead of materializing
+    repeated K/V heads."""
+    b, tq, hq, d = q.shape
+    h_kv = k.shape[2]
+    scale = d**-0.5
+    if hq != h_kv:
+        g = hq // h_kv
+        q5 = q.reshape(b, tq, h_kv, g, d)
+        s = jnp.einsum(
+            "bqhgd,bkhd->bhgqk", q5, k, preferred_element_type=jnp.float32
+        ) * scale
+    else:
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) * scale
     if causal:
-        tq, tk = q.shape[1], k.shape[1]
+        tk = k.shape[1]
         mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
-        s = jnp.where(mask[None, None], s, NEG_INF)
+        s = jnp.where(mask.reshape((1,) * (s.ndim - 2) + mask.shape), s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    if hq != h_kv:
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", p, v)
+        return out.reshape(b, tq, hq, d)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
@@ -139,6 +155,8 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
     from jax.experimental.pallas import tpu as pltpu
 
     b, t, h, d = q.shape
+    h_kv = k.shape[2]
+    g = h // h_kv  # GQA group: g query heads read each k/v head's block
     scale = d**-0.5
     # [b, t, h, d] -> [b, h, t, d]: sequence in the sublane dim, head_dim in
     # lanes — the MXU-native layout for the q·kᵀ and p·v contractions.
@@ -155,9 +173,13 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, kb: (bi, hi, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, kb: (bi, hi, kb, 0),
+            # GQA: query head hi reads k/v head hi//g — the [b,t,h_kv,d]
+            # tensors are never repeated to h query heads anywhere
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, kb: (bi, hi // g, kb, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, kb: (bi, hi, kb, 0),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, kb: (bi, hi // g, kb, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
@@ -227,14 +249,21 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                    dk_scr, dv_scr, *, causal, block_q, block_k, scale):
+                    dk_scr, dv_scr, *, causal, block_q, block_k, scale, nqb):
+    """dk/dv for one k/v head. GQA: grid dim 1 iterates K/V heads and the
+    innermost dim fuses (group member, q block) as j = gi*nqb + qb, so the
+    [block_k, d] scratch accumulates every query head of the group before
+    the single output write — the output block (bi, kv_head, ki) is
+    revisited only on consecutive grid steps, which is what makes carried
+    scratch and one final write sound on TPU."""
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(2)
-    qb = pl.program_id(3)
-    nqb = pl.num_programs(3)
+    j = pl.program_id(3)
+    qb = j % nqb
+    nj = pl.num_programs(3)
 
-    @pl.when(qb == 0)
+    @pl.when(j == 0)
     def _init():
         dk_scr[:, :] = jnp.zeros_like(dk_scr)
         dv_scr[:, :] = jnp.zeros_like(dv_scr)
@@ -267,7 +296,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bk, d]
 
-    @pl.when(qb == nqb - 1)
+    @pl.when(j == nj - 1)
     def _finish():
         dk_ref[0, 0, :, :] = dk_scr[:, :].astype(dk_ref.dtype)  # q pre-scaled
         dv_ref[0, 0, :, :] = dv_scr[:, :].astype(dv_ref.dtype)
@@ -279,6 +308,9 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g):
 
     qt, kt, vt, o, lse = residuals
     b, h, t, d = qt.shape
+    h_kv = kt.shape[1]
+    grp = h // h_kv  # GQA group size (1 = classic MHA)
+    nqb = t // block_q
     scale = d**-0.5
     do = g.transpose(0, 2, 1, 3)
     # delta_i = rowsum(do_i * o_i) — the softmax-jacobian correction term —
@@ -286,26 +318,19 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g):
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (b, h, t, LSE_LANES))
 
-    def qspec(idx):  # block over the q/sequence dim, selected by grid dim idx
+    # ---- dq: grid (b, h, nq, nk); k/v heads indexed hi // grp -----------
+    def q_by_qi(shape_last):
         return pl.BlockSpec(
-            (1, 1, block_q, d),
-            lambda bi, hi, i, j, idx=idx: (bi, hi, (i, j)[idx], 0),
+            (1, 1, block_q, shape_last),
+            lambda bi, hi, qi, kb: (bi, hi, qi, 0),
             memory_space=pltpu.VMEM,
         )
 
-    def lspec(idx):  # lse/delta blocks, same sequence indexing
-        return pl.BlockSpec(
-            (1, 1, block_q, LSE_LANES),
-            lambda bi, hi, i, j, idx=idx: (bi, hi, (i, j)[idx], 0),
-            memory_space=pltpu.VMEM,
-        )
-
-    def kspec(idx):
-        return pl.BlockSpec(
-            (1, 1, block_k, d),
-            lambda bi, hi, i, j, idx=idx: (bi, hi, (i, j)[idx], 0),
-            memory_space=pltpu.VMEM,
-        )
+    kv_by_kb = pl.BlockSpec(
+        (1, 1, block_k, d),
+        lambda bi, hi, qi, kb: (bi, hi // grp, kb, 0),
+        memory_space=pltpu.VMEM,
+    )
 
     dq_kernel = functools.partial(
         _bwd_dq_kernel, causal=causal, block_q=block_q, block_k=block_k, scale=scale
@@ -313,24 +338,46 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g):
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b, h, t // block_q, t // block_k),
-        in_specs=[qspec(0), kspec(1), kspec(1), qspec(0), lspec(0), lspec(0)],
-        out_specs=qspec(0),
+        in_specs=[q_by_qi(d), kv_by_kb, kv_by_kb, q_by_qi(d),
+                  q_by_qi(LSE_LANES), q_by_qi(LSE_LANES)],
+        out_specs=q_by_qi(d),
         out_shape=jax.ShapeDtypeStruct((b, h, t, d), qt.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(qt, kt, vt, do, lse, delta)
 
+    # ---- dk/dv: grid (b, h_kv, nk, grp*nqb) -----------------------------
+    # Grid dim 1 iterates K/V heads; the innermost dim fuses (group
+    # member gi, q block qb) as j = gi*nqb + qb so all grp query heads
+    # accumulate into one [block_k, d] scratch before the single output
+    # write (see _bwd_dkv_kernel). Query-side tensors select head
+    # hk*grp + j//nqb and sequence block j%nqb.
+    def q_by_group(shape_last):
+        return pl.BlockSpec(
+            (1, 1, block_q, shape_last),
+            lambda bi, hk, ki, j: (bi, hk * grp + j // nqb, j % nqb, 0),
+            memory_space=pltpu.VMEM,
+        )
+
+    kv_by_ki = pl.BlockSpec(
+        (1, 1, block_k, d),
+        lambda bi, hk, ki, j: (bi, hk, ki, 0),
+        memory_space=pltpu.VMEM,
+    )
+
     dkv_kernel = functools.partial(
-        _bwd_dkv_kernel, causal=causal, block_q=block_q, block_k=block_k, scale=scale
+        _bwd_dkv_kernel, causal=causal, block_q=block_q, block_k=block_k,
+        scale=scale, nqb=nqb,
     )
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(b, h, t // block_k, t // block_q),
-        in_specs=[qspec(1), kspec(0), kspec(0), qspec(1), lspec(1), lspec(1)],
-        out_specs=[kspec(0), kspec(0)],
+        grid=(b, h_kv, t // block_k, grp * nqb),
+        in_specs=[q_by_group(d), kv_by_ki, kv_by_ki, q_by_group(d),
+                  q_by_group(LSE_LANES), q_by_group(LSE_LANES)],
+        out_specs=[kv_by_ki, kv_by_ki],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, t, d), kt.dtype),
-            jax.ShapeDtypeStruct((b, h, t, d), vt.dtype),
+            jax.ShapeDtypeStruct((b, h_kv, t, d), kt.dtype),
+            jax.ShapeDtypeStruct((b, h_kv, t, d), vt.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -390,6 +437,15 @@ def flash_attention(
 ):
     """Self-attention over [b, t, h, d] with softmax(q·kᵀ/√d)·v semantics.
 
+    GQA-native (r3): k/v may carry h_kv < h heads (h % h_kv == 0, the
+    llama2-70b 64q/8kv shape). Neither path materializes repeated K/V —
+    the kernel's k/v BlockSpecs index head hi//g (each K/V block loads
+    once per group from HBM and serves g query heads from VMEM), the
+    dk/dv grid accumulates the group into one scratch, and the dense
+    fallback contracts through a grouped einsum. That preserves exactly
+    the activation-bandwidth/HBM advantage GQA exists to buy at long
+    context.
+
     Dispatches to the Pallas kernel on TPU when shapes tile cleanly
     (t divisible by both block sizes, blocks 8-aligned, d a lane-friendly
     multiple — see _use_kernel); otherwise the jnp reference (identical
@@ -400,6 +456,12 @@ def flash_attention(
     (tiling constraints still apply) — the measurement hook behind the
     tools/roofline --mode attn crossover table."""
     t, d = q.shape[1], q.shape[3]
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"q heads {q.shape[2]} not a multiple of kv heads {k.shape[2]}"
+        )
+    if k.shape[2] != v.shape[2]:
+        raise ValueError(f"k/v head mismatch: {k.shape[2]} vs {v.shape[2]}")
     block_q = _pick_block(t, block_q or 512)
     block_k = _pick_block(t, block_k or 1024)
     use = _use_kernel(t, d, block_q, block_k, bool(interpret))

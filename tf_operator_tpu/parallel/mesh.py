@@ -137,7 +137,14 @@ def build_hybrid_mesh(
         )
 
     slice_ids = {getattr(d, "slice_index", None) for d in devs}
-    has_slice_info = None not in slice_ids
+    # Slice topology is only meaningful on TPU: the CPU backend stamps
+    # every device slice_index=0 across all processes, which would reject
+    # any multi-process dcn mesh. On CPU the contiguous-block fallback
+    # applies, and the global device list orders by process — so process
+    # boundaries become the DCN stand-in (the gang e2e contract).
+    has_slice_info = (
+        None not in slice_ids and getattr(devs[0], "platform", "") == "tpu"
+    )
     if has_slice_info and (len(slice_ids) > 1 or n_slices > 1):
         if len(slice_ids) != n_slices:
             # Never fall back silently: a contiguous-block layout here

@@ -1,11 +1,29 @@
-"""Pipeline parallelism: GPipe-style microbatch schedule over the ``pp`` axis.
+"""Pipeline parallelism: microbatch schedules over the ``pp`` axis.
 
 Each pipeline stage lives on one slice of the ``pp`` mesh axis and holds its
 own layer parameters; activations flow stage-to-stage with ``ppermute`` over
-neighbor ICI links. The schedule is the classic GPipe fill-drain loop:
-with S stages and M microbatches, T = M + S - 1 ticks; at tick t, stage s
-computes microbatch (t - s) when 0 <= t - s < M. Bubble fraction
-(S-1)/(M+S-1) shrinks as M grows.
+neighbor ICI links. Two schedules:
+
+- ``"gpipe"``: the classic fill-drain loop; the backward pass is whatever
+  JAX autodiff derives from the forward scan. With S stages and M
+  microbatches, T = M + S - 1 ticks per phase; bubble (S-1)/(M+S-1).
+  Autodiff saves per-TICK residuals — T slots, garbage fill/drain ticks
+  included.
+- ``"1f1b"`` (r3): an explicit custom-VJP schedule with the 1F1B memory
+  discipline — the forward saves ONLY each stage's M microbatch inputs,
+  and the backward is a hand-scheduled reverse pipeline that recomputes
+  each stage-microbatch forward via jax.vjp at its saved input (the
+  standard 1F1B recompute recipe). Per-stage activation memory drops from
+  M+S-1 tick-saves to M input-saves, and the backward never replays the
+  fill/drain garbage ticks' residuals. Because JAX's grad boundary sits
+  at the loss (all output cotangents arrive at once), the fwd and bwd
+  phases cannot physically interleave — the schedule realizes 1F1B's
+  memory/recompute structure, with the same 2(M+S-1)-tick timeline as
+  GPipe at equal M. The practical bubble win is therefore what 1F1B's
+  always was: at a FIXED activation budget the schedule affords a larger
+  M — e.g. at pp=4 with an 8-slot budget, GPipe fits M=5 (bubble
+  (S-1)/(M+S-1) = 37.5%) while 1F1B fits M=8 (27%); see
+  ``bubble_fraction``.
 
 The reference has no pipeline support at all (SURVEY.md §2.3); this is new
 TPU-native surface.
@@ -65,36 +83,130 @@ def _pipeline_local(stage_params, x_micro, fn: Callable, axis_name: str):
     return y
 
 
-def pipeline_apply(
-    stage_params,
-    x,
-    fn: Callable,
-    mesh,
-    n_microbatches: int,
-    axis_name: str = "pp",
-    batch_axes: tuple = ("dp", "fsdp"),
-):
-    """Run ``fn(stage_params, x_mb)`` as a pipeline over ``axis_name``.
+def bubble_fraction(n_stages: int, n_micro: int) -> float:
+    """Idle fraction of the fill-drain timeline: (S-1)/(M+S-1). Both
+    schedules share it at equal M; 1F1B's lever is affording a larger M at
+    fixed activation memory (module docstring)."""
+    return (n_stages - 1) / (n_micro + n_stages - 1)
 
-    stage_params: pytree whose leaves have leading dim == pp size (one slice
-    per stage). x: [batch, ...] input. fn must map a microbatch through ONE
-    stage, preserving shape (classic equal-width pipeline). Returns
-    [batch, ...] outputs.
 
-    Composes with data parallelism: the microbatch dim shards over any
-    ``batch_axes`` present in the mesh (each dp group runs its own
-    pipeline over its batch slice — activations ppermute within the group,
-    nothing crosses dp), while stage params shard over ``axis_name`` and
-    replicate over the data axes.
-    """
-    from jax import shard_map
+def _fwd_save_ticks(stage_params, x_micro, fn: Callable, axis_name: str):
+    """_pipeline_local plus residual capture: returns (y, x_saved) where
+    x_saved[m] is THIS stage's input for microbatch m — the only
+    activation the 1F1B backward needs (it recomputes the rest)."""
+    n_stages = axis_size(axis_name)
+    stage = axis_index(axis_name)
+    n_micro = x_micro.shape[0]
+    mb_shape = x_micro.shape[1:]
+    total_ticks = n_micro + n_stages - 1
 
+    def tick(carry, t):
+        prev_out, y_acc, x_saved = carry
+        recv = ring_shift(prev_out, axis_name)
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        first_in = jax.lax.dynamic_index_in_dim(x_micro, mb_idx, keepdims=False)
+        x_in = jnp.where(stage == 0, first_in, recv)
+        # stage s processes microbatch t-s at tick t
+        m = t - stage
+        valid = (m >= 0) & (m < n_micro)
+        slot = jnp.clip(m, 0, n_micro - 1)
+        prev_save = jax.lax.dynamic_index_in_dim(x_saved, slot, keepdims=False)
+        x_saved = jax.lax.dynamic_update_index_in_dim(
+            x_saved, jnp.where(valid, x_in, prev_save), slot, 0
+        )
+        out = fn(stage_params, x_in)
+        out_idx = t - (n_stages - 1)
+        ovalid = (stage == n_stages - 1) & (out_idx >= 0) & (out_idx < n_micro)
+        write_idx = jnp.clip(out_idx, 0, n_micro - 1)
+        prev_slot = jax.lax.dynamic_index_in_dim(y_acc, write_idx, keepdims=False)
+        y_acc = jax.lax.dynamic_update_index_in_dim(
+            y_acc, jnp.where(ovalid, out, prev_slot), write_idx, 0
+        )
+        return (out, y_acc, x_saved), None
+
+    out0 = jnp.zeros(mb_shape, x_micro.dtype)
+    y0 = jnp.zeros((n_micro,) + mb_shape, x_micro.dtype)
+    s0 = jnp.zeros((n_micro,) + mb_shape, x_micro.dtype)
+    (_, y, x_saved), _ = jax.lax.scan(
+        tick, (out0, y0, s0), jnp.arange(total_ticks)
+    )
+    y = jax.lax.psum(
+        jnp.where(stage == n_stages - 1, y, jnp.zeros_like(y)), axis_name
+    )
+    return y, x_saved
+
+
+def _bwd_ticks(stage_params, x_saved, gy, fn: Callable, axis_name: str):
+    """The reverse pipeline: cotangents enter at the LAST stage and
+    ppermute backwards; stage s handles microbatch m = t - (S-1-s) at tick
+    t, recomputing its forward from the saved input via jax.vjp (1F1B
+    recompute) and accumulating param grads. Returns (dparams, dx) with
+    dx valid on stage 0 (psum-broadcast like the forward's y).
+
+    tp-within-stage note: ``fn`` must handle its own tp cotangent algebra
+    via the Megatron f/g conjugate pair (collectives.tp_region_enter/
+    tp_region_exit, as models/transformer._layer does) — with those in
+    place every shard's vjp already yields the full replicated input
+    cotangent, so no stage-level reduction is needed here (and a naive
+    psum of dx would double-count the residual path)."""
+    n_stages = axis_size(axis_name)
+    stage = axis_index(axis_name)
+    n_micro = x_saved.shape[0]
+    mb_shape = x_saved.shape[1:]
+    total_ticks = n_micro + n_stages - 1
+
+    dp0 = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), stage_params
+    )
+
+    def tick(carry, t):
+        prev_dx, dp_acc, dx_acc = carry
+        recv = ring_shift(prev_dx, axis_name, shift=-1)  # from stage s+1
+        m = t - (n_stages - 1 - stage)
+        valid = (m >= 0) & (m < n_micro)
+        slot = jnp.clip(m, 0, n_micro - 1)
+        g_in = jnp.where(
+            stage == n_stages - 1,
+            jax.lax.dynamic_index_in_dim(gy, slot, keepdims=False),
+            recv,
+        )
+        x_in = jax.lax.dynamic_index_in_dim(x_saved, slot, keepdims=False)
+        _, vjp_fn = jax.vjp(fn, stage_params, x_in)
+        dp, dx = vjp_fn(g_in)
+        dp_acc = jax.tree_util.tree_map(
+            lambda acc, new: acc
+            + jnp.where(valid, new.astype(jnp.float32), jnp.zeros_like(new, jnp.float32)),
+            dp_acc,
+            dp,
+        )
+        w_valid = valid & (stage == 0)
+        prev_slot = jax.lax.dynamic_index_in_dim(dx_acc, slot, keepdims=False)
+        dx_acc = jax.lax.dynamic_update_index_in_dim(
+            dx_acc, jnp.where(w_valid, dx, prev_slot), slot, 0
+        )
+        return (dx, dp_acc, dx_acc), None
+
+    dx0 = jnp.zeros(mb_shape, x_saved.dtype)
+    dxa0 = jnp.zeros((n_micro,) + mb_shape, x_saved.dtype)
+    (_, dparams, dx), _ = jax.lax.scan(
+        tick, (dx0, dp0, dxa0), jnp.arange(total_ticks)
+    )
+    dx = jax.lax.psum(
+        jnp.where(stage == 0, dx, jnp.zeros_like(dx)), axis_name
+    )
+    dparams = jax.tree_util.tree_map(
+        lambda g, p: g.astype(p.dtype), dparams, stage_params
+    )
+    return dparams, dx
+
+
+def _shard_specs(stage_params, x, mesh, n_microbatches, axis_name, batch_axes,
+                 param_specs):
     batch = x.shape[0]
     if batch % n_microbatches:
         raise ValueError(f"batch {batch} not divisible by {n_microbatches} microbatches")
     mb = batch // n_microbatches
     x_micro = x.reshape((n_microbatches, mb) + x.shape[1:])
-
     data_axes = tuple(
         a for a in batch_axes
         if a in getattr(mesh, "axis_names", ()) and mesh.shape[a] > 1
@@ -108,18 +220,133 @@ def pipeline_apply(
             f"microbatches) not divisible by data shards {n_data}"
         )
     x_spec = P(None, data_axes or None)  # [n_micro, mb(sharded over dp), ...]
-    param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
+    if param_specs is None:
+        param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
+    return x_micro, x_spec, param_specs, data_axes
 
-    def body(params, xm):
-        # strip the per-stage leading dim of 1
-        local = jax.tree_util.tree_map(lambda a: a[0], params)
-        return _pipeline_local(local, xm, fn, axis_name)
 
-    out = shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(param_specs, x_spec),
-        out_specs=x_spec,
-        check_vma=False,
-    )(stage_params, x_micro)
+def pipeline_apply(
+    stage_params,
+    x,
+    fn: Callable,
+    mesh,
+    n_microbatches: int,
+    axis_name: str = "pp",
+    batch_axes: tuple = ("dp", "fsdp"),
+    schedule: str = "gpipe",
+    param_specs=None,
+):
+    """Run ``fn(stage_params, x_mb)`` as a pipeline over ``axis_name``.
+
+    stage_params: pytree whose leaves have leading dim == pp size (one slice
+    per stage). x: [batch, ...] input. fn must map a microbatch through ONE
+    stage, preserving shape (classic equal-width pipeline). Returns
+    [batch, ...] outputs.
+
+    ``schedule``: "gpipe" (autodiff backward) or "1f1b" (explicit
+    custom-VJP backward with stage-input-only residuals + recompute — the
+    1F1B memory discipline; see module docstring).
+
+    ``param_specs``: optional pytree of PartitionSpecs for stage_params
+    (leading dim must map to ``axis_name``); default shards ONLY the stage
+    dim and replicates the rest. Passing specs with a tensor axis (e.g.
+    P("pp", None, "tp")) enables tp-within-stage — ``fn`` then runs on
+    tp-local weight shards and must psum its row-parallel outputs over the
+    tp axis itself (models/transformer._layer does when given tp_axis).
+
+    Composes with data parallelism: the microbatch dim shards over any
+    ``batch_axes`` present in the mesh (each dp group runs its own
+    pipeline over its batch slice — activations ppermute within the group,
+    nothing crosses dp), while stage params shard over ``axis_name`` (+ tp
+    when param_specs say so) and replicate over the data axes.
+    """
+    from jax import shard_map
+
+    batch = x.shape[0]
+    x_micro, x_spec, param_specs, data_axes = _shard_specs(
+        stage_params, x, mesh, n_microbatches, axis_name, batch_axes, param_specs
+    )
+
+    if schedule == "1f1b":
+        out = _apply_1f1b(
+            stage_params, x_micro, fn, mesh, axis_name, x_spec, param_specs,
+            data_axes,
+        )
+    elif schedule == "gpipe":
+        def body(params, xm):
+            # strip the per-stage leading dim of 1
+            local = jax.tree_util.tree_map(lambda a: a[0], params)
+            return _pipeline_local(local, xm, fn, axis_name)
+
+        out = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(param_specs, x_spec),
+            out_specs=x_spec,
+            check_vma=False,
+        )(stage_params, x_micro)
+    else:
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     return out.reshape((batch,) + out.shape[2:])
+
+
+def _apply_1f1b(stage_params, x_micro, fn, mesh, axis_name, x_spec, param_specs,
+                data_axes):
+    """custom-VJP wrapper: forward ticks save stage inputs; backward runs
+    the explicit reverse pipeline (_bwd_ticks)."""
+    from jax import shard_map
+
+    # saved stage inputs live stage-major: [S, M, mb, ...]
+    saved_spec = P(axis_name, *x_spec)
+
+    def strip(params):
+        return jax.tree_util.tree_map(lambda a: a[0], params)
+
+    @jax.custom_vjp
+    def run(params, xm):
+        y, _ = run_fwd(params, xm)
+        return y
+
+    def run_fwd(params, xm):
+        def body(p, x):
+            y, x_saved = _fwd_save_ticks(strip(p), x, fn, axis_name)
+            return y, x_saved[None]
+
+        y, x_saved = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(param_specs, x_spec),
+            out_specs=(x_spec, saved_spec),
+            check_vma=False,
+        )(params, xm)
+        return y, (params, x_saved)
+
+    def run_bwd(residuals, gy):
+        params, x_saved = residuals
+
+        def body(p, saved, g):
+            dparams, dx = _bwd_ticks(
+                strip(p),
+                jax.tree_util.tree_map(lambda a: a[0], saved),
+                g, fn, axis_name,
+            )
+            # params replicate over the data axes, so each data shard holds
+            # PARTIAL grads from its batch slice — sum them (the psum
+            # autodiff's transpose machinery would have inserted).
+            for ax in data_axes:
+                dparams = jax.tree_util.tree_map(
+                    lambda a, ax=ax: jax.lax.psum(a, ax), dparams
+                )
+            return jax.tree_util.tree_map(lambda a: a[None], dparams), dx
+
+        dparams, dx = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(param_specs, saved_spec, x_spec),
+            out_specs=(param_specs, x_spec),
+            check_vma=False,
+        )(params, x_saved, gy)
+        return dparams, dx
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(stage_params, x_micro)

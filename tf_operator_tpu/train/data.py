@@ -35,6 +35,9 @@ __all__ = [
     "MnistIdxDataset",
     "TokenMemmapDataset",
     "write_token_corpus",
+    "augment_images",
+    "AugmentedImages",
+    "prepare_classification_images",
 ]
 
 
@@ -233,6 +236,91 @@ class MnistIdxDataset(ArrayDataset):
                 x, y = x[rank::n], y[rank::n]
         super().__init__({"image": x, "label": y}, batch_size,
                          shuffle=shuffle, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# Host-side image augmentation (the ResNet/ImageNet-recipe half the
+# synthetic paths never needed: random crop + horizontal flip)
+# ---------------------------------------------------------------------------
+
+
+def augment_images(images: np.ndarray, rng: np.random.Generator, *,
+                   pad: int = 4, flip: bool = True) -> np.ndarray:
+    """Random-crop + horizontal-flip augmentation, host-side numpy.
+
+    The standard small-image recipe (ResNet/CIFAR): zero-pad ``pad``
+    pixels on each spatial edge, crop back to the original h×w at a
+    per-image random offset, then mirror each image left-right with
+    probability 1/2 (``flip=False`` for orientation-sensitive classes —
+    digits/text). images: [b, h, w] or [b, h, w, c]; same shape out.
+
+    Runs on the host on purpose: augmentation is per-example branchy work
+    the DeviceLoader's prefetch thread hides behind the step, and keeping
+    it off the device keeps the train step's compiled program static."""
+    b, h, w = images.shape[:3]
+    out = images
+    if pad:
+        widths = [(0, 0), (pad, pad), (pad, pad)] + [(0, 0)] * (images.ndim - 3)
+        padded = np.pad(images, widths)
+        dy = rng.integers(0, 2 * pad + 1, b)
+        dx = rng.integers(0, 2 * pad + 1, b)
+        out = np.empty_like(images)
+        for i in range(b):  # host-side; hidden by the loader's prefetch
+            out[i] = padded[i, dy[i]:dy[i] + h, dx[i]:dx[i] + w]
+    if flip:
+        do = rng.random(b) < 0.5
+        out = np.where(
+            do.reshape((b,) + (1,) * (images.ndim - 1)), out[:, :, ::-1], out
+        )
+    return out
+
+
+class AugmentedImages:
+    """Wraps a dict-batch image iterable with augment_images on the
+    ``key`` leaf (fresh randomness per batch, deterministic per seed).
+    Sits between a disk reader and the DeviceLoader:
+
+        DeviceLoader(AugmentedImages(MnistIdxDataset(...)), sharding)
+    """
+
+    def __init__(self, source: Iterable[Any], *, pad: int = 4,
+                 flip: bool = True, seed: int = 0, key: str = "image") -> None:
+        self.source = source
+        self.pad = pad
+        self.flip = flip
+        self.key = key
+        # ONE rng for the object's lifetime (not per-__iter__): re-seeding
+        # each epoch would replay identical "random" crops/flips every
+        # epoch, defeating the augmentation.
+        self._rng = np.random.default_rng(seed)
+
+    def __iter__(self) -> Iterator[Any]:
+        for batch in self.source:
+            batch = dict(batch)
+            batch[self.key] = augment_images(
+                batch[self.key], self._rng, pad=self.pad, flip=self.flip
+            )
+            yield batch
+
+
+def prepare_classification_images(images: np.ndarray,
+                                  image_size: Optional[int] = None) -> np.ndarray:
+    """Adapt reader output to a convnet's [b, h, w, 3] contract:
+    grayscale [b, h, w] gets a broadcast channel dim, and ``image_size``
+    (must be an integer multiple of the native size) upsamples
+    nearest-neighbor — e.g. the 8×8 scanned-digit fixtures to 32×32 so a
+    /32-downsampling ResNet keeps a spatial cell at the head."""
+    if images.ndim == 3:
+        images = np.repeat(images[..., None], 3, axis=-1)
+    if image_size and image_size != images.shape[1]:
+        factor, rem = divmod(image_size, images.shape[1])
+        if rem or factor < 1:
+            raise ValueError(
+                f"image_size {image_size} is not an integer multiple of the "
+                f"native size {images.shape[1]}"
+            )
+        images = np.repeat(np.repeat(images, factor, axis=1), factor, axis=2)
+    return images
 
 
 def write_token_corpus(path: str, tokens: np.ndarray, dtype=np.uint16) -> None:

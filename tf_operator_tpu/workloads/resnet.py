@@ -1,15 +1,31 @@
 """ResNet training workload (operator-launchable).
 
 The BASELINE.json "ResNet-50 ImageNet → TPUStrategy" config as a TPUJob
-entrypoint: joins the gang, builds the mesh, trains ResNet on synthetic
-ImageNet-shaped data with the sharded Trainer, logs step time and MFU.
+entrypoint: joins the gang, builds the mesh, trains ResNet with the
+sharded Trainer, logs step time and MFU.
 
-workload config keys: steps, batch_size, image_size, num_classes, lr,
-variant ("resnet50"|"resnet18"), checkpoint_dir, checkpoint_every,
-data ("fixed": one resident device batch, the benchmarking shape;
-"stream": host batches through the prefetching DeviceLoader — the
-production input-pipeline shape), profile_dir (capture an XLA trace),
-device_loop (K steps per compiled call — lax.scan device loop).
+Data modes (workload key ``data``):
+
+- ``"idx"`` + ``data_dir``: REAL images from standard idx files (the
+  MNIST wire format the reference's dist_mnist consumes,
+  /root/reference/test/e2e/dist-mnist/dist_mnist.py:214-215), prepared to
+  the convnet contract (3-channel, optional integer upsample to
+  ``image_size``), with random-crop(+flip) augmentation
+  (train.data.augment_images) ahead of the prefetching DeviceLoader.
+  Trains by ``epochs``, evaluates the test split, reports accuracy into
+  TPUJobStatus.eval_metrics, and fails below ``target_accuracy``.
+- ``"stream"``: SYNTHETIC host batches through the DeviceLoader (the
+  input-pipeline-overlap proof, not a dataset).
+- ``"fixed"`` (default): one resident SYNTHETIC device batch — the
+  benchmarking shape.
+
+workload config keys: steps (synthetic) / epochs (idx), batch_size,
+image_size, num_classes, lr, variant ("resnet50"|"resnet18"),
+checkpoint_dir, checkpoint_every, data, data_dir, augment (default true),
+crop_padding (default 4), flip (default false — digit-class fixtures are
+orientation-sensitive; set true for natural images), target_accuracy,
+eval_batch_size, profile_dir (XLA trace), device_loop (K steps per
+compiled call — lax.scan device loop).
 """
 
 from __future__ import annotations
@@ -39,9 +55,11 @@ def main(ctx: JobContext) -> None:
     classes = int(wl.get("num_classes", 1000))
     variant = wl.get("variant", "resnet50")
 
-    cfg = (
-        ResNetConfig.resnet50(classes) if variant == "resnet50" else ResNetConfig.resnet18(classes)
-    )
+    cfg = {
+        "resnet50": ResNetConfig.resnet50,
+        "resnet18": ResNetConfig.resnet18,
+        "tiny": ResNetConfig.tiny,
+    }[variant](classes)
     mesh = ctx.build_mesh()
 
     def loss_fn(params, data, state):
@@ -63,6 +81,9 @@ def main(ctx: JobContext) -> None:
     ckpt = WorkloadCheckpointer(wl)
     if ckpt.is_complete(steps):
         log.info("already complete (budget %d); nothing to do", steps)
+        return
+    if wl.get("data") == "idx":
+        _train_real(ctx, mesh, trainer, cfg, wl)
         return
     loader = None
     if wl.get("data", "fixed") == "stream":
@@ -105,3 +126,107 @@ def main(ctx: JobContext) -> None:
         )
     else:
         log.info("resnet done: loss=%.4f (no timed steps remained)", loss)
+
+
+def _train_real(ctx, mesh, trainer, cfg, wl) -> None:
+    """Real-image path: idx files -> prepare (3ch/upsample) -> augment ->
+    DeviceLoader -> sharded Trainer -> eval-mode test accuracy ->
+    TPUJobStatus.eval_metrics (+ hard gate). The ResNet counterpart of
+    the dist_mnist real-data proof (workloads/mnist._train_real)."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_operator_tpu.models.resnet import resnet_forward
+    from tf_operator_tpu.train.data import (
+        AugmentedImages,
+        DeviceLoader,
+        MnistIdxDataset,
+        prepare_classification_images,
+    )
+
+    global_batch = int(wl.get("batch_size", 128))
+    image_size = int(wl.get("image_size", 32))
+    epochs = max(1, int(wl.get("epochs", 5)))
+    target = float(wl.get("target_accuracy", 0.0))
+    n_proc = jax.process_count()
+    if global_batch % n_proc:
+        raise ValueError(f"batch_size {global_batch} % {n_proc} processes != 0")
+
+    ds = MnistIdxDataset(
+        wl["data_dir"], global_batch // n_proc, split="train",
+        seed=jax.process_index(),
+    )
+    ds.arrays["image"] = prepare_classification_images(
+        ds.arrays["image"], image_size
+    )
+    source = ds
+    if wl.get("augment", True):
+        source = AugmentedImages(
+            ds,
+            pad=int(wl.get("crop_padding", 4)),
+            # digits/text are orientation-sensitive; natural-image recipes
+            # opt in with flip: true
+            flip=bool(wl.get("flip", False)),
+            seed=jax.process_index(),
+        )
+    state = trainer.init(jax.random.PRNGKey(0))
+    loader = DeviceLoader(source, trainer.batch_sharding)
+    # GLOBAL example count -> identical SPMD step count on every rank
+    # (a rank-local count would deadlock the gang; see MnistIdxDataset).
+    steps_per_epoch = max(1, ds.global_n // global_batch)
+    total = epochs * steps_per_epoch
+    loss = float("nan")
+    try:
+        for step in range(total):
+            batch = next(loader)
+            state, m = trainer.step(state, (batch["image"], batch["label"]))
+            if step % max(1, total // 10) == 0:
+                loss = float(m["loss"])
+                log.info("step %d/%d loss %.4f", step, total, loss)
+        loss = float(m["loss"])
+    finally:
+        loader.close()
+    if not math.isfinite(loss):
+        raise AssertionError(f"non-finite training loss {loss}")
+
+    # Eval-mode (running BN stats) accuracy on the test split. Params are
+    # replicated, and eval batches are fed REPLICATED so every rank runs
+    # the identical program — no collectives, no gang divergence. Padded
+    # to a static batch so jit compiles once.
+    test = MnistIdxDataset(
+        wl["data_dir"], batch_size=1, split="test", shuffle=False,
+        process_shard=False,
+    )
+    images = prepare_classification_images(test.arrays["image"], image_size)
+    labels = test.arrays["label"]
+    eval_b = int(wl.get("eval_batch_size", 64))
+
+    @jax.jit
+    def eval_logits(params, bn_state, x):
+        logits, _ = resnet_forward(params, bn_state, x, cfg, train=False)
+        return jnp.argmax(logits, axis=-1)
+
+    correct = 0
+    for i in range(0, len(labels), eval_b):
+        x = images[i : i + eval_b]
+        y = labels[i : i + eval_b]
+        if x.shape[0] < eval_b:  # pad to the static shape, mask the tail
+            padding = eval_b - x.shape[0]
+            x = np.concatenate([x, np.zeros((padding,) + x.shape[1:], x.dtype)])
+        pred = np.asarray(eval_logits(state.params, state.extra, x))[: len(y)]
+        correct += int((pred == y).sum())
+    acc = correct / len(labels)
+    log.info(
+        "resnet done (real data): test accuracy %.4f over %d examples "
+        "(%d epochs, final loss %.4f)", acc, len(labels), epochs, loss,
+    )
+    if ctx.process_id == 0:
+        ctx.report_eval_metrics(total, {"accuracy": acc})
+    if target and acc < target:
+        raise AssertionError(
+            f"test accuracy {acc:.4f} below target {target} — real-image "
+            "training regressed"
+        )
