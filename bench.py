@@ -265,18 +265,26 @@ def main() -> None:
     warmup = 2
 
     cfg = ResNetConfig.resnet50()
-    # Opt-in levers (BASELINE.md "BN decomposition"): BENCH_BN_STATS_GRAD=0
-    # drops the BN stats gradient (+5 MFU pts, changed dynamics — diverges
-    # at lr 0.1 on synthetic data); BENCH_FUSED_1X1=1 routes 1x1 convs
-    # through the Pallas fused matmul+stats kernel (measured SLOWER than
-    # XLA convs — kept as the documented negative result).
+    # BN-stats levers (BASELINE.md "BN decomposition"). Default is the
+    # config default — "var" since r3 (stop the variance gradient only:
+    # ~+5 MFU pts (37.4% vs 31-32% exact), accuracy-validated on real data).
+    # "exact"/"1" restores exact BN, "0" stops both stats gradients
+    # (diverges at lr 0.1 on synthetic — measurement only). BENCH_FUSED_1X1=1
+    # routes 1x1 convs through the Pallas fused matmul+stats kernel
+    # (measured SLOWER than XLA convs — the documented negative result).
     import dataclasses
 
-    sg_env = os.environ.get("BENCH_BN_STATS_GRAD", "1")
+    sg_env = os.environ.get("BENCH_BN_STATS_GRAD", "var")
     if sg_env == "0":
         cfg = dataclasses.replace(cfg, bn_stats_stop_gradient=True)
+    elif sg_env in ("1", "exact"):
+        cfg = dataclasses.replace(cfg, bn_stats_stop_gradient=False)
     elif sg_env == "var":
         cfg = dataclasses.replace(cfg, bn_stats_stop_gradient="var")
+    else:
+        # a typo'd value silently landing on the (faster) var default
+        # would corrupt an intended exact-BN measurement by +5 MFU pts
+        sys.exit(f"unknown BENCH_BN_STATS_GRAD={sg_env!r}; use exact|1|0|var")
     if os.environ.get("BENCH_FUSED_1X1", "0") == "1":
         cfg = dataclasses.replace(cfg, fused_1x1=True)
     mesh = build_mesh({"dp": n_chips})

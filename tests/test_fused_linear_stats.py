@@ -109,32 +109,43 @@ def test_resnet_fused_bottleneck_parity():
 
 
 def test_bn_stats_stop_gradient_forward_identical_backward_differs():
-    """The opt-in speed lever: forward math is untouched (stop_gradient is
-    an identity), only the backward's stats terms disappear."""
+    """The stats-gradient modes (r3: 'var' is the DEFAULT): forward math
+    is untouched (stop_gradient is an identity) for every mode, and the
+    three backward variants are pairwise DISTINCT — exact keeps both
+    stats terms, 'var' drops only the variance term, True drops both.
+    (Pinned explicitly so the default flip can't silently collapse two
+    modes into one.)"""
     import tf_operator_tpu.models.resnet as R
 
-    cfg = R.ResNetConfig((1,), (16,), 10, dtype=jnp.float32)
-    cfg_sg = R.ResNetConfig(
-        (1,), (16,), 10, dtype=jnp.float32, bn_stats_stop_gradient=True
-    )
-    params, state = R.init_resnet(jax.random.PRNGKey(0), cfg)
+    def mk(mode):
+        return R.ResNetConfig(
+            (1,), (16,), 10, dtype=jnp.float32, bn_stats_stop_gradient=mode
+        )
+
+    cfg_exact, cfg_var, cfg_full = mk(False), mk("var"), mk(True)
+    params, state = R.init_resnet(jax.random.PRNGKey(0), cfg_exact)
     x = jax.random.normal(jax.random.PRNGKey(1), (4, 16, 16, 3))
 
-    l0, _ = R.resnet_forward(params, state, x, cfg, train=True)
-    l1, _ = R.resnet_forward(params, state, x, cfg_sg, train=True)
-    np.testing.assert_allclose(np.asarray(l0), np.asarray(l1), rtol=1e-6)
+    l0, _ = R.resnet_forward(params, state, x, cfg_exact, train=True)
+    for c in (cfg_var, cfg_full):
+        l1, _ = R.resnet_forward(params, state, x, c, train=True)
+        np.testing.assert_allclose(np.asarray(l0), np.asarray(l1), rtol=1e-6)
 
     def loss(p, c):
         logits, _ = R.resnet_forward(p, state, x, c, train=True)
         return -jnp.mean(jax.nn.log_softmax(logits)[:, 0])
 
-    g0 = jax.grad(lambda p: loss(p, cfg))(params)
-    g1 = jax.grad(lambda p: loss(p, cfg_sg))(params)
-    diff = max(
-        jax.tree_util.tree_leaves(
-            jax.tree_util.tree_map(
-                lambda a, b: float(jnp.max(jnp.abs(a - b))), g0, g1
+    def gdiff(ca, cb):
+        ga = jax.grad(lambda p: loss(p, ca))(params)
+        gb = jax.grad(lambda p: loss(p, cb))(params)
+        return max(
+            jax.tree_util.tree_leaves(
+                jax.tree_util.tree_map(
+                    lambda a, b: float(jnp.max(jnp.abs(a - b))), ga, gb
+                )
             )
         )
-    )
-    assert diff > 1e-6  # the stats-gradient terms really are gone
+
+    assert gdiff(cfg_exact, cfg_var) > 1e-6   # var really drops the var term
+    assert gdiff(cfg_exact, cfg_full) > 1e-6  # full drops both
+    assert gdiff(cfg_var, cfg_full) > 1e-6    # var keeps the centering term

@@ -48,15 +48,15 @@ class ResNetConfig:
     bn_fused_stats: bool = True
     # Stop the gradient through BN batch statistics: removes the backward's
     # stats-reduction terms (measured −6.9 ms / +5.1 MFU pts on the v5e
-    # b=128 train step) at the cost of changed optimization dynamics — the
-    # stats gradient is a centering stabilizer, and the synthetic-data
-    # bench DIVERGES at lr=0.1 with it fully off. "var" stops only the
-    # variance gradient: measured the SAME full speedup (37.4% MFU) with
-    # the centering gradient kept — gentler, but the synthetic-task
-    # trajectory still differs from exact BN. Opt-in speed lever
-    # (BENCH_BN_STATS_GRAD=0|var); needs accuracy validation per recipe
-    # before production use. Values: False (exact) | True | "var".
-    bn_stats_stop_gradient: Any = False
+    # b=128 train step). Values: False (exact) | True (stop both — the
+    # synthetic bench DIVERGES at lr=0.1; keep opt-in) | "var" (stop only
+    # the variance gradient, keeping the centering stabilizer — measured
+    # the SAME full speedup, 37.4% vs 32% MFU).
+    # DEFAULT "var" since r3: accuracy-validated on REAL data through the
+    # idx/augmentation pipeline — 3-seed test accuracy 0.9764 vs exact's
+    # 0.9787 on real scanned digits, overlapping seed ranges (BASELINE.md
+    # "BN decomposition"); BENCH_BN_STATS_GRAD=exact restores exact BN.
+    bn_stats_stop_gradient: Any = "var"
     # Ghost batch statistics: train-mode normalization uses the PREVIOUS
     # step's batch stats (carried in state) while this step's stats are
     # computed only to ship forward — the normalize affine becomes a step

@@ -279,15 +279,17 @@ def _rope(x, theta: float):
 def _attention(q, k, v, cfg: TransformerConfig, mesh):
     """q: [b,t,nh,hd]; k/v: [b,t,nkv,hd].
 
-    GQA (nkv < nh) runs NATIVE on the dense and flash paths: no
+    GQA (nkv < nh) runs NATIVE on the dense, flash AND ring paths: no
     [b,t,nh,hd] K/V tensor ever exists — the flash kernel indexes k/v
-    head hi//group per query head and the dense path groups the einsum
-    (ops/flash_attention.py), keeping K/V activation HBM traffic at the
-    nkv rate that is GQA's whole point at t>=4096. The cp paths (ring/
-    ulysses) still materialize repeated heads — their all-to-all/ppermute
-    layouts assume equal head counts; lifting that is future surface."""
+    head hi//group per query head, the dense path groups the einsum
+    (ops/flash_attention.py), and ring attention rotates the SMALL
+    [*, nkv, hd] blocks around the cp ring (g-times less ICI traffic per
+    hop — parallel/ring_attention.py), keeping K/V traffic at the nkv
+    rate that is GQA's whole point at t>=4096. Only ulysses still
+    materializes repeated heads: its all-to-all re-shards the head dim
+    over cp, which requires equal head counts."""
     groups = cfg.n_heads // cfg.n_kv_heads
-    if groups > 1 and cfg.attn_impl in ("ring", "ulysses"):
+    if groups > 1 and cfg.attn_impl == "ulysses":
         k = jnp.repeat(k, groups, axis=2)
         v = jnp.repeat(v, groups, axis=2)
     if cfg.attn_impl == "ring" and mesh is not None and cfg.cp_axis in mesh.axis_names:

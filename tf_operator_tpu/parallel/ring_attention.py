@@ -41,26 +41,36 @@ def reference_attention(q, k, v, causal: bool = False):
 
 
 def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
-    """Per-device body (runs inside shard_map). q/k/v: local blocks
-    [b, t_local, h, d]; returns the local output block."""
+    """Per-device body (runs inside shard_map). q: [b, t_local, h, d];
+    k/v: [b, t_local, h_kv, d] with h % h_kv == 0 — GQA-native (r3): the
+    score/value einsums carry a (kv_head, group) split of the query heads
+    instead of materializing repeated K/V, so the ring rotates the SMALL
+    [b, t_local, h_kv, d] blocks — ICI traffic per hop drops by the group
+    factor (8x for the llama2-70b 64q/8kv shape), exactly where ring
+    attention's cost lives. h_kv == h is the classic path (group 1).
+    Returns the local output block [b, t_local, h, d]."""
     n = axis_size(axis_name)
     my_idx = axis_index(axis_name)
     b, t_local, h, d = q.shape
+    h_kv = k.shape[2]
+    g = h // h_kv
     scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
 
-    qf = q.astype(jnp.float32)
+    # [b, t, h, d] -> [b, t, h_kv, g, d]: group dim explicit for the
+    # grouped contractions (h label below is the KV head dim).
+    qf = q.astype(jnp.float32).reshape(b, t_local, h_kv, g, d)
 
     def attend_block(o, m, l, k_blk, v_blk, step):
         """Fold one K/V block into the online-softmax accumulators."""
         # The block currently held arrived from device (my_idx - step) mod n.
         src = (my_idx - step) % n
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32)) * scale
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qf, k_blk.astype(jnp.float32)) * scale
         if causal:
             q_pos = my_idx * t_local + jnp.arange(t_local)
             k_pos = src * t_local + jnp.arange(t_local)
             mask = q_pos[:, None] >= k_pos[None, :]
-            s = jnp.where(mask[None, None], s, -jnp.inf)
-        m_blk = jnp.max(s, axis=-1)  # [b,h,q]
+            s = jnp.where(mask[None, None, None], s, -jnp.inf)
+        m_blk = jnp.max(s, axis=-1)  # [b,h_kv,g,q]
         m_new = jnp.maximum(m, m_blk)
         # -inf accumulators need explicit guards: exp(-inf - -inf) is nan.
         alpha = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - m_new))
@@ -68,7 +78,7 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
         p = jnp.where(jnp.isneginf(m_new)[..., None], 0.0, p)
         l_new = l * alpha + jnp.sum(p, axis=-1)
         o_new = o * alpha[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+            "bhgqk,bkhd->bhgqd", p, v_blk.astype(jnp.float32)
         )
         return o_new, m_new, l_new
 
@@ -81,9 +91,9 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
         v_next = ring_shift(v_blk, axis_name)
         return (o, m, l, k_next, v_next), None
 
-    o0 = jnp.zeros((b, h, t_local, d), jnp.float32)
-    m0 = jnp.full((b, h, t_local), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((b, h, t_local), jnp.float32)
+    o0 = jnp.zeros((b, h_kv, g, t_local, d), jnp.float32)
+    m0 = jnp.full((b, h_kv, g, t_local), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h_kv, g, t_local), jnp.float32)
     (o, m, l, k_last, v_last), _ = jax.lax.scan(
         scan_body, (o0, m0, l0, k, v), jnp.arange(n - 1)
     )
@@ -91,7 +101,7 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
     # Rows that attended to nothing keep l=0 (cannot happen for causal self-
     # attention with t_local >= 1, but guard the division anyway).
     o = o / jnp.where(l == 0.0, 1.0, l)[..., None]
-    return jnp.einsum("bhqd->bqhd", o).astype(q.dtype)
+    return jnp.einsum("bhgqd->bqhgd", o).reshape(b, t_local, h, d).astype(q.dtype)
 
 
 def ring_attention(
@@ -116,6 +126,13 @@ def ring_attention(
         raise ValueError(
             f"ring attention is self-attention: q/k/v seq lengths must match, "
             f"got {q.shape[1]}/{k.shape[1]}/{v.shape[1]}"
+        )
+    if k.shape[2] != v.shape[2]:
+        raise ValueError(f"k/v head mismatch: {k.shape[2]} vs {v.shape[2]}")
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"q heads {q.shape[2]} not a multiple of kv heads {k.shape[2]} "
+            "(GQA group must divide evenly)"
         )
     if q.shape[1] % cp:
         raise ValueError(f"seq length {q.shape[1]} must divide by {axis_name}={cp}")
