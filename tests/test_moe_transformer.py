@@ -383,14 +383,106 @@ def test_pipeline_transformer_trains_through_trainer():
     assert losses[-1] < losses[0], losses
 
 
-def test_pipeline_moe_rejected_loudly():
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pipeline_moe_forward_matches_single_device(schedule):
+    """MoE + pipeline (r3): experts replicated per stage through the
+    no-ep routing path — the pp forward must equal the plain scan.
+
+    capacity_factor is raised so nothing drops: expert capacity is
+    computed per MICROBATCH under pp (each microbatch routes alone), so
+    at tight capacity the dropped-token sets legitimately differ from
+    full-batch routing — with headroom the math is exactly equal."""
+    from tf_operator_tpu.models.transformer import transformer_hidden
+
+    cfg_pp = preset("tiny-moe", dtype=jnp.float32, pp_microbatches=4,
+                    pp_schedule=schedule, capacity_factor=8.0)
+    cfg_1d = preset("tiny-moe", dtype=jnp.float32, capacity_factor=8.0)
+    params = init_transformer(jax.random.PRNGKey(0), cfg_pp)
+    tok = tokens(batch=16)
+    mesh = build_mesh({"pp": 2, "dp": 4})
+    got, aux = transformer_hidden(params, tok, cfg_pp, mesh, with_aux=True)
+    want, aux_1d = transformer_hidden(params, tok, cfg_1d, None, with_aux=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+    )
+    # aux z-loss is microbatch-invariant (per-token logsumexp mean);
+    # lb_loss differs only through per-microbatch load fractions
+    np.testing.assert_allclose(
+        float(aux["z_loss"]), float(aux_1d["z_loss"]), rtol=1e-3
+    )
+    assert aux["expert_load"] is None  # telemetry not carried through pp
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pipeline_moe_trains_with_router_gradient(schedule):
+    """MoE TRAINS through the pipeline with the aux losses active: loss
+    decreases and the ROUTER receives gradient through the pp aux channel
+    (a broken channel would zero it — routing then collapses silently)."""
+    cfg = preset("tiny-moe", dtype=jnp.float32, pp_microbatches=4,
+                 pp_schedule=schedule)
+    mesh = build_mesh({"pp": 2, "dp": 4})
+    trainer = Trainer(
+        mesh,
+        loss_fn=lambda p, tok, e: lm_loss(p, tok, cfg, mesh=mesh),
+        init_fn=lambda k: init_transformer(k, cfg),
+        logical_axes=transformer_logical_axes(cfg),
+        config=TrainerConfig(optimizer="adamw", learning_rate=1e-3),
+    )
+    state = trainer.init(jax.random.PRNGKey(0))
+    tok = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (16, 32), 0, cfg.vocab),
+        trainer.batch_sharding,
+    )
+    g = jax.grad(lambda p: lm_loss(p, tok, cfg, mesh=mesh))(state.params)
+    assert float(jnp.max(jnp.abs(g["layers"]["w_router"]))) > 0.0
+    losses = []
+    for _ in range(4):
+        state, metrics = trainer.step(state, tok)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
+
+
+def test_pipeline_moe_grads_match_single_device():
+    """Full lm_loss gradient parity for pp+MoE (1f1b): the aux-channel
+    cotangent path (run_bwd feeds g_aux into every valid tick's vjp) must
+    reproduce the plain scan's gradients — router included. Drop-free
+    capacity (see the forward oracle), and lb weight 0: the load-balance
+    fractions are per-MICROBATCH under pp (mean-of-products != full-batch
+    product), so only the z-loss — whose per-token mean IS microbatch-
+    invariant — admits an exact cross-layout gradient oracle; lb gradient
+    flow is covered by test_pipeline_moe_trains_with_router_gradient."""
+    cfg_pp = preset("tiny-moe", dtype=jnp.float32, pp_microbatches=4,
+                    capacity_factor=8.0, moe_aux_weight=0.0)
+    cfg_1d = preset("tiny-moe", dtype=jnp.float32, capacity_factor=8.0,
+                    moe_aux_weight=0.0)
+    params = init_transformer(jax.random.PRNGKey(0), cfg_pp)
+    tok = tokens(batch=16)
+    mesh = build_mesh({"pp": 2, "dp": 4})
+    g_pp = jax.grad(lambda p: lm_loss(p, tok, cfg_pp, mesh=mesh))(params)
+    g_1d = jax.grad(lambda p: lm_loss(p, tok, cfg_1d, mesh=None))(params)
+    for (path, a), b in zip(
+        jax.tree_util.tree_flatten_with_path(g_pp)[0],
+        jax.tree_util.tree_leaves(g_1d),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_pipeline_moe_invalid_meshes_rejected():
     from tf_operator_tpu.models.transformer import transformer_hidden
 
     cfg = preset("tiny-moe", dtype=jnp.float32, pp_microbatches=2)
     params = init_transformer(jax.random.PRNGKey(0), cfg)
-    mesh = build_mesh({"pp": 2, "dp": 4})
-    with pytest.raises(NotImplementedError, match="MoE"):
-        transformer_hidden(params, tokens(), cfg, mesh)
+    with pytest.raises(NotImplementedError, match="ep axis"):
+        transformer_hidden(params, tokens(), cfg, build_mesh({"pp": 2, "ep": 4}))
+    cfg_tp = preset("tiny-moe", dtype=jnp.float32, pp_microbatches=2,
+                    n_heads=4, n_kv_heads=2)
+    with pytest.raises(NotImplementedError, match="tensor-parallel"):
+        transformer_hidden(
+            params, tokens(), cfg_tp, build_mesh({"pp": 2, "tp": 2, "dp": 2})
+        )
 
 
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])

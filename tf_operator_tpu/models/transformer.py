@@ -526,14 +526,32 @@ def transformer_hidden_pp(params, tokens, cfg: TransformerConfig, mesh):
     Composes with dp (each dp group pipelines its batch slice) and, r3,
     with tp-WITHIN-STAGE: with a tp axis in the mesh, stage weights shard
     Megatron-style (_pp_param_specs) and _layer psums its row-parallel
-    matmuls over tp. MoE + pipeline is rejected loudly rather than
-    silently mis-sharded."""
+    matmuls over tp.
+
+    MoE + pipeline (r3): supported with experts REPLICATED within each
+    stage (the moe_apply no-ep routing path — identical math to the
+    ep-sharded dispatch; an ep axis inside a pipeline stage would nest
+    shard_maps and is rejected). The router aux losses ride the
+    pipeline's aux channel (pipeline_apply aux_size=2: summed lb/z per
+    (stage-layer, microbatch), normalized back to means here) so MoE
+    trains at quality under pp — with the caveat that load-balance
+    fractions are computed per MICROBATCH rather than per batch.
+    Per-layer router telemetry (expert_load/drop_frac) is not carried
+    through the pipeline; lm_loss_and_metrics reports the scalar losses
+    only for pp+MoE. MoE + tp-within-stage is rejected (the expert MLP
+    has no tp split)."""
     from tf_operator_tpu.parallel.pipeline import pipeline_apply
 
-    if cfg.n_experts:
+    if cfg.n_experts and "tp" in mesh.axis_names and mesh.shape["tp"] > 1:
         raise NotImplementedError(
-            "MoE layers inside a pipeline stage are not supported yet — "
-            "run MoE configs with ep (+dp), or dense configs with pp"
+            "MoE + tp-within-stage is not supported (the expert MLP has "
+            "no tensor-parallel split); use pp x dp for MoE pipelines"
+        )
+    if cfg.n_experts and cfg.ep_axis in mesh.axis_names and mesh.shape[cfg.ep_axis] > 1:
+        raise NotImplementedError(
+            "an ep axis inside a pipeline stage would nest shard_maps — "
+            "MoE pipelines run with experts replicated per stage (drop the "
+            "ep axis) or MoE runs non-pipelined with ep"
         )
     n_stages = mesh.shape[cfg.pp_axis]
     if cfg.n_layers % n_stages:
@@ -554,26 +572,56 @@ def transformer_hidden_pp(params, tokens, cfg: TransformerConfig, mesh):
                 tp_manual_vjp=(cfg.pp_schedule == "1f1b")),
         cfg,
     )
+    moe = bool(cfg.n_experts)
 
-    def stage_fn(stage_layers, xb):
-        def body(h, lp):
-            out, _ = layer_fn(h, lp)
-            return out, None
+    if moe:
+        def stage_fn(stage_layers, xb):
+            def body(carry, lp):
+                h, acc = carry
+                out, aux = layer_fn(h, lp)
+                acc = acc + jnp.stack(
+                    [aux["lb_loss"], aux["z_loss"]]
+                ).astype(jnp.float32)
+                return (out, acc), None
 
-        out, _ = jax.lax.scan(body, xb, stage_layers)
-        return out
+            (out, acc), _ = jax.lax.scan(
+                body, (xb, jnp.zeros((2,), jnp.float32)), stage_layers
+            )
+            return out, acc
+    else:
+        def stage_fn(stage_layers, xb):
+            def body(h, lp):
+                out, _ = layer_fn(h, lp)
+                return out, None
+
+            out, _ = jax.lax.scan(body, xb, stage_layers)
+            return out
 
     per_stage = cfg.n_layers // n_stages
     stage_params = jax.tree_util.tree_map(
         lambda a: a.reshape((n_stages, per_stage) + a.shape[1:]),
         params["layers"],
     )
-    h = pipeline_apply(
+    res = pipeline_apply(
         stage_params, x, stage_fn, mesh, cfg.pp_microbatches, cfg.pp_axis,
         schedule=cfg.pp_schedule,
         param_specs=_pp_param_specs(cfg, tp_axis) if tp_axis else None,
+        aux_size=2 if moe else 0,
     )
-    return _rms_norm(h, params["final_norm"], cfg.norm_eps)
+    if moe:
+        h, aux_sums = res
+        # sums over (layers x microbatches) -> the means the loss head
+        # expects (matching the non-pp per-layer-mean semantics up to
+        # microbatched load-balance fractions)
+        denom = cfg.n_layers * cfg.pp_microbatches
+        aux = {
+            "lb_loss": aux_sums[0] / denom,
+            "z_loss": aux_sums[1] / denom,
+            "expert_load": None,  # per-layer telemetry not carried via pp
+            "drop_frac": None,
+        }
+        return _rms_norm(h, params["final_norm"], cfg.norm_eps), aux
+    return _rms_norm(res, params["final_norm"], cfg.norm_eps), None
 
 
 def transformer_hidden(params, tokens, cfg: TransformerConfig, mesh=None,
@@ -587,8 +635,8 @@ def transformer_hidden(params, tokens, cfg: TransformerConfig, mesh=None,
     With cfg.pp_microbatches set and a pp axis in the mesh, the layer
     stack runs as a GPipe pipeline (transformer_hidden_pp)."""
     if _use_pipeline(cfg, mesh):
-        h = transformer_hidden_pp(params, tokens, cfg, mesh)
-        return (h, None) if with_aux else h
+        h, aux = transformer_hidden_pp(params, tokens, cfg, mesh)
+        return (h, aux) if with_aux else h
     x = params["embed"].astype(cfg.dtype)[tokens]
 
     layer_fn = _remat_wrap(partial(_layer, cfg=cfg, mesh=mesh), cfg)
@@ -684,15 +732,17 @@ def lm_loss_and_metrics(params, tokens, cfg: TransformerConfig, mesh=None, key=N
             + cfg.moe_aux_weight * aux["lb_loss"]
             + cfg.moe_zloss_weight * aux["z_loss"]
         )
-        load = aux["expert_load"]  # [L, E]
-        p = load / jnp.maximum(jnp.sum(load, axis=-1, keepdims=True), 1e-9)
-        entropy = -jnp.sum(p * jnp.log(jnp.maximum(p, 1e-9)), axis=-1)  # [L]
-        metrics.update(
-            moe_lb_loss=aux["lb_loss"],
-            moe_z_loss=aux["z_loss"],
-            moe_expert_entropy=jnp.mean(entropy),
-            moe_drop_frac=jnp.mean(aux["drop_frac"]),
-        )
+        metrics.update(moe_lb_loss=aux["lb_loss"], moe_z_loss=aux["z_loss"])
+        if aux.get("expert_load") is not None:
+            # per-layer router telemetry (absent under pipeline parallelism
+            # — only the scalar losses ride the pp aux channel)
+            load = aux["expert_load"]  # [L, E]
+            p = load / jnp.maximum(jnp.sum(load, axis=-1, keepdims=True), 1e-9)
+            entropy = -jnp.sum(p * jnp.log(jnp.maximum(p, 1e-9)), axis=-1)  # [L]
+            metrics.update(
+                moe_expert_entropy=jnp.mean(entropy),
+                moe_drop_frac=jnp.mean(aux["drop_frac"]),
+            )
     return total, metrics
 
 

@@ -40,14 +40,21 @@ from jax.sharding import PartitionSpec as P
 from tf_operator_tpu.parallel.collectives import axis_index, axis_size, ring_shift
 
 
-def _pipeline_local(stage_params, x_micro, fn: Callable, axis_name: str):
+def _pipeline_local(stage_params, x_micro, fn: Callable, axis_name: str,
+                    aux_size: int = 0):
     """Per-device body (inside shard_map).
 
     stage_params: this stage's params (leading dim of size 1 stripped).
     x_micro: [n_micro, mb, ...] — full microbatched input, replicated.
     Returns [n_micro, mb, ...] outputs (valid on the last stage; psum'ed so
     every stage returns the same array).
-    """
+
+    ``aux_size`` > 0: fn returns (out, aux[aux_size] f32) — summable side
+    losses (e.g. MoE router lb/z losses). Each stage accumulates its VALID
+    ticks' aux and returns the LOCAL sum (no collective: the caller stacks
+    per-shard rows through the shard_map output and reduces outside it,
+    where autodiff needs no collective-transpose reasoning). Also
+    returned: (y, aux_local)."""
     n_stages = axis_size(axis_name)
     stage = axis_index(axis_name)
     n_micro = x_micro.shape[0]
@@ -56,14 +63,19 @@ def _pipeline_local(stage_params, x_micro, fn: Callable, axis_name: str):
     total_ticks = n_micro + n_stages - 1
 
     def tick(carry, t):
-        prev_out, y_acc = carry
+        prev_out, y_acc, aux_acc = carry
         # Receive activation from the previous stage (stage 0 receives
         # garbage from the last stage and ignores it).
         recv = ring_shift(prev_out, axis_name)
         mb_idx = jnp.clip(t, 0, n_micro - 1)
         first_in = jax.lax.dynamic_index_in_dim(x_micro, mb_idx, keepdims=False)
         x_in = jnp.where(stage == 0, first_in, recv)
-        out = fn(stage_params, x_in)
+        if aux_size:
+            out, aux = fn(stage_params, x_in)
+            live = (t - stage >= 0) & (t - stage < n_micro)
+            aux_acc = aux_acc + jnp.where(live, aux, jnp.zeros_like(aux))
+        else:
+            out = fn(stage_params, x_in)
         # Last stage writes its result for microbatch t-(S-1) when valid.
         out_idx = t - (n_stages - 1)
         valid = (stage == n_stages - 1) & (out_idx >= 0) & (out_idx < n_micro)
@@ -71,15 +83,20 @@ def _pipeline_local(stage_params, x_micro, fn: Callable, axis_name: str):
         prev_slot = jax.lax.dynamic_index_in_dim(y_acc, write_idx, keepdims=False)
         new_slot = jnp.where(valid, out, prev_slot)
         y_acc = jax.lax.dynamic_update_index_in_dim(y_acc, new_slot, write_idx, 0)
-        return (out, y_acc), None
+        return (out, y_acc, aux_acc), None
 
     out0 = jnp.zeros(mb_shape, x_micro.dtype)
     y0 = jnp.zeros((n_micro,) + mb_shape, x_micro.dtype)
-    (_, y), _ = jax.lax.scan(tick, (out0, y0), jnp.arange(total_ticks))
+    aux0 = jnp.zeros((max(aux_size, 1),), jnp.float32)
+    (_, y, aux_acc), _ = jax.lax.scan(
+        tick, (out0, y0, aux0), jnp.arange(total_ticks)
+    )
     # Broadcast the last stage's result to every stage (replicated output).
     y = jax.lax.psum(
         jnp.where(stage == n_stages - 1, y, jnp.zeros_like(y)), axis_name
     )
+    if aux_size:
+        return y, aux_acc
     return y
 
 
@@ -90,9 +107,10 @@ def bubble_fraction(n_stages: int, n_micro: int) -> float:
     return (n_stages - 1) / (n_micro + n_stages - 1)
 
 
-def _fwd_save_ticks(stage_params, x_micro, fn: Callable, axis_name: str):
-    """_pipeline_local plus residual capture: returns (y, x_saved) where
-    x_saved[m] is THIS stage's input for microbatch m — the only
+def _fwd_save_ticks(stage_params, x_micro, fn: Callable, axis_name: str,
+                    aux_size: int = 0):
+    """_pipeline_local plus residual capture: returns (y, aux?, x_saved)
+    where x_saved[m] is THIS stage's input for microbatch m — the only
     activation the 1F1B backward needs (it recomputes the rest)."""
     n_stages = axis_size(axis_name)
     stage = axis_index(axis_name)
@@ -101,7 +119,7 @@ def _fwd_save_ticks(stage_params, x_micro, fn: Callable, axis_name: str):
     total_ticks = n_micro + n_stages - 1
 
     def tick(carry, t):
-        prev_out, y_acc, x_saved = carry
+        prev_out, y_acc, aux_acc, x_saved = carry
         recv = ring_shift(prev_out, axis_name)
         mb_idx = jnp.clip(t, 0, n_micro - 1)
         first_in = jax.lax.dynamic_index_in_dim(x_micro, mb_idx, keepdims=False)
@@ -114,7 +132,11 @@ def _fwd_save_ticks(stage_params, x_micro, fn: Callable, axis_name: str):
         x_saved = jax.lax.dynamic_update_index_in_dim(
             x_saved, jnp.where(valid, x_in, prev_save), slot, 0
         )
-        out = fn(stage_params, x_in)
+        if aux_size:
+            out, aux = fn(stage_params, x_in)
+            aux_acc = aux_acc + jnp.where(valid, aux, jnp.zeros_like(aux))
+        else:
+            out = fn(stage_params, x_in)
         out_idx = t - (n_stages - 1)
         ovalid = (stage == n_stages - 1) & (out_idx >= 0) & (out_idx < n_micro)
         write_idx = jnp.clip(out_idx, 0, n_micro - 1)
@@ -122,21 +144,24 @@ def _fwd_save_ticks(stage_params, x_micro, fn: Callable, axis_name: str):
         y_acc = jax.lax.dynamic_update_index_in_dim(
             y_acc, jnp.where(ovalid, out, prev_slot), write_idx, 0
         )
-        return (out, y_acc, x_saved), None
+        return (out, y_acc, aux_acc, x_saved), None
 
     out0 = jnp.zeros(mb_shape, x_micro.dtype)
     y0 = jnp.zeros((n_micro,) + mb_shape, x_micro.dtype)
+    aux0 = jnp.zeros((max(aux_size, 1),), jnp.float32)
     s0 = jnp.zeros((n_micro,) + mb_shape, x_micro.dtype)
-    (_, y, x_saved), _ = jax.lax.scan(
-        tick, (out0, y0, s0), jnp.arange(total_ticks)
+    (_, y, aux_acc, x_saved), _ = jax.lax.scan(
+        tick, (out0, y0, aux0, s0), jnp.arange(total_ticks)
     )
     y = jax.lax.psum(
         jnp.where(stage == n_stages - 1, y, jnp.zeros_like(y)), axis_name
     )
-    return y, x_saved
+    aux = aux_acc if aux_size else None
+    return y, aux, x_saved
 
 
-def _bwd_ticks(stage_params, x_saved, gy, fn: Callable, axis_name: str):
+def _bwd_ticks(stage_params, x_saved, gy, fn: Callable, axis_name: str,
+               aux_size: int = 0, g_aux=None):
     """The reverse pipeline: cotangents enter at the LAST stage and
     ppermute backwards; stage s handles microbatch m = t - (S-1-s) at tick
     t, recomputing its forward from the saved input via jax.vjp (1F1B
@@ -172,7 +197,14 @@ def _bwd_ticks(stage_params, x_saved, gy, fn: Callable, axis_name: str):
         )
         x_in = jax.lax.dynamic_index_in_dim(x_saved, slot, keepdims=False)
         _, vjp_fn = jax.vjp(fn, stage_params, x_in)
-        dp, dx = vjp_fn(g_in)
+        if aux_size:
+            # every valid tick's aux entered the sum with weight 1, so its
+            # cotangent is g_aux itself; invalid ticks' pollution of
+            # dparams is masked below and their dx never reaches a valid
+            # consumer (the reverse schedule masks by the same validity)
+            dp, dx = vjp_fn((g_in, g_aux))
+        else:
+            dp, dx = vjp_fn(g_in)
         dp_acc = jax.tree_util.tree_map(
             lambda acc, new: acc
             + jnp.where(valid, new.astype(jnp.float32), jnp.zeros_like(new, jnp.float32)),
@@ -235,6 +267,7 @@ def pipeline_apply(
     batch_axes: tuple = ("dp", "fsdp"),
     schedule: str = "gpipe",
     param_specs=None,
+    aux_size: int = 0,
 ):
     """Run ``fn(stage_params, x_mb)`` as a pipeline over ``axis_name``.
 
@@ -242,6 +275,14 @@ def pipeline_apply(
     per stage). x: [batch, ...] input. fn must map a microbatch through ONE
     stage, preserving shape (classic equal-width pipeline). Returns
     [batch, ...] outputs.
+
+    ``aux_size`` > 0: fn instead returns (x_mb_out, aux[aux_size] f32) —
+    summable side losses (MoE router lb/z). pipeline_apply then returns
+    (y, aux_total) where aux_total sums every (stage, microbatch)
+    contribution (psum over pp, mean over the data axes) — the caller
+    normalizes by layers x microbatches. Differentiable under both
+    schedules (the 1F1B backward feeds each tick's vjp the aux cotangent
+    directly).
 
     ``schedule``: "gpipe" (autodiff backward) or "1f1b" (explicit
     custom-VJP backward with stage-input-only residuals + recompute — the
@@ -268,67 +309,132 @@ def pipeline_apply(
     )
 
     if schedule == "1f1b":
-        out = _apply_1f1b(
+        res = _apply_1f1b(
             stage_params, x_micro, fn, mesh, axis_name, x_spec, param_specs,
-            data_axes,
+            data_axes, aux_size,
         )
     elif schedule == "gpipe":
         def body(params, xm):
             # strip the per-stage leading dim of 1
             local = jax.tree_util.tree_map(lambda a: a[0], params)
-            return _pipeline_local(local, xm, fn, axis_name)
+            res = _pipeline_local(local, xm, fn, axis_name, aux_size)
+            if not aux_size:
+                return res
+            y, aux = res
+            return y, aux[None]  # [1, k] row per (stage, data-shard)
 
-        out = shard_map(
+        aux_spec = P((axis_name,) + data_axes, None)
+        out_specs = (x_spec, aux_spec) if aux_size else x_spec
+        res = shard_map(
             body,
             mesh=mesh,
             in_specs=(param_specs, x_spec),
-            out_specs=x_spec,
+            out_specs=out_specs,
             check_vma=False,
         )(stage_params, x_micro)
     else:
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    if aux_size:
+        out, aux_rows = res
+        aux = _reduce_aux_rows(aux_rows, mesh, axis_name, data_axes, aux_size)
+        return out.reshape((batch,) + out.shape[2:]), aux
+    out = res
     return out.reshape((batch,) + out.shape[2:])
 
 
+def _reduce_aux_rows(aux_rows, mesh, axis_name, data_axes, aux_size):
+    """[S * n_data, k] stacked per-shard aux sums -> [k]: SUM over stages
+    (each stage holds distinct layers), MEAN over data shards (each routes
+    its own batch slice). Plain jnp outside the shard_map — autodiff
+    differentiates it natively, so the cotangent rows arriving back at
+    each shard already carry the right scaling."""
+    n_data = 1
+    for ax in data_axes:
+        n_data *= mesh.shape[ax]
+    rows = aux_rows.reshape(mesh.shape[axis_name], n_data, aux_size)
+    return rows.sum(axis=0).mean(axis=0)
+
+
 def _apply_1f1b(stage_params, x_micro, fn, mesh, axis_name, x_spec, param_specs,
-                data_axes):
+                data_axes, aux_size: int = 0):
     """custom-VJP wrapper: forward ticks save stage inputs; backward runs
-    the explicit reverse pipeline (_bwd_ticks)."""
+    the explicit reverse pipeline (_bwd_ticks). With ``aux_size`` the
+    primal output is (y, aux_rows[S*n_data, k]) — the caller reduces the
+    rows outside (sum over stages, mean over data shards), so the aux
+    cotangent arrives back per shard already correctly scaled and feeds
+    straight into every valid tick's vjp."""
     from jax import shard_map
 
     # saved stage inputs live stage-major: [S, M, mb, ...]
     saved_spec = P(axis_name, *x_spec)
+    aux_spec = P((axis_name,) + data_axes, None)
 
     def strip(params):
         return jax.tree_util.tree_map(lambda a: a[0], params)
 
     @jax.custom_vjp
     def run(params, xm):
-        y, _ = run_fwd(params, xm)
-        return y
+        out, _ = run_fwd(params, xm)
+        return out
 
     def run_fwd(params, xm):
         def body(p, x):
-            y, x_saved = _fwd_save_ticks(strip(p), x, fn, axis_name)
+            y, aux, x_saved = _fwd_save_ticks(
+                strip(p), x, fn, axis_name, aux_size
+            )
+            if aux_size:
+                return y, aux[None], x_saved[None]
             return y, x_saved[None]
 
+        if aux_size:
+            y, aux_rows, x_saved = shard_map(
+                body, mesh=mesh,
+                in_specs=(param_specs, x_spec),
+                out_specs=(x_spec, aux_spec, saved_spec),
+                check_vma=False,
+            )(params, xm)
+            return (y, aux_rows), (params, x_saved)
         y, x_saved = shard_map(
-            body,
-            mesh=mesh,
+            body, mesh=mesh,
             in_specs=(param_specs, x_spec),
             out_specs=(x_spec, saved_spec),
             check_vma=False,
         )(params, xm)
         return y, (params, x_saved)
 
-    def run_bwd(residuals, gy):
+    def run_bwd(residuals, g):
         params, x_saved = residuals
+        if aux_size:
+            gy, gaux_rows = g
 
-        def body(p, saved, g):
+            def body(p, saved, gy_in, gaux_row):
+                dparams, dx = _bwd_ticks(
+                    strip(p),
+                    jax.tree_util.tree_map(lambda a: a[0], saved),
+                    gy_in, fn, axis_name, aux_size,
+                    gaux_row[0].astype(jnp.float32),
+                )
+                for ax in data_axes:
+                    dparams = jax.tree_util.tree_map(
+                        lambda a, ax=ax: jax.lax.psum(a, ax), dparams
+                    )
+                return jax.tree_util.tree_map(lambda a: a[None], dparams), dx
+
+            dparams, dx = shard_map(
+                body, mesh=mesh,
+                in_specs=(param_specs, saved_spec, x_spec, aux_spec),
+                out_specs=(param_specs, x_spec),
+                check_vma=False,
+            )(params, x_saved, gy, gaux_rows)
+            return dparams, dx
+
+        gy = g
+
+        def body(p, saved, gy_in):
             dparams, dx = _bwd_ticks(
                 strip(p),
                 jax.tree_util.tree_map(lambda a: a[0], saved),
-                g, fn, axis_name,
+                gy_in, fn, axis_name,
             )
             # params replicate over the data axes, so each data shard holds
             # PARTIAL grads from its batch slice — sum them (the psum
