@@ -285,13 +285,10 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
     (ops/flash_attention.py), and ring attention rotates the SMALL
     [*, nkv, hd] blocks around the cp ring (g-times less ICI traffic per
     hop — parallel/ring_attention.py), keeping K/V traffic at the nkv
-    rate that is GQA's whole point at t>=4096. Only ulysses still
-    materializes repeated heads: its all-to-all re-shards the head dim
-    over cp, which requires equal head counts."""
-    groups = cfg.n_heads // cfg.n_kv_heads
-    if groups > 1 and cfg.attn_impl == "ulysses":
-        k = jnp.repeat(k, groups, axis=2)
-        v = jnp.repeat(v, groups, axis=2)
+    rate that is GQA's whole point at t>=4096. Ulysses is GQA-native when
+    n_kv % cp == 0 (K/V all-to-all on their own smaller head dim) and
+    falls back to an internal repeat otherwise — both handled inside
+    parallel/ulysses.py."""
     if cfg.attn_impl == "ring" and mesh is not None and cfg.cp_axis in mesh.axis_names:
         from tf_operator_tpu.parallel.ring_attention import ring_attention
 

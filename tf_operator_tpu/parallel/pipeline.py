@@ -41,7 +41,7 @@ from tf_operator_tpu.parallel.collectives import axis_index, axis_size, ring_shi
 
 
 def _pipeline_local(stage_params, x_micro, fn: Callable, axis_name: str,
-                    aux_size: int = 0):
+                    aux_size: int = 1):
     """Per-device body (inside shard_map).
 
     stage_params: this stage's params (leading dim of size 1 stripped).
@@ -70,12 +70,9 @@ def _pipeline_local(stage_params, x_micro, fn: Callable, axis_name: str,
         mb_idx = jnp.clip(t, 0, n_micro - 1)
         first_in = jax.lax.dynamic_index_in_dim(x_micro, mb_idx, keepdims=False)
         x_in = jnp.where(stage == 0, first_in, recv)
-        if aux_size:
-            out, aux = fn(stage_params, x_in)
-            live = (t - stage >= 0) & (t - stage < n_micro)
-            aux_acc = aux_acc + jnp.where(live, aux, jnp.zeros_like(aux))
-        else:
-            out = fn(stage_params, x_in)
+        out, aux = fn(stage_params, x_in)
+        live = (t - stage >= 0) & (t - stage < n_micro)
+        aux_acc = aux_acc + jnp.where(live, aux, jnp.zeros_like(aux))
         # Last stage writes its result for microbatch t-(S-1) when valid.
         out_idx = t - (n_stages - 1)
         valid = (stage == n_stages - 1) & (out_idx >= 0) & (out_idx < n_micro)
@@ -95,9 +92,7 @@ def _pipeline_local(stage_params, x_micro, fn: Callable, axis_name: str,
     y = jax.lax.psum(
         jnp.where(stage == n_stages - 1, y, jnp.zeros_like(y)), axis_name
     )
-    if aux_size:
-        return y, aux_acc
-    return y
+    return y, aux_acc
 
 
 def bubble_fraction(n_stages: int, n_micro: int) -> float:
@@ -108,7 +103,7 @@ def bubble_fraction(n_stages: int, n_micro: int) -> float:
 
 
 def _fwd_save_ticks(stage_params, x_micro, fn: Callable, axis_name: str,
-                    aux_size: int = 0):
+                    aux_size: int = 1):
     """_pipeline_local plus residual capture: returns (y, aux?, x_saved)
     where x_saved[m] is THIS stage's input for microbatch m — the only
     activation the 1F1B backward needs (it recomputes the rest)."""
@@ -132,11 +127,8 @@ def _fwd_save_ticks(stage_params, x_micro, fn: Callable, axis_name: str,
         x_saved = jax.lax.dynamic_update_index_in_dim(
             x_saved, jnp.where(valid, x_in, prev_save), slot, 0
         )
-        if aux_size:
-            out, aux = fn(stage_params, x_in)
-            aux_acc = aux_acc + jnp.where(valid, aux, jnp.zeros_like(aux))
-        else:
-            out = fn(stage_params, x_in)
+        out, aux = fn(stage_params, x_in)
+        aux_acc = aux_acc + jnp.where(valid, aux, jnp.zeros_like(aux))
         out_idx = t - (n_stages - 1)
         ovalid = (stage == n_stages - 1) & (out_idx >= 0) & (out_idx < n_micro)
         write_idx = jnp.clip(out_idx, 0, n_micro - 1)
@@ -156,12 +148,11 @@ def _fwd_save_ticks(stage_params, x_micro, fn: Callable, axis_name: str,
     y = jax.lax.psum(
         jnp.where(stage == n_stages - 1, y, jnp.zeros_like(y)), axis_name
     )
-    aux = aux_acc if aux_size else None
-    return y, aux, x_saved
+    return y, aux_acc, x_saved
 
 
 def _bwd_ticks(stage_params, x_saved, gy, fn: Callable, axis_name: str,
-               aux_size: int = 0, g_aux=None):
+               g_aux=None):
     """The reverse pipeline: cotangents enter at the LAST stage and
     ppermute backwards; stage s handles microbatch m = t - (S-1-s) at tick
     t, recomputing its forward from the saved input via jax.vjp (1F1B
@@ -197,14 +188,11 @@ def _bwd_ticks(stage_params, x_saved, gy, fn: Callable, axis_name: str,
         )
         x_in = jax.lax.dynamic_index_in_dim(x_saved, slot, keepdims=False)
         _, vjp_fn = jax.vjp(fn, stage_params, x_in)
-        if aux_size:
-            # every valid tick's aux entered the sum with weight 1, so its
-            # cotangent is g_aux itself; invalid ticks' pollution of
-            # dparams is masked below and their dx never reaches a valid
-            # consumer (the reverse schedule masks by the same validity)
-            dp, dx = vjp_fn((g_in, g_aux))
-        else:
-            dp, dx = vjp_fn(g_in)
+        # every valid tick's aux entered the sum with weight 1, so its
+        # cotangent is g_aux itself; invalid ticks' pollution of dparams
+        # is masked below and their dx never reaches a valid consumer
+        # (the reverse schedule masks by the same validity)
+        dp, dx = vjp_fn((g_in, g_aux))
         dp_acc = jax.tree_util.tree_map(
             lambda acc, new: acc
             + jnp.where(valid, new.astype(jnp.float32), jnp.zeros_like(new, jnp.float32)),
@@ -317,29 +305,35 @@ def pipeline_apply(
         def body(params, xm):
             # strip the per-stage leading dim of 1
             local = jax.tree_util.tree_map(lambda a: a[0], params)
-            res = _pipeline_local(local, xm, fn, axis_name, aux_size)
-            if not aux_size:
-                return res
-            y, aux = res
+            y, aux = _pipeline_local(
+                local, xm, _with_aux(fn, aux_size), axis_name, max(aux_size, 1)
+            )
             return y, aux[None]  # [1, k] row per (stage, data-shard)
 
         aux_spec = P((axis_name,) + data_axes, None)
-        out_specs = (x_spec, aux_spec) if aux_size else x_spec
         res = shard_map(
             body,
             mesh=mesh,
             in_specs=(param_specs, x_spec),
-            out_specs=out_specs,
+            out_specs=(x_spec, aux_spec),
             check_vma=False,
         )(stage_params, x_micro)
     else:
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    out, aux_rows = res
+    out = out.reshape((batch,) + out.shape[2:])
     if aux_size:
-        out, aux_rows = res
-        aux = _reduce_aux_rows(aux_rows, mesh, axis_name, data_axes, aux_size)
-        return out.reshape((batch,) + out.shape[2:]), aux
-    out = res
-    return out.reshape((batch,) + out.shape[2:])
+        return out, _reduce_aux_rows(aux_rows, mesh, axis_name, data_axes, aux_size)
+    return out
+
+
+def _with_aux(fn, aux_size: int):
+    """Uniform stage-body contract: fn always returns (out, aux_row). A
+    non-aux fn gets a zero dummy row so one code path serves both cases
+    (the [1]-vector costs nothing and its cotangent is discarded)."""
+    if aux_size:
+        return fn
+    return lambda p, x: (fn(p, x), jnp.zeros((1,), jnp.float32))
 
 
 def _reduce_aux_rows(aux_rows, mesh, axis_name, data_axes, aux_size):
@@ -358,13 +352,17 @@ def _reduce_aux_rows(aux_rows, mesh, axis_name, data_axes, aux_size):
 def _apply_1f1b(stage_params, x_micro, fn, mesh, axis_name, x_spec, param_specs,
                 data_axes, aux_size: int = 0):
     """custom-VJP wrapper: forward ticks save stage inputs; backward runs
-    the explicit reverse pipeline (_bwd_ticks). With ``aux_size`` the
-    primal output is (y, aux_rows[S*n_data, k]) — the caller reduces the
-    rows outside (sum over stages, mean over data shards), so the aux
-    cotangent arrives back per shard already correctly scaled and feeds
-    straight into every valid tick's vjp."""
+    the explicit reverse pipeline (_bwd_ticks). One body serves the aux
+    and non-aux cases (_with_aux dummy row): the primal output is always
+    (y, aux_rows[S*n_data, k]); the caller reduces the rows outside the
+    shard_map (sum over stages, mean over data shards), so aux cotangent
+    rows arrive back per shard already correctly scaled and feed straight
+    into every valid tick's vjp (a discarded dummy row's cotangent is
+    zeros)."""
     from jax import shard_map
 
+    fn2 = _with_aux(fn, aux_size)
+    k = max(aux_size, 1)
     # saved stage inputs live stage-major: [S, M, mb, ...]
     saved_spec = P(axis_name, *x_spec)
     aux_spec = P((axis_name,) + data_axes, None)
@@ -379,62 +377,27 @@ def _apply_1f1b(stage_params, x_micro, fn, mesh, axis_name, x_spec, param_specs,
 
     def run_fwd(params, xm):
         def body(p, x):
-            y, aux, x_saved = _fwd_save_ticks(
-                strip(p), x, fn, axis_name, aux_size
-            )
-            if aux_size:
-                return y, aux[None], x_saved[None]
-            return y, x_saved[None]
+            y, aux, x_saved = _fwd_save_ticks(strip(p), x, fn2, axis_name, k)
+            return y, aux[None], x_saved[None]
 
-        if aux_size:
-            y, aux_rows, x_saved = shard_map(
-                body, mesh=mesh,
-                in_specs=(param_specs, x_spec),
-                out_specs=(x_spec, aux_spec, saved_spec),
-                check_vma=False,
-            )(params, xm)
-            return (y, aux_rows), (params, x_saved)
-        y, x_saved = shard_map(
+        y, aux_rows, x_saved = shard_map(
             body, mesh=mesh,
             in_specs=(param_specs, x_spec),
-            out_specs=(x_spec, saved_spec),
+            out_specs=(x_spec, aux_spec, saved_spec),
             check_vma=False,
         )(params, xm)
-        return y, (params, x_saved)
+        return (y, aux_rows), (params, x_saved)
 
     def run_bwd(residuals, g):
         params, x_saved = residuals
-        if aux_size:
-            gy, gaux_rows = g
+        gy, gaux_rows = g
 
-            def body(p, saved, gy_in, gaux_row):
-                dparams, dx = _bwd_ticks(
-                    strip(p),
-                    jax.tree_util.tree_map(lambda a: a[0], saved),
-                    gy_in, fn, axis_name, aux_size,
-                    gaux_row[0].astype(jnp.float32),
-                )
-                for ax in data_axes:
-                    dparams = jax.tree_util.tree_map(
-                        lambda a, ax=ax: jax.lax.psum(a, ax), dparams
-                    )
-                return jax.tree_util.tree_map(lambda a: a[None], dparams), dx
-
-            dparams, dx = shard_map(
-                body, mesh=mesh,
-                in_specs=(param_specs, saved_spec, x_spec, aux_spec),
-                out_specs=(param_specs, x_spec),
-                check_vma=False,
-            )(params, x_saved, gy, gaux_rows)
-            return dparams, dx
-
-        gy = g
-
-        def body(p, saved, gy_in):
+        def body(p, saved, gy_in, gaux_row):
             dparams, dx = _bwd_ticks(
                 strip(p),
                 jax.tree_util.tree_map(lambda a: a[0], saved),
-                gy_in, fn, axis_name,
+                gy_in, fn2, axis_name,
+                gaux_row[0].astype(jnp.float32),
             )
             # params replicate over the data axes, so each data shard holds
             # PARTIAL grads from its batch slice — sum them (the psum
@@ -446,12 +409,11 @@ def _apply_1f1b(stage_params, x_micro, fn, mesh, axis_name, x_spec, param_specs,
             return jax.tree_util.tree_map(lambda a: a[None], dparams), dx
 
         dparams, dx = shard_map(
-            body,
-            mesh=mesh,
-            in_specs=(param_specs, saved_spec, x_spec),
+            body, mesh=mesh,
+            in_specs=(param_specs, saved_spec, x_spec, aux_spec),
             out_specs=(param_specs, x_spec),
             check_vma=False,
-        )(params, x_saved, gy)
+        )(params, x_saved, gy, gaux_rows)
         return dparams, dx
 
     run.defvjp(run_fwd, run_bwd)

@@ -33,7 +33,9 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from tf_operator_tpu.parallel.collectives import axis_size
-from tf_operator_tpu.parallel.ring_attention import reference_attention
+# the GQA-native dense oracle (grouped einsum) — parallel/ring_attention's
+# reference is MHA-only and would reject mismatched local head counts
+from tf_operator_tpu.ops.flash_attention import reference_attention
 
 
 def _ulysses_local(q, k, v, axis_name: str, causal: bool,
@@ -88,6 +90,7 @@ def ulysses_attention(
 
     cp = mesh.shape[axis_name]
     b, t, h, d = q.shape
+    h_kv = k.shape[2]
     if t % cp:
         raise ValueError(f"seq length {t} must divide by {axis_name}={cp}")
     if h % cp:
@@ -95,6 +98,22 @@ def ulysses_attention(
             f"ulysses needs heads % cp == 0 (got {h} heads, cp={cp}) — "
             "use attn_impl='ring' for head counts the cp axis cannot split"
         )
+    if k.shape[2] != v.shape[2]:
+        raise ValueError(f"k/v head mismatch: {k.shape[2]} vs {v.shape[2]}")
+    if h % h_kv:
+        raise ValueError(
+            f"q heads {h} not a multiple of kv heads {h_kv}"
+        )
+    # GQA (r3): when the kv heads divide cp, K/V all-to-all on their OWN
+    # (smaller) head dim — each shard gets n_kv/cp kv heads + full seq,
+    # moving group-times less data per all-to-all, and the local
+    # attention runs GQA-native (contiguous head blocks keep query head
+    # j -> kv head j//group aligned per shard since h/cp = g * n_kv/cp).
+    # Indivisible kv counts (n_kv < cp) materialize the repeat as before.
+    if h_kv != h and h_kv % cp:
+        g = h // h_kv
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
     spec = P(batch_axes, axis_name, None, None)
     fn = shard_map(
         partial(_ulysses_local, axis_name=axis_name, causal=causal,
