@@ -763,7 +763,7 @@ CONFIG_OVERRIDE_FIELDS = frozenset(
         "vocab", "d_model", "n_layers", "n_heads", "n_kv_heads", "d_ff",
         "max_seq", "causal", "remat", "fused_xent", "n_experts",
         "moe_top_k", "capacity_factor", "moe_aux_weight", "moe_zloss_weight",
-        "pp_microbatches",
+        "pp_microbatches", "pp_schedule",
     }
 )
 

@@ -41,7 +41,7 @@ from tf_operator_tpu.parallel.collectives import axis_index, axis_size, ring_shi
 
 
 def _pipeline_local(stage_params, x_micro, fn: Callable, axis_name: str,
-                    aux_size: int = 1):
+                    aux_size: int):
     """Per-device body (inside shard_map).
 
     stage_params: this stage's params (leading dim of size 1 stripped).
@@ -49,12 +49,13 @@ def _pipeline_local(stage_params, x_micro, fn: Callable, axis_name: str,
     Returns [n_micro, mb, ...] outputs (valid on the last stage; psum'ed so
     every stage returns the same array).
 
-    ``aux_size`` > 0: fn returns (out, aux[aux_size] f32) — summable side
-    losses (e.g. MoE router lb/z losses). Each stage accumulates its VALID
-    ticks' aux and returns the LOCAL sum (no collective: the caller stacks
-    per-shard rows through the shard_map output and reduces outside it,
-    where autodiff needs no collective-transpose reasoning). Also
-    returned: (y, aux_local)."""
+    fn ALWAYS returns (out, aux[aux_size] f32) — plain stage bodies are
+    wrapped by _with_aux at the call sites (a zero dummy row). aux rows
+    are summable side losses (MoE router lb/z): each stage accumulates
+    its VALID ticks' aux and returns the LOCAL sum (no collective — the
+    caller stacks per-shard rows through the shard_map output and reduces
+    outside it, where autodiff needs no collective-transpose reasoning).
+    Returns (y, aux_local)."""
     n_stages = axis_size(axis_name)
     stage = axis_index(axis_name)
     n_micro = x_micro.shape[0]
@@ -84,7 +85,7 @@ def _pipeline_local(stage_params, x_micro, fn: Callable, axis_name: str,
 
     out0 = jnp.zeros(mb_shape, x_micro.dtype)
     y0 = jnp.zeros((n_micro,) + mb_shape, x_micro.dtype)
-    aux0 = jnp.zeros((max(aux_size, 1),), jnp.float32)
+    aux0 = jnp.zeros((aux_size,), jnp.float32)
     (_, y, aux_acc), _ = jax.lax.scan(
         tick, (out0, y0, aux0), jnp.arange(total_ticks)
     )
@@ -103,10 +104,12 @@ def bubble_fraction(n_stages: int, n_micro: int) -> float:
 
 
 def _fwd_save_ticks(stage_params, x_micro, fn: Callable, axis_name: str,
-                    aux_size: int = 1):
-    """_pipeline_local plus residual capture: returns (y, aux?, x_saved)
+                    aux_size: int):
+    """_pipeline_local plus residual capture: returns (y, aux, x_saved)
     where x_saved[m] is THIS stage's input for microbatch m — the only
-    activation the 1F1B backward needs (it recomputes the rest)."""
+    activation the 1F1B backward needs (it recomputes the rest). Same fn
+    contract as _pipeline_local: ALWAYS (out, aux) — wrap plain bodies
+    with _with_aux."""
     n_stages = axis_size(axis_name)
     stage = axis_index(axis_name)
     n_micro = x_micro.shape[0]
@@ -140,7 +143,7 @@ def _fwd_save_ticks(stage_params, x_micro, fn: Callable, axis_name: str,
 
     out0 = jnp.zeros(mb_shape, x_micro.dtype)
     y0 = jnp.zeros((n_micro,) + mb_shape, x_micro.dtype)
-    aux0 = jnp.zeros((max(aux_size, 1),), jnp.float32)
+    aux0 = jnp.zeros((aux_size,), jnp.float32)
     s0 = jnp.zeros((n_micro,) + mb_shape, x_micro.dtype)
     (_, y, aux_acc, x_saved), _ = jax.lax.scan(
         tick, (out0, y0, aux0, s0), jnp.arange(total_ticks)
@@ -151,8 +154,7 @@ def _fwd_save_ticks(stage_params, x_micro, fn: Callable, axis_name: str,
     return y, aux_acc, x_saved
 
 
-def _bwd_ticks(stage_params, x_saved, gy, fn: Callable, axis_name: str,
-               g_aux=None):
+def _bwd_ticks(stage_params, x_saved, gy, fn: Callable, axis_name: str, g_aux):
     """The reverse pipeline: cotangents enter at the LAST stage and
     ppermute backwards; stage s handles microbatch m = t - (S-1-s) at tick
     t, recomputing its forward from the saved input via jax.vjp (1F1B

@@ -19,6 +19,12 @@ at t/cp blocks and has no head-divisibility constraint, at the cost of
 cp-1 sequential ppermute steps. The transformer exposes both:
 ``attn_impl="ring" | "ulysses"``.
 
+GQA (r3): with n_kv % cp == 0, K/V all-to-all on their OWN head dim —
+each device then holds h/cp query heads and n_kv/cp kv heads, and
+``attn_fn`` MUST accept GQA-shaped inputs (the flash kernel and the
+grouped dense reference both do). n_kv < cp falls back to an internal
+repeat, restoring equal head counts.
+
 Layout contract matches ring_attention: global [batch, seq, heads,
 head_dim], sequence sharded over ``axis_name`` on entry and exit.
 """
@@ -81,11 +87,13 @@ def ulysses_attention(
     """Exact self-attention with sequence sharded over ``axis_name`` via
     head/sequence all-to-all re-sharding (DeepSpeed-Ulysses recipe).
 
-    q/k/v: global [batch, seq, heads, head_dim]; seq % cp == 0 and
-    heads % cp == 0 required. ``attn_fn(q, k, v)`` runs the per-device
-    full-sequence attention (defaults to the dense reference; pass the
-    Pallas flash kernel for long context — it sees ordinary unsharded
-    shapes)."""
+    q/k/v: global [batch, seq, heads, head_dim] (k/v may carry
+    n_kv < heads GQA heads); seq % cp == 0 and heads % cp == 0 required.
+    ``attn_fn(q, k, v)`` runs the per-device full-sequence attention and
+    must handle GQA-shaped k/v when n_kv % cp == 0 (its local inputs are
+    then h/cp query vs n_kv/cp kv heads — the flash kernel and the
+    grouped dense default both do; an MHA-only attn_fn is safe only for
+    equal-head models)."""
     from jax import shard_map
 
     cp = mesh.shape[axis_name]
