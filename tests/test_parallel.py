@@ -558,7 +558,7 @@ def test_ulysses_transformer_trains():
 def test_ulysses_gqa_matches_repeat_oracle(h_kv):
     """Ulysses GQA (r3): n_kv % cp == 0 re-shards K/V on their own head
     dim (group-times less all-to-all traffic, contiguous-block alignment
-    keeps q head j -> kv head j//g per shard); n_kv < cp falls back to an
+    keeps q head j -> kv head j//g per shard); n_kv % cp != 0 falls back to an
     internal repeat. Both must equal the repeat formulation, fwd + grads."""
     from tf_operator_tpu.parallel.ulysses import ulysses_attention
     from tf_operator_tpu.parallel.ring_attention import reference_attention

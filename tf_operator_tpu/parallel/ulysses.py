@@ -22,8 +22,8 @@ cp-1 sequential ppermute steps. The transformer exposes both:
 GQA (r3): with n_kv % cp == 0, K/V all-to-all on their OWN head dim —
 each device then holds h/cp query heads and n_kv/cp kv heads, and
 ``attn_fn`` MUST accept GQA-shaped inputs (the flash kernel and the
-grouped dense reference both do). n_kv < cp falls back to an internal
-repeat, restoring equal head counts.
+grouped dense reference both do). n_kv % cp != 0 falls back to an internal
+repeat, restoring equal head counts (condition: n_kv % cp != 0 — e.g. n_kv=6, cp=4 also falls back).
 
 Layout contract matches ring_attention: global [batch, seq, heads,
 head_dim], sequence sharded over ``axis_name`` on entry and exit.
@@ -117,7 +117,7 @@ def ulysses_attention(
     # moving group-times less data per all-to-all, and the local
     # attention runs GQA-native (contiguous head blocks keep query head
     # j -> kv head j//group aligned per shard since h/cp = g * n_kv/cp).
-    # Indivisible kv counts (n_kv < cp) materialize the repeat as before.
+    # Indivisible kv counts (n_kv % cp != 0) materialize the repeat as before.
     if h_kv != h and h_kv % cp:
         g = h // h_kv
         k = jnp.repeat(k, g, axis=2)

@@ -1,0 +1,112 @@
+"""Async-checkpoint overlap probe (r3, VERDICT #9 done-bar).
+
+Measures the steady-state step time of a gpt-small training loop WITH
+periodic async orbax checkpointing vs WITHOUT, through the exact
+production path (WorkloadCheckpointer.run_loop — the same warmup/timed
+protocol the workloads use). Async saves pay only the device->host
+transfer inside save(); serialization overlaps subsequent steps, so the
+with-checkpointing step time should be ~equal to the clean loop
+(delta ~0 at bench scale). ``--sync`` additionally measures the r2
+blocking behavior for contrast.
+
+    python -m tools.ckpt_bench [--steps 30] [--every 5] [--sync]
+
+Prints one JSON line per mode plus the overhead summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+
+def run_mode(mode: str, steps: int, every: int, tmpdir: str) -> float:
+    import jax
+
+    from tf_operator_tpu.models.transformer import (
+        init_transformer,
+        lm_loss,
+        preset,
+        transformer_logical_axes,
+    )
+    from tf_operator_tpu.parallel import build_mesh
+    from tf_operator_tpu.train.checkpoint import CheckpointManager, WorkloadCheckpointer
+    from tf_operator_tpu.train.trainer import Trainer, TrainerConfig
+
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    cfg = preset(
+        "gpt-small" if on_tpu else "tiny",
+        max_seq=512 if on_tpu else 64,
+        attn_impl="flash" if on_tpu else "dense",
+    )
+    mesh = build_mesh({"dp": jax.device_count()})
+    trainer = Trainer(
+        mesh,
+        loss_fn=lambda p, tok, e: lm_loss(p, tok, cfg, mesh=mesh),
+        init_fn=lambda k: init_transformer(k, cfg),
+        logical_axes=transformer_logical_axes(cfg),
+        config=TrainerConfig(optimizer="adamw", learning_rate=1e-4),
+    )
+    batch = 32 if on_tpu else jax.device_count()
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (batch, cfg.max_seq), 0, cfg.vocab),
+        trainer.batch_sharding,
+    )
+    wl = {} if mode == "none" else {
+        "checkpoint_dir": tmpdir, "checkpoint_every": every,
+    }
+    ckpt = WorkloadCheckpointer(wl)
+    if mode == "sync":
+        # swap the manager for a blocking one (the r2 default); close the
+        # async manager first or its background machinery leaks alongside
+        ckpt.manager.close()
+        ckpt.manager = CheckpointManager(tmpdir, async_save=False)
+    _, loss, timed, step_s = ckpt.run_loop(
+        trainer, jax.random.PRNGKey(0), tokens, steps
+    )
+    print(json.dumps({
+        "metric": f"ckpt_{mode}_step_s", "value": round(step_s, 5),
+        "timed_steps": timed, "loss": round(float(loss), 4),
+        "checkpoint_every": every if mode != "none" else 0,
+    }), flush=True)
+    return step_s
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--every", type=int, default=5)
+    p.add_argument("--sync", action="store_true",
+                   help="also measure the blocking (async_save=False) mode")
+    args = p.parse_args(argv)
+
+    from tf_operator_tpu.train.compile_cache import enable as enable_compile_cache
+
+    enable_compile_cache()
+    base = tempfile.mkdtemp(prefix="ckpt-bench-")
+    try:
+        clean = run_mode("none", args.steps, args.every, os.path.join(base, "a"))
+        asyn = run_mode("async", args.steps, args.every, os.path.join(base, "b"))
+        out = {
+            "metric": "async_ckpt_overhead_pct",
+            "value": round(100 * (asyn / clean - 1), 2),
+        }
+        if args.sync:
+            syn = run_mode("sync", args.steps, args.every, os.path.join(base, "c"))
+            out["sync_overhead_pct"] = round(100 * (syn / clean - 1), 2)
+        print(json.dumps(out))
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

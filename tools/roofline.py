@@ -394,10 +394,16 @@ def conv_roofline(batch: int, image: int, fwd_only: bool = False) -> int:
     return 0
 
 
-def measure_attn(b, t, h, d, causal, impl, iters=20):
+def measure_attn(b, t, h, d, causal, impl, iters=20, h_kv=None,
+                 repeat_from=None):
     """Sustained ms/step for one attention config, fwd+bwd (training path),
     chained on-device like the other probes (tiny data-dependent weight
-    perturbation defeats loop hoisting)."""
+    perturbation defeats loop hoisting). ``h_kv`` < h measures the
+    GQA-native path (k/v carry h_kv heads end to end); ``repeat_from``
+    instead measures the pre-r3 layout — k/v allocated at repeat_from
+    heads and jnp.repeat-expanded to h INSIDE the differentiated function,
+    so the broadcast copy and its backward group-sum are part of the
+    measurement."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -409,16 +415,26 @@ def measure_attn(b, t, h, d, causal, impl, iters=20):
     )
 
     keys = jax.random.split(jax.random.PRNGKey(0), 3)
-    q, k, v = (
-        jax.random.normal(kk, (b, t, h, d)).astype(jnp.bfloat16) for kk in keys
-    )
+    kv_heads = repeat_from or h_kv or h
+    q = jax.random.normal(keys[0], (b, t, h, d)).astype(jnp.bfloat16)
+    k = jax.random.normal(keys[1], (b, t, kv_heads, d)).astype(jnp.bfloat16)
+    v = jax.random.normal(keys[2], (b, t, kv_heads, d)).astype(jnp.bfloat16)
 
     if impl == "flash":
-        def attn(q_, k_, v_):
+        def base_attn(q_, k_, v_):
             return flash_attention(q_, k_, v_, causal=causal, force_kernel=True)
     else:
-        def attn(q_, k_, v_):
+        def base_attn(q_, k_, v_):
             return reference_attention(q_, k_, v_, causal=causal)
+    if repeat_from:
+        g_rep = h // repeat_from
+
+        def attn(q_, k_, v_):
+            return base_attn(
+                q_, jnp.repeat(k_, g_rep, axis=2), jnp.repeat(v_, g_rep, axis=2)
+            )
+    else:
+        attn = base_attn
 
     def head(q_, k_, v_):
         return 0.5 * jnp.sum(jnp.square(attn(q_, k_, v_).astype(jnp.float32)))
@@ -436,6 +452,33 @@ def measure_attn(b, t, h, d, causal, impl, iters=20):
     t0 = time.perf_counter()
     float(run(jnp.float32(0.0)))
     return (time.perf_counter() - t0) / iters * 1e3  # ms per fwd+bwd
+
+
+def gqa_roofline(d: int = 128) -> int:
+    """GQA A/B (r3, VERDICT #2 done-bar): flash fwd+bwd at a GQA shape —
+    native h_kv-head K/V vs the pre-r3 materialized repeat (k/v expanded
+    to h heads before the kernel). Reports the time ratio and the K/V
+    activation bytes each layout keeps resident per layer."""
+    sys.path.insert(0, _REPO_ROOT)
+    from tf_operator_tpu.train.compile_cache import enable as enable_compile_cache
+
+    enable_compile_cache()
+    import jax
+
+    dev = jax.devices()[0]
+    h, h_kv = 16, 2
+    print(f"# GQA flash fwd+bwd, causal, bf16, hd={d}, {h}q/{h_kv}kv heads on "
+          f"{getattr(dev, 'device_kind', dev.platform)}")
+    print(f"# {'b':>3} {'t':>6}  {'repeat ms':>10} {'native ms':>10} "
+          f"{'speedup':>8} {'kv MiB rep':>10} {'kv MiB nat':>10}")
+    for b, t in ((4, 2048), (2, 4096), (1, 8192)):
+        # pre-r3 layout: h_kv-head K/V repeat-expanded INSIDE the step
+        rep = measure_attn(b, t, h, d, True, "flash", repeat_from=h_kv)
+        nat = measure_attn(b, t, h, d, True, "flash", h_kv=h_kv)
+        mib = lambda heads: 2 * b * t * heads * d * 2 / 2**20
+        print(f"  {b:>3} {t:>6}  {rep:>10.2f} {nat:>10.2f} "
+              f"{rep / nat:>7.2f}x {mib(h):>10.1f} {mib(h_kv):>10.1f}")
+    return 0
 
 
 def attn_roofline(d: int = 64) -> int:
@@ -460,7 +503,7 @@ def attn_roofline(d: int = 64) -> int:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("--mode", choices=("matmul", "conv", "attn"), default="matmul")
+    p.add_argument("--mode", choices=("matmul", "conv", "attn", "gqa"), default="matmul")
     p.add_argument("--m", type=int, default=16384)
     p.add_argument("--k", type=int, default=768)
     p.add_argument("--n", type=int, default=3072)
@@ -468,7 +511,8 @@ def main(argv=None) -> int:
     p.add_argument("--batch", type=int, default=128)
     p.add_argument("--image", type=int, default=224)
     p.add_argument("--fwd-only", action="store_true")
-    p.add_argument("--d", type=int, default=64, help="head_dim for --mode attn")
+    p.add_argument("--d", type=int, default=None,
+                   help="head_dim (default: 64 for --mode attn, 128 for gqa)")
     args = p.parse_args(argv)
 
     import jax
@@ -476,7 +520,9 @@ def main(argv=None) -> int:
     if args.mode == "conv":
         return conv_roofline(args.batch, args.image, args.fwd_only)
     if args.mode == "attn":
-        return attn_roofline(args.d)
+        return attn_roofline(args.d or 64)
+    if args.mode == "gqa":
+        return gqa_roofline(args.d or 128)
 
     dev = jax.devices()[0]
     tflops = measure(args.m, args.k, args.n, args.iters)
