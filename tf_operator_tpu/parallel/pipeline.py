@@ -25,6 +25,12 @@ neighbor ICI links. Two schedules:
   (S-1)/(M+S-1) = 37.5%) while 1F1B fits M=8 (27%); see
   ``bubble_fraction``.
 
+Future surface: the interleaved (virtual-stage) schedule — v chunks per
+device shrink the bubble to ~(S-1)/(vM+S-1) at the price of v-times the
+ppermute volume and activation saves. The tick/table machinery here
+extends to it (a statically built [tick, device] -> (chunk, microbatch)
+schedule with the same uniform ring shift); not yet implemented.
+
 The reference has no pipeline support at all (SURVEY.md §2.3); this is new
 TPU-native surface.
 """
