@@ -439,12 +439,15 @@ def flash_attention(
 
     GQA-native (r3): k/v may carry h_kv < h heads (h % h_kv == 0, the
     llama2-70b 64q/8kv shape). Neither path materializes repeated K/V —
-    the kernel's k/v BlockSpecs index head hi//g (each K/V block loads
-    once per group from HBM and serves g query heads from VMEM), the
-    dk/dv grid accumulates the group into one scratch, and the dense
-    fallback contracts through a grouped einsum. That preserves exactly
-    the activation-bandwidth/HBM advantage GQA exists to buy at long
-    context.
+    the kernel's k/v BlockSpecs index head hi//g, the dk/dv grid
+    accumulates the group into one scratch, and the dense fallback
+    contracts through a grouped einsum. That removes the repeated-K/V
+    TENSOR (its allocation, its write, and the repeat op's read) from
+    the model. Known headroom: within the kernel, K/V blocks still
+    stream per QUERY head (the grid's kb dim is innermost, so the
+    (hi//g, kb) block isn't VMEM-resident across hi) — folding the
+    group into the q tile ([g*block_q, d] q rows per K/V block load)
+    would cut in-kernel K/V HBM reads by g; future kernel work.
 
     Dispatches to the Pallas kernel on TPU when shapes tile cleanly
     (t divisible by both block sizes, blocks 8-aligned, d a lane-friendly
