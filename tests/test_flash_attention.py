@@ -158,6 +158,32 @@ def test_gqa_kernel_forward_matches_oracle(causal, h, h_kv):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("g", [3, 5, 12])
+def test_gqa_default_blocks_stay_kernel_eligible(g):
+    """Non-power-of-two group sizes: the default q-block target 512//g is
+    not 8-aligned, and _pick_block's candidate scan steps by 8 from the
+    target — an unaligned start would only visit unaligned candidates, so
+    the gate would silently drop to the dense fallback at EVERY t (the
+    regression this pins). The target must round down to 8-aligned
+    first."""
+    from tf_operator_tpu.ops.flash_attention import _pick_block, _use_kernel
+
+    t = 2048
+    bq = _pick_block(t, max(8, 512 // g))
+    assert bq % 8 == 0 and t % bq == 0, (g, bq)
+    assert _use_kernel(t, 128, bq, _pick_block(t, 1024), True)
+
+
+def test_gqa_g3_kernel_matches_oracle():
+    """End-to-end through flash_attention's DEFAULT block selection for a
+    g=3 shape (t divisible only by 8-aligned blocks): the kernel must
+    engage and agree with the repeat oracle."""
+    q, k, v = _gqa_qkv(jax.random.PRNGKey(7), b=1, t=64, h=6, h_kv=2, d=32)
+    want = _repeat_oracle(q, k, v, True)
+    got = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_gqa_kernel_grads_match_oracle(causal):
     """dk/dv must accumulate ALL query heads of a group (the fused

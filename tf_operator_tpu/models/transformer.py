@@ -280,8 +280,10 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
     """q: [b,t,nh,hd]; k/v: [b,t,nkv,hd].
 
     GQA (nkv < nh) runs NATIVE on the dense, flash AND ring paths: no
-    [b,t,nh,hd] K/V tensor ever exists — the flash kernel indexes k/v
-    head hi//group per query head, the dense path groups the einsum
+    [b,t,nh,hd] K/V tensor ever exists — the flash kernel grids over K/V
+    heads with the group folded into its q tile ([g·block_q, hd] rows
+    per K/V block load, so in-kernel K/V HBM traffic scales with nkv),
+    the dense path groups the einsum
     (ops/flash_attention.py), and ring attention rotates the SMALL
     [*, nkv, hd] blocks around the cp ring (g-times less ICI traffic per
     hop — parallel/ring_attention.py), keeping K/V traffic at the nkv

@@ -9,13 +9,23 @@ pass recomputes P from the saved logsumexp instead of storing it (the
 standard flash recipe), trading FLOPs for HBM exactly as TPUs want.
 
 Kernel structure: the contraction dimension is a GRID dimension, not a
-VMEM-resident loop — grid (b, h, nq, nk) for forward/dq and (b, h, nk, nq)
-for dk/dv, with the running (m, l, acc) state in VMEM scratch that
-persists across the innermost grid dimension (TPU grids iterate the last
-dimension sequentially, which is what makes carried scratch sound). VMEM
-holds only one block of each operand at a time, so sequence length is
-bounded by HBM, not by the ~16 MB VMEM budget. Causal grids skip
-above-diagonal blocks with `pl.when` (zero compute, still one grid step).
+VMEM-resident loop — grid (b, h_kv, nq, nk) for forward/dq and
+(b, h_kv, nk, nq) for dk/dv, with the running (m, l, acc) state in VMEM
+scratch that persists across the innermost grid dimension (TPU grids
+iterate the last dimension sequentially, which is what makes carried
+scratch sound). VMEM holds only one block of each operand at a time, so
+sequence length is bounded by HBM, not by the ~16 MB VMEM budget. Causal
+grids skip above-diagonal blocks with `pl.when` (zero compute, still one
+grid step).
+
+GQA is folded into the q tile: the grid's head dimension iterates K/V
+heads, and each step's q tile is [g·block_q, d] — the g query heads of
+the group stacked on the sublane dim (g = h // h_kv, 1 for classic MHA).
+One K/V block load therefore serves every query head of its group, so
+in-kernel K/V HBM reads scale with h_kv, not h — the whole point of GQA
+(llama2-70b's 64q/8kv shape reads 8x less K/V than a repeat would), and
+the s = q·kᵀ contraction sees a g·block_q-row tile, which feeds the MXU
+better than g separate block_q-row tiles.
 
 Layout: q/k/v are [b, t, h, d] (the model layout), transposed to
 [b, h, t, d] so seq is the sublane dim and head_dim the lane dim. The
@@ -94,23 +104,60 @@ def reference_attention(q, k, v, causal: bool = False):
 
 
 def _causal_mask(s, qi, kb, block_q, block_k):
-    qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-    kpos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    """Causal mask for an s tile whose rows may stack g group members:
+    row r is sequence position qi*block_q + (r % block_q) — members share
+    the same q sequence block, so position repeats per member (for g=1,
+    r % block_q == r and this is the classic tile mask)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) % block_q
+    qpos = qi * block_q + rows
+    kpos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     return jnp.where(kpos <= qpos, s, NEG_INF)
 
 
+def _gqa_specs(g, block_q, block_k, d, q_grid_dim):
+    """BlockSpec factories shared by all three folded-GQA grids.
+
+    Query-side tiles are (1, g, block_q, last) — the g query heads of kv
+    head ``hk`` (contiguous in the h dim) stacked over one sequence
+    block. ``q_grid_dim`` says which innermost grid dim walks q blocks:
+    2 for the (b, h_kv, nq, nk) fwd/dq grids, 3 for the (b, h_kv, nk, nq)
+    dk/dv grid; the other innermost dim walks K/V blocks. Returns
+    (q_spec_factory, kv_spec)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if q_grid_dim == 2:
+        q_idx = lambda bi, hk, qi, kb: (bi, hk, qi, 0)
+        kv_idx = lambda bi, hk, qi, kb: (bi, hk, kb, 0)
+    else:
+        q_idx = lambda bi, hk, ki, qb: (bi, hk, qb, 0)
+        kv_idx = lambda bi, hk, ki, qb: (bi, hk, ki, 0)
+
+    def q_spec(shape_last):
+        return pl.BlockSpec(
+            (1, g, block_q, shape_last), q_idx, memory_space=pltpu.VMEM
+        )
+
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_k, d), kv_idx, memory_space=pltpu.VMEM
+    )
+    return q_spec, kv_spec
+
+
 # ---------------------------------------------------------------------------
-# forward kernel — grid (b, h, nq, nk), carry (m, l, acc) in scratch
+# forward kernel — grid (b, h_kv, nq, nk), carry (m, l, acc) in scratch
 # ---------------------------------------------------------------------------
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, causal, block_q, block_k, scale):
+                *, causal, block_q, block_k, scale, g):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(2)
     kb = pl.program_id(3)
     nkb = pl.num_programs(3)
+    d = q_ref.shape[-1]
+    rows = g * block_q
 
     @pl.when(kb == 0)
     def _init():
@@ -118,17 +165,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:, :] = jnp.zeros_like(l_scr)
         acc_scr[:, :] = jnp.zeros_like(acc_scr)
 
-    # Above-diagonal blocks contribute nothing under causal masking.
+    # Above-diagonal blocks contribute nothing under causal masking (every
+    # group member in the tile shares the same q sequence block).
     live = (kb * block_k <= qi * block_q + block_q - 1) if causal else True
 
     @pl.when(live)
     def _step():
-        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale  # [bq, d]
-        k = k_ref[0, 0, :, :].astype(jnp.float32)          # [bk, d]
+        q = q_ref[0].reshape(rows, d).astype(jnp.float32) * scale  # [g·bq, d]
+        k = k_ref[0, 0, :, :].astype(jnp.float32)                  # [bk, d]
         v = v_ref[0, 0, :, :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [bq, bk]
+        )  # [g·bq, bk]
         if causal:
             s = _causal_mask(s, qi, kb, block_q, block_k)
         m_prev = m_scr[:, 0]
@@ -144,10 +192,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     @pl.when(kb == nkb - 1)
     def _finish():
         l = l_scr[:, 0]
-        o_ref[0, 0, :, :] = (acc_scr[:, :] / l[:, None]).astype(o_ref.dtype)
-        lse_ref[0, 0, :, :] = jnp.broadcast_to(
-            (m_scr[:, 0] + jnp.log(l))[:, None], lse_ref.shape[2:]
-        )
+        o_ref[0] = (acc_scr[:, :] / l[:, None]).reshape(g, block_q, d).astype(o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(
+            (m_scr[:, 0] + jnp.log(l))[:, None], (rows, LSE_LANES)
+        ).reshape(g, block_q, LSE_LANES)
 
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
@@ -156,7 +204,7 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
 
     b, t, h, d = q.shape
     h_kv = k.shape[2]
-    g = h // h_kv  # GQA group: g query heads read each k/v head's block
+    g = h // h_kv  # GQA group: g query heads fold into one q tile
     scale = d**-0.5
     # [b, t, h, d] -> [b, h, t, d]: sequence in the sublane dim, head_dim in
     # lanes — the MXU-native layout for the q·kᵀ and p·v contractions.
@@ -164,38 +212,25 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
 
+    q_by_qi, kv_by_kb = _gqa_specs(g, block_q, block_k, d, q_grid_dim=2)
+
     kernel = functools.partial(
-        _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k, scale=scale
+        _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k,
+        scale=scale, g=g,
     )
     o, lse = pl.pallas_call(
         kernel,
-        grid=(b, h, t // block_q, t // block_k),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, kb: (bi, hi, qi, 0),
-                         memory_space=pltpu.VMEM),
-            # GQA: query head hi reads k/v head hi//g — the [b,t,h_kv,d]
-            # tensors are never repeated to h query heads anywhere
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, kb: (bi, hi // g, kb, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, kb: (bi, hi // g, kb, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, kb: (bi, hi, qi, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q, LSE_LANES), lambda bi, hi, qi, kb: (bi, hi, qi, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        grid=(b, h_kv, t // block_q, t // block_k),
+        in_specs=[q_by_qi(d), kv_by_kb, kv_by_kb],
+        out_specs=[q_by_qi(d), q_by_qi(LSE_LANES)],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, t, LSE_LANES), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, LSE_LANES), jnp.float32),  # running max m
-            pltpu.VMEM((block_q, LSE_LANES), jnp.float32),  # running sum l
-            pltpu.VMEM((block_q, d), jnp.float32),          # output accumulator
+            pltpu.VMEM((g * block_q, LSE_LANES), jnp.float32),  # running max m
+            pltpu.VMEM((g * block_q, LSE_LANES), jnp.float32),  # running sum l
+            pltpu.VMEM((g * block_q, d), jnp.float32),          # output accumulator
         ],
         interpret=interpret,
     )(qt, kt, vt)
@@ -208,12 +243,14 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-                   *, causal, block_q, block_k, scale):
+                   *, causal, block_q, block_k, scale, g):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(2)
     kb = pl.program_id(3)
     nkb = pl.num_programs(3)
+    d = q_ref.shape[-1]
+    rows = g * block_q
 
     @pl.when(kb == 0)
     def _init():
@@ -223,10 +260,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
 
     @pl.when(live)
     def _step():
-        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
-        do = do_ref[0, 0, :, :].astype(jnp.float32)
-        lse = lse_ref[0, 0, :, :1]      # [bq, 1] (value replicated on lanes)
-        delta = delta_ref[0, 0, :, :1]  # [bq, 1]
+        q = q_ref[0].reshape(rows, d).astype(jnp.float32) * scale
+        do = do_ref[0].reshape(rows, d).astype(jnp.float32)
+        lse = lse_ref[0].reshape(rows, LSE_LANES)[:, :1]      # value replicated on lanes
+        delta = delta_ref[0].reshape(rows, LSE_LANES)[:, :1]
         k = k_ref[0, 0, :, :].astype(jnp.float32)
         v = v_ref[0, 0, :, :].astype(jnp.float32)
         s = jax.lax.dot_general(
@@ -245,25 +282,27 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
 
     @pl.when(kb == nkb - 1)
     def _finish():
-        dq_ref[0, 0, :, :] = (dq_scr[:, :] * scale).astype(dq_ref.dtype)
+        dq_ref[0] = (dq_scr[:, :] * scale).reshape(g, block_q, d).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                    dk_scr, dv_scr, *, causal, block_q, block_k, scale, nqb):
-    """dk/dv for one k/v head. GQA: grid dim 1 iterates K/V heads and the
-    innermost dim fuses (group member, q block) as j = gi*nqb + qb, so the
-    [block_k, d] scratch accumulates every query head of the group before
-    the single output write — the output block (bi, kv_head, ki) is
-    revisited only on consecutive grid steps, which is what makes carried
-    scratch and one final write sound on TPU."""
+                    dk_scr, dv_scr, *, causal, block_q, block_k, scale, g):
+    """dk/dv for one k/v head. The q tile stacks the g query heads of the
+    group ([g·block_q, d]), so the row contraction in p·ᵀdo and ds·ᵀq sums
+    over every group member in one matmul — the [block_k, d] scratch
+    accumulates across the innermost q-block grid dim and writes once at
+    the end (the output block (bi, hk, ki) is revisited only on
+    consecutive grid steps, which is what makes carried scratch and one
+    final write sound on TPU)."""
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(2)
-    j = pl.program_id(3)
-    qb = j % nqb
-    nj = pl.num_programs(3)
+    qb = pl.program_id(3)
+    nqb = pl.num_programs(3)
+    d = q_ref.shape[-1]
+    rows = g * block_q
 
-    @pl.when(j == 0)
+    @pl.when(qb == 0)
     def _init():
         dk_scr[:, :] = jnp.zeros_like(dk_scr)
         dv_scr[:, :] = jnp.zeros_like(dv_scr)
@@ -275,28 +314,28 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
     def _step():
         k = k_ref[0, 0, :, :].astype(jnp.float32)
         v = v_ref[0, 0, :, :].astype(jnp.float32)
-        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
-        do = do_ref[0, 0, :, :].astype(jnp.float32)
-        lse = lse_ref[0, 0, :, :1]
-        delta = delta_ref[0, 0, :, :1]
+        q = q_ref[0].reshape(rows, d).astype(jnp.float32) * scale
+        do = do_ref[0].reshape(rows, d).astype(jnp.float32)
+        lse = lse_ref[0].reshape(rows, LSE_LANES)[:, :1]
+        delta = delta_ref[0].reshape(rows, LSE_LANES)[:, :1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [bq, bk]
+        )  # [g·bq, bk]
         if causal:
             s = _causal_mask(s, qb, ki, block_q, block_k)
-        p = jnp.exp(s - lse)  # [bq, bk]
+        p = jnp.exp(s - lse)  # [g·bq, bk]
         dv_scr[:, :] = dv_scr[:, :] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [bk, d]
+        )  # [bk, d] — row contraction sums the whole group
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [bq, bk]
+        )  # [g·bq, bk]
         ds = p * (dp - delta)
         dk_scr[:, :] = dk_scr[:, :] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bk, d]
 
-    @pl.when(j == nj - 1)
+    @pl.when(qb == nqb - 1)
     def _finish():
         dk_ref[0, 0, :, :] = dk_scr[:, :].astype(dk_ref.dtype)  # q pre-scaled
         dv_ref[0, 0, :, :] = dv_scr[:, :].astype(dv_ref.dtype)
@@ -310,7 +349,6 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g):
     b, h, t, d = qt.shape
     h_kv = kt.shape[1]
     grp = h // h_kv  # GQA group size (1 = classic MHA)
-    nqb = t // block_q
     scale = d**-0.5
     do = g.transpose(0, 2, 1, 3)
     # delta_i = rowsum(do_i * o_i) — the softmax-jacobian correction term —
@@ -318,62 +356,39 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g):
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (b, h, t, LSE_LANES))
 
-    # ---- dq: grid (b, h, nq, nk); k/v heads indexed hi // grp -----------
-    def q_by_qi(shape_last):
-        return pl.BlockSpec(
-            (1, 1, block_q, shape_last),
-            lambda bi, hi, qi, kb: (bi, hi, qi, 0),
-            memory_space=pltpu.VMEM,
-        )
-
-    kv_by_kb = pl.BlockSpec(
-        (1, 1, block_k, d),
-        lambda bi, hi, qi, kb: (bi, hi // grp, kb, 0),
-        memory_space=pltpu.VMEM,
-    )
+    # ---- dq: grid (b, h_kv, nq, nk); q tiles fold the group ------------
+    q_by_qi, kv_by_kb = _gqa_specs(grp, block_q, block_k, d, q_grid_dim=2)
 
     dq_kernel = functools.partial(
-        _bwd_dq_kernel, causal=causal, block_q=block_q, block_k=block_k, scale=scale
+        _bwd_dq_kernel, causal=causal, block_q=block_q, block_k=block_k,
+        scale=scale, g=grp,
     )
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(b, h, t // block_q, t // block_k),
+        grid=(b, h_kv, t // block_q, t // block_k),
         in_specs=[q_by_qi(d), kv_by_kb, kv_by_kb, q_by_qi(d),
                   q_by_qi(LSE_LANES), q_by_qi(LSE_LANES)],
         out_specs=q_by_qi(d),
         out_shape=jax.ShapeDtypeStruct((b, h, t, d), qt.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((grp * block_q, d), jnp.float32)],
         interpret=interpret,
     )(qt, kt, vt, do, lse, delta)
 
-    # ---- dk/dv: grid (b, h_kv, nk, grp*nqb) -----------------------------
-    # Grid dim 1 iterates K/V heads; the innermost dim fuses (group
-    # member gi, q block qb) as j = gi*nqb + qb so all grp query heads
-    # accumulate into one [block_k, d] scratch before the single output
-    # write (see _bwd_dkv_kernel). Query-side tensors select head
-    # hk*grp + j//nqb and sequence block j%nqb.
-    def q_by_group(shape_last):
-        return pl.BlockSpec(
-            (1, 1, block_q, shape_last),
-            lambda bi, hk, ki, j: (bi, hk * grp + j // nqb, j % nqb, 0),
-            memory_space=pltpu.VMEM,
-        )
-
-    kv_by_ki = pl.BlockSpec(
-        (1, 1, block_k, d),
-        lambda bi, hk, ki, j: (bi, hk, ki, 0),
-        memory_space=pltpu.VMEM,
-    )
+    # ---- dk/dv: grid (b, h_kv, nk, nq) ---------------------------------
+    # Query-side tiles fold the group ([grp·block_q, d] rows), so one K/V
+    # block load serves all grp query heads and the scratch accumulates
+    # the whole group per grid step (see _bwd_dkv_kernel).
+    q_by_qb, kv_by_ki = _gqa_specs(grp, block_q, block_k, d, q_grid_dim=3)
 
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, causal=causal, block_q=block_q, block_k=block_k,
-        scale=scale, nqb=nqb,
+        scale=scale, g=grp,
     )
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(b, h_kv, t // block_k, grp * nqb),
-        in_specs=[q_by_group(d), kv_by_ki, kv_by_ki, q_by_group(d),
-                  q_by_group(LSE_LANES), q_by_group(LSE_LANES)],
+        grid=(b, h_kv, t // block_k, t // block_q),
+        in_specs=[q_by_qb(d), kv_by_ki, kv_by_ki, q_by_qb(d),
+                  q_by_qb(LSE_LANES), q_by_qb(LSE_LANES)],
         out_specs=[kv_by_ki, kv_by_ki],
         out_shape=[
             jax.ShapeDtypeStruct((b, h_kv, t, d), kt.dtype),
@@ -415,8 +430,13 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def _pick_block(t: int, target: int) -> int:
     """Largest 8-aligned divisor of t not exceeding target (grid overhead
     falls with block size: 512/1024 blocks measured 2.2x faster than
-    128/128 at t=2048 on v5e). Returns target when none divides — the
-    _use_kernel gate then routes to the dense fallback."""
+    128/128 at t=2048 on v5e). A misaligned target is first rounded down
+    to a multiple of 8 — the candidate scan steps by 8, so an unaligned
+    start would only ever visit unaligned candidates and the gate would
+    silently reject the kernel (the g=3/5/12 GQA default targets hit
+    exactly this). Returns target when none divides — the _use_kernel
+    gate then routes to the dense fallback."""
+    target = max(8, target - target % 8)
     if t <= target:
         return t
     for cand in range(target, 7, -8):
@@ -439,20 +459,18 @@ def flash_attention(
 
     GQA-native (r3): k/v may carry h_kv < h heads (h % h_kv == 0, the
     llama2-70b 64q/8kv shape). Neither path materializes repeated K/V —
-    the kernel's k/v BlockSpecs index head hi//g, the dk/dv grid
-    accumulates the group into one scratch, and the dense fallback
-    contracts through a grouped einsum. That removes the repeated-K/V
-    TENSOR (its allocation, its write, and the repeat op's read) from
-    the model. Known headroom: within the kernel, K/V blocks still
-    stream per QUERY head (the grid's kb dim is innermost, so the
-    (hi//g, kb) block isn't VMEM-resident across hi) — folding the
-    group into the q tile ([g*block_q, d] q rows per K/V block load)
-    would cut in-kernel K/V HBM reads by g; future kernel work.
+    the kernel folds the g = h/h_kv group members into its q tile
+    ([g·block_q, d] rows per K/V block load, grid over K/V heads), so
+    both the repeated-K/V TENSOR and the in-kernel K/V HBM re-reads per
+    query head are gone: K/V traffic scales with h_kv. The dense
+    fallback contracts through a grouped einsum. The default q-block
+    target shrinks by g so the folded tile stays within the measured
+    512-row sweet spot (and VMEM).
 
     Dispatches to the Pallas kernel on TPU when shapes tile cleanly
     (t divisible by both block sizes, blocks 8-aligned, d a lane-friendly
     multiple — see _use_kernel); otherwise the jnp reference (identical
-    math). Blocks default to the largest divisors of t up to 512 (q) /
+    math). Blocks default to the largest divisors of t up to 512/g (q) /
     1024 (k) — measured optimum on v5e. ``interpret=True`` forces the
     kernel through the Pallas interpreter — the CPU test path for kernel
     logic. ``force_kernel`` overrides the dispatch heuristic both ways
@@ -465,7 +483,15 @@ def flash_attention(
         )
     if k.shape[2] != v.shape[2]:
         raise ValueError(f"k/v head mismatch: {k.shape[2]} vs {v.shape[2]}")
-    block_q = _pick_block(t, block_q or 512)
+    grp = q.shape[2] // k.shape[2]
+    # Folded tiles and scratch scale as grp*block_q rows, so the q-block
+    # target is bounded by the group: default lands on the measured
+    # 512-row sweet spot, and an EXPLICIT block_q is clamped to 1024 rows
+    # — without the clamp a block size that compiled fine pre-fold (per-
+    # query-head tiles) would blow VMEM at large g instead of running.
+    block_q = _pick_block(
+        t, max(8, min(block_q or (512 // grp), 1024 // grp))
+    )
     block_k = _pick_block(t, block_k or 1024)
     use = _use_kernel(t, d, block_q, block_k, bool(interpret))
     if force_kernel is not None:
