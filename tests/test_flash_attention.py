@@ -158,6 +158,88 @@ def test_gqa_kernel_forward_matches_oracle(causal, h, h_kv):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
 
 
+# ---------------------------------------------------------------------------
+# (o, lse) entry — the blockwise/ring composition surface
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("h,h_kv", [(4, 4), (6, 2)])
+def test_lse_entry_matches_reference(causal, h, h_kv):
+    from tf_operator_tpu.ops.flash_attention import (
+        flash_attention_lse, reference_attention_lse)
+
+    q, k, v = _gqa_qkv(jax.random.PRNGKey(8), b=2, t=64, h=h, h_kv=h_kv, d=32)
+    ow, lw = reference_attention_lse(q, k, v, causal=causal)
+    ok_, lk = flash_attention_lse(q, k, v, causal=causal, block_q=32,
+                                  block_k=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(ok_), np.asarray(ow),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(lk), np.asarray(lw),
+                               atol=2e-5, rtol=2e-5)
+    # lse must also equal the repeat-oracle's logsumexp head-for-head
+    # (pins the hk*g+gi head ordering of both layouts)
+    _, l_rep = reference_attention_lse(
+        q, jnp.repeat(k, h // h_kv, axis=2), jnp.repeat(v, h // h_kv, axis=2),
+        causal=causal)
+    np.testing.assert_allclose(np.asarray(lw), np.asarray(l_rep),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_lse_entry_grads_through_lse(causal):
+    """Gradients THROUGH the lse output: the lse cotangent folds into the
+    backward kernels' delta term (ds = p·(dp − (delta − g))) — the
+    contract ring attention's merge relies on. Tolerances are f32-rounding
+    scale: both paths sit ~1e-2 relative from the f64 truth on the
+    squared-sum scalar (measured; the kernel is marginally CLOSER), so
+    kernel-vs-reference comparisons cannot be tighter."""
+    from tf_operator_tpu.ops.flash_attention import (
+        flash_attention_lse, reference_attention_lse)
+
+    q, k, v = _gqa_qkv(jax.random.PRNGKey(9), b=1, t=64, h=4, h_kv=2, d=32)
+
+    def scal(r):
+        return jnp.sum(r[0] ** 2) + jnp.sum(jnp.tanh(r[1]))
+
+    def loss_ref(q, k, v):
+        return scal(reference_attention_lse(q, k, v, causal=causal))
+
+    def loss_ker(q, k, v):
+        return scal(flash_attention_lse(q, k, v, causal=causal, block_q=32,
+                                        block_k=32, interpret=True))
+
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    got = jax.grad(loss_ker, argnums=(0, 1, 2))(q, k, v)
+    for name, w, g in zip("qkv", want, got):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   atol=1e-2, rtol=1e-2,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_lse_only_grads_are_tight():
+    """With ONLY the lse cotangent live (o unused), the delta-adjustment
+    path is isolated and f32 agreement is tight — separates 'lse path
+    correct' from the looser o-path rounding above."""
+    from tf_operator_tpu.ops.flash_attention import (
+        flash_attention_lse, reference_attention_lse)
+
+    q, k, v = _gqa_qkv(jax.random.PRNGKey(10), b=1, t=64, h=4, h_kv=4, d=32)
+
+    def loss(fn, **kw):
+        def f(q, k, v):
+            return jnp.sum(jnp.tanh(fn(q, k, v, causal=False, **kw)[1]))
+        return f
+
+    want = jax.grad(loss(reference_attention_lse), argnums=(0, 1, 2))(q, k, v)
+    got = jax.grad(loss(flash_attention_lse, block_q=32, block_k=32,
+                        interpret=True), argnums=(0, 1, 2))(q, k, v)
+    for name, w, g in zip("qkv", want, got):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name} mismatch")
+
+
 @pytest.mark.parametrize("g", [3, 5, 12])
 def test_gqa_default_blocks_stay_kernel_eligible(g):
     """Non-power-of-two group sizes: the default q-block target 512//g is

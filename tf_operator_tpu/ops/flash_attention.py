@@ -78,29 +78,9 @@ def reference_attention(q, k, v, causal: bool = False):
     off-TPU fallback (same contract as the kernel path). GQA-native: k/v
     may carry fewer heads than q (h % h_kv == 0); the grouped einsum
     keeps the group dim in the contraction instead of materializing
-    repeated K/V heads."""
-    b, tq, hq, d = q.shape
-    h_kv = k.shape[2]
-    scale = d**-0.5
-    if hq != h_kv:
-        g = hq // h_kv
-        q5 = q.reshape(b, tq, h_kv, g, d)
-        s = jnp.einsum(
-            "bqhgd,bkhd->bhgqk", q5, k, preferred_element_type=jnp.float32
-        ) * scale
-    else:
-        s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-        ) * scale
-    if causal:
-        tk = k.shape[1]
-        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
-        s = jnp.where(mask.reshape((1,) * (s.ndim - 2) + mask.shape), s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    if hq != h_kv:
-        out = jnp.einsum("bhgqk,bkhd->bqhgd", p, v)
-        return out.reshape(b, tq, hq, d)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    repeated K/V heads. One implementation: softmax(s) == exp(s − lse),
+    so this is the lse variant with the lse dropped."""
+    return reference_attention_lse(q, k, v, causal=causal)[0]
 
 
 def _causal_mask(s, qi, kb, block_q, block_k):
@@ -341,7 +321,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dv_ref[0, 0, :, :] = dv_scr[:, :].astype(dv_ref.dtype)
 
 
-def _bwd(causal, block_q, block_k, interpret, residuals, g):
+def _bwd(causal, block_q, block_k, interpret, residuals, g, dlse=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -354,6 +334,12 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g):
     # delta_i = rowsum(do_i * o_i) — the softmax-jacobian correction term —
     # lane-broadcast to the same [b,h,t,LSE_LANES] layout as lse.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        # lse cotangent (the flash_attention_lse entry): ∂lse_i/∂s_ij = p_ij,
+        # so the s gradient gains p_ij·g_i — algebraically ds = p·(dp −
+        # (delta − g)), i.e. the whole lse-gradient path folds into the
+        # delta term and the kernels run UNCHANGED. dlse arrives [b, t, h].
+        delta = delta - dlse.astype(jnp.float32).transpose(0, 2, 1)
     delta = jnp.broadcast_to(delta[..., None], (b, h, t, LSE_LANES))
 
     # ---- dq: grid (b, h_kv, nq, nk); q tiles fold the group ------------
@@ -427,6 +413,88 @@ def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _lse_public(lse):
+    """Internal [b, h, t, LSE_LANES] (value replicated on lanes) → the
+    public [b, t, h] f32 row-logsumexp."""
+    return lse[..., 0].transpose(0, 2, 1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, causal, block_q, block_k, interpret):
+    out, res = _fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out, _lse_public(res[4])
+
+
+def _flash_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, res = _fwd(q, k, v, causal, block_q, block_k, interpret)
+    return (out, _lse_public(res[4])), res
+
+
+def _flash_lse_bwd(causal, block_q, block_k, interpret, residuals, cts):
+    do, dlse = cts
+    return _bwd(causal, block_q, block_k, interpret, residuals, do, dlse=dlse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def reference_attention_lse(q, k, v, causal: bool = False):
+    """Dense (o, lse) — the fallback for flash_attention_lse. lse is the
+    row logsumexp of the scaled (masked) scores, [b, t, h] f32; rows with
+    every key masked get lse = NEG_INF (their o is the uniform-softmax
+    artifact over NEG_INF scores, weight 0 in any downstream merge)."""
+    b, tq, hq, d = q.shape
+    h_kv = k.shape[2]
+    scale = d**-0.5
+    if hq != h_kv:
+        g = hq // h_kv
+        q5 = q.reshape(b, tq, h_kv, g, d)
+        s = jnp.einsum(
+            "bqhgd,bkhd->bhgqk", q5, k, preferred_element_type=jnp.float32
+        ) * scale
+    else:
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) * scale
+    if causal:
+        tk = k.shape[1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        s = jnp.where(mask.reshape((1,) * (s.ndim - 2) + mask.shape), s, NEG_INF)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)  # [b,h,q] or [b,h_kv,g,q]
+    p = jnp.exp(s - lse[..., None]).astype(q.dtype)
+    if hq != h_kv:
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", p, v).reshape(b, tq, hq, d)
+        lse = lse.reshape(b, h_kv * (hq // h_kv), tq)  # head hi = hk·g + gi
+    else:
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return out, lse.transpose(0, 2, 1)
+
+
+def flash_attention_lse(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
+    force_kernel: Optional[bool] = None,
+):
+    """flash_attention returning ``(o, lse)`` with lse [b, t, h] f32 —
+    the row logsumexp of scaled scores. This is the composition surface
+    for blockwise/distributed attention (ring attention's per-hop local
+    compute): normalized partial outputs merge exactly across key blocks
+    via their lse. Gradients are exact THROUGH lse — the lse cotangent
+    folds into the backward kernels' delta term (see _bwd), so callers
+    may use lse in differentiable math. Same dispatch gate and fallback
+    as flash_attention."""
+    use, block_q, block_k = _dispatch(q, k, v, block_q, block_k, interpret,
+                                      force_kernel)
+    if not use:
+        return reference_attention_lse(q, k, v, causal=causal)
+    return _flash_lse(q, k, v, causal, block_q, block_k, bool(interpret))
+
+
 def _pick_block(t: int, target: int) -> int:
     """Largest 8-aligned divisor of t not exceeding target (grid overhead
     falls with block size: 512/1024 blocks measured 2.2x faster than
@@ -443,6 +511,41 @@ def _pick_block(t: int, target: int) -> int:
         if t % cand == 0:
             return cand
     return target
+
+
+def _dispatch(q, k, v, block_q, block_k, interpret, force_kernel):
+    """Shared entry logic: validate head shapes, pick group-bounded
+    blocks, and decide kernel-vs-fallback. Returns (use, block_q,
+    block_k)."""
+    t, d = q.shape[1], q.shape[3]
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"q heads {q.shape[2]} not a multiple of kv heads {k.shape[2]}"
+        )
+    if k.shape[2] != v.shape[2]:
+        raise ValueError(f"k/v head mismatch: {k.shape[2]} vs {v.shape[2]}")
+    grp = q.shape[2] // k.shape[2]
+    # Folded tiles and scratch scale as grp*block_q rows, so the q-block
+    # target is bounded by the group: default lands on the measured
+    # 512-row sweet spot, and an EXPLICIT block_q is clamped to 1024 rows
+    # — without the clamp a block size that compiled fine pre-fold (per-
+    # query-head tiles) would blow VMEM at large g instead of running.
+    block_q = _pick_block(
+        t, max(8, min(block_q or (512 // grp), 1024 // grp))
+    )
+    block_k = _pick_block(t, block_k or 1024)
+    use = _use_kernel(t, d, block_q, block_k, bool(interpret))
+    if force_kernel is not None:
+        # HARD constraints still bind (exact tiling; a compiled Pallas TPU
+        # kernel cannot run on CPU — off-TPU only the interpreter engages).
+        # The d % 128 lane HEURISTIC is deliberately overridden: the kernel
+        # is correct at any d (Mosaic pads the lane dim) — d % 128 is a
+        # performance gate, and measuring shapes on the other side of it
+        # is exactly what this hook is for (tools/roofline --mode attn).
+        use = force_kernel and not (
+            t % block_q or t % block_k or block_q % 8 or block_k % 8
+        ) and (bool(interpret) or jax.default_backend() == "tpu")
+    return use, block_q, block_k
 
 
 def flash_attention(
@@ -476,34 +579,8 @@ def flash_attention(
     logic. ``force_kernel`` overrides the dispatch heuristic both ways
     (tiling constraints still apply) — the measurement hook behind the
     tools/roofline --mode attn crossover table."""
-    t, d = q.shape[1], q.shape[3]
-    if q.shape[2] % k.shape[2]:
-        raise ValueError(
-            f"q heads {q.shape[2]} not a multiple of kv heads {k.shape[2]}"
-        )
-    if k.shape[2] != v.shape[2]:
-        raise ValueError(f"k/v head mismatch: {k.shape[2]} vs {v.shape[2]}")
-    grp = q.shape[2] // k.shape[2]
-    # Folded tiles and scratch scale as grp*block_q rows, so the q-block
-    # target is bounded by the group: default lands on the measured
-    # 512-row sweet spot, and an EXPLICIT block_q is clamped to 1024 rows
-    # — without the clamp a block size that compiled fine pre-fold (per-
-    # query-head tiles) would blow VMEM at large g instead of running.
-    block_q = _pick_block(
-        t, max(8, min(block_q or (512 // grp), 1024 // grp))
-    )
-    block_k = _pick_block(t, block_k or 1024)
-    use = _use_kernel(t, d, block_q, block_k, bool(interpret))
-    if force_kernel is not None:
-        # HARD constraints still bind (exact tiling; a compiled Pallas TPU
-        # kernel cannot run on CPU — off-TPU only the interpreter engages).
-        # The d % 128 lane HEURISTIC is deliberately overridden: the kernel
-        # is correct at any d (Mosaic pads the lane dim) — d % 128 is a
-        # performance gate, and measuring shapes on the other side of it
-        # is exactly what this hook is for (tools/roofline --mode attn).
-        use = force_kernel and not (
-            t % block_q or t % block_k or block_q % 8 or block_k % 8
-        ) and (bool(interpret) or jax.default_backend() == "tpu")
+    use, block_q, block_k = _dispatch(q, k, v, block_q, block_k, interpret,
+                                      force_kernel)
     if not use:
         return reference_attention(q, k, v, causal=causal)
     return _flash(q, k, v, causal, block_q, block_k, bool(interpret))

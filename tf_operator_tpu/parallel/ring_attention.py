@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from tf_operator_tpu.ops.flash_attention import flash_attention_lse
 from tf_operator_tpu.parallel.collectives import axis_index, axis_size, ring_shift
 
 
@@ -104,6 +105,81 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
     return jnp.einsum("bhgqd->bqhgd", o).reshape(b, t_local, h, d).astype(q.dtype)
 
 
+def _merge_partials(o, m, d_acc, o_j, lse_j):
+    """Fold one normalized partial attention (o_j, lse_j) into the
+    running lse-weighted merge. Carry: o = Σ o_i·exp(lse_i − m) (f32),
+    d_acc = Σ exp(lse_i − m), m = max lse so far. The standard exact
+    softmax decomposition: each block's normalized output re-weighted by
+    its share of the global mass. −inf lse (fully-masked hop) folds in
+    with weight 0."""
+    m_new = jnp.maximum(m, lse_j)
+    # exp(-inf - -inf) would be nan: a -inf running max (nothing folded
+    # yet) or a -inf hop must contribute factor 0, not nan.
+    alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_new))
+    beta = jnp.where(jnp.isneginf(lse_j), 0.0, jnp.exp(lse_j - m_new))
+    o_new = o * alpha[..., None] + o_j.astype(jnp.float32) * beta[..., None]
+    return o_new, m_new, d_acc * alpha + beta
+
+
+def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool,
+                                interpret: bool):
+    """Per-device body, flash-backed (r3): each hop's local attention runs
+    through ``flash_attention_lse`` — the Pallas kernel when shapes tile
+    (O(t_local·d) HBM per hop), the dense lse fallback otherwise — and
+    hops merge EXACTLY via their logsumexp (_merge_partials). Versus the
+    einsum body this never materializes the [t_local, t_local] score
+    tensor on the kernel path, which is what caps per-device chunk sizes
+    at long context (at t_local=8k, b=1, h=12 the per-hop score tensor
+    alone is 3 GiB f32 — the kernel path needs none of it). Gradients are
+    exact: flash_attention_lse's VJP includes the lse path, and autodiff
+    composes it through the merge + scan + ppermute.
+
+    Hop schedule: the diagonal hop (local K/V, causal mask iff causal)
+    runs first, outside the scan; the scan then rotates K/V and folds
+    each arriving block — under causal masking a block from a LATER
+    device contributes nothing and is skipped via lax.cond (its flash
+    call never runs; ICI rotation still proceeds)."""
+    n = axis_size(axis_name)
+    my_idx = axis_index(axis_name)
+    b, t_local, h, d = q.shape
+
+    attend = partial(flash_attention_lse, interpret=interpret)
+
+    # Hop 0: the device's own K/V block — the only hop that can need a
+    # causal mask (q and k positions share the same global block).
+    o0, lse0 = attend(q, k, v, causal=causal)
+    o_acc = jnp.zeros((b, t_local, h, d), jnp.float32)
+    m0 = jnp.full((b, t_local, h), -jnp.inf, jnp.float32)
+    o_acc, m_acc, d_acc = _merge_partials(
+        o_acc, m0, jnp.zeros((b, t_local, h), jnp.float32), o0, lse0)
+
+    def scan_body(carry, step):
+        o_m_d, k_blk, v_blk = carry
+        k_blk = ring_shift(k_blk, axis_name)
+        v_blk = ring_shift(v_blk, axis_name)
+        src = (my_idx - step) % n  # device whose block just arrived
+
+        def live(_):
+            return attend(q, k_blk, v_blk, causal=False)
+
+        def skip(_):
+            return (jnp.zeros((b, t_local, h, d), q.dtype),
+                    jnp.full((b, t_local, h), -jnp.inf, jnp.float32))
+
+        if causal:
+            # src > my_idx ⇒ every key position is in the future of every
+            # local query position ⇒ the hop is fully masked.
+            o_j, lse_j = jax.lax.cond(src < my_idx, live, skip, None)
+        else:
+            o_j, lse_j = live(None)
+        return ((_merge_partials(*o_m_d, o_j, lse_j), k_blk, v_blk), None)
+
+    ((o_acc, m_acc, d_acc), _, _), _ = jax.lax.scan(
+        scan_body, ((o_acc, m_acc, d_acc), k, v), jnp.arange(1, n))
+    o = o_acc / jnp.where(d_acc == 0.0, 1.0, d_acc)[..., None]
+    return o.astype(q.dtype)
+
+
 def ring_attention(
     q,
     k,
@@ -112,12 +188,20 @@ def ring_attention(
     axis_name: str = "cp",
     causal: bool = False,
     batch_axes: Optional[tuple] = None,
+    impl: Optional[str] = None,
+    interpret: bool = False,
 ):
     """Exact self-attention with sequence sharded over ``axis_name``.
 
     q/k/v: global arrays [batch, seq, heads, head_dim] sharing one seq
     length divisible by the cp axis size. ``batch_axes``: mesh axes the
     batch dim is sharded over (kept sharded through the computation).
+
+    ``impl``: "flash" (default — per-hop local attention through
+    flash_attention_lse, Pallas kernel on TPU when shapes tile, dense
+    lse fallback otherwise) or "einsum" (the blockwise online-softmax
+    oracle body, materializes per-hop scores). ``interpret`` forces the
+    flash path's kernels through the Pallas interpreter (CPU tests).
     """
     from jax import shard_map
 
@@ -136,9 +220,16 @@ def ring_attention(
         )
     if q.shape[1] % cp:
         raise ValueError(f"seq length {q.shape[1]} must divide by {axis_name}={cp}")
+    if impl not in (None, "flash", "einsum"):
+        raise ValueError(f"unknown ring attention impl {impl!r}")
+    if impl == "einsum":
+        body = partial(_ring_attention_local, axis_name=axis_name, causal=causal)
+    else:
+        body = partial(_ring_attention_local_flash, axis_name=axis_name,
+                       causal=causal, interpret=interpret)
     spec = P(batch_axes, axis_name, None, None)
     fn = shard_map(
-        partial(_ring_attention_local, axis_name=axis_name, causal=causal),
+        body,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
