@@ -83,9 +83,15 @@ class TransformerConfig:
     # over the tp axis; _layer psums its row-parallel matmuls). 0 = no
     # pipeline. pp_schedule: "1f1b" (explicit backward, stage-input-only
     # residuals — the memory-disciplined default) | "gpipe" (autodiff).
+    # pp_chunks (r3): virtual stages per device — the INTERLEAVED 1F1B
+    # schedule. n_layers splits into pp*pp_chunks chunks (chunk j on
+    # device j mod pp, model order); bubble shrinks from
+    # (pp-1)/(M+pp-1) to (pp-1)/(M*v+pp-1). Requires pp_schedule="1f1b"
+    # and pp_microbatches % pp == 0.
     pp_microbatches: int = 0
     pp_axis: str = "pp"
     pp_schedule: str = "1f1b"
+    pp_chunks: int = 1
 
     def __post_init__(self):
         if self.n_experts and not (1 <= self.moe_top_k <= self.n_experts):
@@ -553,9 +559,11 @@ def transformer_hidden_pp(params, tokens, cfg: TransformerConfig, mesh):
             "ep axis) or MoE runs non-pipelined with ep"
         )
     n_stages = mesh.shape[cfg.pp_axis]
-    if cfg.n_layers % n_stages:
+    n_virtual = n_stages * cfg.pp_chunks
+    if cfg.n_layers % n_virtual:
         raise ValueError(
-            f"n_layers={cfg.n_layers} not divisible by pp={n_stages}"
+            f"n_layers={cfg.n_layers} not divisible by pp*pp_chunks="
+            f"{n_virtual}"
         )
     tp_axis = None
     if "tp" in mesh.axis_names and mesh.shape["tp"] > 1:
@@ -596,9 +604,9 @@ def transformer_hidden_pp(params, tokens, cfg: TransformerConfig, mesh):
             out, _ = jax.lax.scan(body, xb, stage_layers)
             return out
 
-    per_stage = cfg.n_layers // n_stages
+    per_stage = cfg.n_layers // n_virtual
     stage_params = jax.tree_util.tree_map(
-        lambda a: a.reshape((n_stages, per_stage) + a.shape[1:]),
+        lambda a: a.reshape((n_virtual, per_stage) + a.shape[1:]),
         params["layers"],
     )
     res = pipeline_apply(
@@ -606,6 +614,7 @@ def transformer_hidden_pp(params, tokens, cfg: TransformerConfig, mesh):
         schedule=cfg.pp_schedule,
         param_specs=_pp_param_specs(cfg, tp_axis) if tp_axis else None,
         aux_size=2 if moe else 0,
+        n_chunks=cfg.pp_chunks,
     )
     if moe:
         h, aux_sums = res

@@ -25,11 +25,23 @@ neighbor ICI links. Two schedules:
   (S-1)/(M+S-1) = 37.5%) while 1F1B fits M=8 (27%); see
   ``bubble_fraction``.
 
-Future surface: the interleaved (virtual-stage) schedule — v chunks per
-device shrink the bubble to ~(S-1)/(vM+S-1) at the price of v-times the
-ppermute volume and activation saves. The tick/table machinery here
-extends to it (a statically built [tick, device] -> (chunk, microbatch)
-schedule with the same uniform ring shift); not yet implemented.
+- ``"1f1b"`` with ``n_chunks=v > 1`` (r3): the INTERLEAVED virtual-stage
+  schedule. The model splits into J = S·v chunks; device d holds chunks
+  d, d+S, …, d+(v-1)S, so a microbatch laps the ring v times. Schedule:
+  microbatches run in rounds of S; round r's chunk-j execution of its
+  m-th member lands at tick r·S·v + m + j on device j mod S. Two
+  properties make this a single uniform scan: (a) within a round each
+  device's executions occupy DISTINCT ticks (m < S and the device's
+  chunks are S apart), and (b) consecutive rounds offset by S·v slot
+  into each device's busy window back-to-back — so every activation
+  produced at tick t is consumed at tick t+1 one neighbor over
+  (chunk j → j+1 is device j%S → (j+1)%S, cyclic: the wrap S-1 → 0 is
+  the same ppermute hop), no buffering, no stalls beyond fill/drain.
+  Timeline: M·v + S - 1 ticks for M·v chunk-executions per device ⇒
+  bubble (S-1)/(M·v + S - 1) — v times smaller than GPipe/plain-1F1B at
+  equal M (27% → 16% at pp=4, M=4, v=2 → v=4). Costs: v·M saved stage
+  inputs per device (vs M) and v× the ppermute volume — the standard
+  interleaved trade. v=1 reduces exactly to the plain 1F1B schedule.
 
 The reference has no pipeline support at all (SURVEY.md §2.3); this is new
 TPU-native surface.
@@ -102,55 +114,100 @@ def _pipeline_local(stage_params, x_micro, fn: Callable, axis_name: str,
     return y, aux_acc
 
 
-def bubble_fraction(n_stages: int, n_micro: int) -> float:
-    """Idle fraction of the fill-drain timeline: (S-1)/(M+S-1). Both
-    schedules share it at equal M; 1F1B's lever is affording a larger M at
-    fixed activation memory (module docstring)."""
-    return (n_stages - 1) / (n_micro + n_stages - 1)
+def bubble_fraction(n_stages: int, n_micro: int, n_chunks: int = 1) -> float:
+    """Idle fraction of the fill-drain timeline: (S-1)/(M·v + S-1).
+    v = 1: both schedules share it at equal M — plain 1F1B's lever is
+    affording a larger M at fixed activation memory. v > 1 (interleaved):
+    the same S-1 fill/drain ticks amortize over v times the per-device
+    work (module docstring)."""
+    return (n_stages - 1) / (n_micro * n_chunks + n_stages - 1)
+
+
+def _fwd_coords(t, stage, n_stages, n_micro, n_chunks):
+    """Decode the interleaved forward schedule: at tick t, the device at
+    ``stage`` executes chunk i (its i-th virtual stage, global
+    j = stage + i·S) of microbatch m_total — or nothing (valid False).
+    Derivation (module docstring): exec tick of round r's m-th member at
+    virtual stage j is r·S·v + m + j, so with u = t - stage:
+    u = (r·v + i)·S + m."""
+    u = t - stage
+    q = u // n_stages
+    m = u % n_stages
+    r = q // n_chunks
+    i = q % n_chunks
+    m_total = r * n_stages + m
+    valid = (u >= 0) & (u < n_micro * n_chunks)
+    return valid, jnp.clip(i, 0, n_chunks - 1), jnp.clip(m_total, 0, n_micro - 1)
+
+
+def _bwd_coords(t, stage, n_stages, n_micro, n_chunks):
+    """The backward schedule is the forward's mirror (stage → S-1-stage,
+    chunk → v-1-chunk, round → R-1-round, member → S-1-member): cotangents
+    enter at the last virtual stage on device S-1 and hop backwards one
+    neighbor per tick, with the same contiguous busy windows."""
+    ub = t - (n_stages - 1 - stage)
+    valid = (ub >= 0) & (ub < n_micro * n_chunks)
+    if n_chunks == 1:
+        # plain mirror over microbatches — no round structure, so any M
+        # (the interleaved decode below needs M % S == 0, enforced by
+        # pipeline_apply for v > 1)
+        m_total = n_micro - 1 - ub
+        return valid, jnp.zeros_like(ub), jnp.clip(m_total, 0, n_micro - 1)
+    qb = ub // n_stages
+    mb = ub % n_stages
+    rb = qb // n_chunks
+    ib = qb % n_chunks
+    n_rounds = n_micro // n_stages
+    i = n_chunks - 1 - ib
+    m_total = (n_rounds - 1 - rb) * n_stages + (n_stages - 1 - mb)
+    return valid, jnp.clip(i, 0, n_chunks - 1), jnp.clip(m_total, 0, n_micro - 1)
 
 
 def _fwd_save_ticks(stage_params, x_micro, fn: Callable, axis_name: str,
-                    aux_size: int):
+                    aux_size: int, n_chunks: int = 1):
     """_pipeline_local plus residual capture: returns (y, aux, x_saved)
-    where x_saved[m] is THIS stage's input for microbatch m — the only
-    activation the 1F1B backward needs (it recomputes the rest). Same fn
-    contract as _pipeline_local: ALWAYS (out, aux) — wrap plain bodies
-    with _with_aux."""
+    where x_saved[i·M + m] is THIS device's chunk-i input for microbatch
+    m — the only activation the 1F1B backward needs (it recomputes the
+    rest). stage_params carry a leading chunk dim [v, ...] (v = n_chunks;
+    1 = plain 1F1B). Same fn contract as _pipeline_local: ALWAYS
+    (out, aux) — wrap plain bodies with _with_aux."""
     n_stages = axis_size(axis_name)
     stage = axis_index(axis_name)
     n_micro = x_micro.shape[0]
     mb_shape = x_micro.shape[1:]
-    total_ticks = n_micro + n_stages - 1
+    total_ticks = n_micro * n_chunks + n_stages - 1
 
     def tick(carry, t):
         prev_out, y_acc, aux_acc, x_saved = carry
         recv = ring_shift(prev_out, axis_name)
-        mb_idx = jnp.clip(t, 0, n_micro - 1)
-        first_in = jax.lax.dynamic_index_in_dim(x_micro, mb_idx, keepdims=False)
-        x_in = jnp.where(stage == 0, first_in, recv)
-        # stage s processes microbatch t-s at tick t
-        m = t - stage
-        valid = (m >= 0) & (m < n_micro)
-        slot = jnp.clip(m, 0, n_micro - 1)
+        valid, ci, m_total = _fwd_coords(t, stage, n_stages, n_micro, n_chunks)
+        first_in = jax.lax.dynamic_index_in_dim(x_micro, m_total, keepdims=False)
+        # Fresh microbatches enter only at the FIRST virtual stage (device
+        # 0, chunk 0); every other execution consumes its neighbor's hop.
+        x_in = jnp.where((stage == 0) & (ci == 0), first_in, recv)
+        slot = ci * n_micro + m_total
         prev_save = jax.lax.dynamic_index_in_dim(x_saved, slot, keepdims=False)
         x_saved = jax.lax.dynamic_update_index_in_dim(
             x_saved, jnp.where(valid, x_in, prev_save), slot, 0
         )
-        out, aux = fn(stage_params, x_in)
+        params_i = jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, ci, keepdims=False),
+            stage_params,
+        )
+        out, aux = fn(params_i, x_in)
         aux_acc = aux_acc + jnp.where(valid, aux, jnp.zeros_like(aux))
-        out_idx = t - (n_stages - 1)
-        ovalid = (stage == n_stages - 1) & (out_idx >= 0) & (out_idx < n_micro)
-        write_idx = jnp.clip(out_idx, 0, n_micro - 1)
-        prev_slot = jax.lax.dynamic_index_in_dim(y_acc, write_idx, keepdims=False)
+        # The LAST virtual stage (device S-1, chunk v-1) emits results.
+        ovalid = valid & (stage == n_stages - 1) & (ci == n_chunks - 1)
+        prev_slot = jax.lax.dynamic_index_in_dim(y_acc, m_total, keepdims=False)
         y_acc = jax.lax.dynamic_update_index_in_dim(
-            y_acc, jnp.where(ovalid, out, prev_slot), write_idx, 0
+            y_acc, jnp.where(ovalid, out, prev_slot), m_total, 0
         )
         return (out, y_acc, aux_acc, x_saved), None
 
     out0 = jnp.zeros(mb_shape, x_micro.dtype)
     y0 = jnp.zeros((n_micro,) + mb_shape, x_micro.dtype)
     aux0 = jnp.zeros((aux_size,), jnp.float32)
-    s0 = jnp.zeros((n_micro,) + mb_shape, x_micro.dtype)
+    s0 = jnp.zeros((n_chunks * n_micro,) + mb_shape, x_micro.dtype)
     (_, y, aux_acc, x_saved), _ = jax.lax.scan(
         tick, (out0, y0, aux0, s0), jnp.arange(total_ticks)
     )
@@ -160,12 +217,16 @@ def _fwd_save_ticks(stage_params, x_micro, fn: Callable, axis_name: str,
     return y, aux_acc, x_saved
 
 
-def _bwd_ticks(stage_params, x_saved, gy, fn: Callable, axis_name: str, g_aux):
-    """The reverse pipeline: cotangents enter at the LAST stage and
-    ppermute backwards; stage s handles microbatch m = t - (S-1-s) at tick
-    t, recomputing its forward from the saved input via jax.vjp (1F1B
-    recompute) and accumulating param grads. Returns (dparams, dx) with
-    dx valid on stage 0 (psum-broadcast like the forward's y).
+def _bwd_ticks(stage_params, x_saved, gy, fn: Callable, axis_name: str, g_aux,
+               n_chunks: int = 1):
+    """The reverse pipeline: cotangents enter at the LAST virtual stage
+    (device S-1, chunk v-1) and ppermute backwards one neighbor per tick
+    (_bwd_coords — the forward schedule's mirror); each tick recomputes
+    its (chunk, microbatch) forward from the saved input via jax.vjp
+    (1F1B recompute) and accumulates that chunk's param grads. Inputs:
+    stage_params [v, ...] per-chunk, x_saved [v·M, mb...] as
+    _fwd_save_ticks wrote it. Returns (dparams [v, ...], dx) with dx
+    valid on stage 0 (psum-broadcast like the forward's y).
 
     tp-within-stage note: ``fn`` must handle its own tp cotangent algebra
     via the Megatron f/g conjugate pair (collectives.tp_region_enter/
@@ -175,9 +236,9 @@ def _bwd_ticks(stage_params, x_saved, gy, fn: Callable, axis_name: str, g_aux):
     psum of dx would double-count the residual path)."""
     n_stages = axis_size(axis_name)
     stage = axis_index(axis_name)
-    n_micro = x_saved.shape[0]
+    n_micro = x_saved.shape[0] // n_chunks
     mb_shape = x_saved.shape[1:]
-    total_ticks = n_micro + n_stages - 1
+    total_ticks = n_micro * n_chunks + n_stages - 1
 
     dp0 = jax.tree_util.tree_map(
         lambda p: jnp.zeros(p.shape, jnp.float32), stage_params
@@ -186,31 +247,39 @@ def _bwd_ticks(stage_params, x_saved, gy, fn: Callable, axis_name: str, g_aux):
     def tick(carry, t):
         prev_dx, dp_acc, dx_acc = carry
         recv = ring_shift(prev_dx, axis_name, shift=-1)  # from stage s+1
-        m = t - (n_stages - 1 - stage)
-        valid = (m >= 0) & (m < n_micro)
-        slot = jnp.clip(m, 0, n_micro - 1)
+        valid, ci, m_total = _bwd_coords(t, stage, n_stages, n_micro, n_chunks)
         g_in = jnp.where(
-            stage == n_stages - 1,
-            jax.lax.dynamic_index_in_dim(gy, slot, keepdims=False),
+            (stage == n_stages - 1) & (ci == n_chunks - 1),
+            jax.lax.dynamic_index_in_dim(gy, m_total, keepdims=False),
             recv,
         )
+        slot = ci * n_micro + m_total
         x_in = jax.lax.dynamic_index_in_dim(x_saved, slot, keepdims=False)
-        _, vjp_fn = jax.vjp(fn, stage_params, x_in)
+        params_i = jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, ci, keepdims=False),
+            stage_params,
+        )
+        _, vjp_fn = jax.vjp(fn, params_i, x_in)
         # every valid tick's aux entered the sum with weight 1, so its
         # cotangent is g_aux itself; invalid ticks' pollution of dparams
         # is masked below and their dx never reaches a valid consumer
         # (the reverse schedule masks by the same validity)
         dp, dx = vjp_fn((g_in, g_aux))
         dp_acc = jax.tree_util.tree_map(
-            lambda acc, new: acc
-            + jnp.where(valid, new.astype(jnp.float32), jnp.zeros_like(new, jnp.float32)),
+            lambda acc, new: jax.lax.dynamic_update_index_in_dim(
+                acc,
+                jax.lax.dynamic_index_in_dim(acc, ci, keepdims=False)
+                + jnp.where(valid, new.astype(jnp.float32),
+                            jnp.zeros_like(new, jnp.float32)),
+                ci, 0,
+            ),
             dp_acc,
             dp,
         )
-        w_valid = valid & (stage == 0)
-        prev_slot = jax.lax.dynamic_index_in_dim(dx_acc, slot, keepdims=False)
+        w_valid = valid & (stage == 0) & (ci == 0)
+        prev_slot = jax.lax.dynamic_index_in_dim(dx_acc, m_total, keepdims=False)
         dx_acc = jax.lax.dynamic_update_index_in_dim(
-            dx_acc, jnp.where(w_valid, dx, prev_slot), slot, 0
+            dx_acc, jnp.where(w_valid, dx, prev_slot), m_total, 0
         )
         return (dx, dp_acc, dx_acc), None
 
@@ -264,13 +333,20 @@ def pipeline_apply(
     schedule: str = "gpipe",
     param_specs=None,
     aux_size: int = 0,
+    n_chunks: int = 1,
 ):
     """Run ``fn(stage_params, x_mb)`` as a pipeline over ``axis_name``.
 
-    stage_params: pytree whose leaves have leading dim == pp size (one slice
-    per stage). x: [batch, ...] input. fn must map a microbatch through ONE
-    stage, preserving shape (classic equal-width pipeline). Returns
-    [batch, ...] outputs.
+    stage_params: pytree whose leaves have leading dim == pp size ×
+    ``n_chunks`` (one slice per VIRTUAL stage, in model order — chunk j
+    runs on device j mod pp). x: [batch, ...] input. fn must map a
+    microbatch through ONE virtual stage, preserving shape (classic
+    equal-width pipeline). Returns [batch, ...] outputs.
+
+    ``n_chunks``: virtual stages per device (the interleaved 1F1B
+    schedule, module docstring) — requires schedule="1f1b" and
+    n_microbatches % pp == 0; bubble shrinks to
+    (pp-1)/(n_micro·v + pp-1).
 
     ``aux_size`` > 0: fn instead returns (x_mb_out, aux[aux_size] f32) —
     summable side losses (MoE router lb/z). pipeline_apply then returns
@@ -300,6 +376,15 @@ def pipeline_apply(
     from jax import shard_map
 
     batch = x.shape[0]
+    if n_chunks > 1:
+        if schedule != "1f1b":
+            raise ValueError("n_chunks > 1 (interleaved) requires schedule='1f1b'")
+        if n_microbatches % mesh.shape[axis_name]:
+            raise ValueError(
+                f"interleaved schedule needs n_microbatches "
+                f"({n_microbatches}) divisible by {axis_name}="
+                f"{mesh.shape[axis_name]} (round structure)"
+            )
     x_micro, x_spec, param_specs, data_axes = _shard_specs(
         stage_params, x, mesh, n_microbatches, axis_name, batch_axes, param_specs
     )
@@ -307,7 +392,7 @@ def pipeline_apply(
     if schedule == "1f1b":
         res = _apply_1f1b(
             stage_params, x_micro, fn, mesh, axis_name, x_spec, param_specs,
-            data_axes, aux_size,
+            data_axes, aux_size, n_chunks,
         )
     elif schedule == "gpipe":
         def body(params, xm):
@@ -358,7 +443,7 @@ def _reduce_aux_rows(aux_rows, mesh, axis_name, data_axes, aux_size):
 
 
 def _apply_1f1b(stage_params, x_micro, fn, mesh, axis_name, x_spec, param_specs,
-                data_axes, aux_size: int = 0):
+                data_axes, aux_size: int = 0, n_chunks: int = 1):
     """custom-VJP wrapper: forward ticks save stage inputs; backward runs
     the explicit reverse pipeline (_bwd_ticks). One body serves the aux
     and non-aux cases (_with_aux dummy row): the primal output is always
@@ -366,17 +451,36 @@ def _apply_1f1b(stage_params, x_micro, fn, mesh, axis_name, x_spec, param_specs,
     shard_map (sum over stages, mean over data shards), so aux cotangent
     rows arrive back per shard already correctly scaled and feed straight
     into every valid tick's vjp (a discarded dummy row's cotangent is
-    zeros)."""
+    zeros).
+
+    Interleaved (n_chunks = v > 1): the caller's [S·v, ...] virtual-stage
+    params reshape to [v, S, ...] OUTSIDE the custom_vjp (chunk j = i·S+d
+    lands at [i, d] — device d's i-th chunk; autodiff transposes the
+    reshape on the way back), specs shift to P(None, axis_name, …), and
+    the local tick bodies see chunk-major [v, ...] params. v = 1 keeps
+    the [S, ...] layout where the local [1, ...] block IS chunk-major."""
     from jax import shard_map
 
     fn2 = _with_aux(fn, aux_size)
     k = max(aux_size, 1)
-    # saved stage inputs live stage-major: [S, M, mb, ...]
+    n_stages = mesh.shape[axis_name]
+    # saved stage inputs live stage-major: [S, v*M, mb, ...]
     saved_spec = P(axis_name, *x_spec)
     aux_spec = P((axis_name,) + data_axes, None)
 
-    def strip(params):
-        return jax.tree_util.tree_map(lambda a: a[0], params)
+    is_spec = lambda s: isinstance(s, P)
+    if n_chunks > 1:
+        pspecs = jax.tree_util.tree_map(
+            lambda s: P(None, *s), param_specs, is_leaf=is_spec)
+        prepare = lambda p: jax.tree_util.tree_map(
+            lambda a: a.reshape((n_chunks, n_stages) + a.shape[1:]), p)
+        to_local = lambda p: jax.tree_util.tree_map(lambda a: a[:, 0], p)
+        from_local = lambda d: jax.tree_util.tree_map(lambda a: a[:, None], d)
+    else:
+        pspecs = param_specs
+        prepare = lambda p: p
+        to_local = lambda p: p      # local [1, ...] block is chunk-major
+        from_local = lambda d: d
 
     @jax.custom_vjp
     def run(params, xm):
@@ -385,12 +489,13 @@ def _apply_1f1b(stage_params, x_micro, fn, mesh, axis_name, x_spec, param_specs,
 
     def run_fwd(params, xm):
         def body(p, x):
-            y, aux, x_saved = _fwd_save_ticks(strip(p), x, fn2, axis_name, k)
+            y, aux, x_saved = _fwd_save_ticks(
+                to_local(p), x, fn2, axis_name, k, n_chunks)
             return y, aux[None], x_saved[None]
 
         y, aux_rows, x_saved = shard_map(
             body, mesh=mesh,
-            in_specs=(param_specs, x_spec),
+            in_specs=(pspecs, x_spec),
             out_specs=(x_spec, aux_spec, saved_spec),
             check_vma=False,
         )(params, xm)
@@ -402,10 +507,11 @@ def _apply_1f1b(stage_params, x_micro, fn, mesh, axis_name, x_spec, param_specs,
 
         def body(p, saved, gy_in, gaux_row):
             dparams, dx = _bwd_ticks(
-                strip(p),
+                to_local(p),
                 jax.tree_util.tree_map(lambda a: a[0], saved),
                 gy_in, fn2, axis_name,
                 gaux_row[0].astype(jnp.float32),
+                n_chunks,
             )
             # params replicate over the data axes, so each data shard holds
             # PARTIAL grads from its batch slice — sum them (the psum
@@ -414,15 +520,15 @@ def _apply_1f1b(stage_params, x_micro, fn, mesh, axis_name, x_spec, param_specs,
                 dparams = jax.tree_util.tree_map(
                     lambda a, ax=ax: jax.lax.psum(a, ax), dparams
                 )
-            return jax.tree_util.tree_map(lambda a: a[None], dparams), dx
+            return from_local(dparams), dx
 
         dparams, dx = shard_map(
             body, mesh=mesh,
-            in_specs=(param_specs, saved_spec, x_spec, aux_spec),
-            out_specs=(param_specs, x_spec),
+            in_specs=(pspecs, saved_spec, x_spec, aux_spec),
+            out_specs=(pspecs, x_spec),
             check_vma=False,
         )(params, x_saved, gy, gaux_rows)
         return dparams, dx
 
     run.defvjp(run_fwd, run_bwd)
-    return run(stage_params, x_micro)
+    return run(prepare(stage_params), x_micro)
