@@ -1,0 +1,119 @@
+// Native host-side input-pipeline ops for tf_operator_tpu.
+//
+// The reference operator has no data plane at all (SURVEY.md §2: the user
+// container owns input); this framework's workload library does, and its
+// augmentation (train/data.py augment_images) is per-example branchy
+// memory work — exactly what a compiled loop with threads does well while
+// the DeviceLoader's prefetch thread hides it behind the step. The
+// randomness (crop offsets, flip flags) stays in numpy so the Python
+// fallback and this path produce bit-identical outputs from one RNG
+// stream; this library only does the deterministic gather:
+//
+//   pad-crop: output row y of image i reads padded row y+dy[i], i.e.
+//   source row y+dy[i]-pad (zero outside [0,h)); columns likewise — the
+//   overlapping segment is one memcpy, the borders are memset.
+//   flip: reverse the row's pixels (pixel = c*elem bytes) during the
+//   final write, so flipped images cost no extra pass.
+//
+// Layout contract: images are C-contiguous [b, h, w, pixel_bytes] where
+// pixel_bytes folds trailing channel dims and element size (any dtype —
+// the op is pure byte movement). Threads split the batch.
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct AugmentArgs {
+  const uint8_t* in;
+  uint8_t* out;
+  int64_t b, h, w, pixel;  // pixel = bytes per pixel
+  int64_t pad;
+  const int32_t* dy;
+  const int32_t* dx;
+  const uint8_t* flip;
+};
+
+void augment_range(const AugmentArgs& a, int64_t i0, int64_t i1,
+                   std::vector<uint8_t>* rowbuf) {
+  const int64_t row_bytes = a.w * a.pixel;
+  rowbuf->resize(static_cast<size_t>(row_bytes));
+  uint8_t* tmp = rowbuf->data();
+  for (int64_t i = i0; i < i1; ++i) {
+    const uint8_t* img = a.in + i * a.h * row_bytes;
+    uint8_t* dst_img = a.out + i * a.h * row_bytes;
+    const int64_t dy = a.pad ? a.dy[i] : 0;
+    const int64_t dx = a.pad ? a.dx[i] : 0;
+    const bool flip = a.flip && a.flip[i];
+    // source col for out col x is x + dx - pad; valid out cols:
+    const int64_t x_lo = std::max<int64_t>(0, a.pad - dx);
+    const int64_t x_hi = std::min<int64_t>(a.w, a.w + a.pad - dx);
+    for (int64_t y = 0; y < a.h; ++y) {
+      const int64_t ys = y + dy - a.pad;
+      uint8_t* dst = dst_img + y * row_bytes;
+      if (ys < 0 || ys >= a.h || x_hi <= x_lo) {
+        std::memset(dst, 0, static_cast<size_t>(row_bytes));
+        continue;
+      }
+      const uint8_t* src_row = img + ys * row_bytes;
+      uint8_t* row = flip ? tmp : dst;
+      if (x_lo > 0) std::memset(row, 0, static_cast<size_t>(x_lo * a.pixel));
+      std::memcpy(row + x_lo * a.pixel,
+                  src_row + (x_lo + dx - a.pad) * a.pixel,
+                  static_cast<size_t>((x_hi - x_lo) * a.pixel));
+      if (x_hi < a.w)
+        std::memset(row + x_hi * a.pixel, 0,
+                    static_cast<size_t>((a.w - x_hi) * a.pixel));
+      if (flip) {
+        for (int64_t x = 0; x < a.w; ++x)
+          std::memcpy(dst + x * a.pixel, tmp + (a.w - 1 - x) * a.pixel,
+                      static_cast<size_t>(a.pixel));
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Random-crop (from virtual zero padding) + horizontal flip. dy/dx are
+// per-image offsets in [0, 2*pad] (ignored when pad == 0; may be null);
+// flip is a per-image 0/1 mask (null = no flips). n_threads <= 0 picks
+// hardware concurrency. Returns 0 on success, nonzero on bad arguments.
+int tpuj_augment(const void* in, void* out, int64_t b, int64_t h, int64_t w,
+                 int64_t pixel_bytes, int64_t pad, const int32_t* dy,
+                 const int32_t* dx, const uint8_t* flip, int n_threads) {
+  if (!in || !out || b < 0 || h <= 0 || w <= 0 || pixel_bytes <= 0 || pad < 0)
+    return 1;
+  if (pad > 0 && (!dy || !dx)) return 2;
+  AugmentArgs a{static_cast<const uint8_t*>(in), static_cast<uint8_t*>(out),
+                b, h, w, pixel_bytes, pad, dy, dx, flip};
+  int hw = static_cast<int>(std::thread::hardware_concurrency());
+  int nt = n_threads > 0 ? n_threads : (hw > 0 ? hw : 1);
+  nt = static_cast<int>(std::min<int64_t>(nt, std::max<int64_t>(b, 1)));
+  if (nt <= 1) {
+    std::vector<uint8_t> buf;
+    augment_range(a, 0, b, &buf);
+    return 0;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(static_cast<size_t>(nt));
+  const int64_t chunk = (b + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    const int64_t i0 = t * chunk;
+    const int64_t i1 = std::min<int64_t>(b, i0 + chunk);
+    if (i0 >= i1) break;
+    threads.emplace_back([&a, i0, i1]() {
+      std::vector<uint8_t> buf;
+      augment_range(a, i0, i1, &buf);
+    });
+  }
+  for (auto& th : threads) th.join();
+  return 0;
+}
+
+}  // extern "C"

@@ -352,3 +352,38 @@ def test_prepare_classification_images():
     assert prepare_classification_images(rgb, None).shape == (2, 16, 16, 3)
     with pytest.raises(ValueError, match="integer multiple"):
         prepare_classification_images(gray, 20)
+
+
+def test_augment_native_matches_numpy_bit_exact():
+    """The native dataops gather (native/dataops.cc) and the numpy
+    fallback consume the SAME rng draws and must produce identical bytes
+    — every dtype/rank the augmenter accepts, and the pad-only/flip-only
+    sub-paths."""
+    pytest.importorskip("ctypes")
+    from tf_operator_tpu.runtime.native import NativeBuildError
+
+    for shape, dtype in [((16, 12, 12, 3), np.uint8),
+                         ((16, 10, 10), np.float32),
+                         ((3, 8, 8, 1), np.int16)]:
+        imgs = (np.random.default_rng(0).random(shape) * 255).astype(dtype)
+        for kw in ({}, {"pad": 0}, {"flip": False}):
+            try:
+                got = augment_images(imgs, np.random.default_rng(7),
+                                     native=True, **kw)
+            except (RuntimeError, NativeBuildError):
+                pytest.skip("native dataops unavailable in this environment")
+            want = augment_images(imgs, np.random.default_rng(7),
+                                  native=False, **kw)
+            np.testing.assert_array_equal(got, want)
+
+
+def test_augment_native_falls_back_on_noncontiguous():
+    """A non-C-contiguous view can't hand a flat pointer to C — the auto
+    path must silently produce the numpy result, not garbage."""
+    imgs = np.asfortranarray(
+        (np.random.default_rng(1).random((8, 10, 10, 3)) * 255).astype(np.uint8)
+    )
+    got = augment_images(imgs, np.random.default_rng(3))  # auto dispatch
+    want = augment_images(np.ascontiguousarray(imgs), np.random.default_rng(3),
+                          native=False)
+    np.testing.assert_array_equal(got, want)

@@ -27,54 +27,67 @@ from typing import Dict, List, Optional
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 LIB_PATH = os.path.join(NATIVE_DIR, "build", "libtpujob_supervisor.so")
-_SOURCE = os.path.join(NATIVE_DIR, "supervisor.cc")
+
+# Native libraries this module can build/load. "supervisor" is the process
+# runtime; "dataops" is the host input-pipeline kernels (train/data.py
+# dispatches its augmentation gather there when available).
+_LIBS = {
+    "supervisor": (os.path.join(NATIVE_DIR, "supervisor.cc"), LIB_PATH),
+    "dataops": (
+        os.path.join(NATIVE_DIR, "dataops.cc"),
+        os.path.join(NATIVE_DIR, "build", "libtpujob_dataops.so"),
+    ),
+}
 
 _build_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
+_dataops_lib: Optional[ctypes.CDLL] = None
 
 
 class NativeBuildError(RuntimeError):
     pass
 
 
-def _fresh() -> bool:
-    return os.path.exists(LIB_PATH) and (
-        not os.path.exists(_SOURCE)
-        or os.path.getmtime(LIB_PATH) >= os.path.getmtime(_SOURCE)
+def _fresh(lib_name: str = "supervisor") -> bool:
+    source, lib_path = _LIBS[lib_name]
+    return os.path.exists(lib_path) and (
+        not os.path.exists(source)
+        or os.path.getmtime(lib_path) >= os.path.getmtime(source)
     )
 
 
-def ensure_built() -> str:
-    """Compile the supervisor library if missing or older than its source.
+def ensure_built(lib_name: str = "supervisor") -> str:
+    """Compile a native library if missing or older than its source.
 
     Safe across threads (in-process lock) AND processes (flock + compile to
     a temp name, atomically os.replace'd in): several operator candidates
     on one host may race here, and dlopen of a half-written .so crashes."""
     import fcntl
 
+    source, lib_path = _LIBS[lib_name]
     with _build_lock:
-        if _fresh():
-            return LIB_PATH
-        if not os.path.exists(_SOURCE):
-            raise NativeBuildError(f"native source not found: {_SOURCE}")
-        os.makedirs(os.path.dirname(LIB_PATH), exist_ok=True)
-        lock_fd = os.open(LIB_PATH + ".buildlock", os.O_CREAT | os.O_RDWR)
+        if _fresh(lib_name):
+            return lib_path
+        if not os.path.exists(source):
+            raise NativeBuildError(f"native source not found: {source}")
+        os.makedirs(os.path.dirname(lib_path), exist_ok=True)
+        lock_fd = os.open(lib_path + ".buildlock", os.O_CREAT | os.O_RDWR)
         try:
             fcntl.flock(lock_fd, fcntl.LOCK_EX)
-            if _fresh():  # another process built it while we waited
-                return LIB_PATH
+            if _fresh(lib_name):  # another process built it while we waited
+                return lib_path
             # The Makefile is the single source of truth for build flags;
             # build into a private BUILD dir and atomically replace in, so
             # a concurrent dlopen never sees a half-written .so. Direct g++
             # only as fallback when make itself is absent.
             tmp_dir = os.path.join(NATIVE_DIR, "build", f".mk.{os.getpid()}")
-            tmp_lib = os.path.join(tmp_dir, os.path.basename(LIB_PATH))
+            tmp_lib = os.path.join(tmp_dir, os.path.basename(lib_path))
             cmds = [
                 ["make", "-C", NATIVE_DIR, f"BUILD={tmp_dir}"],
                 [
                     os.environ.get("CXX", "g++"),
                     "-std=c++17", "-O2", "-Wall", "-Wextra", "-fPIC", "-pthread",
-                    "-shared", "-o", tmp_lib, _SOURCE,
+                    "-shared", "-o", tmp_lib, source,
                 ],
             ]
             try:
@@ -96,12 +109,19 @@ def ensure_built() -> str:
                             f"native build failed ({proc.returncode}):\n{proc.stderr}"
                         )
                     break
-                os.replace(tmp_lib, LIB_PATH)
+                # make builds every library into tmp_dir; install them all
+                # while we hold the lock (the g++ fallback builds just one)
+                for _, other_path in _LIBS.values():
+                    cand = os.path.join(tmp_dir, os.path.basename(other_path))
+                    if os.path.exists(cand):
+                        os.replace(cand, other_path)
+                if not os.path.exists(lib_path):
+                    raise NativeBuildError(f"build produced no {lib_path}")
             finally:
                 import shutil
 
                 shutil.rmtree(tmp_dir, ignore_errors=True)
-            return LIB_PATH
+            return lib_path
         finally:
             os.close(lock_fd)
 
@@ -135,6 +155,25 @@ def load_library() -> ctypes.CDLL:
     lib.tpuj_tracked_count.restype = ctypes.c_int
     lib.tpuj_tracked_count.argtypes = []
     _lib = lib
+    return lib
+
+
+def load_dataops() -> ctypes.CDLL:
+    """Load (building if needed) the host data-ops library; cached."""
+    global _dataops_lib
+    if _dataops_lib is not None:
+        return _dataops_lib
+    path = ensure_built("dataops")
+    lib = ctypes.CDLL(path)
+    lib.tpuj_augment.restype = ctypes.c_int
+    lib.tpuj_augment.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
+    ]
+    _dataops_lib = lib
     return lib
 
 

@@ -245,8 +245,9 @@ class MnistIdxDataset(ArrayDataset):
 
 
 def augment_images(images: np.ndarray, rng: np.random.Generator, *,
-                   pad: int = 4, flip: bool = True) -> np.ndarray:
-    """Random-crop + horizontal-flip augmentation, host-side numpy.
+                   pad: int = 4, flip: bool = True,
+                   native: Optional[bool] = None) -> np.ndarray:
+    """Random-crop + horizontal-flip augmentation, host-side.
 
     The standard small-image recipe (ResNet/CIFAR): zero-pad ``pad``
     pixels on each spatial edge, crop back to the original h×w at a
@@ -256,22 +257,83 @@ def augment_images(images: np.ndarray, rng: np.random.Generator, *,
 
     Runs on the host on purpose: augmentation is per-example branchy work
     the DeviceLoader's prefetch thread hides behind the step, and keeping
-    it off the device keeps the train step's compiled program static."""
+    it off the device keeps the train step's compiled program static.
+
+    Dispatch: the RANDOMNESS is always drawn here (numpy Generator, one
+    draw order regardless of path — outputs are bit-identical for one
+    seed), and the gather work runs through the native dataops library
+    (native/dataops.cc: threaded memcpy crop + in-write flip) when
+    ``native`` is None/True, falling back to the numpy loop when the
+    library is unavailable or the array layout is unsupported
+    (``native=False`` forces the fallback; True raises if unusable)."""
     b, h, w = images.shape[:3]
+    if not pad and not flip:
+        return images  # no-op config: input returned as-is on EVERY path
+    dy = dx = do = None
+    if pad:
+        dy = rng.integers(0, 2 * pad + 1, b)
+        dx = rng.integers(0, 2 * pad + 1, b)
+    if flip:
+        do = rng.random(b) < 0.5
+    if native is not False and b > 0:
+        out = _augment_native(images, pad, dy, dx, do)
+        if out is not None:
+            return out
+        if native:
+            raise RuntimeError("native augmentation unavailable for this input")
     out = images
     if pad:
         widths = [(0, 0), (pad, pad), (pad, pad)] + [(0, 0)] * (images.ndim - 3)
         padded = np.pad(images, widths)
-        dy = rng.integers(0, 2 * pad + 1, b)
-        dx = rng.integers(0, 2 * pad + 1, b)
         out = np.empty_like(images)
         for i in range(b):  # host-side; hidden by the loader's prefetch
             out[i] = padded[i, dy[i]:dy[i] + h, dx[i]:dx[i] + w]
     if flip:
-        do = rng.random(b) < 0.5
         out = np.where(
             do.reshape((b,) + (1,) * (images.ndim - 1)), out[:, :, ::-1], out
         )
+    return out
+
+
+def _augment_native(images: np.ndarray, pad: int, dy, dx, do) -> Optional[np.ndarray]:
+    """Run the crop/flip gather through native/dataops.cc. Returns None
+    when the native path cannot serve this input (library missing/broken,
+    non-C-contiguous array) so the caller falls back — same offsets, same
+    output bytes either way."""
+    import ctypes
+
+    try:
+        from tf_operator_tpu.runtime.native import load_dataops
+
+        lib = load_dataops()
+    except Exception:
+        return None
+    arr = images if images.flags["C_CONTIGUOUS"] else None
+    if arr is None:
+        return None
+    b, h, w = arr.shape[:3]
+    # fold trailing dims + element size into bytes-per-pixel (the op is
+    # pure byte movement, dtype-agnostic)
+    pixel = arr.itemsize
+    for dim in arr.shape[3:]:
+        pixel *= dim
+    out = np.empty_like(arr)
+    # staging arrays must stay referenced across the call (ctypes keeps no
+    # reference; a GC'd temp would hand C a dangling pointer)
+    dy_a = np.ascontiguousarray(dy, dtype=np.int32) if dy is not None else None
+    dx_a = np.ascontiguousarray(dx, dtype=np.int32) if dx is not None else None
+    do_a = np.ascontiguousarray(do, dtype=np.uint8) if do is not None else None
+    rc = lib.tpuj_augment(
+        arr.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        b, h, w, pixel, pad,
+        dy_a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)) if dy_a is not None else None,
+        dx_a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)) if dx_a is not None else None,
+        do_a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)) if do_a is not None else None,
+        0,
+    )
+    if rc != 0:
+        return None
     return out
 
 

@@ -1,0 +1,70 @@
+"""Host augmentation throughput: numpy loop vs native dataops (r3).
+
+The input pipeline's augmentation runs on the host inside the
+DeviceLoader's prefetch thread; its throughput bounds how large a batch
+the loader can hide behind a step. Same RNG draws feed both paths
+(outputs are bit-identical — pinned in tests/test_data.py), so this is a
+pure gather-speed A/B of train/data.augment_images' two backends.
+
+    python -m tools.augbench [--batch 256] [--size 224] [--iters 30]
+
+Prints one JSON line per path plus the speedup.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+
+def run(native: bool, images: np.ndarray, iters: int) -> float:
+    from tf_operator_tpu.train.data import augment_images
+
+    rng = np.random.default_rng(0)
+    augment_images(images, rng, native=native)  # warm (build/load the lib)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        augment_images(images, rng, native=native)
+    dt = time.perf_counter() - t0
+    return images.shape[0] * iters / dt
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--size", type=int, default=224)
+    p.add_argument("--iters", type=int, default=30)
+    args = p.parse_args(argv)
+    images = (
+        np.random.default_rng(1)
+        .random((args.batch, args.size, args.size, 3)) * 255
+    ).astype(np.uint8)
+    rates = {}
+    for name, native in (("numpy", False), ("native", True)):
+        try:
+            rates[name] = run(native, images, args.iters)
+        except RuntimeError as exc:
+            print(json.dumps({"metric": f"aug_{name}", "error": str(exc)}))
+            continue
+        print(json.dumps({
+            "metric": f"aug_{name}_images_per_s", "value": round(rates[name], 1),
+            "batch": args.batch, "size": args.size,
+        }), flush=True)
+    if len(rates) == 2:
+        print(json.dumps({
+            "metric": "aug_native_speedup",
+            "value": round(rates["native"] / rates["numpy"], 2),
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
