@@ -777,3 +777,62 @@ def test_ulysses_gqa_matches_repeat_oracle(h_kv):
             np.asarray(a), np.asarray(w), rtol=5e-4, atol=5e-5,
             err_msg=f"d{name}",
         )
+
+
+@pytest.mark.parametrize("k_top", [1, 2])
+@pytest.mark.parametrize("dropped", ["passthrough", "zero"])
+def test_moe_dispatch_impl_parity(k_top, dropped):
+    """Sort-based dispatch (r3 default: argsort/scatter/gather, O(T·d))
+    vs the one-hot einsum oracle (O(T²·d)): identical queue semantics
+    means identical outputs, gradients, and stats — INCLUDING which
+    tokens drop (capacity_factor 0.5 forces overflow)."""
+    n_experts, d, tokens = 8, 16, 64
+    mesh = build_mesh({"ep": 8})
+    ks = jax.random.split(jax.random.PRNGKey(21), 3)
+    x = jax.random.normal(ks[0], (tokens, d))
+    gates = jax.random.normal(ks[1], (tokens, n_experts))
+    wexp = jax.random.normal(ks[2], (n_experts, d, d)) / np.sqrt(d)
+
+    def run(impl, cf):
+        return moe_apply(x, gates, wexp, lambda w, t: jnp.tanh(t @ w), mesh,
+                         capacity_factor=cf, k_top=k_top, dropped=dropped,
+                         dispatch_impl=impl, return_stats=True)
+
+    for cf in (2.0, 0.5):  # ample capacity AND forced drops
+        got, gstats = run("sort", cf)
+        want, wstats = run("einsum", cf)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        for key in gstats:
+            np.testing.assert_allclose(np.asarray(gstats[key]),
+                                       np.asarray(wstats[key]),
+                                       rtol=1e-6, atol=1e-6, err_msg=key)
+
+    def loss(impl):
+        def f(x, gates, wexp):
+            return jnp.sum(
+                moe_apply(x, gates, wexp, lambda w, t: jnp.tanh(t @ w), mesh,
+                          capacity_factor=0.5, k_top=k_top, dropped=dropped,
+                          dispatch_impl=impl) ** 2)
+        return f
+
+    got = jax.grad(loss("sort"), argnums=(0, 1, 2))(x, gates, wexp)
+    want = jax.grad(loss("einsum"), argnums=(0, 1, 2))(x, gates, wexp)
+    for name, a, w in zip(["x", "gates", "wexp"], got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                   rtol=5e-4, atol=5e-5, err_msg=f"d{name}")
+
+
+def test_moe_dispatch_impl_parity_single_device():
+    """Same parity on the no-ep fallback path (_moe_single)."""
+    n_experts, d, tokens = 4, 8, 32
+    ks = jax.random.split(jax.random.PRNGKey(22), 3)
+    x = jax.random.normal(ks[0], (tokens, d))
+    gates = jax.random.normal(ks[1], (tokens, n_experts))
+    wexp = jax.random.normal(ks[2], (n_experts, d, d)) / np.sqrt(d)
+    got = moe_apply(x, gates, wexp, lambda w, t: jnp.tanh(t @ w), None,
+                    capacity_factor=0.75, dispatch_impl="sort")
+    want = moe_apply(x, gates, wexp, lambda w, t: jnp.tanh(t @ w), None,
+                     capacity_factor=0.75, dispatch_impl="einsum")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)

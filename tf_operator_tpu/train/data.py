@@ -306,7 +306,18 @@ def _augment_native(images: np.ndarray, pad: int, dy, dx, do) -> Optional[np.nda
         from tf_operator_tpu.runtime.native import load_dataops
 
         lib = load_dataops()
-    except Exception:
+    except Exception as exc:
+        # Warn ONCE: the numpy fallback is ~6x slower (BASELINE.md) — at
+        # ResNet rates it cannot feed the step, and without a diagnostic
+        # an input-bound job points at nothing.
+        global _dataops_warned
+        if not _dataops_warned:
+            _dataops_warned = True
+            import warnings
+
+            warnings.warn(
+                f"native dataops unavailable ({exc!r}); augmentation falls "
+                "back to the ~6x-slower numpy path", RuntimeWarning)
         return None
     arr = images if images.flags["C_CONTIGUOUS"] else None
     if arr is None:
