@@ -294,9 +294,10 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
     [*, nkv, hd] blocks around the cp ring (g-times less ICI traffic per
     hop — parallel/ring_attention.py), keeping K/V traffic at the nkv
     rate that is GQA's whole point at t>=4096. Ulysses is GQA-native when
-    n_kv % cp == 0 (K/V all-to-all on their own smaller head dim) and
-    falls back to an internal repeat otherwise — both handled inside
-    parallel/ulysses.py."""
+    n_kv % cp == 0 (K/V all-to-all on their own smaller head dim); with
+    indivisible kv counts it all-gathers the small K/V over cp and
+    head-maps per shard (r4 — no repeated [t, h, hd] tensor either way),
+    both handled inside parallel/ulysses.py."""
     if cfg.attn_impl == "ring" and mesh is not None and cfg.cp_axis in mesh.axis_names:
         from tf_operator_tpu.parallel.ring_attention import ring_attention
 

@@ -441,8 +441,11 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 def reference_attention_lse(q, k, v, causal: bool = False):
     """Dense (o, lse) — the fallback for flash_attention_lse. lse is the
     row logsumexp of the scaled (masked) scores, [b, t, h] f32; rows with
-    every key masked get lse = NEG_INF (their o is the uniform-softmax
-    artifact over NEG_INF scores, weight 0 in any downstream merge)."""
+    every key masked get lse = NEG_INF — the finite -1e30 sentinel, NOT
+    -inf (their o is the uniform-softmax artifact over NEG_INF scores).
+    Downstream merges must treat lse <= NEG_INF/2 as masked/weight-0 the
+    way ring_attention's _merge_partials does; an isinf check will NOT
+    catch it."""
     b, tq, hq, d = q.shape
     h_kv = k.shape[2]
     scale = d**-0.5
@@ -487,7 +490,9 @@ def flash_attention_lse(
     via their lse. Gradients are exact THROUGH lse — the lse cotangent
     folds into the backward kernels' delta term (see _bwd), so callers
     may use lse in differentiable math. Same dispatch gate and fallback
-    as flash_attention."""
+    as flash_attention — including the explicit-block clamp/rounding
+    documented there. Fully-masked rows report the finite NEG_INF
+    sentinel, not -inf (see reference_attention_lse)."""
     use, block_q, block_k = _dispatch(q, k, v, block_q, block_k, interpret,
                                       force_kernel)
     if not use:
@@ -578,7 +583,15 @@ def flash_attention(
     kernel through the Pallas interpreter — the CPU test path for kernel
     logic. ``force_kernel`` overrides the dispatch heuristic both ways
     (tiling constraints still apply) — the measurement hook behind the
-    tools/roofline --mode attn crossover table."""
+    tools/roofline --mode attn crossover table.
+
+    An EXPLICIT ``block_q``/``block_k`` is a TARGET, not a verbatim
+    config: block_q is clamped to 1024//g rows (VMEM bound for folded
+    GQA tiles), both are rounded down to a multiple of 8 and then to a
+    divisor of t when one exists (_pick_block) — the resolved blocks may
+    differ from what was passed. Callers probing an exact configuration
+    should treat a changed block as "that config cannot run", not as a
+    measurement of it."""
     use, block_q, block_k = _dispatch(q, k, v, block_q, block_k, interpret,
                                       force_kernel)
     if not use:

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from tf_operator_tpu.ops.flash_attention import flash_attention_lse
+from tf_operator_tpu.ops.flash_attention import NEG_INF, flash_attention_lse
 from tf_operator_tpu.parallel.collectives import axis_index, axis_size, ring_shift
 
 
@@ -110,13 +110,17 @@ def _merge_partials(o, m, d_acc, o_j, lse_j):
     running lse-weighted merge. Carry: o = Σ o_i·exp(lse_i − m) (f32),
     d_acc = Σ exp(lse_i − m), m = max lse so far. The standard exact
     softmax decomposition: each block's normalized output re-weighted by
-    its share of the global mass. −inf lse (fully-masked hop) folds in
-    with weight 0."""
+    its share of the global mass. A fully-masked hop folds in with
+    weight 0 — masked means lse <= NEG_INF/2, covering BOTH the empty
+    carry's true -inf and the kernels' finite NEG_INF sentinel (-1e30;
+    r3 advisor: an isneginf-only guard gave a fully-masked partial
+    weight 1 against an empty carry, surviving as its uniform-softmax
+    artifact)."""
     m_new = jnp.maximum(m, lse_j)
-    # exp(-inf - -inf) would be nan: a -inf running max (nothing folded
-    # yet) or a -inf hop must contribute factor 0, not nan.
-    alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_new))
-    beta = jnp.where(jnp.isneginf(lse_j), 0.0, jnp.exp(lse_j - m_new))
+    # exp(-inf - -inf) would be nan: a masked running max (nothing folded
+    # yet) or a masked hop must contribute factor 0, not nan.
+    alpha = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_new))
+    beta = jnp.where(lse_j <= NEG_INF / 2, 0.0, jnp.exp(lse_j - m_new))
     o_new = o * alpha[..., None] + o_j.astype(jnp.float32) * beta[..., None]
     return o_new, m_new, d_acc * alpha + beta
 

@@ -22,8 +22,15 @@ cp-1 sequential ppermute steps. The transformer exposes both:
 GQA (r3): with n_kv % cp == 0, K/V all-to-all on their OWN head dim —
 each device then holds h/cp query heads and n_kv/cp kv heads, and
 ``attn_fn`` MUST accept GQA-shaped inputs (the flash kernel and the
-grouped dense reference both do). n_kv % cp != 0 falls back to an internal
-repeat, restoring equal head counts (condition: n_kv % cp != 0 — e.g. n_kv=6, cp=4 also falls back).
+grouped dense reference both do). n_kv % cp != 0 (r4): K/V are
+ALL-GATHERED over cp on the sequence dim instead — (cp-1)/cp · t·n_kv·d
+moved per device vs the r3 silent repeat's (cp-1)/cp · t·h·d/cp through
+the all-to-all, i.e. cp/g the traffic (less whenever cp < g) and no
+[t, h, d] repeated tensor is ever materialized. Each shard then takes
+exactly the kv heads its contiguous query-head block maps to
+(j -> j//g), so the local attention is equal-headed and any MHA
+``attn_fn`` works. Per-device K/V HBM is t·(n_kv + h/cp)·d — same
+order as the n_kv % cp == 0 path when g >= cp.
 
 Layout contract matches ring_attention: global [batch, seq, heads,
 head_dim], sequence sharded over ``axis_name`` on entry and exit.
@@ -45,11 +52,19 @@ from tf_operator_tpu.ops.flash_attention import reference_attention
 
 
 def _ulysses_local(q, k, v, axis_name: str, causal: bool,
-                   attn_fn: Optional[Callable]):
+                   attn_fn: Optional[Callable], gather_kv: bool = False):
     """Per-device body. q/k/v: [b, t_local, h, d] (sequence-sharded).
 
     all_to_all over the heads dim: [b, t_local, h, d] -> concat over the
     cp group's t blocks with h/cp local heads -> [b, t_global, h_local, d].
+
+    ``gather_kv`` (the n_kv % cp != 0 path): K/V skip the head split —
+    they are all-gathered whole over the sequence dim, then each shard
+    TAKES the kv head serving each of its h/cp contiguous query heads
+    (global query head i·h/cp + j -> kv head (i·h/cp + j)//g), handing
+    attn_fn an equal-headed local problem. Exact: same softmax, the
+    take only materializes the repeat lazily and only for this shard's
+    query block.
     """
     n = axis_size(axis_name)
 
@@ -66,7 +81,18 @@ def _ulysses_local(q, k, v, axis_name: str, causal: bool,
             x, axis_name, split_axis=1, concat_axis=2, tiled=True
         )  # [b, t_local, h, d]
 
-    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    qg = seq_to_heads(q)
+    if gather_kv:
+        h, h_kv = q.shape[2], k.shape[2]
+        g, h_loc = h // h_kv, h // n
+        kg = jax.lax.all_gather(k, axis_name, axis=1, tiled=True)
+        vg = jax.lax.all_gather(v, axis_name, axis=1, tiled=True)
+        i = jax.lax.axis_index(axis_name)
+        head_map = (i * h_loc + jnp.arange(h_loc)) // g
+        kg = jnp.take(kg, head_map, axis=2)
+        vg = jnp.take(vg, head_map, axis=2)
+    else:
+        kg, vg = seq_to_heads(k), seq_to_heads(v)
     if attn_fn is None:
         out = reference_attention(qg, kg, vg, causal=causal)
     else:
@@ -117,15 +143,14 @@ def ulysses_attention(
     # moving group-times less data per all-to-all, and the local
     # attention runs GQA-native (contiguous head blocks keep query head
     # j -> kv head j//group aligned per shard since h/cp = g * n_kv/cp).
-    # Indivisible kv counts (n_kv % cp != 0) materialize the repeat as before.
-    if h_kv != h and h_kv % cp:
-        g = h // h_kv
-        k = jnp.repeat(k, g, axis=2)
-        v = jnp.repeat(v, g, axis=2)
+    # Indivisible kv counts (r4): all-gather the small K/V whole and map
+    # heads per shard inside the body — no silent repeat (the r3
+    # fallback restored exactly the K/V traffic GQA removes).
+    gather_kv = bool(h_kv != h and h_kv % cp)
     spec = P(batch_axes, axis_name, None, None)
     fn = shard_map(
         partial(_ulysses_local, axis_name=axis_name, causal=causal,
-                attn_fn=attn_fn),
+                attn_fn=attn_fn, gather_kv=gather_kv),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -124,6 +124,11 @@ class SyntheticTokens(ArrayDataset):
 # Disk-backed readers: MNIST idx-ubyte + tokenized-corpus memmap
 # ---------------------------------------------------------------------------
 
+# once-only latch for the native-dataops-unavailable warning in
+# _augment_native (must exist at module scope: the warning path is the
+# first reader, on hosts where the C++ build fails)
+_dataops_warned = False
+
 _IDX_DTYPES = {
     0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
     0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64,
