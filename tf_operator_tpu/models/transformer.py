@@ -150,6 +150,19 @@ PRESETS: Dict[str, TransformerConfig] = {
         vocab=30522, d_model=768, n_layers=12, n_heads=12, n_kv_heads=12, d_ff=3072,
         max_seq=512, causal=False,
     ),
+    # North-star-shape single-chip config (r4): the largest GQA model
+    # whose adamw state fits one 16 GB chip, at the d>=2048 shapes where
+    # the chip's practical matmul ceiling is ~60% (BASELINE.md roofline:
+    # [16k,4096]² sustains 118 TFLOP/s vs 103 at d=768) — the regime the
+    # 50%-MFU target presumes. ~795M params — sized against the MEASURED
+    # adamw residency of ~18 bytes/param at grad_accum=1 (p+m+v+grads f32
+    # + the bf16 compute cast; accum>1 adds a second f32 grad buffer and
+    # pushed the L=14 variant to 19.9G on a 15.75G chip). The
+    # [b·t,2048]x[2048,8192] MLP matmuls dominate the FLOPs.
+    "gqa-2048": TransformerConfig(
+        vocab=32000, d_model=2048, n_layers=12, n_heads=16, n_kv_heads=4,
+        d_ff=8192, max_seq=4096,
+    ),
     "llama2-7b": TransformerConfig(
         vocab=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=32, d_ff=11008,
         max_seq=4096,

@@ -307,6 +307,12 @@ def _augment_native(images: np.ndarray, pad: int, dy, dx, do) -> Optional[np.nda
     output bytes either way."""
     import ctypes
 
+    global _dataops_warned
+    # A failed load already warned once — don't re-run the (subprocess,
+    # up-to-120s) native build attempt on every batch of a job that is
+    # going to fall back to numpy anyway.
+    if _dataops_warned:
+        return None
     try:
         from tf_operator_tpu.runtime.native import load_dataops
 
@@ -315,7 +321,6 @@ def _augment_native(images: np.ndarray, pad: int, dy, dx, do) -> Optional[np.nda
         # Warn ONCE: the numpy fallback is ~6x slower (BASELINE.md) — at
         # ResNet rates it cannot feed the step, and without a diagnostic
         # an input-bound job points at nothing.
-        global _dataops_warned
         if not _dataops_warned:
             _dataops_warned = True
             import warnings

@@ -53,8 +53,15 @@ def plan(preset_name: str, mesh_axes: dict, batch: int, seq: int,
 
     ``grad_accum`` > 1 (TrainerConfig.grad_accum) scales the activation
     term by 1/accum — only one microbatch's activations are live at a
-    time inside the accumulation scan; params/optimizer/grads are
-    unchanged (the f32 grad accumulators ARE the grads term)."""
+    time inside the accumulation scan — but ADDS a params-sized f32
+    transient: the scan's grad carry and the current microbatch's grads
+    coexist at the accumulate (r4, measured: the L=14 gqa-2048 plan said
+    14.9 GB and the chip requested 19.9). A second transient applies
+    regardless of accum: the bf16 compute cast of the f32 master params
+    (~params/2). Both are in ``transient_gb``. XLA workspace/fragmentation
+    is NOT modeled — treat a margin under ~2% of budget as "does not
+    fit" (the gqa-2048 b=8 plan margin was 0.04 GB and the chip OOM'd
+    by 22 MB)."""
     import math
 
     n_chips = math.prod(mesh_axes.values()) or 1
@@ -107,6 +114,12 @@ def plan(preset_name: str, mesh_axes: dict, batch: int, seq: int,
     opt_b = shard_bytes(tmpl.opt_state)
     # gradients materialize alongside params during the update
     grads_b = params_b
+    # step-transients (r4): the bf16 compute cast of the f32 master
+    # params is live through fwd+bwd; with accumulation the scan's f32
+    # grad carry and the microbatch grads coexist at the accumulate
+    transient_b = params_b * dtype_bytes // 4
+    if grad_accum > 1:
+        transient_b += params_b
 
     # Activation estimate. Batch shards over (dp, fsdp); seq over cp;
     # within a shard, full remat keeps L residual-stream saves [b,t,d]
@@ -142,7 +155,7 @@ def plan(preset_name: str, mesh_axes: dict, batch: int, seq: int,
         head = local_tokens * (v // tp) * 4  # f32 logits
     acts_b = saved + working + head
 
-    total = params_b + opt_b + grads_b + acts_b
+    total = params_b + opt_b + grads_b + transient_b + acts_b
     return {
         "preset": preset_name,
         "mesh": mesh_axes,
@@ -154,6 +167,7 @@ def plan(preset_name: str, mesh_axes: dict, batch: int, seq: int,
         "params_gb": params_b / 2**30,
         "optimizer_gb": opt_b / 2**30,
         "grads_gb": grads_b / 2**30,
+        "transient_gb": transient_b / 2**30,
         "activations_gb": acts_b / 2**30,
         "total_gb": total / 2**30,
     }
