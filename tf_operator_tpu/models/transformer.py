@@ -151,10 +151,12 @@ PRESETS: Dict[str, TransformerConfig] = {
         max_seq=512, causal=False,
     ),
     # North-star-shape single-chip config (r4): the largest GQA model
-    # whose adamw state fits one 16 GB chip, at the d>=2048 shapes where
-    # the chip's practical matmul ceiling is ~60% (BASELINE.md roofline:
-    # [16k,4096]² sustains 118 TFLOP/s vs 103 at d=768) — the regime the
-    # 50%-MFU target presumes. ~795M params — sized against the MEASURED
+    # whose adamw state fits one 16 GB chip, at the d>=2048 shapes the
+    # 50%-MFU target presumes — measured 56% exact MFU / 49.7% 6ND vs
+    # gpt-small's 38% at d=768 (BASELINE.md; the gap is model-level
+    # per-op overhead at small d, not a matmul-rate wall — the chip's
+    # chained-matmul rate is ~flat across these shapes under the r4
+    # corrected protocol). ~795M params — sized against the MEASURED
     # adamw residency of ~18 bytes/param at grad_accum=1 (p+m+v+grads f32
     # + the bf16 compute cast; accum>1 adds a second f32 grad buffer and
     # pushed the L=14 variant to 19.9G on a 15.75G chip). The

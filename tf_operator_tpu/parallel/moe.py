@@ -183,13 +183,16 @@ def _moe_single(x, gate_logits, expert_params, expert_fn, capacity: int, dropped
         dispatch_w, keep_any, inbox, stats = _route(
             x, gate_logits, capacity, k_top, dropped)
 
-    def run_expert(e, acc):
-        params_e = jax.tree_util.tree_map(lambda a: a[e], expert_params)
-        out = expert_fn(params_e, inbox[e].astype(x.dtype)).astype(jnp.float32)
-        return acc.at[e].set(out)
-
-    outbox = jnp.zeros((n_experts, capacity, d), jnp.float32)
-    outbox = jax.lax.fori_loop(0, n_experts, run_expert, outbox)
+    # vmap over the stacked expert dim — ONE batched-matmul program for
+    # all experts. r4: the previous fori_loop ran E sequential [C,d]
+    # matmul chains with a dynamic-slice parameter gather and an
+    # acc.at[e].set copy per step; at bench shapes the identical FLOPs
+    # measured 15.1 ms looped vs 8.1 ms batched (tools/roofline --mode
+    # moe), and the batched form runs at 87% of the chip's chained
+    # matmul rate.
+    outbox = jax.vmap(
+        lambda w_e, t: expert_fn(w_e, t.astype(x.dtype))
+    )(expert_params, inbox).astype(jnp.float32)
     if dispatch_impl == "sort":
         combined = _combine_sparse(outbox, slot, w)
     else:
@@ -227,15 +230,17 @@ def _moe_local(x, gate_logits, expert_params, expert_fn, axis_name: str, capacit
     # Now: [n_shards(source), E_local, C, d] on each device.
     inbox = inbox.reshape(n_shards, experts_per_shard, capacity, d)
 
-    # Run each local expert over its gathered tokens.
-    def run_expert(e, acc):
-        params_e = jax.tree_util.tree_map(lambda a: a[e], expert_params)
-        toks = inbox[:, e].reshape(n_shards * capacity, d)
-        out = expert_fn(params_e, toks.astype(x.dtype)).astype(jnp.float32)
-        return acc.at[:, e].set(out.reshape(n_shards, capacity, d))
+    # Run each local expert over its gathered tokens — vmapped over the
+    # expert dim into one batched-matmul program (r4, same rationale as
+    # _moe_single: the fori_loop form measured 1.87x slower on identical
+    # FLOPs).
+    def one_expert(params_e, toks):  # toks: [n_shards, C, d]
+        out = expert_fn(params_e, toks.reshape(n_shards * capacity, d).astype(x.dtype))
+        return out.astype(jnp.float32).reshape(n_shards, capacity, d)
 
-    outbox = jnp.zeros((n_shards, experts_per_shard, capacity, d), jnp.float32)
-    outbox = jax.lax.fori_loop(0, experts_per_shard, run_expert, outbox)
+    outbox = jax.vmap(one_expert, in_axes=(0, 1), out_axes=1)(
+        expert_params, inbox
+    )
 
     # Return results to source shards.
     outbox = jax.lax.all_to_all(outbox, axis_name, split_axis=0, concat_axis=0, tiled=False)
