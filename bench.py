@@ -75,14 +75,28 @@ def run_timed_steps(trainer, state, pull, steps: int, stream: bool,
     return state, metrics, steps, step_s
 
 
-def run_first_step(trainer, pull, breakdown, t_submit):
+def start_precompile(trainer, batch_spec):
+    """Kick off the background step compile (r4 submit overlap) — called
+    BEFORE batch staging so the step program's trace+compile+upload
+    overlaps the batch upload AND the init phase. BENCH_OVERLAP=0
+    restores the serial path for A/B."""
+    if os.environ.get("BENCH_OVERLAP", "1") != "1":
+        return None
+    if os.environ.get("BENCH_FUSED_SUBMIT", "0") == "1":
+        return None
+    return trainer.precompile_step_async(batch_spec)
+
+
+def run_first_step(trainer, pull, breakdown, t_submit, pre=None):
     """Submit-phase protocol shared by both benches: the split
-    init-then-step path by default (two programs, phase-timed), or the
-    fused single-program path under BENCH_FUSED_SUBMIT=1
-    (Trainer.init_and_step — one executable upload; measured no net win
-    through this tunnel, see BASELINE.md submit section). Returns
-    (state, metrics). float() forces a host fetch — plain
-    block_until_ready does not synchronize through the remote TPU tunnel."""
+    init-then-step path by default (two programs, phase-timed, with the
+    step program compiling on ``pre``'s background thread — r3 measured
+    the two phases strictly serialized at 5.0 s + 9.9 s), or the fused
+    single-program path under BENCH_FUSED_SUBMIT=1 (Trainer.init_and_step
+    — one executable upload; measured no net win through this tunnel, see
+    BASELINE.md submit section). Returns (state, metrics). float() forces
+    a host fetch — plain block_until_ready does not synchronize through
+    the remote TPU tunnel."""
     import jax
 
     if os.environ.get("BENCH_FUSED_SUBMIT", "0") == "1":
@@ -95,6 +109,10 @@ def run_first_step(trainer, pull, breakdown, t_submit):
         t0 = time.perf_counter()
         state = trainer.init(jax.random.PRNGKey(0))
         breakdown["init_dispatch_s"] = round(time.perf_counter() - t0, 2)
+        if pre is not None:
+            t0 = time.perf_counter()
+            pre.join()
+            breakdown["step_compile_join_s"] = round(time.perf_counter() - t0, 2)
         t0 = time.perf_counter()
         state, metrics = trainer.step(state, pull())
         _ = float(metrics["loss"])
@@ -190,6 +208,9 @@ def bench_lm(model: str) -> None:
 
     t_submit = time.perf_counter()
     breakdown = {}
+    pre = start_precompile(
+        trainer, jax.ShapeDtypeStruct((batch, seq), "int32")
+    )
     if not stream:
         tokens = jax.device_put(
             jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab),
@@ -201,7 +222,7 @@ def bench_lm(model: str) -> None:
 
     breakdown["stage_batch_dispatch_s"] = round(time.perf_counter() - t_submit, 2)
     try:
-        state, metrics = run_first_step(trainer, pull, breakdown, t_submit)
+        state, metrics = run_first_step(trainer, pull, breakdown, t_submit, pre)
         first_step_s = time.perf_counter() - t_submit
         # 5 warmup steps, one fetch: the hint carries the fixed ~70-100 ms
         # tunnel sync divided by 5 (≤20 ms) — at 2 steps the sync term
@@ -361,6 +382,13 @@ def main() -> None:
 
     t_submit = time.perf_counter()
     breakdown = {}
+    pre = start_precompile(
+        trainer,
+        (
+            jax.ShapeDtypeStruct((batch, image_size, image_size, 3), "float32"),
+            jax.ShapeDtypeStruct((batch,), "int32"),
+        ),
+    )
 
     if not stream:
         # Staged FIRST: device_put dispatches the (77 MB at b=128) upload
@@ -379,7 +407,7 @@ def main() -> None:
 
     breakdown["stage_batch_dispatch_s"] = round(time.perf_counter() - t_submit, 2)
     try:
-        state, metrics = run_first_step(trainer, pull, breakdown, t_submit)
+        state, metrics = run_first_step(trainer, pull, breakdown, t_submit, pre)
         first_step_s = time.perf_counter() - t_submit
         t_warm = time.perf_counter()
         for _ in range(warmup):
