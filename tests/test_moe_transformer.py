@@ -651,3 +651,86 @@ def test_pipeline_interleaved_trains_through_trainer():
         state, m = trainer.step(state, tok)
         losses.append(float(m["loss"] if isinstance(m, dict) else m))
     assert losses[-1] < losses[0], losses
+
+
+# ---- flagship MoE sharding: ep x fsdp (r4, VERDICT r3 #5) -----------------
+
+
+def test_moe_apply_ep_fsdp_matches_single_device_oracle():
+    """Expert weights sharded over ep (expert dim) AND fsdp (embed dim),
+    tokens over (dp, fsdp, ep) — the mixtral-8x7b layout — must match
+    the single-device moe_apply exactly, fwd and grads. capacity 8.0:
+    no drops, so the per-shard-queue caveat doesn't apply and parity is
+    exact."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tf_operator_tpu.parallel.moe import moe_apply
+
+    T, d, f, E = 64, 16, 32, 4
+    ks = jax.random.split(jax.random.PRNGKey(0), 5)
+    x = jax.random.normal(ks[0], (T, d), jnp.float32)
+    gl = jax.random.normal(ks[1], (T, E), jnp.float32)
+    wp = {
+        "w_gate": jax.random.normal(ks[2], (E, d, f)) * 0.1,
+        "w_up": jax.random.normal(ks[3], (E, d, f)) * 0.1,
+        "w_down": jax.random.normal(ks[4], (E, f, d)) * 0.1,
+    }
+
+    def expert_fn(w, t):
+        return (jax.nn.silu(t @ w["w_gate"]) * (t @ w["w_up"])) @ w["w_down"]
+
+    mesh = build_mesh({"dp": 2, "fsdp": 2, "ep": 2})
+    xs = jax.device_put(x, NamedSharding(mesh, P(("dp", "fsdp", "ep"))))
+    gls = jax.device_put(gl, NamedSharding(mesh, P(("dp", "fsdp", "ep"))))
+    wps = jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, NamedSharding(mesh, P("ep", "fsdp"))), wp
+    )
+
+    want = moe_apply(x, gl, wp, expert_fn, None, capacity_factor=8.0, k_top=2)
+    got = moe_apply(xs, gls, wps, expert_fn, mesh, capacity_factor=8.0, k_top=2)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+    def loss(fn_mesh, gl_):
+        def f(x_, wp_):
+            return jnp.sum(
+                moe_apply(x_, gl_, wp_, expert_fn, fn_mesh,
+                          capacity_factor=8.0, k_top=2) ** 2)
+        return f
+
+    # mesh path closes over the SHARDED gating logits (gls) so the
+    # backward through sharded routing is what's tested
+    got_g = jax.grad(loss(mesh, gls), argnums=(0, 1))(xs, wps)
+    want_g = jax.grad(loss(None, gl), argnums=(0, 1))(x, wp)
+    for a, b in zip(jax.tree_util.tree_leaves(got_g),
+                    jax.tree_util.tree_leaves(want_g)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-5)
+
+
+def test_moe_transformer_trains_ep_fsdp_dp():
+    """Full Trainer on the dp x fsdp x ep mesh: expert weights must be
+    STORED sharded over both ep and fsdp (no per-dp-replica expert
+    replication — the flagship memplan depends on it) and the model must
+    train."""
+    cfg = preset("tiny-moe", dtype=jnp.float32, remat=False, moe_top_k=2)
+    mesh = build_mesh({"dp": 2, "fsdp": 2, "ep": 2})
+    trainer = Trainer(
+        mesh,
+        loss_fn=lambda p, tok, e: lm_loss(p, tok, cfg, mesh=mesh),
+        init_fn=lambda k: init_transformer(k, cfg),
+        logical_axes=transformer_logical_axes(cfg),
+        config=TrainerConfig(optimizer="adamw", learning_rate=3e-3),
+    )
+    state = trainer.init(jax.random.PRNGKey(0))
+    spec = tuple(state.params["layers"]["w_gate"].sharding.spec)
+    assert "ep" in spec and "fsdp" in spec, spec
+    tok = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab),
+        trainer.batch_sharding,
+    )
+    losses = []
+    for _ in range(10):
+        state, m = trainer.step(state, tok)
+        losses.append(float(m["loss"]))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses

@@ -181,6 +181,17 @@ PRESETS: Dict[str, TransformerConfig] = {
         vocab=32000, d_model=8192, n_layers=80, n_heads=64, n_kv_heads=8, d_ff=28672,
         max_seq=4096,
     ),
+    # Flagship-scale sparse config (r4, VERDICT r3 #5): Mixtral-8x7B
+    # shapes — 8 experts top-2, GQA 32q/8kv, ~46.5B total / ~12.7B
+    # active params. Its legal mesh is dp x fsdp x ep: experts shard
+    # over ep on their expert dim AND over fsdp on their embed dim
+    # (DEFAULT_RULES "expert"/"embed"), so expert weights no longer
+    # replicate per dp replica — the memplan-closing layout for a
+    # v5p-256 pod (examples/mixtral_8x7b_v5p256.json).
+    "mixtral-8x7b": TransformerConfig(
+        vocab=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        d_ff=14336, max_seq=4096, n_experts=8, moe_top_k=2,
+    ),
 }
 
 

@@ -51,10 +51,14 @@ class TrainerConfig:
     # threefry RNG subgraphs dominate the init EXECUTABLE — the unrolled
     # ResNet-50 init measured 2.5 s of executable transfer + 11.6 s cold
     # compile through the tunnel vs 0.4 s / 5.4 s with rbg. Same
-    # distributions, different stream (and rbg streams are per-backend) —
-    # fine for weight init, wrong for anything needing cross-backend
-    # bit-reproducibility, hence the switch. Restores/resumes never
-    # re-init, so recovery semantics are unchanged.
+    # distributions, different stream — and rbg streams vary with
+    # BACKEND, COMPILER VERSION, and MESH/PARTITION LAYOUT (XLA
+    # RngBitGenerator documents no stability across any of these), so
+    # same-seed init is no longer bit-identical across dp=4 vs dp=8
+    # meshes the way threefry was. Fine for weight init; set False for
+    # seed-matched ablations across mesh layouts or anything needing
+    # bit-reproducibility. Restores/resumes never re-init, so recovery
+    # semantics are unchanged.
     fast_init_rng: bool = True
 
 
@@ -137,6 +141,8 @@ class Trainer:
         self._step_jit = None
         self._step_compiled = None
         self._precompile_error = None
+        self._compiled_hits = 0
+        self._compiled_rejections = 0
         self._multi_jit: Dict[Any, Any] = {}
 
     # ---- init -----------------------------------------------------------
@@ -359,6 +365,8 @@ class Trainer:
                     state.params, state.opt_state, state.step, state.extra,
                     batch,
                 )
+                self._compiled_hits += 1
+                self._compiled_rejections = 0
                 return (TrainState(params, opt_state, step, extra),
                         {"loss": loss})
             except (TypeError, ValueError) as exc:
@@ -366,15 +374,27 @@ class Trainer:
                 # checking, so no buffer was donated. Route only THIS
                 # call to the jit path and KEEP the executable: one
                 # odd-shaped batch (e.g. a final partial batch) must not
-                # force a cold recompile of the common shape. Runtime
-                # errors propagate — retrying after a mid-execution
-                # failure could touch already-donated buffers.
+                # force a cold recompile of the common shape. But an
+                # executable that NEVER matched (the precompile guessed
+                # the wrong batch spec) is dropped after 3 straight
+                # rejections — otherwise every step of a long run pays
+                # the failed call + a warning. Runtime errors propagate —
+                # retrying after a mid-execution failure could touch
+                # already-donated buffers.
                 import logging
 
-                logging.getLogger(__name__).warning(
-                    "precompiled step rejected args (%s); jit path for "
-                    "this call", exc,
-                )
+                self._compiled_rejections += 1
+                if self._compiled_rejections == 1:
+                    logging.getLogger(__name__).warning(
+                        "precompiled step rejected args (%s); jit path "
+                        "for this call", exc,
+                    )
+                if self._compiled_hits == 0 and self._compiled_rejections >= 3:
+                    logging.getLogger(__name__).warning(
+                        "precompiled step never matched a real batch; "
+                        "dropping it (submit overlap not realized)",
+                    )
+                    self._step_compiled = None
         if self._step_jit is None:
             self._step_jit = self._build_step()
         params, opt_state, step, extra, loss = self._step_jit(
