@@ -281,7 +281,109 @@ def bench_lm(model: str) -> None:
     )
 
 
+def bench_resnet_bn_ab() -> None:
+    """Same-INVOCATION A/B of the BN stats-gradient modes (VERDICT r3
+    #3): var and exact trainers built side by side, timed regions
+    interleaved var/exact/var/exact on the same chip minutes apart — the
+    receipt chip-day variance cannot fake. One JSON line with both."""
+    from tf_operator_tpu.train.compile_cache import enable as enable_compile_cache
+
+    enable_compile_cache()
+
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.resnet import ResNetConfig, init_resnet, resnet_forward
+    from tf_operator_tpu.train.metrics import mfu, resnet_train_flops
+    from tf_operator_tpu.train.trainer import Trainer, TrainerConfig
+    from tf_operator_tpu.parallel import build_mesh
+
+    dev = jax.devices()[0]
+    n_chips = jax.device_count()
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    image_size = int(os.environ.get("BENCH_IMAGE", "224"))
+    steps = int(os.environ.get("BENCH_STEPS", "15"))
+    mesh = build_mesh({"dp": n_chips})
+
+    def make_trainer(mode):
+        cfg = dataclasses.replace(
+            ResNetConfig.resnet50(), bn_stats_stop_gradient=mode
+        )
+
+        def loss_fn(params, batch_data, st):
+            images, labels = batch_data
+            logits, new_state = resnet_forward(params, st, images, cfg, train=True)
+            logp = jax.nn.log_softmax(logits)
+            loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+            return loss, new_state
+
+        return Trainer(
+            mesh,
+            loss_fn=loss_fn,
+            init_fn=lambda k: init_resnet(k, cfg),
+            config=TrainerConfig(optimizer="sgd", learning_rate=0.1,
+                                 grad_clip=None),
+        ), cfg
+
+    arms = {}
+    images = labels = None
+    for mode in ("var", False):
+        name = "var" if mode == "var" else "exact"
+        trainer, cfg = make_trainer(mode)
+        if images is None:
+            images = jax.device_put(
+                jax.random.normal(
+                    jax.random.PRNGKey(1), (batch, image_size, image_size, 3)
+                ),
+                trainer.batch_sharding,
+            )
+            labels = jax.device_put(
+                jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, 1000),
+                trainer.batch_sharding,
+            )
+        state = trainer.init(jax.random.PRNGKey(0))
+        for _ in range(3):  # compile + warm
+            state, m = trainer.step(state, (images, labels))
+        _ = float(m["loss"])
+        arms[name] = {"trainer": trainer, "state": state, "cfg": cfg,
+                      "times": []}
+    # interleave: var, exact, var, exact — same chip, minutes apart
+    for _ in range(2):
+        for name in ("var", "exact"):
+            a = arms[name]
+            t0 = time.perf_counter()
+            st = a["state"]
+            for _ in range(steps):
+                st, m = a["trainer"].step(st, (images, labels))
+            _ = float(m["loss"])
+            a["state"] = st
+            a["times"].append((time.perf_counter() - t0) / steps)
+    fwd_flops = arms["var"]["cfg"].flops_per_image(image_size)
+    train_flops = resnet_train_flops(fwd_flops, batch)
+    out = {
+        "metric": "resnet50_bn_ab_step_time_s",
+        "value": round(min(arms["var"]["times"]), 5),
+        "unit": "s/step (var mode, best of interleaved runs)",
+        "vs_baseline": round(
+            min(arms["exact"]["times"]) / min(arms["var"]["times"]), 4),
+        "interleave_order": "var,exact,var,exact",
+        "n_chips": n_chips,
+        "batch": batch,
+        "device": getattr(dev, "device_kind", dev.platform),
+    }
+    for name in ("var", "exact"):
+        ts = arms[name]["times"]
+        out[f"{name}_step_time_s"] = [round(t, 5) for t in ts]
+        out[f"{name}_mfu"] = round(mfu(train_flops, min(ts), n_chips), 4)
+    print(json.dumps(out))
+
+
 def main() -> None:
+    if os.environ.get("BENCH_BN_AB", "0") == "1":
+        bench_resnet_bn_ab()
+        return
     model = os.environ.get("BENCH_MODEL", "resnet50").lower()
     if model not in ("resnet50", "resnet"):
         from tf_operator_tpu.models.transformer import PRESETS

@@ -230,3 +230,54 @@ def test_open_server_ignores_tokens():
         assert len(RemoteStore(server.url, token="").list("TPUJob")) == 1
     finally:
         server.stop()
+
+
+# ---- full-surface reads auth (r4, --auth-reads) ---------------------------
+
+
+@pytest.fixture
+def auth_reads_server():
+    store = Store()
+    server = DashboardServer(store, port=0, auth_token=TOKEN, auth_reads=True)
+    server.start()
+    yield store, server
+    server.stop()
+
+
+def test_auth_reads_gates_every_read_route(auth_reads_server):
+    """With --auth-reads, job reads, events, logs, /metrics and the UI
+    all require the bearer (reference parity: Kubernetes auth covers ALL
+    API access, k8sutil.go:53-77); /healthz stays open for probes."""
+    store, server = auth_reads_server
+    store.create(_job())
+    for path in ("/api/tpujob", "/api/tpujob/default/j1", "/api/events",
+                 "/api/namespaces", "/ui", "/metrics"):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(server.url + path, timeout=5)
+        assert exc.value.code == 401, path
+
+    # with the token: reads serve (metrics 404s — no controller wired —
+    # but NOT 401)
+    hdrs = bearer_headers(TOKEN)
+    req = urllib.request.Request(server.url + "/api/tpujob", headers=hdrs)
+    body = json.loads(urllib.request.urlopen(req, timeout=5).read())
+    assert any(j["metadata"]["name"] == "j1" for j in body["items"])
+    req = urllib.request.Request(server.url + "/ui", headers=hdrs)
+    assert urllib.request.urlopen(req, timeout=5).status == 200
+
+    # liveness: open, by design
+    assert (
+        json.loads(urllib.request.urlopen(server.url + "/healthz", timeout=5).read())["ok"]
+        is True
+    )
+
+
+def test_auth_reads_off_by_default(auth_server):
+    """Without the flag the r3 posture holds: human reads stay open even
+    on a token-bearing server."""
+    store, server = auth_server
+    store.create(_job())
+    body = json.loads(
+        urllib.request.urlopen(server.url + "/api/tpujob", timeout=5).read()
+    )
+    assert any(j["metadata"]["name"] == "j1" for j in body["items"])

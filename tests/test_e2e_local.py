@@ -523,7 +523,11 @@ def test_lm_training_streams_through_device_loader(rig):
     trains the LM with host batches flowing through the prefetching
     DeviceLoader (data="stream") instead of one resident device batch.
     In multi-process mode each process stages only its local slice
-    (make_array_from_process_local_data)."""
+    (make_array_from_process_local_data). device_loop=2 (r4, VERDICT r3
+    #7a): stream chunks are stacked by a JITTED stacker — multi-host
+    global arrays can't stack eagerly — and run through
+    Trainer.multi_step(stacked=True); the r3 behavior silently fell
+    back to per-step dispatch here."""
     store = rig
     job = TPUJob(
         metadata=ObjectMeta(name="lm-stream"),
@@ -541,10 +545,11 @@ def test_lm_training_streams_through_device_loader(rig):
     )
     job.spec.workload = {
         "preset": "tiny",
-        "steps": 4,
+        "steps": 7,
         "batch_size": 4,
         "seq_len": 32,
         "data": "stream",
+        "device_loop": 2,
     }
     store.create(job)
     ok = wait_for(
@@ -769,3 +774,94 @@ def test_jobs_survive_chaos_kills(tmp_path):
         monkey.stop()
         ctl.stop()
         pc.shutdown()
+
+
+def test_resnet_evaluator_reports_accuracy(rig_api, tmp_path):
+    """VERDICT r3 #7b done-bar: a resnet_real_idx-class job with an
+    EVALUATOR replica reporting accuracy into eval_metrics. The trainer
+    gang checkpoints (params + BN stats); the evaluator — model="resnet",
+    outside the gang — restores both subtrees per checkpoint and scores
+    test-split accuracy through the same idx reader."""
+    import numpy as np
+
+    sklearn_datasets = pytest.importorskip(
+        "sklearn.datasets", reason="real-digits fixture needs scikit-learn"
+    )
+    from tf_operator_tpu.train.data import write_idx
+
+    digits = sklearn_datasets.load_digits()
+    order = np.random.default_rng(0).permutation(len(digits.target))
+    images = (digits.images * (255.0 / 16.0)).astype(np.uint8)[order]
+    labels = digits.target.astype(np.uint8)[order]
+    data_dir = tmp_path / "digits"
+    data_dir.mkdir()
+    write_idx(str(data_dir / "train-images-idx3-ubyte.gz"), images[:1500])
+    write_idx(str(data_dir / "train-labels-idx1-ubyte.gz"), labels[:1500])
+    write_idx(str(data_dir / "t10k-images-idx3-ubyte"), images[1500:])
+    write_idx(str(data_dir / "t10k-labels-idx1-ubyte"), labels[1500:])
+
+    store = rig_api
+    ckpt_dir = str(tmp_path / "ckpt")
+    report = str(tmp_path / "eval_report.json")
+    job = TPUJob(
+        metadata=ObjectMeta(name="resnet-eval"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=1,
+                    template=ProcessTemplate(
+                        entrypoint="tf_operator_tpu.workloads.resnet:main",
+                        env=dict(DATAPLANE_ENV),
+                    ),
+                ),
+                ReplicaType.EVALUATOR: ReplicaSpec(
+                    replicas=1,
+                    template=ProcessTemplate(
+                        entrypoint="tf_operator_tpu.workloads.eval:main",
+                        env=dict(DATAPLANE_ENV),
+                    ),
+                ),
+            },
+        ),
+    )
+    job.spec.workload = {
+        "data": "idx",
+        "data_dir": str(data_dir),
+        "variant": "tiny",
+        "num_classes": 10,
+        "image_size": 32,
+        "epochs": 4,
+        "batch_size": 256,
+        "lr": 0.02,
+        "augment": True,
+        "flip": False,
+        "checkpoint_dir": ckpt_dir,
+        "checkpoint_every": 2,
+        # evaluator keys: model selects the resnet scorer; train_steps=2
+        # so the evaluator finishes BEFORE the trainer (job success is
+        # chief-driven; cleanup kills stragglers — same protocol as the
+        # LM evaluator e2e above)
+        "model": "resnet",
+        "train_steps": 2,
+        "eval_batch_size": 64,
+        "poll_interval_s": 0.2,
+        "max_wait_s": 180,
+        "eval_report": report,
+    }
+    store.create(job)
+    ok = wait_for(
+        lambda: has_condition(job_status(store, "resnet-eval"), ConditionType.SUCCEEDED),
+        timeout=360,
+    )
+    st = job_status(store, "resnet-eval")
+    assert ok, f"conditions: {[(c.type.value, c.reason, c.message) for c in st.conditions]}"
+    # the trainer's own end-of-run gate also reports accuracy; the
+    # EVALUATOR's per-checkpoint scoring is asserted via its report
+    # artifact — written before job cleanup because train_steps=2 ends
+    # the evaluator while the trainer still has epochs to run, so its
+    # absence means the scoring path is broken, not a timing race
+    import json as _json
+
+    scored = _json.loads(open(report).read())
+    assert scored and all(0.0 <= v <= 1.0 for v in scored.values()), scored
+    assert "metrics" in st.eval_metrics, st.eval_metrics

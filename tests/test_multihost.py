@@ -272,10 +272,12 @@ def test_ha_operators_daemon_level_failover(tmp_path):
     reconciles (a submitted job completes); SIGKILLing the active leader
     fails over to the standby, which completes a second job.
 
-    Runs with API auth ENABLED (VERDICT r2 #5): every daemon carries the
-    shared bearer token ($TPUJOB_AUTH_TOKEN), an unauthenticated submit is
-    rejected 401, and the whole store-server machine surface (leases,
-    watches, object writes) operates authenticated."""
+    Runs with API auth ENABLED (VERDICT r2 #5) and, r4, with READS
+    authed too (--auth-reads, VERDICT r3 #8): every daemon carries the
+    shared bearer token ($TPUJOB_AUTH_TOKEN), an unauthenticated submit
+    AND an unauthenticated job read are rejected 401, and the whole
+    store-server surface (leases, watches, object reads and writes)
+    operates authenticated."""
     import json
     import signal
     import socket
@@ -338,21 +340,31 @@ def test_ha_operators_daemon_level_failover(tmp_path):
 
     def phase(name):
         try:
-            with urllib.request.urlopen(
-                f"{store_url}/api/tpujob/default/{name}", timeout=5
-            ) as r:
+            req = urllib.request.Request(
+                f"{store_url}/api/tpujob/default/{name}",
+                headers={"Authorization": f"Bearer {token}"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
                 return json.load(r)["job"]["phase"]
         except Exception:
             return ""
 
     try:
-        spawn("--store-only", "--port", str(store_port),
+        spawn("--store-only", "--port", str(store_port), "--auth-reads",
               log=str(tmp_path / "store.log"))
         assert wait_http(f"{store_url}/healthz"), "store server did not come up"
 
-        # Auth gate: a tokenless mutate against the HA store is a 401.
+        # Reads-auth gate (r4): a tokenless job READ is a 401 too —
+        # /healthz above stayed open (liveness by design).
         import urllib.error
 
+        try:
+            with urllib.request.urlopen(f"{store_url}/api/tpujob", timeout=5):
+                raise AssertionError("unauthenticated read was accepted")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 401, exc.code
+
+        # Auth gate: a tokenless mutate against the HA store is a 401.
         try:
             submit("anon-job", with_token=False)
         except urllib.error.HTTPError as exc:

@@ -87,6 +87,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "/ $TPUJOB_AUTH_TOKEN_FILE; unset = open server "
                         "(reference parity note: k8sutil.go:53-77 rode "
                         "kubeconfig auth instead)")
+    p.add_argument("--auth-reads", action="store_true",
+                   help="extend the bearer check to every READ route except "
+                        "/healthz (job reads, events, logs, /metrics, UI) — "
+                        "full reference parity, where Kubernetes auth covers "
+                        "all API access. Requires --auth-token-file.")
     return p
 
 
@@ -159,6 +164,11 @@ def main(argv=None) -> int:
     from tf_operator_tpu.utils.auth import resolve_token
 
     auth_token = resolve_token(token_file=args.auth_token_file)
+    if args.auth_reads and not auth_token:
+        # a tokenless "authed-reads" server would silently serve open —
+        # the exact hole the flag exists to close
+        sys.exit("--auth-reads requires an auth token "
+                 "(--auth-token-file / $TPUJOB_AUTH_TOKEN)")
     if auth_token:
         log.info("API auth enabled (bearer token)")
         # Export to our own env: launched child processes inherit it, so
@@ -181,7 +191,8 @@ def main(argv=None) -> int:
         if args.store_server:
             sys.exit("--store-only hosts the store; it conflicts with --store-server")
         dashboard = DashboardServer(
-            store, host=args.host, port=args.port, auth_token=auth_token
+            store, host=args.host, port=args.port, auth_token=auth_token,
+            auth_reads=args.auth_reads,
         )
         stop = threading.Event()
         signal.signal(signal.SIGTERM, lambda *_: stop.set())
@@ -221,7 +232,7 @@ def main(argv=None) -> int:
     # --port 0 picks an ephemeral port for candidates sharing a machine.
     dashboard = DashboardServer(
         store, host=args.host, port=args.port, metrics=controller.metrics,
-        auth_token=auth_token,
+        auth_token=auth_token, auth_reads=args.auth_reads,
     )
     chaos = ChaosMonkey(store, args.chaos_level, args.chaos_interval)
 

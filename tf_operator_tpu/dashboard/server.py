@@ -28,9 +28,12 @@ Generic object API (the remote-store seam; clients: runtime/remote_store.py):
 Auth (utils.auth, r3): constructed with ``auth_token``, the server
 requires ``Authorization: Bearer <token>`` on every mutating route and on
 the whole /api/v1 surface (the machine seam); human read routes
-(/ui, job reads, events, logs, /metrics, /healthz) stay open. The
-reference rode Kubernetes apiserver auth instead
-(pkg/util/k8sutil/k8sutil.go:53-77).
+(/ui, job reads, events, logs, /metrics, /healthz) stay open by default.
+``auth_reads`` (r4, ``--auth-reads``) extends the same bearer to every
+read route except /healthz — full reference parity, where Kubernetes
+auth covers ALL API access (pkg/util/k8sutil/k8sutil.go:53-77) and the
+dashboard talks to the authenticated apiserver
+(dashboard/backend/client/manager.go:13-45).
 """
 
 from __future__ import annotations
@@ -90,6 +93,7 @@ class _Handler(BaseHTTPRequestHandler):
     metrics = None  # ControllerMetrics, set by server factory when wired
     watch_ping_interval: float = 15.0  # idle keep-alive period on watches
     auth_token: Optional[str] = None  # shared secret; None = open server
+    auth_reads: bool = False  # r4 --auth-reads: bearer on EVERY route but /healthz
 
     # silence default request logging
     def log_message(self, fmt, *args):
@@ -141,7 +145,19 @@ class _Handler(BaseHTTPRequestHandler):
         path = url.path
 
         if path == "/healthz":
+            # liveness stays open even under --auth-reads: probes carry
+            # no data and a dead-token probe loop would mask real outages
             return self._json(200, {"ok": True})
+        # Full-surface auth (r4, --auth-reads): the reference rides
+        # Kubernetes auth for EVERY API access, reads included
+        # (/root/reference/pkg/util/k8sutil/k8sutil.go:53-77; the
+        # dashboard talks to the authenticated apiserver,
+        # dashboard/backend/client/manager.go:13-45). With auth_reads the
+        # same bearer gates job reads, events, logs, /metrics and the UI
+        # — training logs and eval metrics are not public data in the HA
+        # topology this server advertises.
+        if self.auth_reads and not self._authorized():
+            return
         if path == "/metrics":
             if self.metrics is None:
                 return self._error(404, "metrics not wired (no controller)")
@@ -441,10 +457,13 @@ class DashboardServer:
         metrics=None,
         watch_ping_interval: float = 15.0,
         auth_token: Optional[str] = None,
+        auth_reads: bool = False,
     ) -> None:
         """``auth_token``: shared secret (utils.auth) required on mutating
         routes and the /api/v1 surface; None serves anonymously (tests,
-        localhost dev)."""
+        localhost dev). ``auth_reads`` (r4): extend the bearer check to
+        every read route except /healthz — reference-parity with
+        Kubernetes auth covering all API access."""
         self._watches: set = set()
         self._watch_closed = threading.Event()
         handler = type(
@@ -455,6 +474,7 @@ class DashboardServer:
                 "metrics": metrics,
                 "watch_ping_interval": watch_ping_interval,
                 "auth_token": auth_token,
+                "auth_reads": bool(auth_reads and auth_token),
                 "_active_watches": self._watches,
                 "_watch_lock": threading.Lock(),
                 "_watch_closed": self._watch_closed,

@@ -12,12 +12,16 @@ excluded from the training gang, so it needs no rendezvous with the
 trainers — the checkpoint directory IS the interface, exactly the
 coupling the reference's design doc prescribes for the data plane.
 
-workload config keys: preset (+ TransformerConfig overrides, as lm.py),
-checkpoint_dir (required), eval_batch_size, eval_seq_len, eval_batches,
-poll_interval_s, train_steps (stop once a checkpoint >= this step is
-scored; otherwise score the first checkpoint seen and every newer one
-until then), max_wait_s (give up if nothing new appears), eval_report
-(path: per-checkpoint losses written as JSON — the scored artifact other
+workload config keys: model ("lm" default | "resnet" — r4, VERDICT r3
+#7b: the scorer follows the model family), preset (+ TransformerConfig
+overrides, as lm.py; LM only), variant/num_classes/image_size/data_dir
+(resnet only — scores test-split accuracy from idx files, restoring
+params AND BN running stats via restore_subtrees), checkpoint_dir
+(required), eval_batch_size, eval_seq_len, eval_batches, poll_interval_s,
+train_steps (stop once a checkpoint >= this step is scored; otherwise
+score the first checkpoint seen and every newer one until then),
+max_wait_s (give up if nothing new appears), eval_report (path:
+per-checkpoint scores written as JSON — the scored artifact other
 tooling and the e2e oracle read).
 """
 
@@ -33,8 +37,10 @@ from tf_operator_tpu.rendezvous.context import JobContext
 log = logging.getLogger("tpujob.eval")
 
 
-def main(ctx: JobContext) -> None:
-    # Evaluators are outside the gang: single-process jax, no rendezvous.
+def _lm_scorer(wl):
+    """LM scorer: held-out token batches, mean cross-entropy per
+    checkpoint (lower is better). Returns (templates, score_fn, best_fn)
+    — the model-agnostic polling loop's contract."""
     import jax
 
     from tf_operator_tpu.models.transformer import (
@@ -44,20 +50,12 @@ def main(ctx: JobContext) -> None:
         transformer_logical_axes,
     )
     from tf_operator_tpu.parallel import build_mesh
-    from tf_operator_tpu.train.checkpoint import CheckpointManager
     from tf_operator_tpu.train.trainer import Trainer, TrainerConfig
 
-    wl = ctx.workload
-    ckpt_dir = wl.get("checkpoint_dir")
-    if not ckpt_dir:
-        raise ValueError("eval workload requires workload.checkpoint_dir")
     cfg = preset_from_workload(wl)
     batch = int(wl.get("eval_batch_size", 8))
     seq = int(wl.get("eval_seq_len", min(cfg.max_seq, 512)))
     n_batches = max(1, int(wl.get("eval_batches", 4)))
-    poll_s = float(wl.get("poll_interval_s", 2.0))
-    train_steps = int(wl.get("train_steps", 0))
-    max_wait_s = float(wl.get("max_wait_s", 600.0))
 
     # dp must divide the eval batch; gcd keeps any batch size valid on any
     # device count (spare devices idle — eval is cheap and off the gang).
@@ -73,10 +71,6 @@ def main(ctx: JobContext) -> None:
         logical_axes=transformer_logical_axes(cfg),
         config=TrainerConfig(),
     )
-    # readonly: never sweep a live trainer's tmp dirs, never save.
-    manager = CheckpointManager(ckpt_dir, readonly=True)
-    report_path = wl.get("eval_report")
-
     # Held-out batches: a seed stream disjoint from the trainers' (they
     # seed data by process rank; 10_000+ is reserved for eval).
     eval_batches = [
@@ -97,6 +91,93 @@ def main(ctx: JobContext) -> None:
             "ce_loss"
         ]
     )
+    templates = {"params": trainer.state_template().params}
+
+    def score(restored):
+        losses = [float(eval_fn(restored["params"], tok)) for tok in eval_batches]
+        v = sum(losses) / len(losses)
+        return v, {"loss": v}
+
+    return templates, score, min
+
+
+def _resnet_scorer(wl):
+    """ResNet scorer (r4): test-split top-1 accuracy from idx files
+    (higher is better). Restores params AND the BN running stats —
+    eval-mode inference is wrong without them."""
+    import jax
+
+    from tf_operator_tpu.models.resnet import init_resnet, resnet_forward
+    from tf_operator_tpu.parallel import build_mesh
+    from tf_operator_tpu.train.data import (
+        MnistIdxDataset,
+        prepare_classification_images,
+    )
+    from tf_operator_tpu.train.trainer import Trainer, TrainerConfig
+    from tf_operator_tpu.workloads.resnet import (
+        make_test_accuracy,
+        resnet_config_from_workload,
+    )
+
+    if not wl.get("data_dir"):
+        raise ValueError('eval workload with model="resnet" requires '
+                         "workload.data_dir (idx test split to score)")
+    cfg = resnet_config_from_workload(wl)
+    image_size = int(wl.get("image_size", 32))
+    eval_b = int(wl.get("eval_batch_size", 64))
+
+    mesh = build_mesh({"dp": 1}, devices=jax.devices()[:1])
+
+    def loss_fn(params, data, st):
+        # templates only — the evaluator never steps
+        logits, new_state = resnet_forward(params, st, data[0], cfg, train=True)
+        return logits.sum(), new_state
+
+    trainer = Trainer(
+        mesh, loss_fn=loss_fn, init_fn=lambda k: init_resnet(k, cfg),
+        config=TrainerConfig(),
+    )
+    test = MnistIdxDataset(
+        wl["data_dir"], batch_size=1, split="test", shuffle=False,
+        process_shard=False,
+    )
+    images = prepare_classification_images(test.arrays["image"], image_size)
+    labels = test.arrays["label"]
+    tmpl = trainer.state_template()
+    templates = {"params": tmpl.params, "extra": tmpl.extra}
+    # one jitted eval forward shared across all scored checkpoints
+    accuracy = make_test_accuracy(cfg)
+
+    def score(restored):
+        acc = accuracy(restored["params"], restored["extra"], images, labels,
+                       eval_b)
+        return acc, {"accuracy": acc}
+
+    return templates, score, max
+
+
+def main(ctx: JobContext) -> None:
+    # Evaluators are outside the gang: single-process jax, no rendezvous.
+    from tf_operator_tpu.train.checkpoint import CheckpointManager
+
+    wl = ctx.workload
+    ckpt_dir = wl.get("checkpoint_dir")
+    if not ckpt_dir:
+        raise ValueError("eval workload requires workload.checkpoint_dir")
+    model = wl.get("model", "lm")
+    if model == "resnet":
+        templates, score_fn, best_fn = _resnet_scorer(wl)
+    elif model == "lm":
+        templates, score_fn, best_fn = _lm_scorer(wl)
+    else:
+        raise ValueError(f'unknown eval model {model!r}; use "lm" or "resnet"')
+    poll_s = float(wl.get("poll_interval_s", 2.0))
+    train_steps = int(wl.get("train_steps", 0))
+    max_wait_s = float(wl.get("max_wait_s", 600.0))
+
+    # readonly: never sweep a live trainer's tmp dirs, never save.
+    manager = CheckpointManager(ckpt_dir, readonly=True)
+    report_path = wl.get("eval_report")
 
     def write_report(scored):
         if not report_path:
@@ -125,9 +206,7 @@ def main(ctx: JobContext) -> None:
             if step in scored or step in pruned:
                 continue
             try:
-                params = manager.restore_params(
-                    trainer.state_template().params, step=step
-                )
+                restored = manager.restore_subtrees(templates, step=step)
             except Exception as exc:  # noqa: BLE001
                 # Keep-N retention can prune an older step between our
                 # directory scan and the restore (the exact races-with-a-
@@ -138,17 +217,14 @@ def main(ctx: JobContext) -> None:
                             step, exc)
                 pruned.add(step)
                 continue
-            losses = [float(eval_fn(params, tok)) for tok in eval_batches]
-            scored[step] = sum(losses) / len(losses)
-            log.info(
-                "eval: checkpoint step=%d loss=%.4f (%d batches of %dx%d)",
-                step, scored[step], n_batches, batch, seq,
-            )
+            scored[step], metrics = score_fn(restored)
+            log.info("eval: checkpoint step=%d %s", step,
+                     " ".join(f"{k}={v:.4f}" for k, v in metrics.items()))
             write_report(scored)
             # Surface the score where it is queryable: tpujob get / the
             # dashboard read TPUJobStatus.eval_metrics (best-effort —
             # standalone runs without an operator just skip it).
-            ctx.report_eval_metrics(step, {"loss": scored[step]})
+            ctx.report_eval_metrics(step, metrics)
             deadline = time.time() + max_wait_s  # progress resets the clock
             if train_steps and step >= train_steps:
                 done = True
@@ -163,5 +239,5 @@ def main(ctx: JobContext) -> None:
         if not done:
             time.sleep(poll_s)
 
-    best = min(scored.values())
-    log.info("eval done: %d checkpoints scored, best loss %.4f", len(scored), best)
+    best = best_fn(scored.values())
+    log.info("eval done: %d checkpoints scored, best %.4f", len(scored), best)

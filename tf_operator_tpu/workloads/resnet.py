@@ -38,6 +38,61 @@ from tf_operator_tpu.train.profile import profile_ctx
 log = logging.getLogger("tpujob.resnet")
 
 
+def resnet_config_from_workload(wl):
+    """ResNetConfig from the shared workload dict — ONE builder for every
+    role reading spec.workload (trainer here, evaluator in eval.py), so
+    the roles cannot drift apart and fail at checkpoint restore."""
+    from tf_operator_tpu.models.resnet import ResNetConfig
+
+    classes = int(wl.get("num_classes", 1000))
+    variant = wl.get("variant", "resnet50")
+    return {
+        "resnet50": ResNetConfig.resnet50,
+        "resnet18": ResNetConfig.resnet18,
+        "tiny": ResNetConfig.tiny,
+    }[variant](classes)
+
+
+def make_test_accuracy(cfg):
+    """Build a reusable eval-mode accuracy scorer: the jitted forward is
+    created ONCE and shared across calls — the Evaluator role scores many
+    checkpoints, and a per-call @jax.jit closure would recompile the full
+    eval ResNet every time (identity-keyed jit cache)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_operator_tpu.models.resnet import resnet_forward
+
+    @jax.jit
+    def eval_logits(params, bn_state, x):
+        logits, _ = resnet_forward(params, bn_state, x, cfg, train=False)
+        return jnp.argmax(logits, axis=-1)
+
+    def score(params, bn_state, images, labels, eval_b: int = 64) -> float:
+        correct = 0
+        for i in range(0, len(labels), eval_b):
+            x = images[i : i + eval_b]
+            y = labels[i : i + eval_b]
+            if x.shape[0] < eval_b:  # pad to the static shape, mask the tail
+                padding = eval_b - x.shape[0]
+                x = np.concatenate(
+                    [x, np.zeros((padding,) + x.shape[1:], x.dtype)]
+                )
+            pred = np.asarray(eval_logits(params, bn_state, x))[: len(y)]
+            correct += int((pred == y).sum())
+        return correct / len(labels)
+
+    return score
+
+
+def test_accuracy(params, bn_state, cfg, images, labels, eval_b: int = 64) -> float:
+    """Eval-mode (running BN stats) top-1 accuracy — one-shot convenience
+    over make_test_accuracy (the trainer's end-of-run gate; repeat
+    callers like the Evaluator should hold the factory's scorer)."""
+    return make_test_accuracy(cfg)(params, bn_state, images, labels, eval_b)
+
+
 def main(ctx: JobContext) -> None:
     ctx.initialize_distributed()
 
@@ -53,13 +108,8 @@ def main(ctx: JobContext) -> None:
     batch = int(wl.get("batch_size", 128))
     image_size = int(wl.get("image_size", 224))
     classes = int(wl.get("num_classes", 1000))
-    variant = wl.get("variant", "resnet50")
 
-    cfg = {
-        "resnet50": ResNetConfig.resnet50,
-        "resnet18": ResNetConfig.resnet18,
-        "tiny": ResNetConfig.tiny,
-    }[variant](classes)
+    cfg = resnet_config_from_workload(wl)
     mesh = ctx.build_mesh()
 
     def loss_fn(params, data, state):
@@ -136,10 +186,7 @@ def _train_real(ctx, mesh, trainer, cfg, wl) -> None:
     import math
 
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
-    from tf_operator_tpu.models.resnet import resnet_forward
     from tf_operator_tpu.train.data import (
         AugmentedImages,
         DeviceLoader,
@@ -174,19 +221,43 @@ def _train_real(ctx, mesh, trainer, cfg, wl) -> None:
         )
     state = trainer.init(jax.random.PRNGKey(0))
     loader = DeviceLoader(source, trainer.batch_sharding)
+    # Periodic checkpoints (r4): the Evaluator role scores them as they
+    # land (workloads/eval.py model="resnet") — params + BN stats both,
+    # restore_subtrees.
+    from tf_operator_tpu.train.checkpoint import CheckpointManager
+
+    ckpt_dir = wl.get("checkpoint_dir")
+    every = int(wl.get("checkpoint_every", 0))
+    mgr = CheckpointManager(ckpt_dir) if ckpt_dir else None
     # GLOBAL example count -> identical SPMD step count on every rank
     # (a rank-local count would deadlock the gang; see MnistIdxDataset).
     steps_per_epoch = max(1, ds.global_n // global_batch)
     total = epochs * steps_per_epoch
     loss = float("nan")
+    def checkpoint(step, state, m, wait=False):
+        # EVERY rank calls save (orbax save is a collective — a rank-0
+        # gate would deadlock multi-host gangs; same convention as
+        # WorkloadCheckpointer.advance), and a non-finite state is
+        # refused: persisting a diverged state would hand the Evaluator
+        # a poisoned latest checkpoint.
+        cur = float(m["loss"])
+        if not math.isfinite(cur):
+            log.warning("skipping checkpoint at step %d: loss %r", step, cur)
+            return
+        mgr.save(step, state, wait=wait)
+
     try:
         for step in range(total):
             batch = next(loader)
             state, m = trainer.step(state, (batch["image"], batch["label"]))
+            if mgr and every and (step + 1) % every == 0:
+                checkpoint(step + 1, state, m)
             if step % max(1, total // 10) == 0:
                 loss = float(m["loss"])
                 log.info("step %d/%d loss %.4f", step, total, loss)
         loss = float(m["loss"])
+        if mgr:
+            checkpoint(total, state, m, wait=True)
     finally:
         loader.close()
     if not math.isfinite(loss):
@@ -202,23 +273,10 @@ def _train_real(ctx, mesh, trainer, cfg, wl) -> None:
     )
     images = prepare_classification_images(test.arrays["image"], image_size)
     labels = test.arrays["label"]
-    eval_b = int(wl.get("eval_batch_size", 64))
-
-    @jax.jit
-    def eval_logits(params, bn_state, x):
-        logits, _ = resnet_forward(params, bn_state, x, cfg, train=False)
-        return jnp.argmax(logits, axis=-1)
-
-    correct = 0
-    for i in range(0, len(labels), eval_b):
-        x = images[i : i + eval_b]
-        y = labels[i : i + eval_b]
-        if x.shape[0] < eval_b:  # pad to the static shape, mask the tail
-            padding = eval_b - x.shape[0]
-            x = np.concatenate([x, np.zeros((padding,) + x.shape[1:], x.dtype)])
-        pred = np.asarray(eval_logits(state.params, state.extra, x))[: len(y)]
-        correct += int((pred == y).sum())
-    acc = correct / len(labels)
+    acc = test_accuracy(
+        state.params, state.extra, cfg, images, labels,
+        eval_b=int(wl.get("eval_batch_size", 64)),
+    )
     log.info(
         "resnet done (real data): test accuracy %.4f over %d examples "
         "(%d epochs, final loss %.4f)", acc, len(labels), epochs, loss,
