@@ -865,3 +865,47 @@ def test_resnet_evaluator_reports_accuracy(rig_api, tmp_path):
     scored = _json.loads(open(report).read())
     assert scored and all(0.0 <= v <= 1.0 for v in scored.values()), scored
     assert "metrics" in st.eval_metrics, st.eval_metrics
+
+
+def test_moe_pipeline_ep_gang(rig):
+    """ep INSIDE the pipeline through the FULL stack (r4): a 2-process
+    gang with 2 virtual devices per process builds a pp=2 x ep=2 mesh —
+    pipeline ppermutes cross one process boundary, expert all-to-alls
+    the other — and trains the MoE transformer to Done. Also pins the
+    lm workload's router health check under pp (per-layer telemetry is
+    absent there; the job must log scalars, not crash)."""
+    store = rig
+    env = dict(DATAPLANE_ENV)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    job = TPUJob(
+        metadata=ObjectMeta(name="moe-ppep"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=2,
+                    template=ProcessTemplate(
+                        entrypoint="tf_operator_tpu.workloads.lm:main",
+                        chips_per_process=2,
+                        env=env,
+                    ),
+                )
+            },
+        ),
+    )
+    job.spec.topology.mesh_axes = {"pp": 2, "ep": 2}
+    job.spec.workload = {
+        "preset": "tiny-moe",
+        "n_layers": 4,
+        "moe_top_k": 2,
+        "pp_microbatches": 2,
+        "steps": 3,
+        "batch_size": 8,
+        "seq_len": 32,
+    }
+    store.create(job)
+    ok = wait_for(
+        lambda: has_condition(job_status(store, "moe-ppep"), ConditionType.SUCCEEDED),
+        timeout=420,
+    )
+    st = job_status(store, "moe-ppep")
+    assert ok, f"conditions: {[(c.type.value, c.reason, c.message) for c in st.conditions]}"

@@ -471,17 +471,24 @@ def test_pipeline_moe_grads_match_single_device():
 
 
 def test_pipeline_moe_invalid_meshes_rejected():
+    """r4: ep INSIDE a pipeline stage is now supported (see the pp x ep
+    oracle below) — only MoE + tp-within-stage and indivisible expert
+    counts remain rejections."""
     from tf_operator_tpu.models.transformer import transformer_hidden
 
-    cfg = preset("tiny-moe", dtype=jnp.float32, pp_microbatches=2)
-    params = init_transformer(jax.random.PRNGKey(0), cfg)
-    with pytest.raises(NotImplementedError, match="ep axis"):
-        transformer_hidden(params, tokens(), cfg, build_mesh({"pp": 2, "ep": 4}))
     cfg_tp = preset("tiny-moe", dtype=jnp.float32, pp_microbatches=2,
                     n_heads=4, n_kv_heads=2)
+    params = init_transformer(jax.random.PRNGKey(0), cfg_tp)
     with pytest.raises(NotImplementedError, match="tensor-parallel"):
         transformer_hidden(
             params, tokens(), cfg_tp, build_mesh({"pp": 2, "tp": 2, "dp": 2})
+        )
+    cfg3 = preset("tiny-moe", dtype=jnp.float32, pp_microbatches=2,
+                  n_experts=3)
+    params3 = init_transformer(jax.random.PRNGKey(0), cfg3)
+    with pytest.raises(ValueError, match="divisible"):
+        transformer_hidden(
+            params3, tokens(), cfg3, build_mesh({"pp": 2, "ep": 4})
         )
 
 
@@ -731,6 +738,76 @@ def test_moe_transformer_trains_ep_fsdp_dp():
     )
     losses = []
     for _ in range(10):
+        state, m = trainer.step(state, tok)
+        losses.append(float(m["loss"]))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
+
+
+# ---- ep INSIDE the pipeline (r4, VERDICT r3 #5 stretch) -------------------
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pipeline_ep_in_stage_matches_single_device(schedule):
+    """pp x ep x dp: experts shard over ep INSIDE each pipeline stage
+    (pipeline_apply's one shard_map binds every mesh axis; the stage body
+    runs parallel.moe._moe_local against the bound ep name — no nested
+    shard_map). CE forward and grads must match the single-device oracle
+    exactly at no-drop capacity; the total loss differs only by the
+    documented per-microbatch/per-shard aux estimators. The 1f1b arm
+    additionally pins the backward's per-leaf data-axis reduction — a
+    uniform psum over data axes scrambles ep-sharded expert grads."""
+    import dataclasses
+
+    from tf_operator_tpu.models.transformer import lm_loss_and_metrics
+
+    cfg = preset("tiny-moe", dtype=jnp.float32, remat=False, n_layers=4,
+                 pp_microbatches=2, capacity_factor=8.0, moe_top_k=2,
+                 pp_schedule=schedule)
+    mesh = build_mesh({"pp": 2, "ep": 2, "dp": 2})
+    params = init_transformer(jax.random.PRNGKey(0), cfg)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (16, 32), 0, cfg.vocab)
+
+    def ce(p, m):
+        return lm_loss_and_metrics(p, tok, cfg, mesh=m)[1]["ce_loss"]
+
+    np.testing.assert_allclose(
+        float(ce(params, mesh)), float(ce(params, None)), rtol=2e-5)
+    g_got = jax.grad(ce)(params, mesh)
+    g_want = jax.grad(ce)(params, None)
+    for (pa, a), (_, b) in zip(jax.tree_util.tree_leaves_with_path(g_got),
+                               jax.tree_util.tree_leaves_with_path(g_want)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-6,
+            err_msg=jax.tree_util.keystr(pa))
+    # aux losses: finite and same order as single-device (different
+    # estimator — per microbatch x ep shard)
+    m_pp = lm_loss_and_metrics(params, tok, cfg, mesh=mesh)[1]
+    m_sd = lm_loss_and_metrics(params, tok, cfg, mesh=None)[1]
+    assert np.isfinite(float(m_pp["moe_lb_loss"]))
+    np.testing.assert_allclose(float(m_pp["moe_lb_loss"]),
+                               float(m_sd["moe_lb_loss"]), rtol=0.2)
+
+
+def test_pipeline_ep_in_stage_trains():
+    """Full Trainer over pp=2 x ep=2 x dp=2 — the flagship-MoE pipeline
+    mesh end to end, expert weights stored sharded over (pp, ep)."""
+    cfg = preset("tiny-moe", dtype=jnp.float32, remat=False, n_layers=4,
+                 pp_microbatches=2, moe_top_k=2)
+    mesh = build_mesh({"pp": 2, "ep": 2, "dp": 2})
+    trainer = Trainer(
+        mesh,
+        loss_fn=lambda p, tok, e: lm_loss(p, tok, cfg, mesh=mesh),
+        init_fn=lambda k: init_transformer(k, cfg),
+        logical_axes=transformer_logical_axes(cfg),
+        config=TrainerConfig(optimizer="adamw", learning_rate=3e-3),
+    )
+    state = trainer.init(jax.random.PRNGKey(0))
+    tok = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (16, 32), 0, cfg.vocab),
+        trainer.batch_sharding,
+    )
+    losses = []
+    for _ in range(8):
         state, m = trainer.step(state, tok)
         losses.append(float(m["loss"]))
     assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses

@@ -388,7 +388,7 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
 
 
 def _layer(x, layer_params, cfg: TransformerConfig, mesh, tp_axis=None,
-           tp_manual_vjp=True):
+           tp_manual_vjp=True, local_ep_axis: Optional[str] = None):
     """One decoder layer. ``tp_axis`` (pipeline tp-within-stage, r3):
     weights arrive as tp-LOCAL shards (wq/wk/wv/w_gate/w_up
     column-parallel, wo/w_down row-parallel — the Megatron split).
@@ -437,7 +437,8 @@ def _layer(x, layer_params, cfg: TransformerConfig, mesh, tp_axis=None,
 
     h = _rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps)
     if cfg.n_experts:
-        moe_out, aux = _moe_mlp(h, layer_params, cfg, mesh)
+        moe_out, aux = _moe_mlp(h, layer_params, cfg, mesh,
+                                local_ep_axis=local_ep_axis)
         return x + moe_out, aux
     if tp_axis is not None:
         h = enter(h)
@@ -449,16 +450,28 @@ def _layer(x, layer_params, cfg: TransformerConfig, mesh, tp_axis=None,
     return x + down, None
 
 
-def _moe_mlp(h, layer_params, cfg: TransformerConfig, mesh):
+def _moe_mlp(h, layer_params, cfg: TransformerConfig, mesh,
+             local_ep_axis: Optional[str] = None):
     """Top-k expert MLP (k = cfg.moe_top_k: 1 Switch / 2 Mixtral-style):
     router -> all-to-all dispatch over the ep axis (parallel.moe) ->
     per-expert SwiGLU -> gate-weighted combine.
+
+    ``local_ep_axis`` (r4, ep-inside-pipeline): the caller already runs
+    inside a shard_map that maps the ep axis (pipeline_apply binds every
+    mesh axis), so moe_apply's own shard_map would nest — instead the
+    per-device body (parallel.moe._moe_local) runs directly against the
+    bound axis name: h is this shard's token slice, layer_params carry
+    this shard's E/ep experts.
 
     Returns (out, aux) — aux carries the router losses (UNWEIGHTED; the
     loss head applies cfg.moe_aux_weight / cfg.moe_zloss_weight) plus
     observability stats: {"lb_loss", "z_loss", "expert_load" [E],
     "drop_frac"}."""
-    from tf_operator_tpu.parallel.moe import moe_apply
+    from tf_operator_tpu.parallel.moe import (
+        _moe_local,
+        expert_capacity,
+        moe_apply,
+    )
 
     b, t, d = h.shape
     flat = h.reshape(b * t, d)
@@ -474,20 +487,32 @@ def _moe_mlp(h, layer_params, cfg: TransformerConfig, mesh):
         "w_up": layer_params["w_up"],
         "w_down": layer_params["w_down"],
     }
-    out, stats = moe_apply(
-        flat,
-        gate_logits,
-        expert_params,
-        expert_fn,
-        mesh,
-        axis_name=cfg.ep_axis,
-        capacity_factor=cfg.capacity_factor,
-        # the result feeds a residual add: a capacity-dropped token's MLP
-        # must contribute 0, not its own input again
-        dropped="zero",
-        k_top=cfg.moe_top_k,
-        return_stats=True,
-    )
+    if local_ep_axis is not None:
+        # same capacity rule as moe_apply's sharded branch: flat is
+        # already the per-shard token slice
+        capacity = expert_capacity(
+            cfg.capacity_factor, cfg.moe_top_k, flat.shape[0], cfg.n_experts
+        )
+        out, stats = _moe_local(
+            flat, gate_logits, expert_params, expert_fn,
+            axis_name=local_ep_axis, capacity=capacity, dropped="zero",
+            k_top=cfg.moe_top_k, stat_axes=(local_ep_axis,),
+        )
+    else:
+        out, stats = moe_apply(
+            flat,
+            gate_logits,
+            expert_params,
+            expert_fn,
+            mesh,
+            axis_name=cfg.ep_axis,
+            capacity_factor=cfg.capacity_factor,
+            # the result feeds a residual add: a capacity-dropped token's
+            # MLP must contribute 0, not its own input again
+            dropped="zero",
+            k_top=cfg.moe_top_k,
+            return_stats=True,
+        )
     # Switch load-balance loss: E * Σ_e f_e·P_e. f_e (expert_load) comes
     # out of the discrete top-k assignment, so it carries no gradient and
     # acts as a per-expert coefficient on the differentiable mean gate
@@ -547,6 +572,22 @@ def _pp_param_specs(cfg: TransformerConfig, tp_axis: Optional[str]):
     }
 
 
+def _pp_param_specs_moe(cfg: TransformerConfig):
+    """PartitionSpecs for MoE stage params under ep-in-stage (r4): stage
+    dim over pp everywhere; the expert leaves additionally shard their
+    expert dim (index 2 of [S, per_stage, E, ...]) over ep, so each
+    device holds its stage's layers x its E/ep experts."""
+    from jax.sharding import PartitionSpec as P
+
+    pp, ep = cfg.pp_axis, cfg.ep_axis
+    exp = P(pp, None, ep)
+    return {
+        "attn_norm": P(pp), "wq": P(pp), "wk": P(pp), "wv": P(pp),
+        "wo": P(pp), "mlp_norm": P(pp), "w_router": P(pp),
+        "w_gate": exp, "w_up": exp, "w_down": exp,
+    }
+
+
 def transformer_hidden_pp(params, tokens, cfg: TransformerConfig, mesh):
     """Pipeline-parallel layer stack: n_layers/pp contiguous layers per
     stage through parallel.pipeline.pipeline_apply (fill-drain pipeline —
@@ -560,30 +601,40 @@ def transformer_hidden_pp(params, tokens, cfg: TransformerConfig, mesh):
     Megatron-style (_pp_param_specs) and _layer psums its row-parallel
     matmuls over tp.
 
-    MoE + pipeline (r3): supported with experts REPLICATED within each
-    stage (the moe_apply no-ep routing path — identical math to the
-    ep-sharded dispatch; an ep axis inside a pipeline stage would nest
-    shard_maps and is rejected). The router aux losses ride the
-    pipeline's aux channel (pipeline_apply aux_size=2: summed lb/z per
-    (stage-layer, microbatch), normalized back to means here) so MoE
-    trains at quality under pp — with the caveat that load-balance
-    fractions are computed per MICROBATCH rather than per batch.
-    Per-layer router telemetry (expert_load/drop_frac) is not carried
-    through the pipeline; lm_loss_and_metrics reports the scalar losses
-    only for pp+MoE. MoE + tp-within-stage is rejected (the expert MLP
-    has no tp split)."""
+    MoE + pipeline: experts REPLICATE within each stage by default (the
+    moe_apply no-ep routing path — identical math to the ep-sharded
+    dispatch); with an ep axis in the mesh (r4 — the VERDICT r3 #5
+    stretch), experts SHARD over ep inside each stage: pipeline_apply's
+    one shard_map binds every mesh axis, so the stage body runs
+    parallel.moe._moe_local directly against the bound "ep" name (no
+    nesting) — tokens shard over (dp, fsdp, ep) as additional pipeline
+    data axes, expert weights shard over (pp on the stage dim, ep on the
+    expert dim), and the all-to-all dispatch runs per (stage,
+    microbatch). The router aux losses ride the pipeline's aux channel
+    (pipeline_apply aux_size=2: summed lb/z per (stage-layer,
+    microbatch), normalized back to means here) so MoE trains at quality
+    under pp — with the caveat that load-balance fractions are computed
+    per MICROBATCH rather than per batch. Per-layer router telemetry
+    (expert_load/drop_frac) is not carried through the pipeline;
+    lm_loss_and_metrics reports the scalar losses only for pp+MoE.
+    MoE + tp-within-stage is rejected (the expert MLP has no tp
+    split)."""
     from tf_operator_tpu.parallel.pipeline import pipeline_apply
 
     if cfg.n_experts and "tp" in mesh.axis_names and mesh.shape["tp"] > 1:
         raise NotImplementedError(
             "MoE + tp-within-stage is not supported (the expert MLP has "
-            "no tensor-parallel split); use pp x dp for MoE pipelines"
+            "no tensor-parallel split); use pp x ep x dp for MoE pipelines"
         )
-    if cfg.n_experts and cfg.ep_axis in mesh.axis_names and mesh.shape[cfg.ep_axis] > 1:
-        raise NotImplementedError(
-            "an ep axis inside a pipeline stage would nest shard_maps — "
-            "MoE pipelines run with experts replicated per stage (drop the "
-            "ep axis) or MoE runs non-pipelined with ep"
+    ep_in_stage = bool(
+        cfg.n_experts
+        and cfg.ep_axis in mesh.axis_names
+        and mesh.shape[cfg.ep_axis] > 1
+    )
+    if ep_in_stage and cfg.n_experts % mesh.shape[cfg.ep_axis]:
+        raise ValueError(
+            f"{cfg.n_experts} experts not divisible by "
+            f"{cfg.ep_axis}={mesh.shape[cfg.ep_axis]}"
         )
     n_stages = mesh.shape[cfg.pp_axis]
     n_virtual = n_stages * cfg.pp_chunks
@@ -603,7 +654,8 @@ def transformer_hidden_pp(params, tokens, cfg: TransformerConfig, mesh):
     x = params["embed"].astype(cfg.dtype)[tokens]
     layer_fn = _remat_wrap(
         partial(_layer, cfg=cfg, mesh=None, tp_axis=tp_axis,
-                tp_manual_vjp=(cfg.pp_schedule == "1f1b")),
+                tp_manual_vjp=(cfg.pp_schedule == "1f1b"),
+                local_ep_axis=(cfg.ep_axis if ep_in_stage else None)),
         cfg,
     )
     moe = bool(cfg.n_experts)
@@ -636,10 +688,21 @@ def transformer_hidden_pp(params, tokens, cfg: TransformerConfig, mesh):
         lambda a: a.reshape((n_virtual, per_stage) + a.shape[1:]),
         params["layers"],
     )
+    if tp_axis:
+        param_specs = _pp_param_specs(cfg, tp_axis)
+    elif ep_in_stage:
+        param_specs = _pp_param_specs_moe(cfg)
+    else:
+        param_specs = None
     res = pipeline_apply(
         stage_params, x, stage_fn, mesh, cfg.pp_microbatches, cfg.pp_axis,
         schedule=cfg.pp_schedule,
-        param_specs=_pp_param_specs(cfg, tp_axis) if tp_axis else None,
+        # with ep-in-stage the ep axis is a pipeline DATA axis too: each
+        # (dp, ep) coordinate pipelines its own token slice, and the MoE
+        # layers all-to-all those slices to the expert owners over ep
+        batch_axes=(("dp", "fsdp", cfg.ep_axis) if ep_in_stage
+                    else ("dp", "fsdp")),
+        param_specs=param_specs,
         aux_size=2 if moe else 0,
         n_chunks=cfg.pp_chunks,
     )

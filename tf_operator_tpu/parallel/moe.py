@@ -26,6 +26,17 @@ from jax.sharding import PartitionSpec as P
 from tf_operator_tpu.parallel.collectives import axis_size
 
 
+def expert_capacity(capacity_factor: float, k_top: int, local_tokens: int,
+                    n_experts: int) -> int:
+    """THE per-expert queue length rule — one definition for every
+    routing path (single-device, ep-sharded, and ep-inside-pipeline):
+    capacity = cf·k·T_local/E, floored, at least 1. A second copy of
+    this formula diverging (different rounding, forgetting k_top) would
+    give pp x ep different drop patterns than non-pipelined ep with
+    nothing pinning the difference."""
+    return max(1, int(capacity_factor * k_top * local_tokens / n_experts))
+
+
 def _route(x, gate_logits, capacity: int, k_top: int = 1, dropped: str = "passthrough"):
     """Top-k routing bookkeeping shared by the sharded and single-device
     paths. Each token goes to its ``k_top`` highest-gated experts; with
@@ -313,7 +324,7 @@ def moe_apply(
     if mesh is None or axis_name not in getattr(mesh, "axis_names", ()) or (
         mesh.shape[axis_name] == 1
     ):
-        capacity = max(1, int(capacity_factor * k_top * tokens / n_experts))
+        capacity = expert_capacity(capacity_factor, k_top, tokens, n_experts)
         out, stats = _moe_single(
             x, gate_logits, expert_params, expert_fn, capacity, dropped, k_top,
             dispatch_impl,
@@ -329,7 +340,7 @@ def moe_apply(
             f"{tokens} tokens not divisible by ep={ep} x data={n_data}"
         )
     local_tokens = tokens // (ep * n_data)
-    capacity = max(1, int(capacity_factor * k_top * local_tokens / n_experts))
+    capacity = expert_capacity(capacity_factor, k_top, local_tokens, n_experts)
 
     token_spec = P((*data_axes, axis_name))
     param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), expert_params)

@@ -505,6 +505,29 @@ def _apply_1f1b(stage_params, x_micro, fn, mesh, axis_name, x_spec, param_specs,
         params, x_saved = residuals
         gy, gaux_rows = g
 
+        def _spec_axes(s):
+            names = set()
+            for part in s:
+                if part is None:
+                    continue
+                for a in (part if isinstance(part, (tuple, list)) else (part,)):
+                    if a:
+                        names.add(a)
+            return names
+
+        # Per-leaf data-axis reduction (r4): a param leaf's grad is summed
+        # over exactly the data axes the leaf REPLICATES over. An axis
+        # the leaf's spec SHARDS (ep on expert-weight leaves under
+        # ep-in-stage MoE) must NOT be psum'd — each ep shard's slice is
+        # a different parameter block, and summing across it scrambles
+        # the expert gradients (caught by the pp x ep oracle).
+        # CSV strings because tuples are pytree nodes, not leaves.
+        reduce_axes = jax.tree_util.tree_map(
+            lambda s: ",".join(ax for ax in data_axes
+                               if ax not in _spec_axes(s)),
+            pspecs, is_leaf=is_spec,
+        )
+
         def body(p, saved, gy_in, gaux_row):
             dparams, dx = _bwd_ticks(
                 to_local(p),
@@ -513,13 +536,18 @@ def _apply_1f1b(stage_params, x_micro, fn, mesh, axis_name, x_spec, param_specs,
                 gaux_row[0].astype(jnp.float32),
                 n_chunks,
             )
-            # params replicate over the data axes, so each data shard holds
-            # PARTIAL grads from its batch slice — sum them (the psum
-            # autodiff's transpose machinery would have inserted).
-            for ax in data_axes:
-                dparams = jax.tree_util.tree_map(
-                    lambda a, ax=ax: jax.lax.psum(a, ax), dparams
-                )
+            # params replicate over (most of) the data axes, so each data
+            # shard holds PARTIAL grads from its batch slice — sum them
+            # (the psum autodiff's transpose machinery would have
+            # inserted), leaf by leaf per reduce_axes above.
+            def reduce_leaf(a, axes_csv):
+                for ax in (axes_csv.split(",") if axes_csv else ()):
+                    a = jax.lax.psum(a, ax)
+                return a
+
+            # reduce_axes shares dparams' tree STRUCTURE (to_local only
+            # reshapes leaves), so it zips directly
+            dparams = jax.tree_util.tree_map(reduce_leaf, dparams, reduce_axes)
             return from_local(dparams), dx
 
         dparams, dx = shard_map(

@@ -155,13 +155,24 @@ def main(ctx: JobContext) -> None:
         _, m = jax.jit(
             lambda p, tok: lm_loss_and_metrics(p, tok, cfg, mesh=mesh)
         )(state.params, probe)
-        log.info(
-            "moe router: expert_entropy=%.3f (uniform=%.3f) drop_frac=%.3f "
-            "lb_loss=%.3f z_loss=%.4f",
-            float(m["moe_expert_entropy"]), math.log(cfg.n_experts),
-            float(m["moe_drop_frac"]), float(m["moe_lb_loss"]),
-            float(m["moe_z_loss"]),
-        )
+        if "moe_expert_entropy" in m:
+            log.info(
+                "moe router: expert_entropy=%.3f (uniform=%.3f) "
+                "drop_frac=%.3f lb_loss=%.3f z_loss=%.4f",
+                float(m["moe_expert_entropy"]), math.log(cfg.n_experts),
+                float(m["moe_drop_frac"]), float(m["moe_lb_loss"]),
+                float(m["moe_z_loss"]),
+            )
+        else:
+            # pipeline + MoE: per-layer router telemetry doesn't ride the
+            # pp aux channel — only the scalar losses do (transformer
+            # docstring); a missing key must not fail the job (caught by
+            # the pp x ep gang e2e, r4)
+            log.info(
+                "moe router (pp — scalar losses only): lb_loss=%.3f "
+                "z_loss=%.4f",
+                float(m["moe_lb_loss"]), float(m["moe_z_loss"]),
+            )
     if step_s is not None:
         n_chips = mesh.devices.size
         # active params: for top-1 MoE only one expert's FLOPs count per
