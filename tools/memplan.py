@@ -48,7 +48,7 @@ def _parse_mesh(s: str) -> dict:
 
 def plan(preset_name: str, mesh_axes: dict, batch: int, seq: int,
          remat="full", optimizer: str = "adamw", dtype_bytes: int = 2,
-         grad_accum: int = 1):
+         grad_accum: int = 1, pp_microbatches: int = 0):
     """Returns a dict of per-chip byte totals for one train step.
 
     ``grad_accum`` > 1 (TrainerConfig.grad_accum) scales the activation
@@ -92,7 +92,8 @@ def plan(preset_name: str, mesh_axes: dict, batch: int, seq: int,
             f"need {n_chips} virtual devices, have {jax.device_count()} — "
             "run in a fresh process (XLA_FLAGS is read at backend init)"
         )
-    cfg = preset(preset_name, max_seq=seq, remat=remat)
+    overrides = {"pp_microbatches": pp_microbatches} if pp_microbatches else {}
+    cfg = preset(preset_name, max_seq=seq, remat=remat, **overrides)
     mesh = build_mesh(mesh_axes, devices=jax.devices()[:n_chips])
     trainer = Trainer(
         mesh,
@@ -128,6 +129,11 @@ def plan(preset_name: str, mesh_axes: dict, batch: int, seq: int,
     data_shards = 1
     for ax in ("dp", "fsdp"):
         data_shards *= mesh_axes.get(ax, 1)
+    pp = mesh_axes.get("pp", 1)
+    pp_micro = int(getattr(cfg, "pp_microbatches", 0) or 0)
+    if cfg.n_experts and pp > 1 and mesh_axes.get("ep", 1) > 1:
+        # ep-inside-pipeline (r4): ep is an additional TOKEN axis there
+        data_shards *= mesh_axes["ep"]
     seq_shards = mesh_axes.get("cp", 1)
     tp = mesh_axes.get("tp", 1)
     local_tokens = (batch // max(1, data_shards)) * (seq // max(1, seq_shards))
@@ -142,7 +148,14 @@ def plan(preset_name: str, mesh_axes: dict, batch: int, seq: int,
     # 8 kv vs 64 q heads) the k/v activations are kv/d = 1/8 the width of
     # q, and r3's repeat-free attention keeps them that size end to end.
     kv = cfg.n_kv_heads * cfg.head_dim
-    if cfg.remat in (True, "full"):
+    if pp > 1 and pp_micro:
+        # Pipeline (1f1b): each stage holds M microbatch-INPUT saves plus,
+        # transiently during one microbatch's backward, that microbatch's
+        # per-layer remat saves for the STAGE's L/pp layers; the working
+        # set below also shrinks to one microbatch.
+        local_tokens = max(1, local_tokens // pp_micro)
+        saved = (pp_micro + L // pp) * local_tokens * d * dtype_bytes
+    elif cfg.remat in (True, "full"):
         saved = L * local_tokens * d * dtype_bytes
     else:  # no remat: every layer's intermediates persist to the backward
         saved = L * local_tokens * (3 * d + kv + 2 * f // tp) * dtype_bytes
@@ -184,6 +197,9 @@ def main(argv=None) -> int:
     p.add_argument("--grad-accum", type=int, default=1,
                    help="TrainerConfig.grad_accum microbatching (activations "
                         "scale ~1/accum at the same global batch)")
+    p.add_argument("--pp-microbatches", type=int, default=0,
+                   help="1f1b microbatches (activations scale ~1/M per "
+                        "stage; read from the job spec in --job mode)")
     p.add_argument("--job", default=None,
                    help="read preset/mesh/batch/seq from a TPUJob JSON spec")
     p.add_argument("--hbm-gb", type=float, default=None,
@@ -200,6 +216,7 @@ def main(argv=None) -> int:
         seq = int(wl.get("seq_len", args.seq))
         remat = wl.get("remat", args.remat)
         args.grad_accum = int(wl.get("grad_accum", args.grad_accum))
+        args.pp_microbatches = int(wl.get("pp_microbatches", 0))
     else:
         if not args.preset:
             p.error("--preset or --job required")
@@ -207,7 +224,8 @@ def main(argv=None) -> int:
         batch, seq, remat = args.batch, args.seq, args.remat
 
     out = plan(preset_name, mesh_axes, batch, seq, remat, args.optimizer,
-               grad_accum=args.grad_accum)
+               grad_accum=args.grad_accum,
+               pp_microbatches=args.pp_microbatches)
     for k, val in out.items():
         print(f"  {k:<16} {val if not isinstance(val, float) else f'{val:.2f}'}")
     if args.hbm_gb is not None:
