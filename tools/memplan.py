@@ -48,7 +48,8 @@ def _parse_mesh(s: str) -> dict:
 
 def plan(preset_name: str, mesh_axes: dict, batch: int, seq: int,
          remat="full", optimizer: str = "adamw", dtype_bytes: int = 2,
-         grad_accum: int = 1, pp_microbatches: int = 0):
+         grad_accum: int = 1, pp_microbatches: int = 0,
+         workload: dict | None = None):
     """Returns a dict of per-chip byte totals for one train step.
 
     ``grad_accum`` > 1 (TrainerConfig.grad_accum) scales the activation
@@ -82,6 +83,7 @@ def plan(preset_name: str, mesh_axes: dict, batch: int, seq: int,
         init_transformer,
         lm_loss,
         preset,
+        preset_from_workload,
         transformer_logical_axes,
     )
     from tf_operator_tpu.parallel import build_mesh
@@ -92,8 +94,23 @@ def plan(preset_name: str, mesh_axes: dict, batch: int, seq: int,
             f"need {n_chips} virtual devices, have {jax.device_count()} — "
             "run in a fresh process (XLA_FLAGS is read at backend init)"
         )
-    overrides = {"pp_microbatches": pp_microbatches} if pp_microbatches else {}
-    cfg = preset(preset_name, max_seq=seq, remat=remat, **overrides)
+    if workload is not None:
+        # --job mode: build the config exactly as every RUNNING role does
+        # (preset_from_workload honors all CONFIG_OVERRIDE_FIELDS) — a
+        # hand-threaded subset here would let the memory plan size a
+        # different model than the one the job launches.
+        wl = dict(workload)
+        wl.setdefault("preset", preset_name)
+        wl["max_seq"] = seq
+        wl.setdefault("remat", remat)
+        if pp_microbatches:
+            wl.setdefault("pp_microbatches", pp_microbatches)
+        cfg = preset_from_workload(wl)
+    else:
+        overrides = (
+            {"pp_microbatches": pp_microbatches} if pp_microbatches else {}
+        )
+        cfg = preset(preset_name, max_seq=seq, remat=remat, **overrides)
     mesh = build_mesh(mesh_axes, devices=jax.devices()[:n_chips])
     trainer = Trainer(
         mesh,
@@ -131,8 +148,11 @@ def plan(preset_name: str, mesh_axes: dict, batch: int, seq: int,
         data_shards *= mesh_axes.get(ax, 1)
     pp = mesh_axes.get("pp", 1)
     pp_micro = int(getattr(cfg, "pp_microbatches", 0) or 0)
-    if cfg.n_experts and pp > 1 and mesh_axes.get("ep", 1) > 1:
+    pipelined = pp > 1 and pp_micro > 0
+    if cfg.n_experts and pipelined and mesh_axes.get("ep", 1) > 1:
         # ep-inside-pipeline (r4): ep is an additional TOKEN axis there
+        # (only when the pipeline actually runs — non-pipelined MoE
+        # shards tokens over dp/fsdp and routes over ep internally)
         data_shards *= mesh_axes["ep"]
     seq_shards = mesh_axes.get("cp", 1)
     tp = mesh_axes.get("tp", 1)
@@ -148,13 +168,28 @@ def plan(preset_name: str, mesh_axes: dict, batch: int, seq: int,
     # 8 kv vs 64 q heads) the k/v activations are kv/d = 1/8 the width of
     # q, and r3's repeat-free attention keeps them that size end to end.
     kv = cfg.n_kv_heads * cfg.head_dim
-    if pp > 1 and pp_micro:
-        # Pipeline (1f1b): each stage holds M microbatch-INPUT saves plus,
-        # transiently during one microbatch's backward, that microbatch's
-        # per-layer remat saves for the STAGE's L/pp layers; the working
-        # set below also shrinks to one microbatch.
+    if pipelined:
+        # Pipeline: the working set below shrinks to one microbatch.
+        # 1f1b holds M microbatch-INPUT saves per stage plus ONE
+        # microbatch's transient backward saves for the stage's L/pp
+        # layers; gpipe's autodiff instead saves per-TICK residuals for
+        # all M+S-1 ticks (fill/drain included). Per-layer save width
+        # follows remat: d bytes/token with full remat, the wide
+        # intermediates without.
         local_tokens = max(1, local_tokens // pp_micro)
-        saved = (pp_micro + L // pp) * local_tokens * d * dtype_bytes
+        per_layer = (
+            d if cfg.remat in (True, "full")
+            else (3 * d + kv + 2 * f // tp)
+        )
+        l_stage = L // pp
+        if getattr(cfg, "pp_schedule", "1f1b") == "gpipe":
+            ticks = pp_micro + pp - 1
+            saved = ticks * local_tokens * (d + l_stage * per_layer) * dtype_bytes
+        else:
+            saved = (
+                (pp_micro * d + l_stage * per_layer)
+                * local_tokens * dtype_bytes
+            )
     elif cfg.remat in (True, "full"):
         saved = L * local_tokens * d * dtype_bytes
     else:  # no remat: every layer's intermediates persist to the backward
@@ -216,16 +251,20 @@ def main(argv=None) -> int:
         seq = int(wl.get("seq_len", args.seq))
         remat = wl.get("remat", args.remat)
         args.grad_accum = int(wl.get("grad_accum", args.grad_accum))
-        args.pp_microbatches = int(wl.get("pp_microbatches", 0))
+        args.pp_microbatches = int(
+            wl.get("pp_microbatches", args.pp_microbatches)
+        )
     else:
         if not args.preset:
             p.error("--preset or --job required")
+        wl = None
         preset_name, mesh_axes = args.preset, _parse_mesh(args.mesh)
         batch, seq, remat = args.batch, args.seq, args.remat
 
     out = plan(preset_name, mesh_axes, batch, seq, remat, args.optimizer,
                grad_accum=args.grad_accum,
-               pp_microbatches=args.pp_microbatches)
+               pp_microbatches=args.pp_microbatches,
+               workload=wl if args.job else None)
     for k, val in out.items():
         print(f"  {k:<16} {val if not isinstance(val, float) else f'{val:.2f}'}")
     if args.hbm_gb is not None:
