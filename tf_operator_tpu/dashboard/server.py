@@ -463,7 +463,16 @@ class DashboardServer:
         routes and the /api/v1 surface; None serves anonymously (tests,
         localhost dev). ``auth_reads`` (r4): extend the bearer check to
         every read route except /healthz — reference-parity with
-        Kubernetes auth covering all API access."""
+        Kubernetes auth covering all API access. Requesting auth_reads
+        without a token is refused loudly (r5, ADVICE r4): silently
+        serving an open server is the exact hole the flag exists to
+        close — the CLI guard in cli/operator.py only covers CLI
+        callers."""
+        if auth_reads and not auth_token:
+            raise ValueError(
+                "auth_reads=True requires auth_token — without a token the "
+                "server would serve every read anonymously"
+            )
         self._watches: set = set()
         self._watch_closed = threading.Event()
         handler = type(
@@ -474,7 +483,7 @@ class DashboardServer:
                 "metrics": metrics,
                 "watch_ping_interval": watch_ping_interval,
                 "auth_token": auth_token,
-                "auth_reads": bool(auth_reads and auth_token),
+                "auth_reads": bool(auth_reads),
                 "_active_watches": self._watches,
                 "_watch_lock": threading.Lock(),
                 "_watch_closed": self._watch_closed,

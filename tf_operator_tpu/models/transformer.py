@@ -63,6 +63,15 @@ class TransformerConfig:
     moe_top_k: int = 1
     capacity_factor: float = 2.0
     ep_axis: str = "ep"
+    # Expert dispatch: "sort" (capacity queues + scatter/gather, the ep
+    # all_to_all layout), "einsum" (one-hot oracle), "ragged" (r5 —
+    # lax.ragged_dot over actual per-expert counts; measured SLOWER than
+    # the padded vmap on v5e — kept as the negative-result receipt), or
+    # "gmm" (r5 — the Pallas grouped-matmul kernel: block-granular
+    # padding only, no drops; ops/grouped_matmul.py). ragged/gmm engage
+    # on the no-ep path and fall back to sort under ep sharding
+    # (parallel.moe.moe_apply).
+    moe_dispatch: str = "sort"
     # Router auxiliary losses — without them top-k routing collapses onto a
     # few experts under real training. moe_aux_weight scales the Switch
     # load-balance loss  E * Σ_e f_e·P_e  (f_e = fraction of token-choices
@@ -433,7 +442,10 @@ def _layer(x, layer_params, cfg: TransformerConfig, mesh, tp_axis=None,
     proj = attn @ layer_params["wo"].astype(x.dtype)
     if tp_axis is not None:
         proj = leave(proj)
-    x = x + proj
+    # Selective-remat tag: saving the post-attention residual stream lets
+    # the MLP recompute chain start HERE instead of replaying qkv →
+    # attention → wo to rebuild it (see _remat_wrap).
+    x = checkpoint_name(x + proj, "resid_mid")
 
     h = _rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps)
     if cfg.n_experts:
@@ -442,9 +454,13 @@ def _layer(x, layer_params, cfg: TransformerConfig, mesh, tp_axis=None,
         return x + moe_out, aux
     if tp_axis is not None:
         h = enter(h)
-    gate = jax.nn.silu(h @ layer_params["w_gate"].astype(x.dtype))
-    up = h @ layer_params["w_up"].astype(x.dtype)
-    down = (gate * up) @ layer_params["w_down"].astype(x.dtype)
+    # PRE-activation tags: the silu backward needs the pre-activation
+    # value (silu'(z) is a function of z, not of silu(z)), so saving z
+    # rather than silu(z) is what actually retires the gate/up matmul
+    # recompute — the elementwise silu/mul replay from z is free.
+    z_gate = checkpoint_name(h @ layer_params["w_gate"].astype(x.dtype), "mlp_gate")
+    up = checkpoint_name(h @ layer_params["w_up"].astype(x.dtype), "mlp_up")
+    down = (jax.nn.silu(z_gate) * up) @ layer_params["w_down"].astype(x.dtype)
     if tp_axis is not None:
         down = leave(down)
     return x + down, None
@@ -499,6 +515,8 @@ def _moe_mlp(h, layer_params, cfg: TransformerConfig, mesh,
             k_top=cfg.moe_top_k, stat_axes=(local_ep_axis,),
         )
     else:
+        from tf_operator_tpu.parallel.moe import ragged_swiglu
+
         out, stats = moe_apply(
             flat,
             gate_logits,
@@ -512,6 +530,8 @@ def _moe_mlp(h, layer_params, cfg: TransformerConfig, mesh,
             dropped="zero",
             k_top=cfg.moe_top_k,
             return_stats=True,
+            dispatch_impl=cfg.moe_dispatch,
+            ragged_expert_fn=ragged_swiglu,
         )
     # Switch load-balance loss: E * Σ_e f_e·P_e. f_e (expert_load) comes
     # out of the discrete top-k assignment, so it carries no gradient and
@@ -533,12 +553,69 @@ def _moe_mlp(h, layer_params, cfg: TransformerConfig, mesh,
     return out.reshape(b, t, d), aux
 
 
+# Selective-remat policy ladder (r5, VERDICT r4 #1): named-activation sets
+# between the two extremes full remat (save layer inputs only, fits, but
+# replays qkv+attn+wo+gate+up in the backward) and "dots" (save every
+# matmul output, OOMs at north-star shapes). Ordered by per-layer HBM cost
+# at gqa-2048 b=6 t=2048 (bf16): flash_q 50.3 MB + flash_k/v 12.6 each;
+# resid_mid 50.3; mlp_up/mlp_gate 201 each. The recompute each tier
+# retires (in btd² matmul units of the 23 the full-remat backward replays
+# — the down projection is never replayed, its output is dead in the
+# backward): qkv 3, +wo 2, +up 8, +gate 8. The attention forward replay
+# (~2 units) is the structural floor of every tier: the flash custom-vjp
+# rebuilds its (o, lse) residuals in the backward regardless (see
+# ops/flash_attention.py FLASH_SAVE_NAMES — the boundary is opaque to
+# name policies on the output side).
+_REMAT_SAVE_SETS: Dict[str, tuple] = {
+    # the r5 north-star winner: +50 MB/layer at gqa-2048 b=6 retires the
+    # wo replay AND severs the recompute chain at the residual stream —
+    # measured 57.3% exact / 50.9% 6ND vs full remat's 55.9/49.6 (the
+    # only policy that beats full remat at the max-fit batch; BASELINE.md
+    # selective-remat table)
+    "save_mid": ("resid_mid",),
+    "save_qkv": ("flash_q", "flash_k", "flash_v"),
+    "save_qkv_mid": ("flash_q", "flash_k", "flash_v", "resid_mid"),
+    "save_qkv_mid_up": (
+        "flash_q", "flash_k", "flash_v", "resid_mid", "mlp_up",
+    ),
+    "save_qkv_mid_mlp": (
+        "flash_q", "flash_k", "flash_v", "resid_mid", "mlp_up", "mlp_gate",
+    ),
+    "save_mlp_mid": ("resid_mid", "mlp_gate", "mlp_up"),
+}
+
+
+def remat_save_names(remat) -> Optional[tuple]:
+    """The activation names a remat mode saves (None for non-name modes).
+    Accepts the _REMAT_SAVE_SETS aliases or ``"save:name1,name2"``."""
+    if isinstance(remat, str):
+        if remat in _REMAT_SAVE_SETS:
+            return _REMAT_SAVE_SETS[remat]
+        if remat.startswith("save:"):
+            return tuple(n.strip() for n in remat[5:].split(",") if n.strip())
+    return None
+
+
+def checkpoint_name(x, name: str):
+    """jax.ad_checkpoint.checkpoint_name on every array leaf — identity
+    outside remat; under a save_only_these_names policy the tagged value
+    is stored instead of recomputed."""
+    from jax.ad_checkpoint import checkpoint_name as cn
+
+    return jax.tree_util.tree_map(lambda a: cn(a, name), x)
+
+
 def _remat_wrap(layer_fn, cfg: TransformerConfig):
     if cfg.remat in (True, "full"):
         return jax.checkpoint(layer_fn)
     if cfg.remat == "dots":
         return jax.checkpoint(
             layer_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+    names = remat_save_names(cfg.remat)
+    if names is not None:
+        return jax.checkpoint(
+            layer_fn, policy=jax.checkpoint_policies.save_only_these_names(*names)
         )
     if cfg.remat not in (False, None, "none"):
         raise ValueError(f"unknown remat mode {cfg.remat!r}")
@@ -864,7 +941,7 @@ CONFIG_OVERRIDE_FIELDS = frozenset(
         "vocab", "d_model", "n_layers", "n_heads", "n_kv_heads", "d_ff",
         "max_seq", "causal", "remat", "fused_xent", "n_experts",
         "moe_top_k", "capacity_factor", "moe_aux_weight", "moe_zloss_weight",
-        "pp_microbatches", "pp_schedule",
+        "moe_dispatch", "pp_microbatches", "pp_schedule",
     }
 )
 

@@ -69,6 +69,9 @@ def main(ctx: JobContext) -> None:
         config=TrainerConfig(
             optimizer="adamw", learning_rate=float(wl.get("lr", 3e-4)),
             grad_accum=int(wl.get("grad_accum", 1)),
+            # submit-latency path: rbg init sheds the threefry subgraphs
+            # (opt-in since r5 — library default stays deterministic)
+            fast_init_rng=bool(wl.get("fast_init_rng", True)),
         ),
     )
     from tf_operator_tpu.train.checkpoint import WorkloadCheckpointer
@@ -88,7 +91,13 @@ def main(ctx: JobContext) -> None:
         n_proc = jax.process_count()
         if batch % n_proc:
             raise ValueError(f"batch_size {batch} % {n_proc} processes != 0")
-        ds = TokenMemmapDataset(wl["corpus"], batch // n_proc, seq)
+        # holdout_windows (r5): reserve the corpus tail for the Evaluator
+        # BEFORE rank-sharding — the trainer never sees those windows, so
+        # eval CE measures generalization on this corpus, not memorization.
+        ds = TokenMemmapDataset(
+            wl["corpus"], batch // n_proc, seq,
+            holdout=int(wl.get("holdout_windows", 0)),
+        )
         loader = DeviceLoader(
             ds, trainer.batch_sharding, skip=ckpt.resume_step()
         )

@@ -123,7 +123,10 @@ def main(ctx: JobContext) -> None:
         loss_fn=loss_fn,
         init_fn=lambda k: init_resnet(k, cfg),
         config=TrainerConfig(
-            optimizer="sgd", learning_rate=float(wl.get("lr", 0.1)), grad_clip=None
+            optimizer="sgd", learning_rate=float(wl.get("lr", 0.1)), grad_clip=None,
+            # submit-latency path: rbg init sheds the threefry subgraphs
+            # (opt-in since r5 — library default stays deterministic)
+            fast_init_rng=bool(wl.get("fast_init_rng", True)),
         ),
     )
     from tf_operator_tpu.train.checkpoint import WorkloadCheckpointer
@@ -243,6 +246,10 @@ def _train_real(ctx, mesh, trainer, cfg, wl) -> None:
         cur = float(m["loss"])
         if not math.isfinite(cur):
             log.warning("skipping checkpoint at step %d: loss %r", step, cur)
+            # fence in-flight async saves (r5, ADVICE r4): the caller is
+            # about to raise and exit — without the fence the last
+            # periodic save could still be writing and land torn
+            mgr.wait_until_finished()
             return
         mgr.save(step, state, wait=wait)
 

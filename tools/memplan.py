@@ -168,17 +168,32 @@ def plan(preset_name: str, mesh_axes: dict, batch: int, seq: int,
     # 8 kv vs 64 q heads) the k/v activations are kv/d = 1/8 the width of
     # q, and r3's repeat-free attention keeps them that size end to end.
     kv = cfg.n_kv_heads * cfg.head_dim
+    # Selective-remat name policies (r5): saved width per token per layer
+    # on TOP of the full-remat layer-input save. flash residuals store the
+    # kernel-layout q/k/v/o (bf16) plus the compact f32 lse (n_heads
+    # values/token → 4/dtype_bytes in dtype units).
+    from tf_operator_tpu.models.transformer import remat_save_names
+
+    _name_width = {
+        "flash_q": d, "flash_k": kv, "flash_v": kv,
+        "resid_mid": d, "mlp_gate": f // tp, "mlp_up": f // tp,
+    }
+    save_names = remat_save_names(cfg.remat)
+    policy_width = (
+        sum(_name_width.get(n, 0) for n in save_names) if save_names else 0
+    )
     if pipelined:
         # Pipeline: the working set below shrinks to one microbatch.
         # 1f1b holds M microbatch-INPUT saves per stage plus ONE
         # microbatch's transient backward saves for the stage's L/pp
         # layers; gpipe's autodiff instead saves per-TICK residuals for
         # all M+S-1 ticks (fill/drain included). Per-layer save width
-        # follows remat: d bytes/token with full remat, the wide
-        # intermediates without.
+        # follows remat: d bytes/token with full remat (+ the policy's
+        # named saves), the wide intermediates without.
         local_tokens = max(1, local_tokens // pp_micro)
         per_layer = (
-            d if cfg.remat in (True, "full")
+            d + policy_width
+            if cfg.remat in (True, "full") or save_names is not None
             else (3 * d + kv + 2 * f // tp)
         )
         l_stage = L // pp
@@ -190,8 +205,8 @@ def plan(preset_name: str, mesh_axes: dict, batch: int, seq: int,
                 (pp_micro * d + l_stage * per_layer)
                 * local_tokens * dtype_bytes
             )
-    elif cfg.remat in (True, "full"):
-        saved = L * local_tokens * d * dtype_bytes
+    elif cfg.remat in (True, "full") or save_names is not None:
+        saved = L * local_tokens * (d + policy_width) * dtype_bytes
     else:  # no remat: every layer's intermediates persist to the backward
         saved = L * local_tokens * (3 * d + kv + 2 * f // tp) * dtype_bytes
     # working set: q + attn-out + 2 residual-stream temporaries (d each),

@@ -631,6 +631,31 @@ def moe_roofline(tokens: int = 32768, d: int = 768, f: int = 3072,
                         dropped="zero")
         return jnp.sum(out.astype(jnp.float32) ** 2)
 
+    def body_full_gmm(args):
+        # r5: the padding-free grouped-matmul layer (ops/grouped_matmul)
+        gl = args["x"] @ args["wr"]
+        out = moe_apply(args["x"], gl, args["w"], expert_fn, None,
+                        k_top=k_top, dispatch_impl="gmm")
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def body_experts_gmm(args):
+        # the grouped matmul alone on ACTIVE rows (uniform groups): the
+        # "experts-vmap at cf" rows vs this one isolates the padding term
+        from tf_operator_tpu.ops.grouped_matmul import gmm as gmm_op
+
+        xs, w = args["x"], args["w"]
+        nb_blocks = xs.shape[0] // 256  # the kernel's shipping block size
+        # nondecreasing block→expert map covering every block even when
+        # nb_blocks % n_experts != 0 (a repeat() of nb//E entries would
+        # leave the tail blocks reading out of the prefetch buffer)
+        be = (
+            jnp.arange(nb_blocks, dtype=jnp.int32) * n_experts // nb_blocks
+        ).astype(jnp.int32)
+        zg = gmm_op(xs, w["w_gate"], be)
+        zu = gmm_op(xs, w["w_up"], be)
+        out = gmm_op(jax.nn.silu(zg) * zu, w["w_down"], be)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
     # Active-FLOP reference: 6·(3·d·f)·T_active fwd+bwd matmul FLOPs
     # (2 fwd + 4 bwd per param-token).
     active_flops = 6 * (3 * d * f) * tokens * k_top
@@ -666,12 +691,17 @@ def moe_roofline(tokens: int = 32768, d: int = 768, f: int = 3072,
 
         return slope_per_iter(time_once, iters)
 
+    x_active = (jax.random.normal(ks[5], (tokens * k_top, d)) * 0.02).astype(
+        jnp.bfloat16
+    )
     rows = [
         ("dense", body_dense, {"x": x, "w": dense_w}),
         ("experts-loop", body_experts_loop, {"x": inbox, "w": wp}),
         ("experts-vmap", body_experts_vmap, {"x": inbox, "w": wp}),
+        ("experts-gmm", body_experts_gmm, {"x": x_active, "w": wp}),
         ("routing", body_routing, {"x": x, "w": router}),
         ("full", body_full, {"x": x, "wr": router, "w": wp}),
+        ("full-gmm", body_full_gmm, {"x": x, "wr": router, "w": wp}),
     ]
     results = {}
     for name, fn, arg in rows:
