@@ -1,0 +1,104 @@
+"""Agent registration/drain races (runtime/agent.py _register /
+_touch_heartbeat): the windows noted inline in the agent — a Host object
+vanishing mid-adoption (admin drain racing an agent restart), deletion
+under a live heartbeat, and preemption-drain state surviving both.
+
+These drive the agent's private steps synchronously (no threads), so each
+race interleaving is constructed exactly, not hoped for under load."""
+
+import time
+
+from tf_operator_tpu.api.types import KIND_HOST
+from tf_operator_tpu.runtime import HostAgent, HostPhase, Store
+
+
+class VanishOnAdoptStore(Store):
+    """Deletes the Host between the create's AlreadyExists and the adopt
+    update — the exact mid-adoption vanish the agent must survive by
+    looping back to create."""
+
+    def __init__(self, victim: str) -> None:
+        super().__init__()
+        self._victim = victim
+        self._armed = True
+        self.vanished = 0
+
+    def update_with_retry(self, kind, namespace, name, mutate):
+        if kind == KIND_HOST and name == self._victim and self._armed:
+            self._armed = False
+            self.delete(kind, namespace, name)
+            self.vanished += 1
+            # fall through: the loop now observes NotFound -> returns None
+        return super().update_with_retry(kind, namespace, name, mutate)
+
+
+def test_register_survives_host_vanishing_mid_adoption():
+    store = VanishOnAdoptStore("h1")
+    # a previous incarnation's Host occupies the name -> create conflicts
+    stale = HostAgent(store, "h1", total_chips=2)
+    stale._register()
+    agent = HostAgent(store, "h1", total_chips=4)
+    agent._register()  # AlreadyExists -> adopt -> vanish -> retry create
+    assert store.vanished == 1
+    h = store.get(KIND_HOST, "default", "h1")
+    assert h.status.phase is HostPhase.READY
+    assert h.spec.total_chips == 4  # the new agent's spec won
+
+
+def test_heartbeat_reregisters_after_admin_delete():
+    store = Store()
+    agent = HostAgent(store, "h2", total_chips=2)
+    agent._register()
+    store.delete(KIND_HOST, "default", "h2")
+    agent._touch_heartbeat()  # update_with_retry -> None -> re-register
+    h = store.get(KIND_HOST, "default", "h2")
+    assert h.status.phase is HostPhase.READY
+    assert h.status.heartbeat_time > 0
+
+
+def test_drain_survives_admin_delete_and_reregistration():
+    """An admin deleting the Host object of a DRAINING agent must not
+    resurrect it Ready: the scheduler would place a fresh gang onto a
+    host about to vanish. Drain is sticky across re-registration."""
+    store = Store()
+    agent = HostAgent(store, "h3", total_chips=2)
+    agent._register()
+    agent.notify_preemption("spot eviction notice")
+    assert store.get(KIND_HOST, "default", "h3").status.phase is HostPhase.DRAINING
+    store.delete(KIND_HOST, "default", "h3")
+    agent._touch_heartbeat()
+    h = store.get(KIND_HOST, "default", "h3")
+    assert h.status.phase is HostPhase.DRAINING
+
+
+def test_reregistration_adopts_and_refreshes_spec_and_phase():
+    """Agent restart over an existing (NotReady, stale-spec) Host adopts
+    in place: spec refreshed, phase Ready, heartbeat fresh — the adopt arm
+    of _register rather than the create arm."""
+    store = Store()
+    old = HostAgent(store, "h4", total_chips=2)
+    old._register()
+
+    def droop(cur):
+        cur.status.phase = HostPhase.NOT_READY
+        cur.status.heartbeat_time = time.time() - 1000
+
+    store.update_with_retry(KIND_HOST, "default", "h4", droop)
+    uid_before = store.get(KIND_HOST, "default", "h4").metadata.uid
+
+    fresh = HostAgent(store, "h4", total_chips=8, slice_type="v5e-8")
+    fresh._register()
+    h = store.get(KIND_HOST, "default", "h4")
+    assert h.metadata.uid == uid_before  # adopted, not recreated
+    assert h.status.phase is HostPhase.READY
+    assert h.spec.total_chips == 8 and h.spec.slice_type == "v5e-8"
+    assert time.time() - h.status.heartbeat_time < 5
+
+
+def test_draining_agent_reports_draining_property():
+    store = Store()
+    agent = HostAgent(store, "h5", total_chips=1)
+    agent._register()
+    assert not agent.draining
+    agent.notify_preemption()
+    assert agent.draining

@@ -1,0 +1,472 @@
+"""Chaos subsystem units (fast tier): deterministic schedules, the
+ChaosStore wrapper, store-mode fault application, and the reconciler's
+preemption-drain lifecycle — the gang-restart causes, backoff exemption,
+warm-restart env, per-job heartbeat TTL, and by-cause metrics."""
+
+import os
+import time
+
+import pytest
+
+from tf_operator_tpu.api.types import (
+    API_GROUP,
+    LABEL_GROUP,
+    LABEL_JOB_NAME,
+    LABEL_REPLICA_INDEX,
+    LABEL_REPLICA_TYPE,
+    KIND_HOST,
+    KIND_PROCESS,
+    ConditionType,
+    ObjectMeta,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    TopologySpec,
+    TPUJob,
+    TPUJobSpec,
+)
+from tf_operator_tpu.chaos import ChaosInjector, Fault, FaultKind, FaultSchedule
+from tf_operator_tpu.controller import TPUJobController
+from tf_operator_tpu.controller.reconciler import (
+    ANNOTATION_PORT,
+    CAUSE_FAILURE,
+    CAUSE_NODE_LOST,
+    CAUSE_PREEMPTION,
+)
+from tf_operator_tpu.controller.status import get_condition, has_condition
+from tf_operator_tpu.rendezvous.env import ENV_CHECKPOINT_DIR, ENV_RESUME_STEP
+from tf_operator_tpu.runtime import FakeProcessControl, GangScheduler, Store
+from tf_operator_tpu.runtime.objects import (
+    Host,
+    HostPhase,
+    HostSpec,
+    Process,
+    ProcessPhase,
+    ProcessSpec,
+    ProcessStatus,
+)
+from tf_operator_tpu.runtime.scheduler import SchedulingError
+from tf_operator_tpu.runtime.store import TransientStoreError
+from tf_operator_tpu.utils.exit_codes import (
+    ExitClass,
+    classify_exit_code,
+    is_preemption,
+    is_retryable,
+)
+
+
+# ---------------------------------------------------------------------------
+# exit-code taxonomy: the preemption class
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_and_sigint_classify_preempted():
+    assert classify_exit_code(143) is ExitClass.PREEMPTED
+    assert classify_exit_code(130) is ExitClass.PREEMPTED
+    assert classify_exit_code(-15) is ExitClass.PREEMPTED
+    # SIGKILL stays plain retryable (counted against backoff)
+    assert classify_exit_code(137) is ExitClass.RETRYABLE
+
+
+def test_preempted_is_still_retryable():
+    assert is_retryable(143) and is_preemption(143)
+    assert is_retryable(137) and not is_preemption(137)
+    # OOM overrides even the preemption codes
+    assert classify_exit_code(143, oom_killed=True) is ExitClass.PERMANENT
+
+
+# ---------------------------------------------------------------------------
+# fault schedules: seeded determinism + serialization
+# ---------------------------------------------------------------------------
+
+
+def test_schedule_same_seed_identical():
+    a = FaultSchedule.generate(7, crashes=2, preemptions=1, stalls=1, store_blips=1)
+    b = FaultSchedule.generate(7, crashes=2, preemptions=1, stalls=1, store_blips=1)
+    assert a == b
+    assert a != FaultSchedule.generate(8, crashes=2, preemptions=1, stalls=1,
+                                       store_blips=1)
+
+
+def test_schedule_roundtrips_through_dict():
+    sched = FaultSchedule.generate(3, crashes=1, preemptions=1, store_blips=2)
+    assert FaultSchedule.from_dict(sched.to_dict()) == sched
+
+
+# ---------------------------------------------------------------------------
+# ChaosStore wrapper
+# ---------------------------------------------------------------------------
+
+
+def _host(name, phase=HostPhase.READY, beat=None):
+    h = Host(metadata=ObjectMeta(name=name, namespace="default"),
+             spec=HostSpec(total_chips=4))
+    h.status.phase = phase
+    h.status.heartbeat_time = time.time() if beat is None else beat
+    return h
+
+
+def test_chaos_store_error_budget_raises_then_clears():
+    store = Store()
+    store.create(_host("h1"))
+    inj = ChaosInjector(FaultSchedule(), store)
+    wrapped = inj.wrap()
+    with inj.knobs.lock:
+        inj.knobs.error_budget = 2
+    with pytest.raises(TransientStoreError):
+        wrapped.get(KIND_HOST, "default", "h1")
+    with pytest.raises(TransientStoreError):
+        wrapped.list(KIND_HOST)
+    # budget exhausted: ops flow again
+    assert wrapped.get(KIND_HOST, "default", "h1").metadata.name == "h1"
+
+
+def test_chaos_store_blackholes_heartbeats_but_not_phase_writes():
+    store = Store()
+    store.create(_host("h1", beat=123.0))
+    inj = ChaosInjector(FaultSchedule(), store)
+    wrapped = inj.wrap()
+    with inj.knobs.lock:
+        inj.knobs.blocked_hosts["h1"] = time.monotonic() + 60
+
+    def touch(cur):
+        cur.status.heartbeat_time = 999.0
+
+    # the agent's heartbeat shape: swallowed, but reads as success
+    assert wrapped.update_with_retry(KIND_HOST, "default", "h1", touch) is not None
+    assert store.get(KIND_HOST, "default", "h1").status.heartbeat_time == 123.0
+    # a direct phase write (update_with_retry_loop → get/update) still lands
+    from tf_operator_tpu.runtime.store import update_with_retry_loop
+
+    def drain(cur):
+        cur.status.phase = HostPhase.DRAINING
+
+    update_with_retry_loop(wrapped, KIND_HOST, "default", "h1", drain)
+    assert store.get(KIND_HOST, "default", "h1").status.phase is HostPhase.DRAINING
+
+
+def test_injector_store_mode_crash_marks_failed_with_code():
+    store = Store()
+    proc = Process(
+        metadata=ObjectMeta(name="j-worker-0", namespace="default"),
+        spec=ProcessSpec(job_name="j"),
+        status=ProcessStatus(phase=ProcessPhase.RUNNING),
+    )
+    store.create(proc)
+    sched = FaultSchedule(faults=(Fault(FaultKind.CRASH, exit_code=137),))
+    inj = ChaosInjector(sched, store, job_name="j", poll_interval=0.02)
+    inj.arm()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and not inj.done:
+            time.sleep(0.02)
+    finally:
+        inj.stop()
+    assert inj.done
+    got = store.get(KIND_PROCESS, "default", "j-worker-0")
+    assert got.status.phase is ProcessPhase.FAILED
+    assert got.status.exit_code == 137
+    assert inj.applied[0]["kind"] == "crash"
+    assert inj.applied[0]["target"] == "default/j-worker-0"
+
+
+# ---------------------------------------------------------------------------
+# scheduler: draining hosts are not placement targets
+# ---------------------------------------------------------------------------
+
+
+def _job(name="drainer", workers=2, num_hosts=1, **rp):
+    job = TPUJob(
+        metadata=ObjectMeta(name=name, uid=f"uid-{name}"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=workers, template=ProcessTemplate(entrypoint="wl.m:f")
+                )
+            },
+            topology=TopologySpec(num_hosts=num_hosts, chips_per_host=4),
+        ),
+    )
+    for k, v in rp.items():
+        setattr(job.spec.run_policy, k, v)
+    return job
+
+
+def test_scheduler_excludes_draining_hosts():
+    store = Store()
+    store.create(_host("h1", phase=HostPhase.DRAINING))
+    store.create(_host("h2"))
+    sched = GangScheduler(store)
+    assert [h.metadata.name for h in sched.ready_hosts()] == ["h2"]
+    assert [h.metadata.name for h in sched.draining_hosts()] == ["h1"]
+    job = _job(workers=2, num_hosts=2)  # needs 2 hosts, only 1 Ready
+    procs = [
+        Process(metadata=ObjectMeta(name=f"p{i}"), spec=ProcessSpec(chips=1))
+        for i in range(2)
+    ]
+    with pytest.raises(SchedulingError):
+        sched.place_gang(job, procs)
+
+
+def test_draining_host_with_stale_heartbeat_is_lost_not_draining():
+    store = Store()
+    store.create(_host("h1", phase=HostPhase.DRAINING, beat=time.time() - 100))
+    sched = GangScheduler(store)
+    assert sched.draining_hosts() == []
+    assert [h.metadata.name for h in sched.lost_hosts()] == ["h1"]
+
+
+def test_scheduler_per_call_ttl_override():
+    store = Store()
+    store.create(_host("h1", beat=time.time() - 10))
+    sched = GangScheduler(store)  # default TTL 15: still fresh
+    assert len(sched.ready_hosts()) == 1
+    assert sched.ready_hosts(ttl=5.0) == []
+    assert [h.metadata.name for h in sched.lost_hosts(ttl=5.0)] == ["h1"]
+
+
+# ---------------------------------------------------------------------------
+# reconciler: drain lifecycle, causes, backoff exemption, warm-restart env
+# ---------------------------------------------------------------------------
+
+
+def _member(job, index, phase, node="", exit_code=None, node_lost=False):
+    name = f"{job.metadata.name}-worker-{index}"
+    p = Process(
+        metadata=ObjectMeta(
+            name=name,
+            namespace="default",
+            labels={
+                LABEL_GROUP: API_GROUP,
+                LABEL_JOB_NAME: job.metadata.name,
+                LABEL_REPLICA_TYPE: ReplicaType.WORKER.value,
+                LABEL_REPLICA_INDEX: str(index),
+            },
+            owner_uid=job.metadata.uid,
+            owner_kind="TPUJob",
+            owner_name=job.metadata.name,
+        ),
+        spec=ProcessSpec(
+            job_name=job.metadata.name,
+            replica_type=ReplicaType.WORKER.value,
+            replica_index=index,
+            node_name=node,
+        ),
+        status=ProcessStatus(phase=phase, exit_code=exit_code, node_lost=node_lost),
+    )
+    return p
+
+
+class DrainHarness:
+    def __init__(self, job, processes=(), hosts=()):
+        self.store = Store()
+        self.fake = FakeProcessControl()
+        self.ctl = TPUJobController(self.store, self.fake,
+                                    port_allocator=lambda: 23456)
+        for h in hosts:
+            self.store.create(h)
+        self.job = self.store.create(job)
+        for p in processes:
+            self.store.create(p)
+        self.ctl.job_informer.seed([self.job])
+        self.ctl.process_informer.seed(self.store.list("Process"))
+
+    def sync(self):
+        self.ctl.sync_job(self.job.key())
+
+    def stored(self):
+        return self.store.get("TPUJob", "default", self.job.metadata.name)
+
+
+def test_draining_member_triggers_preemption_restart_not_counted():
+    job = _job(workers=2, num_hosts=2, backoff_limit=0)  # at the limit!
+    hosts = [_host("h1", phase=HostPhase.DRAINING), _host("h2")]
+    procs = [
+        _member(job, 0, ProcessPhase.RUNNING, node="h1"),
+        _member(job, 1, ProcessPhase.RUNNING, node="h2"),
+    ]
+    h = DrainHarness(job, procs, hosts)
+    h.sync()
+    st = h.stored().status
+    # graceful: whole gang deleted, counted as preemption, backoff untouched
+    assert st.preemption_count == 1
+    assert st.restart_count == 0
+    assert st.last_restart_cause == CAUSE_PREEMPTION
+    assert has_condition(st, ConditionType.RESTARTING)
+    assert not has_condition(st, ConditionType.FAILED)
+    # host-bound members are deleted via the store (their agents kill them)
+    assert h.store.list("Process") == []
+    # the rendezvous port was fenced for the relocated gang
+    assert ANNOTATION_PORT not in h.stored().metadata.annotations
+    evs = [e.reason for e in h.store.list("Event")]
+    assert "TPUJobPreempted" in evs
+    # by-cause metric recorded
+    assert 'cause="preemption"' in h.ctl.metrics.render()
+
+
+def test_preempted_exit_143_classifies_preemption_cause():
+    job = _job(workers=2, backoff_limit=0)
+    procs = [
+        _member(job, 0, ProcessPhase.FAILED, exit_code=143),
+        _member(job, 1, ProcessPhase.RUNNING),
+    ]
+    h = DrainHarness(job, procs)
+    h.sync()
+    st = h.stored().status
+    assert st.preemption_count == 1
+    assert st.restart_count == 0
+    assert st.last_restart_cause == CAUSE_PREEMPTION
+    assert not has_condition(st, ConditionType.FAILED)
+
+
+def test_crash_racing_a_drain_still_consumes_backoff():
+    """One member exits 1-like retryable (137) while another sits on a
+    draining host: the crash wins the cause — mixed incidents consume
+    backoff, preemption never hides a real failure."""
+    job = _job(workers=2, num_hosts=2, backoff_limit=5)
+    hosts = [_host("h1", phase=HostPhase.DRAINING), _host("h2")]
+    procs = [
+        _member(job, 0, ProcessPhase.FAILED, node="h1", exit_code=137),
+        _member(job, 1, ProcessPhase.RUNNING, node="h2"),
+    ]
+    h = DrainHarness(job, procs, hosts)
+    h.sync()
+    st = h.stored().status
+    assert st.restart_count == 1
+    assert st.preemption_count == 0
+    assert st.last_restart_cause == CAUSE_FAILURE
+
+
+def test_node_lost_cause_wins_over_preemption():
+    job = _job(workers=2, backoff_limit=5)
+    procs = [
+        _member(job, 0, ProcessPhase.FAILED, exit_code=143),
+        _member(job, 1, ProcessPhase.FAILED, exit_code=137, node_lost=True),
+    ]
+    h = DrainHarness(job, procs)
+    h.sync()
+    st = h.stored().status
+    assert st.last_restart_cause == CAUSE_NODE_LOST
+    assert st.restart_count == 1
+    assert st.preemption_count == 0
+
+
+def test_counted_restart_still_enforces_backoff_limit():
+    job = _job(workers=1, backoff_limit=0)
+    procs = [_member(job, 0, ProcessPhase.FAILED, exit_code=137)]
+    h = DrainHarness(job, procs)
+    h.sync()
+    st = h.stored().status
+    assert has_condition(st, ConditionType.FAILED)
+    assert "backoff" in get_condition(st, ConditionType.FAILED).message
+
+
+def test_warm_restart_env_injected_from_checkpoint_dir(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    (ckpt / "step_4").mkdir(parents=True)
+    (ckpt / "step_4" / "manifest.json").write_text("{}")
+    (ckpt / "step_2").mkdir()
+    (ckpt / "step_2" / "manifest.json").write_text("{}")
+    job = _job(workers=1)
+    job.spec.workload = {"checkpoint_dir": str(ckpt), "checkpoint_every": 2}
+    h = DrainHarness(job)
+    h.sync()
+    env = h.fake.created[0].spec.env
+    assert env[ENV_CHECKPOINT_DIR] == str(ckpt)
+    assert env[ENV_RESUME_STEP] == "4"
+
+
+def test_cold_start_resume_env_is_zero(tmp_path):
+    job = _job(workers=1)
+    job.spec.workload = {"checkpoint_dir": str(tmp_path / "none")}
+    h = DrainHarness(job)
+    h.sync()
+    assert h.fake.created[0].spec.env[ENV_RESUME_STEP] == "0"
+
+
+def test_no_checkpoint_dir_no_resume_env():
+    job = _job(workers=1)
+    h = DrainHarness(job)
+    h.sync()
+    assert ENV_RESUME_STEP not in h.fake.created[0].spec.env
+
+
+def test_per_job_heartbeat_ttl_overrides_default():
+    """A job with a tight run_policy TTL declares its processes lost on a
+    host the controller-wide default still considers fresh."""
+    job = _job(workers=1, num_hosts=1, heartbeat_ttl_seconds=1.0,
+               backoff_limit=5)
+    host = _host("h1", beat=time.time() - 5)  # 5s stale: < default 15, > 1
+    proc = _member(job, 0, ProcessPhase.RUNNING, node="h1")
+    h = DrainHarness(job, [proc], [host])
+    h.sync()
+    # declared lost, then gang-restarted (deleted) within the same sync
+    st = h.stored().status
+    assert st.last_restart_cause == CAUSE_NODE_LOST
+    assert st.restart_count == 1
+    assert "NodeLost" in [e.reason for e in h.store.list("Event")]
+    assert h.store.list(KIND_PROCESS) == []
+
+
+def test_default_ttl_keeps_fresh_host_processes_alive():
+    job = _job(workers=1, num_hosts=1, backoff_limit=5)
+    host = _host("h1", beat=time.time() - 5)
+    proc = _member(job, 0, ProcessPhase.RUNNING, node="h1")
+    h = DrainHarness(job, [proc], [host])
+    h.sync()
+    got = h.store.get(KIND_PROCESS, "default", "drainer-worker-0")
+    assert got.status.phase is ProcessPhase.RUNNING
+
+
+def test_validation_rejects_nonpositive_ttl():
+    from tf_operator_tpu.api.validation import ValidationError, validate_job
+
+    job = _job(workers=1, heartbeat_ttl_seconds=0.0)
+    with pytest.raises(ValidationError):
+        validate_job(job)
+
+
+# ---------------------------------------------------------------------------
+# metrics: labeled counters + draining gauge
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_labeled_counter_and_draining_gauge():
+    from tf_operator_tpu.controller.metrics import ControllerMetrics
+
+    store = Store()
+    store.create(_host("h1", phase=HostPhase.DRAINING))
+    store.create(_host("h2"))
+    m = ControllerMetrics(store=store)
+    m.inc("tpujob_gang_restarts_by_cause_total", labels={"cause": "preemption"})
+    m.inc("tpujob_gang_restarts_by_cause_total", labels={"cause": "preemption"})
+    m.inc("tpujob_gang_restarts_by_cause_total",
+          labels={"cause": "retryable-failure"})
+    text = m.render()
+    assert 'tpujob_gang_restarts_by_cause_total{cause="preemption"} 2' in text
+    assert 'tpujob_gang_restarts_by_cause_total{cause="retryable-failure"} 1' in text
+    assert "tpujob_hosts_draining 1" in text
+    # the HELP/TYPE block renders once per family
+    assert text.count("# TYPE tpujob_gang_restarts_by_cause_total counter") == 1
+
+
+def test_status_roundtrips_preemption_fields():
+    job = _job()
+    job.status.preemption_count = 3
+    job.status.last_restart_cause = CAUSE_PREEMPTION
+    back = TPUJob.from_dict(job.to_dict())
+    assert back.status.preemption_count == 3
+    assert back.status.last_restart_cause == CAUSE_PREEMPTION
+    assert back.spec.run_policy.heartbeat_ttl_seconds is None
+
+
+def test_latest_checkpoint_step_scans_both_layouts(tmp_path):
+    from tf_operator_tpu.train.checkpoint import latest_checkpoint_step
+
+    assert latest_checkpoint_step(str(tmp_path / "missing")) == 0
+    (tmp_path / "step_2").mkdir()
+    (tmp_path / "step_2" / "manifest.json").write_text("{}")
+    (tmp_path / "step_9").mkdir()  # no manifest: in-flight, ignored
+    (tmp_path / "6").mkdir()  # orbax numeric step dir
+    (tmp_path / "7.orbax-checkpoint-tmp-123").mkdir()  # in-flight, ignored
+    assert latest_checkpoint_step(str(tmp_path)) == 6

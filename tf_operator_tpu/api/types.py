@@ -210,6 +210,13 @@ class RunPolicy:
     # rather than the reference's per-pod restart.
     gang_restart: bool = True
     scheduler_name: str = ""  # opaque hint, mirrors SchedulerName v1alpha1/types.go:48-63
+    # Per-job node-lost detection window: a host whose agent has not
+    # heartbeat within this many seconds is treated as lost for THIS job's
+    # processes and placements. None ⇒ the controller-wide default
+    # (runtime/scheduler.py DEFAULT_HEARTBEAT_TTL). Latency-sensitive jobs
+    # tighten it; jobs on flaky networks loosen it instead of eating
+    # spurious gang restarts.
+    heartbeat_ttl_seconds: Optional[float] = None
 
 
 @dataclass
@@ -257,8 +264,16 @@ class TPUJobStatus:
     start_time: Optional[float] = None
     completion_time: Optional[float] = None
     last_reconcile_time: Optional[float] = None
-    # Monotonic count of gang restarts (feeds backoff_limit).
+    # Monotonic count of failure-caused gang restarts (feeds backoff_limit).
     restart_count: int = 0
+    # Monotonic count of preemption-caused gang restarts (host drained /
+    # SIGTERM eviction). Deliberately NOT counted against backoff_limit:
+    # being evicted is infrastructure's doing, not the workload's.
+    preemption_count: int = 0
+    # Cause of the most recent gang restart: "preemption" |
+    # "retryable-failure" | "node-lost" ("" before any restart) — lets
+    # status surfaces report preempted vs failed restarts distinctly.
+    last_restart_cause: str = ""
     # Latest evaluator-reported scores, written by the Evaluator replica
     # through the API (workloads/eval.py → JobContext.report_eval_metrics):
     # {"step": int, "metrics": {name: value}, "time": ts}. The reference
@@ -386,6 +401,8 @@ def _tpujob_from_dict(data: Dict[str, Any]) -> TPUJob:
         completion_time=status_d.get("completion_time"),
         last_reconcile_time=status_d.get("last_reconcile_time"),
         restart_count=status_d.get("restart_count", 0),
+        preemption_count=status_d.get("preemption_count", 0),
+        last_restart_cause=status_d.get("last_restart_cause", ""),
         eval_metrics=status_d.get("eval_metrics", {}) or {},
     )
     return TPUJob(metadata=meta, spec=spec, status=status, kind=data.get("kind", KIND_TPUJOB))

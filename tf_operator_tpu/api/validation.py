@@ -76,6 +76,15 @@ def validate_spec(spec: TPUJobSpec) -> None:
         if tmpl.chips_per_process < 0:
             raise ValidationError(f"{prefix}.template.chips_per_process must be >= 0")
 
+    rp = spec.run_policy
+    if rp.heartbeat_ttl_seconds is not None and rp.heartbeat_ttl_seconds <= 0:
+        raise ValidationError(
+            "spec.run_policy.heartbeat_ttl_seconds must be > 0 "
+            "(omit it to use the controller default)"
+        )
+    if rp.backoff_limit is not None and rp.backoff_limit < 0:
+        raise ValidationError("spec.run_policy.backoff_limit must be >= 0")
+
     coord = spec.replica_specs.get(ReplicaType.COORDINATOR)
     if coord is not None and coord.replicas not in (None, 1):
         # Exactly one coordinator, like the chief (v1alpha2/types.go:105-108).

@@ -1,0 +1,28 @@
+"""Chaos subsystem: deterministic, seeded fault injection.
+
+The reference operator's whole value is surviving failure, yet nothing in
+it could *exercise* those paths on demand — its ``--chaos-level`` flag
+shipped as a placeholder and our ChaosMonkey (cli/operator.py) is random,
+so a failure found by soak cannot be replayed. This package supplies the
+deterministic version:
+
+- ``faults``   — declarative fault schedules (crash / preemption notice /
+                 heartbeat stall / store latency / store error), seeded
+                 generation: same seed ⇒ same schedule.
+- ``injector`` — applies a schedule by wrapping the Store (ChaosStore)
+                 and driving host agents / process backends; records the
+                 applied sequence for replay assertions.
+- ``soak``     — a runnable harness (``python -m tf_operator_tpu.chaos.soak``)
+                 that stands up a multi-host local cluster, runs a real
+                 checkpointing training job under a schedule, and asserts
+                 the recovery invariants (job completes, no partial gang
+                 persists, warm restarts resume monotonically, preemption
+                 restarts never consume backoff).
+"""
+
+from tf_operator_tpu.chaos.faults import (  # noqa: F401
+    Fault,
+    FaultKind,
+    FaultSchedule,
+)
+from tf_operator_tpu.chaos.injector import ChaosInjector, ChaosStore  # noqa: F401

@@ -1,0 +1,163 @@
+"""Declarative fault schedules.
+
+A schedule is an ordered tuple of :class:`Fault` records. Each fault
+declares *when* it fires — a minimum time since arm (``at_s``), an
+optional checkpoint-progress gate (``at_step``: fire once the job's
+checkpoint directory holds a step >= N), and an optional restart gate
+(``after_restarts``: fire once the job has restarted N times, counting
+preemptions) — and *what* it does:
+
+- ``CRASH``            kill a running gang process so it exits ``exit_code``
+                       (137 ⇒ SIGKILL, 143 ⇒ SIGTERM; store-mode targets
+                       are marked Failed with the code directly)
+- ``PREEMPT``          deliver a preemption notice to a host agent
+                       (Host → DRAINING; the graceful drain path)
+- ``STALL_HEARTBEAT``  freeze a host's heartbeat writes for ``duration_s``
+                       (NodeLost detection path, host process untouched)
+- ``STORE_LATENCY``    inject ``latency_s`` per store op for ``duration_s``
+- ``STORE_ERROR``      make the next ``errors`` store ops raise
+                       TransientStoreError (operator-restart blip)
+
+Faults fire strictly in schedule order (a fault waits for its
+predecessors), so the *sequence* is deterministic even though wall-clock
+firing times depend on job progress. Target selection is by deterministic
+index over a sorted candidate list — no RNG at apply time. The only
+randomness lives in :meth:`FaultSchedule.generate`, which derives a
+schedule from a seed: same seed ⇒ identical schedule, which is what makes
+a soak failure reproducible.
+"""
+
+from __future__ import annotations
+
+import enum
+import random
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, Tuple
+
+
+class FaultKind(str, enum.Enum):
+    CRASH = "crash"
+    PREEMPT = "preempt"
+    STALL_HEARTBEAT = "stall-heartbeat"
+    STORE_LATENCY = "store-latency"
+    STORE_ERROR = "store-error"
+
+
+@dataclass(frozen=True)
+class Fault:
+    """One scheduled fault. Only the fields relevant to ``kind`` are read."""
+
+    kind: FaultKind
+    # Trigger: all conditions must hold (and all earlier faults fired).
+    at_s: float = 0.0          # min seconds since injector.arm()
+    at_step: int = 0           # min checkpointed step in the job's ckpt dir
+    after_restarts: int = 0    # min restart_count + preemption_count
+    # Target: index into the sorted candidate list (processes for CRASH,
+    # hosts for PREEMPT/STALL_HEARTBEAT); wraps modulo the list length.
+    target: int = 0
+    # CRASH
+    exit_code: int = 137
+    # STALL_HEARTBEAT / STORE_LATENCY window
+    duration_s: float = 0.0
+    # STORE_LATENCY per-op delay
+    latency_s: float = 0.0
+    # STORE_ERROR: number of consecutive ops to fail
+    errors: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = asdict(self)
+        d["kind"] = self.kind.value
+        return d
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Fault":
+        d = dict(d)
+        d["kind"] = FaultKind(d["kind"])
+        return Fault(**d)
+
+
+@dataclass(frozen=True)
+class FaultSchedule:
+    """An ordered, reproducible fault sequence."""
+
+    seed: int = 0
+    faults: Tuple[Fault, ...] = field(default_factory=tuple)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"seed": self.seed, "faults": [f.to_dict() for f in self.faults]}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "FaultSchedule":
+        return FaultSchedule(
+            seed=int(d.get("seed", 0)),
+            faults=tuple(Fault.from_dict(f) for f in d.get("faults", [])),
+        )
+
+    @staticmethod
+    def generate(
+        seed: int,
+        crashes: int = 1,
+        preemptions: int = 1,
+        stalls: int = 0,
+        store_blips: int = 0,
+        first_step: int = 2,
+        spread_s: float = 20.0,
+    ) -> "FaultSchedule":
+        """Derive a schedule from ``seed`` — the soak's default recipe.
+
+        Every fault is gated on checkpoint progress (``at_step >=
+        first_step``) so recovery is always *warm*: a crash before the
+        first checkpoint would legitimately resume from step 0 and the
+        soak's resume-step assertions would be vacuous. Crashes come
+        first, then preemptions (each gated one restart later so they hit
+        the post-crash gang), then stalls/blips. Same seed ⇒ identical
+        schedule; that plus in-order firing is the reproducibility
+        contract."""
+        rng = random.Random(seed)
+        faults = []
+        restarts_so_far = 0
+        for _ in range(crashes):
+            faults.append(
+                Fault(
+                    FaultKind.CRASH,
+                    at_s=rng.uniform(0.0, spread_s),
+                    at_step=first_step,
+                    after_restarts=restarts_so_far,
+                    target=rng.randrange(16),
+                    # SIGKILL-shaped: a counted retryable failure
+                    exit_code=137,
+                )
+            )
+            restarts_so_far += 1
+        for _ in range(preemptions):
+            faults.append(
+                Fault(
+                    FaultKind.PREEMPT,
+                    at_s=rng.uniform(0.0, spread_s),
+                    at_step=first_step,
+                    after_restarts=restarts_so_far,
+                    target=rng.randrange(16),
+                )
+            )
+            restarts_so_far += 1
+        for _ in range(stalls):
+            faults.append(
+                Fault(
+                    FaultKind.STALL_HEARTBEAT,
+                    at_s=rng.uniform(0.0, spread_s),
+                    at_step=first_step,
+                    after_restarts=restarts_so_far,
+                    target=rng.randrange(16),
+                    duration_s=rng.uniform(5.0, 15.0),
+                )
+            )
+            restarts_so_far += 1
+        for _ in range(store_blips):
+            faults.append(
+                Fault(
+                    FaultKind.STORE_ERROR,
+                    at_s=rng.uniform(0.0, spread_s),
+                    errors=rng.randint(1, 3),
+                )
+            )
+        return FaultSchedule(seed=seed, faults=tuple(faults))

@@ -1,0 +1,388 @@
+"""ChaosInjector: applies a FaultSchedule to a live cluster.
+
+Wraps the three seams the runtime exposes:
+
+- **Store** — :class:`ChaosStore` is a Store-compatible wrapper sharing
+  one knob block per injector: per-op latency windows, TransientStoreError
+  budgets (an operator restart blip), and heartbeat blackholes (a host's
+  Host-object heartbeat writes are silently swallowed so the controller's
+  TTL detection fires while the host process keeps running — the
+  split-brain NodeLost scenario).
+- **Agents** — preemption notices are delivered through
+  ``HostAgent.notify_preemption()`` (Host → DRAINING, the graceful drain
+  path), falling back to a direct Host-phase write when the injector only
+  has the store (remote agents).
+- **Process backend** — crashes go through
+  ``LocalProcessControl.signal_local`` when an agent supervises the
+  victim (the monitor thread reports the exit like a real crash), then
+  ``os.kill`` by pid, then a direct store status write for store-only
+  rigs (unit tests over FakeProcessControl).
+
+Faults fire strictly in schedule order; a fault whose conditions hold but
+whose target does not exist yet (e.g. a preemption scheduled against the
+post-restart gang while it is still being recreated) is retried on the
+next poll tick, so the *sequence* of applied faults is deterministic.
+``applied`` records every applied fault — the replay oracle soak tests
+compare across runs of the same seed.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal as _signal
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from tf_operator_tpu.api.types import (
+    KIND_HOST,
+    KIND_PROCESS,
+    KIND_TPUJOB,
+    ReplicaType,
+)
+from tf_operator_tpu.chaos.faults import Fault, FaultKind, FaultSchedule
+from tf_operator_tpu.runtime.objects import HostPhase, ProcessPhase
+from tf_operator_tpu.runtime.store import (
+    NotFoundError,
+    TransientStoreError,
+    update_with_retry_loop,
+)
+from tf_operator_tpu.train.checkpoint import latest_checkpoint_step
+
+log = logging.getLogger("tpujob.chaos")
+
+
+class _Knobs:
+    """Shared mutable chaos state across every ChaosStore of one injector."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.latency_s = 0.0
+        self.latency_until = 0.0  # monotonic deadline
+        self.error_budget = 0
+        self.blocked_hosts: Dict[str, float] = {}  # host -> monotonic deadline
+
+    def heartbeat_blocked(self, host: str) -> bool:
+        with self.lock:
+            dl = self.blocked_hosts.get(host)
+            if dl is None:
+                return False
+            if time.monotonic() >= dl:
+                del self.blocked_hosts[host]
+                return False
+            return True
+
+
+class ChaosStore:
+    """Store-compatible wrapper applying an injector's knobs to every op.
+
+    Latency and error injection cover the CRUD surface; watches are left
+    untouched (they are long-lived subscriptions, not ops). Heartbeat
+    blackholing intercepts ``update_with_retry`` on Host objects — the
+    exact call shape of ``HostAgent._touch_heartbeat`` — and pretends
+    success without writing, so the agent soldiers on while the
+    controller sees a silent host. Phase writes (drain, NotReady) go
+    through ``update_with_retry_loop`` against get/update and are NOT
+    blackholed: a draining host must still be able to say so."""
+
+    def __init__(self, inner: Any, knobs: _Knobs) -> None:
+        self._inner = inner
+        self._knobs = knobs
+
+    # -- chaos ------------------------------------------------------------
+
+    def _perturb(self) -> None:
+        with self._knobs.lock:
+            if self._knobs.error_budget > 0:
+                self._knobs.error_budget -= 1
+                raise TransientStoreError("chaos: injected store error")
+            lat = (
+                self._knobs.latency_s
+                if time.monotonic() < self._knobs.latency_until
+                else 0.0
+            )
+        if lat > 0:
+            time.sleep(lat)
+
+    # -- Store surface ----------------------------------------------------
+
+    def create(self, obj):
+        self._perturb()
+        return self._inner.create(obj)
+
+    def get(self, kind, namespace, name):
+        self._perturb()
+        return self._inner.get(kind, namespace, name)
+
+    def update(self, obj, check_version: bool = False):
+        self._perturb()
+        return self._inner.update(obj, check_version=check_version)
+
+    def delete(self, kind, namespace, name):
+        self._perturb()
+        return self._inner.delete(kind, namespace, name)
+
+    def list(self, kind, namespace=None, label_selector=None):
+        self._perturb()
+        return self._inner.list(kind, namespace=namespace, label_selector=label_selector)
+
+    def watch(self, kinds=None):
+        return self._inner.watch(kinds=kinds)
+
+    def update_with_retry(self, kind, namespace, name, mutate):
+        if kind == KIND_HOST and self._knobs.heartbeat_blocked(name):
+            # Swallow the write, pretend success: returning None here
+            # would read as "host deleted" and make the agent re-register
+            # (which would refresh the heartbeat and defeat the stall).
+            try:
+                return self._inner.get(kind, namespace, name)
+            except NotFoundError:
+                return None
+        return update_with_retry_loop(self, kind, namespace, name, mutate)
+
+    def __getattr__(self, name):  # uncommon surface (e.g. _remove_watch)
+        return getattr(self._inner, name)
+
+
+class ChaosInjector:
+    """Drives a FaultSchedule against a store + agents cluster."""
+
+    def __init__(
+        self,
+        schedule: FaultSchedule,
+        store: Any,
+        job_name: Optional[str] = None,
+        namespace: str = "default",
+        agents: Optional[Dict[str, Any]] = None,
+        checkpoint_dir: Optional[str] = None,
+        poll_interval: float = 0.1,
+    ) -> None:
+        self.schedule = schedule
+        self.store = store
+        self.job_name = job_name
+        self.namespace = namespace
+        self.agents: Dict[str, Any] = dict(agents or {})
+        self.checkpoint_dir = checkpoint_dir
+        self.poll_interval = poll_interval
+        self.knobs = _Knobs()
+        # Applied faults, in order: {"kind", "target", "t_s", ...detail}.
+        self.applied: List[Dict[str, Any]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._t0 = 0.0
+
+    # -- wiring -----------------------------------------------------------
+
+    def wrap(self, store: Any = None) -> ChaosStore:
+        """A Store-compatible view carrying this injector's knobs; hand it
+        to agents and process backends."""
+        return ChaosStore(store if store is not None else self.store, self.knobs)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def arm(self) -> None:
+        self._t0 = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._loop, name="chaos-injector", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    @property
+    def done(self) -> bool:
+        return len(self.applied) >= len(self.schedule.faults)
+
+    # -- trigger state ----------------------------------------------------
+
+    def _elapsed(self) -> float:
+        return time.monotonic() - self._t0
+
+    def _ckpt_step(self) -> int:
+        if not self.checkpoint_dir:
+            return 0
+        return latest_checkpoint_step(self.checkpoint_dir)
+
+    def _restarts(self) -> int:
+        if not self.job_name:
+            return 0
+        try:
+            job = self.store.get(KIND_TPUJOB, self.namespace, self.job_name)
+        except Exception:
+            return 0
+        return job.status.restart_count + job.status.preemption_count
+
+    def _ready(self, fault: Fault) -> bool:
+        if self._elapsed() < fault.at_s:
+            return False
+        if fault.at_step and self._ckpt_step() < fault.at_step:
+            return False
+        if fault.after_restarts and self._restarts() < fault.after_restarts:
+            return False
+        return True
+
+    # -- driver -----------------------------------------------------------
+
+    def _loop(self) -> None:
+        for fault in self.schedule.faults:
+            while not self._stop.is_set():
+                try:
+                    if self._ready(fault) and self._fire(fault):
+                        break
+                except Exception:
+                    log.exception("chaos: fault %s failed; retrying", fault.kind)
+                if self._stop.wait(self.poll_interval):
+                    return
+            if self._stop.is_set():
+                return
+
+    def _record(self, fault: Fault, target: str, **detail: Any) -> None:
+        rec = {"kind": fault.kind.value, "target": target,
+               "t_s": round(self._elapsed(), 3), **detail}
+        self.applied.append(rec)
+        log.warning("chaos: applied %s", rec)
+
+    # -- fault handlers ---------------------------------------------------
+
+    def _live_processes(self):
+        procs = [
+            p
+            for p in self.store.list(KIND_PROCESS, namespace=self.namespace)
+            if not p.is_finished()
+            and (self.job_name is None or p.spec.job_name == self.job_name)
+        ]
+        procs.sort(key=lambda p: p.metadata.name)
+        return procs
+
+    def _fire(self, fault: Fault) -> bool:
+        """Apply one fault; False ⇒ no eligible target yet, retry."""
+        if fault.kind is FaultKind.CRASH:
+            return self._fire_crash(fault)
+        if fault.kind is FaultKind.PREEMPT:
+            return self._fire_preempt(fault)
+        if fault.kind is FaultKind.STALL_HEARTBEAT:
+            return self._fire_stall(fault)
+        if fault.kind is FaultKind.STORE_LATENCY:
+            with self.knobs.lock:
+                self.knobs.latency_s = fault.latency_s
+                self.knobs.latency_until = time.monotonic() + fault.duration_s
+            self._record(fault, "store", latency_s=fault.latency_s,
+                         duration_s=fault.duration_s)
+            return True
+        if fault.kind is FaultKind.STORE_ERROR:
+            with self.knobs.lock:
+                self.knobs.error_budget += fault.errors
+            self._record(fault, "store", errors=fault.errors)
+            return True
+        raise ValueError(f"unknown fault kind {fault.kind!r}")
+
+    def _fire_crash(self, fault: Fault) -> bool:
+        # Victims must be observably RUNNING: killing a Pending member
+        # races its launch and the fault would be a silent no-op.
+        procs = [p for p in self._live_processes()
+                 if p.status.phase is ProcessPhase.RUNNING]
+        if not procs:
+            return False
+        victim = procs[fault.target % len(procs)]
+        code = fault.exit_code
+        signum = code - 128 if 128 < code < 160 else _signal.SIGKILL
+        ns, name = victim.metadata.namespace, victim.metadata.name
+        # 1) through the supervising agent's backend (exit reported by the
+        #    monitor thread, exactly like a real crash)
+        agent = self.agents.get(victim.spec.node_name)
+        backend = getattr(agent, "backend", None)
+        if backend is not None and getattr(backend, "signal_local", None):
+            if backend.signal_local(ns, name, signum):
+                self._record(fault, victim.metadata.key(), exit_code=code,
+                             via="backend")
+                return True
+        # 2) by pid (single-host rigs where the controller launched it)
+        if victim.status.pid:
+            import os
+
+            try:
+                os.kill(victim.status.pid, signum)
+            except OSError:
+                return False
+            self._record(fault, victim.metadata.key(), exit_code=code, via="pid")
+            return True
+
+        # 3) store-only rigs (FakeProcessControl): declare the failure with
+        #    the scheduled exit code, uid-guarded like declare_lost.
+        uid = victim.metadata.uid
+
+        def mutate(cur):
+            if cur.metadata.uid != uid or cur.is_finished():
+                return False
+            cur.status.phase = ProcessPhase.FAILED
+            cur.status.exit_code = code
+            cur.status.finish_time = time.time()
+            cur.status.message = "chaos: injected crash"
+
+        if self.store.update_with_retry(KIND_PROCESS, ns, name, mutate) is None:
+            return False
+        self._record(fault, victim.metadata.key(), exit_code=code, via="store")
+        return True
+
+    def _candidate_hosts(self) -> List[str]:
+        """Hosts currently holding live processes of the target job,
+        sorted; the deterministic preemption/stall target pool."""
+        nodes = sorted({
+            p.spec.node_name for p in self._live_processes() if p.spec.node_name
+        })
+        return nodes
+
+    def _gang_size(self) -> int:
+        """Coordinator + worker replicas of the target job (0 if unknown)."""
+        if not self.job_name:
+            return 0
+        try:
+            job = self.store.get(KIND_TPUJOB, self.namespace, self.job_name)
+        except Exception:
+            return 0
+        n = 0
+        for rtype, rs in job.spec.replica_specs.items():
+            if rtype in (ReplicaType.COORDINATOR, ReplicaType.WORKER):
+                n += rs.replicas or 1
+        return n
+
+    def _fire_preempt(self, fault: Fault) -> bool:
+        # Deliver the notice only against a FULLY RUNNING gang: preempting
+        # a host while the previous restart's recreation is still in
+        # flight can drain a host that ends up holding nothing — the
+        # notice lands but no graceful restart is exercised, and the
+        # sequence stops being reproducible.
+        running = [
+            p for p in self._live_processes()
+            if p.status.phase is ProcessPhase.RUNNING and p.spec.node_name
+        ]
+        gang = self._gang_size()
+        if not running or (gang and len(running) < gang):
+            return False
+        nodes = sorted({p.spec.node_name for p in running})
+        host = nodes[fault.target % len(nodes)]
+        agent = self.agents.get(host)
+        if agent is not None:
+            agent.notify_preemption("chaos: injected preemption notice")
+        else:
+            def mutate(cur):
+                cur.status.phase = HostPhase.DRAINING
+                cur.status.message = "chaos: injected preemption notice"
+
+            if self.store.update_with_retry(KIND_HOST, "default", host, mutate) is None:
+                return False
+        self._record(fault, host)
+        return True
+
+    def _fire_stall(self, fault: Fault) -> bool:
+        nodes = self._candidate_hosts()
+        if not nodes:
+            return False
+        host = nodes[fault.target % len(nodes)]
+        with self.knobs.lock:
+            self.knobs.blocked_hosts[host] = time.monotonic() + fault.duration_s
+        self._record(fault, host, duration_s=fault.duration_s)
+        return True

@@ -1,0 +1,401 @@
+"""Seeded chaos soak: a real multi-host local job under a fault schedule.
+
+Stands up the full managed-mode stack in one process — Store, controller,
+N HostAgents launching real OS processes over loopback gloo — submits a
+checkpointing LM training job, arms a :class:`ChaosInjector`, and watches
+the recovery invariants the whole subsystem exists to guarantee:
+
+1. **Completion** — the job reaches Succeeded despite every scheduled
+   fault.
+2. **Gang atomicity** — no *persistent* partial gang: at no point does a
+   strict, nonempty subset of the gang exist for longer than the grace
+   window (transient partials during sequential create/delete are
+   physics; a partial gang that sticks is the bug the atomic scheduler
+   forecloses).
+3. **Warm restarts** — every post-fault incarnation carries a
+   ``TPUJOB_RESUME_STEP`` > 0 (it resumes, not retrains), and the declared
+   resume steps never decrease across incarnations.
+4. **Backoff exemption** — preemption restarts increment
+   ``preemption_count``, never ``restart_count``, so they cannot exhaust
+   ``backoff_limit``.
+5. **Reproducibility** — the applied fault sequence matches the schedule,
+   and the schedule is a pure function of the seed.
+
+Runnable standalone (the CI ``chaos-soak`` stage)::
+
+    python -m tf_operator_tpu.chaos.soak --seed 7 --steps 8
+
+Exits nonzero when any invariant is violated.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tf_operator_tpu.api.types import (
+    KIND_PROCESS,
+    ConditionType,
+    ObjectMeta,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    TopologySpec,
+    TPUJob,
+    TPUJobSpec,
+)
+from tf_operator_tpu.chaos.faults import FaultSchedule
+from tf_operator_tpu.chaos.injector import ChaosInjector
+from tf_operator_tpu.controller import TPUJobController
+from tf_operator_tpu.controller.status import has_condition, is_finished
+from tf_operator_tpu.rendezvous.env import ENV_RESUME_STEP
+from tf_operator_tpu.runtime import (
+    FakeProcessControl,
+    HostAgent,
+    LocalProcessControl,
+    Store,
+)
+from tf_operator_tpu.runtime.store import WatchEventType
+
+log = logging.getLogger("tpujob.soak")
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Data-plane env for launched gang members: CPU jax with loopback gloo
+# collectives, ambient TPU plugin hooks disabled (mirrors the e2e tests).
+DATAPLANE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "JAX_CPU_COLLECTIVES_IMPLEMENTATION": "gloo",
+    "PALLAS_AXON_POOL_IPS": "",
+    "XLA_FLAGS": "",
+}
+
+
+def default_schedule(seed: int) -> FaultSchedule:
+    """The acceptance recipe: one mid-run crash (after the first
+    checkpoint exists, so recovery is warm) then one preemption notice
+    delivered to the post-restart gang. Pure function of the seed."""
+    return FaultSchedule.generate(
+        seed, crashes=1, preemptions=1, first_step=2, spread_s=0.0
+    )
+
+
+@dataclass
+class SoakResult:
+    succeeded: bool = False
+    restart_count: int = 0
+    preemption_count: int = 0
+    last_restart_cause: str = ""
+    conditions: List[tuple] = field(default_factory=list)
+    # Controller-declared resume steps, one per created gang process, in
+    # creation (watch ADDED) order.
+    resume_steps: List[int] = field(default_factory=list)
+    partial_gang_violations: List[str] = field(default_factory=list)
+    applied: List[dict] = field(default_factory=list)
+    schedule: Optional[FaultSchedule] = None
+
+    def check(self) -> List[str]:
+        """Invariant failures, empty when the soak passed."""
+        errs = []
+        if not self.succeeded:
+            errs.append(f"job did not succeed: {self.conditions}")
+        if self.partial_gang_violations:
+            errs.append(f"partial gang persisted: {self.partial_gang_violations}")
+        if self.resume_steps != sorted(self.resume_steps):
+            errs.append(f"resume steps not monotonic: {self.resume_steps}")
+        if not any(s > 0 for s in self.resume_steps):
+            errs.append(
+                f"no warm restart observed (resume steps {self.resume_steps})"
+            )
+        sched_kinds = [f.kind.value for f in (self.schedule.faults if self.schedule else ())]
+        applied_kinds = [a["kind"] for a in self.applied]
+        if applied_kinds != sched_kinds:
+            errs.append(
+                f"applied fault sequence {applied_kinds} != schedule {sched_kinds}"
+            )
+        if any(a["kind"] == "preempt" for a in self.applied) and (
+            self.preemption_count < 1
+        ):
+            errs.append("preemption applied but preemption_count is 0")
+        return errs
+
+
+class _InvariantWatcher:
+    """Watches gang-atomicity and warm-restart invariants live.
+
+    Partial-gang detection is persistence-based: sequential store
+    creates/deletes make instantaneous strict subsets unavoidable, so a
+    violation is a strict nonempty subset that survives ``grace_s``
+    continuously — the steady state the atomic scheduler must foreclose."""
+
+    def __init__(self, store: Store, job_name: str, gang_names: List[str],
+                 grace_s: float = 10.0) -> None:
+        self.store = store
+        self.job_name = job_name
+        self.gang_names = set(gang_names)
+        self.grace_s = grace_s
+        self.violations: List[str] = []
+        self.resume_steps: List[int] = []
+        self._partial_since: Optional[float] = None
+        self._stop = threading.Event()
+        self._watch = store.watch(kinds=[KIND_PROCESS])
+        self._threads = [
+            threading.Thread(target=self._watch_loop, daemon=True,
+                             name="soak-watch"),
+            threading.Thread(target=self._poll_loop, daemon=True,
+                             name="soak-invariant"),
+        ]
+
+    def start(self) -> None:
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._watch.stop()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def _watch_loop(self) -> None:
+        for ev in self._watch:
+            if self._stop.is_set():
+                return
+            if ev.type is not WatchEventType.ADDED or ev.obj is None:
+                continue
+            p = ev.obj
+            if p.metadata.name in self.gang_names:
+                self.resume_steps.append(
+                    int(p.spec.env.get(ENV_RESUME_STEP, "0") or 0)
+                )
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(0.2):
+            live = {
+                p.metadata.name
+                for p in self.store.list(KIND_PROCESS, namespace="default")
+                if p.metadata.name in self.gang_names and not p.is_finished()
+            }
+            if live and live != self.gang_names:
+                now = time.monotonic()
+                if self._partial_since is None:
+                    self._partial_since = now
+                elif now - self._partial_since > self.grace_s:
+                    self.violations.append(
+                        f"members {sorted(live)} of {sorted(self.gang_names)} "
+                        f"alone for > {self.grace_s}s"
+                    )
+                    self._partial_since = now  # one report per episode
+            else:
+                self._partial_since = None
+
+
+def _soak_job(
+    name: str,
+    workers: int,
+    num_hosts: int,
+    ckpt_dir: str,
+    steps: int,
+    checkpoint_every: int,
+    backoff_limit: int,
+    heartbeat_ttl: Optional[float],
+    data_plane: str = "light",
+    step_sleep_s: float = 1.0,
+) -> TPUJob:
+    """``data_plane='light'`` (default) runs workloads/soak.py — real
+    checkpoint subsystem, no cross-process collectives, so the soak works
+    in containers whose jax cannot do multi-process CPU SPMD (where ALL
+    real-gang e2es fail). ``'lm'`` runs the full gloo-collectives LM
+    trainer for environments that support it."""
+    env = dict(DATAPLANE_ENV)
+    env["PYTHONPATH"] = _ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")
+    if data_plane == "lm":
+        entrypoint = "tf_operator_tpu.workloads.lm:main"
+        workload = {
+            "preset": "tiny",
+            "steps": steps,
+            "batch_size": 4,
+            "seq_len": 32,
+            "checkpoint_dir": ckpt_dir,
+            "checkpoint_every": checkpoint_every,
+            # chaos needs exact-step semantics; the device loop fires
+            # callbacks per chunk (see WorkloadCheckpointer.run_loop)
+            "device_loop": 1,
+        }
+    else:
+        entrypoint = "tf_operator_tpu.workloads.soak:main"
+        workload = {
+            "steps": steps,
+            "step_sleep_s": step_sleep_s,
+            "checkpoint_dir": ckpt_dir,
+            "checkpoint_every": checkpoint_every,
+        }
+    job = TPUJob(
+        metadata=ObjectMeta(name=name),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=workers,
+                    template=ProcessTemplate(
+                        entrypoint=entrypoint,
+                        env=env,
+                        chips_per_process=1,
+                    ),
+                )
+            },
+            topology=TopologySpec(num_hosts=num_hosts, chips_per_host=1),
+        ),
+    )
+    job.spec.run_policy.backoff_limit = backoff_limit
+    job.spec.run_policy.heartbeat_ttl_seconds = heartbeat_ttl
+    job.spec.workload = workload
+    return job
+
+
+def run_soak(
+    seed: int = 0,
+    schedule: Optional[FaultSchedule] = None,
+    hosts: int = 3,
+    num_hosts: int = 2,
+    workers: int = 2,
+    steps: int = 8,
+    checkpoint_every: int = 2,
+    backoff_limit: int = 2,
+    timeout: float = 420.0,
+    workdir: Optional[str] = None,
+    heartbeat_ttl: float = 3.0,
+    data_plane: str = "light",
+    step_sleep_s: float = 1.0,
+) -> SoakResult:
+    """Run one seeded soak; returns the observations (see SoakResult.check).
+
+    ``hosts`` > ``num_hosts`` leaves spare capacity so a preempted gang has
+    somewhere to move — a drained host is not schedulable."""
+    schedule = schedule if schedule is not None else default_schedule(seed)
+    tmp = workdir or tempfile.mkdtemp(prefix="tpujob-soak-")
+    ckpt_dir = os.path.join(tmp, "ckpt")
+    job_name = "soak-lm"
+
+    store = Store()
+    injector = ChaosInjector(
+        schedule, store, job_name=job_name, checkpoint_dir=ckpt_dir,
+    )
+    agents = [
+        HostAgent(
+            injector.wrap(),
+            f"soak-h{i}",
+            total_chips=workers,  # any single host could hold the full gang
+            heartbeat_interval=0.25,
+            backend=LocalProcessControl(
+                injector.wrap(), log_dir=os.path.join(tmp, "logs")
+            ),
+        )
+        for i in range(hosts)
+    ]
+    injector.agents = {a.name: a for a in agents}
+    # The controller's own process control must stay idle in managed mode
+    # (every gang member is host-bound); a fake makes a leak loud.
+    fake = FakeProcessControl()
+    ctl = TPUJobController(store, fake, resync_period=0.5)
+    ctl.scheduler.heartbeat_ttl = heartbeat_ttl
+
+    gang_names = [f"{job_name}-worker-{i}" for i in range(workers)]
+    watcher = _InvariantWatcher(store, job_name, gang_names)
+    result = SoakResult(schedule=schedule)
+    for a in agents:
+        a.start()
+    ctl.run(workers=2)
+    watcher.start()
+    try:
+        store.create(
+            _soak_job(job_name, workers, num_hosts, ckpt_dir, steps,
+                      checkpoint_every, backoff_limit, heartbeat_ttl,
+                      data_plane=data_plane, step_sleep_s=step_sleep_s)
+        )
+        injector.arm()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = store.get("TPUJob", "default", job_name).status
+            if is_finished(st) and injector.done:
+                break
+            time.sleep(0.25)
+        st = store.get("TPUJob", "default", job_name).status
+        result.succeeded = has_condition(st, ConditionType.SUCCEEDED)
+        result.restart_count = st.restart_count
+        result.preemption_count = st.preemption_count
+        result.last_restart_cause = st.last_restart_cause
+        result.conditions = [
+            (c.type.value, c.reason, c.message) for c in st.conditions
+        ]
+    finally:
+        injector.stop()
+        watcher.stop()
+        ctl.stop()
+        for a in agents:
+            a.stop()
+        fake.clear()
+    result.resume_steps = list(watcher.resume_steps)
+    result.partial_gang_violations = list(watcher.violations)
+    result.applied = list(injector.applied)
+    if fake.created:
+        result.partial_gang_violations.append(
+            "controller launched through its own backend in managed mode: "
+            f"{[p.metadata.name for p in fake.created]}"
+        )
+    return result
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpujob-soak", description="seeded chaos soak runner"
+    )
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--hosts", type=int, default=3)
+    p.add_argument("--num-hosts", type=int, default=2)
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--checkpoint-every", type=int, default=2)
+    p.add_argument("--backoff-limit", type=int, default=2)
+    p.add_argument("--timeout", type=float, default=420.0)
+    p.add_argument("--workdir", default=None)
+    p.add_argument("--data-plane", choices=("light", "lm"), default="light",
+                   help="'light' = real checkpoints, no cross-process "
+                        "collectives (works everywhere); 'lm' = full gloo "
+                        "LM trainer (needs multi-process-capable jax)")
+    p.add_argument("--step-sleep", type=float, default=1.0,
+                   help="light data plane: seconds per step (the fault "
+                        "landing window)")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s [%(levelname)s] %(message)s",
+        stream=sys.stderr,
+    )
+    result = run_soak(
+        seed=args.seed, steps=args.steps, hosts=args.hosts,
+        num_hosts=args.num_hosts, workers=args.workers,
+        checkpoint_every=args.checkpoint_every,
+        backoff_limit=args.backoff_limit, timeout=args.timeout,
+        workdir=args.workdir, data_plane=args.data_plane,
+        step_sleep_s=args.step_sleep,
+    )
+    print(
+        f"soak seed={args.seed}: succeeded={result.succeeded} "
+        f"restarts={result.restart_count} preemptions={result.preemption_count} "
+        f"last_cause={result.last_restart_cause!r} "
+        f"resume_steps={result.resume_steps} applied={result.applied}"
+    )
+    errors = result.check()
+    for e in errors:
+        print(f"INVARIANT VIOLATED: {e}", file=sys.stderr)
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

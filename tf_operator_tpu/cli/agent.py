@@ -19,6 +19,7 @@ import argparse
 import logging
 import signal
 import threading
+import time
 
 from tf_operator_tpu.runtime.agent import HostAgent
 from tf_operator_tpu.runtime.remote_store import RemoteStore
@@ -42,6 +43,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slice-type", default="", help="slice family, e.g. v5e-8")
     p.add_argument("--max-processes", type=int, default=0)
     p.add_argument("--heartbeat-interval", type=float, default=3.0)
+    p.add_argument("--drain-grace", type=float, default=0.0,
+                   help="seconds to drain on SIGTERM before stopping: the "
+                        "agent marks its Host DRAINING (preemption notice) "
+                        "so the controller checkpoint-restarts gangs off "
+                        "this host, and waits until its children are gone "
+                        "or the grace expires. 0 = stop immediately "
+                        "(SIGINT always stops immediately)")
     p.add_argument("--backend", choices=("native", "local"), default="native")
     p.add_argument("--log-dir", default=None,
                    help="capture launched processes' stdout/stderr here")
@@ -100,11 +108,21 @@ def main(argv=None) -> int:
         heartbeat_interval=args.heartbeat_interval,
     )
     stop = threading.Event()
+    drain = threading.Event()
 
     def shutdown(*_):
         stop.set()
 
-    signal.signal(signal.SIGTERM, shutdown)
+    def sigterm(*_):
+        # Cloud preemption delivers SIGTERM with a grace window: drain
+        # first (the controller checkpoint-restarts gangs off this host),
+        # stop when children are gone or the grace expires.
+        if args.drain_grace > 0:
+            drain.set()
+        else:
+            stop.set()
+
+    signal.signal(signal.SIGTERM, sigterm)
     signal.signal(signal.SIGINT, shutdown)
     agent.start()
     log.info(
@@ -114,12 +132,22 @@ def main(argv=None) -> int:
     # Wake periodically to notice a fatal agent (permanent auth failure):
     # a daemon that kept running with a dead watch thread would look alive
     # while every binding to it sat Pending.
+    deadline = None
     while not stop.wait(0.5):
         if agent.fatal:
             log.critical("agent %s fatal: %s", args.name, agent.fatal)
             agent.stop()
             return 1
-    log.info("agent %s draining", args.name)
+        if drain.is_set() and not agent.draining:
+            agent.notify_preemption("SIGTERM: host preempted, draining")
+            deadline = time.monotonic() + args.drain_grace
+        if deadline is not None:
+            drained = not agent.backend.tracked_keys()
+            if drained or time.monotonic() >= deadline:
+                log.info("agent %s drain %s; stopping", args.name,
+                         "complete" if drained else "grace expired")
+                break
+    log.info("agent %s stopping", args.name)
     agent.stop()
     return 0
 

@@ -76,11 +76,15 @@ def main(argv=None) -> int:
             print(f"tpujob {created.key()} created (uid {created.metadata.uid})")
         elif args.cmd == "list":
             jobs = client.list(args.namespace)
-            print(f"{'NAMESPACE':<12} {'NAME':<24} {'PHASE':<10} {'RESTARTS':<8}")
+            print(
+                f"{'NAMESPACE':<12} {'NAME':<24} {'PHASE':<10} "
+                f"{'RESTARTS':<8} {'PREEMPTED':<9}"
+            )
             for j in jobs:
                 print(
                     f"{j.metadata.namespace:<12} {j.metadata.name:<24} "
-                    f"{j.status.phase().value or '-':<10} {j.status.restart_count:<8}"
+                    f"{j.status.phase().value or '-':<10} "
+                    f"{j.status.restart_count:<8} {j.status.preemption_count:<9}"
                 )
         elif args.cmd == "get":
             print(json.dumps(client.get(args.namespace, args.name), indent=2))

@@ -30,6 +30,9 @@ REASON_JOB_CREATED = "TPUJobCreated"
 REASON_JOB_DEADLINE = "TPUJobDeadlineExceeded"
 REASON_FAILED_SCHEDULING = "FailedScheduling"
 REASON_NODE_LOST = "NodeLost"
+# Preemption drain: a host under a preemption notice forced a graceful
+# (checkpoint-resumed, backoff-exempt) gang restart.
+REASON_JOB_PREEMPTED = "TPUJobPreempted"
 
 
 class EventRecorder:

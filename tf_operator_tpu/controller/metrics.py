@@ -12,7 +12,7 @@ server.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List
+from typing import Dict, List, Optional, Tuple
 
 from tf_operator_tpu.api.types import KIND_HOST, KIND_PROCESS, KIND_TPUJOB
 
@@ -29,17 +29,33 @@ class ControllerMetrics:
         "tpujob_node_lost_total": "Processes declared lost (host/agent gone).",
     }
 
+    LABELED_HELP = {
+        "tpujob_gang_restarts_by_cause_total": (
+            "Gang restarts by cause (preemption / retryable-failure / "
+            "node-lost)."
+        ),
+    }
+
     def __init__(self, store=None, queue=None) -> None:
         self.store = store
         self.queue = queue
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = {k: 0.0 for k in self.COUNTER_HELP}
+        # (name, (("label","value"), ...)) -> count
+        self._labeled: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._sync_seconds_sum = 0.0
         self._sync_seconds_count = 0
 
     # -- writers (reconciler) ---------------------------------------------
 
-    def inc(self, name: str, n: float = 1.0) -> None:
+    def inc(
+        self, name: str, n: float = 1.0, labels: Optional[Dict[str, str]] = None
+    ) -> None:
+        if labels:
+            key = (name, tuple(sorted(labels.items())))
+            with self._lock:
+                self._labeled[key] = self._labeled.get(key, 0.0) + n
+            return
         with self._lock:
             self._counters[name] = self._counters.get(name, 0.0) + n
 
@@ -57,6 +73,7 @@ class ControllerMetrics:
         out: List[str] = []
         with self._lock:
             counters = dict(self._counters)
+            labeled = dict(self._labeled)
             s_sum, s_count = self._sync_seconds_sum, self._sync_seconds_count
         # .17g: %g's 6 significant digits would freeze a counter past ~1e6
         # (consecutive increments render identically and rate() reads 0).
@@ -65,6 +82,16 @@ class ControllerMetrics:
             out.append(f"# HELP {name} {help_text}")
             out.append(f"# TYPE {name} counter")
             out.append(f"{name} {value:.17g}")
+        # Labeled counters: one HELP/TYPE block per family, samples sorted
+        # by label set so scrapes are stable.
+        for name in sorted({k[0] for k in labeled}):
+            out.append(f"# HELP {name} {self.LABELED_HELP.get(name, name)}")
+            out.append(f"# TYPE {name} counter")
+            for (n, lbls), value in sorted(labeled.items()):
+                if n != name:
+                    continue
+                rendered = ",".join(f'{k}="{v}"' for k, v in lbls)
+                out.append(f"{name}{{{rendered}}} {value:.17g}")
         out.append("# HELP tpujob_sync_duration_seconds Reconcile sync wall time.")
         out.append("# TYPE tpujob_sync_duration_seconds summary")
         out.append(f"tpujob_sync_duration_seconds_sum {s_sum:.17g}")
@@ -101,10 +128,17 @@ class ControllerMetrics:
         hosts = self.store.list(KIND_HOST)
         if hosts:
             ready = sum(1 for h in hosts if h.status.phase.value == "Ready")
+            draining = sum(1 for h in hosts if h.status.phase.value == "Draining")
             out.append("# HELP tpujob_hosts Registered hosts.")
             out.append("# TYPE tpujob_hosts gauge")
             out.append(f'tpujob_hosts{{ready="true"}} {ready}')
             out.append(f'tpujob_hosts{{ready="false"}} {len(hosts) - ready}')
+            out.append(
+                "# HELP tpujob_hosts_draining Hosts under a preemption "
+                "notice (DRAINING)."
+            )
+            out.append("# TYPE tpujob_hosts_draining gauge")
+            out.append(f"tpujob_hosts_draining {draining}")
         return out
 
 

@@ -72,11 +72,13 @@ from tf_operator_tpu.controller.status import (
 from tf_operator_tpu.controller.workqueue import RateLimitingQueue
 from tf_operator_tpu.rendezvous.env import (
     ENV_API_SERVER,
+    ENV_CHECKPOINT_DIR,
     ENV_COORDINATOR_ADDRESS,
     ENV_DCN_MESH_AXES,
     ENV_MESH_AXES,
     ENV_NUM_PROCESSES,
     ENV_PROCESS_ID,
+    ENV_RESUME_STEP,
     ENV_WORKLOAD,
 )
 from tf_operator_tpu.runtime.objects import (
@@ -95,13 +97,21 @@ from tf_operator_tpu.runtime.store import (
     NotFoundError,
     Store,
 )
-from tf_operator_tpu.utils.exit_codes import ExitClass, classify_exit_code
+from tf_operator_tpu.train.checkpoint import latest_checkpoint_step
+from tf_operator_tpu.utils.exit_codes import ExitClass, classify_exit_code, is_retryable
 
 log = logging.getLogger(__name__)
 
 # Annotation where the controller persists the job's allocated rendezvous
 # port (so reconciles are stable across controller restarts).
 ANNOTATION_PORT = "tpujob.dev/rendezvous-port"
+
+# Gang-restart causes (status.last_restart_cause + the by-cause metric).
+# Preemption restarts are graceful — checkpoint-resumed and NOT counted
+# against backoff_limit; the other two consume restart_count.
+CAUSE_PREEMPTION = "preemption"
+CAUSE_FAILURE = "retryable-failure"
+CAUSE_NODE_LOST = "node-lost"
 
 
 def _default_host_resolver(process: Process) -> str:
@@ -358,6 +368,12 @@ class TPUJobController:
                     pass
         return claimed
 
+    def _job_heartbeat_ttl(self, job: TPUJob) -> float:
+        """Node-lost window for this job: run_policy override, else the
+        controller-wide scheduler default."""
+        ttl = job.spec.run_policy.heartbeat_ttl_seconds
+        return self.scheduler.heartbeat_ttl if ttl is None else ttl
+
     def _mark_node_lost(self, job: TPUJob, processes: List[Process]) -> List[Process]:
         """Failure detection for dead hosts: a process bound to a host whose
         agent stopped heartbeating is marked Failed (exit 137, NodeLost) so
@@ -368,7 +384,8 @@ class TPUJobController:
         drain/delete) counts as lost too, after the same TTL grace —
         otherwise such processes would sit Pending/Running forever with no
         agent to drive them and no heartbeat to miss."""
-        lost = {h.metadata.name for h in self.scheduler.lost_hosts()}
+        ttl = self._job_heartbeat_ttl(job)
+        lost = {h.metadata.name for h in self.scheduler.lost_hosts(ttl=ttl)}
         known = {h.metadata.name for h in self.store.list(KIND_HOST)}
         now = time.time()
         out: List[Process] = []
@@ -377,7 +394,7 @@ class TPUJobController:
             node_lost = node in lost or (
                 node
                 and node not in known
-                and now - p.metadata.creation_timestamp > self.scheduler.heartbeat_ttl
+                and now - p.metadata.creation_timestamp > ttl
             )
             if node_lost and not p.is_finished():
                 updated = declare_lost(
@@ -559,6 +576,15 @@ class TPUJobController:
             return
 
         # -- failure handling --------------------------------------------
+        # Hosts under a preemption notice: live members there take the
+        # graceful drain path below; already-failed members classify by
+        # exit code (130/143 ⇒ preemption — graceful, backoff-exempt).
+        draining = {
+            h.metadata.name
+            for h in self.scheduler.draining_hosts(
+                ttl=self._job_heartbeat_ttl(job)
+            )
+        }
         gang_failed = [
             observed[(r[0].value, r[1])]
             for r in gang
@@ -578,7 +604,7 @@ class TPUJobController:
                     f"{p.metadata.name} exited {p.status.exit_code} (permanent"
                     f"{', oom' if p.status.oom_killed else ''})"
                 )
-            else:  # ALWAYS, ON_FAILURE, or retryable EXIT_CODE
+            else:  # ALWAYS, ON_FAILURE, or retryable/preempted EXIT_CODE
                 retry_needed = True
 
         if permanent_msgs:
@@ -587,29 +613,65 @@ class TPUJobController:
             return
 
         if retry_needed:
-            # Freshen restart_count from the store BEFORE the limit check:
-            # the informer cache may not have absorbed a previous restart's
-            # own status write, and comparing the stale count would allow a
-            # crash-looping job one restart past its backoff_limit.
-            try:
-                stored = self.store.get(
-                    KIND_TPUJOB, job.metadata.namespace, job.metadata.name
-                )
-                job.status.restart_count = max(
-                    job.status.restart_count, stored.status.restart_count
-                )
-            except NotFoundError:
-                pass
-            if rp.backoff_limit is not None and job.status.restart_count >= rp.backoff_limit:
-                self._fail_job(
-                    job, ev.REASON_JOB_FAILED,
-                    f"backoff limit {rp.backoff_limit} exceeded "
-                    f"({job.status.restart_count} restarts)",
-                )
-                self._finish(job)
-                return
-            self._restart_gang(job, gang, observed, exp_key)
+            cause = _restart_cause(gang_failed)
+            if cause is not CAUSE_PREEMPTION:
+                # Freshen restart_count from the store BEFORE the limit
+                # check: the informer cache may not have absorbed a previous
+                # restart's own status write, and comparing the stale count
+                # would allow a crash-looping job one restart past its
+                # backoff_limit. Preemption restarts skip the check entirely
+                # — eviction never consumes the job's failure budget, and an
+                # at-limit job must still be movable off a dying host.
+                try:
+                    stored = self.store.get(
+                        KIND_TPUJOB, job.metadata.namespace, job.metadata.name
+                    )
+                    job.status.restart_count = max(
+                        job.status.restart_count, stored.status.restart_count
+                    )
+                except NotFoundError:
+                    pass
+                if (
+                    rp.backoff_limit is not None
+                    and job.status.restart_count >= rp.backoff_limit
+                ):
+                    self._fail_job(
+                        job, ev.REASON_JOB_FAILED,
+                        f"backoff limit {rp.backoff_limit} exceeded "
+                        f"({job.status.restart_count} restarts)",
+                    )
+                    self._finish(job)
+                    return
+            self._restart_gang(job, gang, observed, exp_key, cause=cause)
             return
+
+        # -- preemption drain: graceful gang restart -----------------------
+        # No member has failed yet, but some live member sits on a host
+        # under a preemption notice. Restart the WHOLE gang now, while the
+        # checkpoint on disk is fresh and the draining host can still
+        # SIGTERM cleanly — waiting for the host to die would turn a
+        # graceful drain into a NodeLost fence. Deletions reach the
+        # draining host's agent as SIGTERM (exit 143, preemption-retryable);
+        # recreation lands on non-draining hosts with warm-restart env.
+        if draining:
+            drain_live = [
+                p
+                for r in gang
+                if (p := observed.get((r[0].value, r[1]))) is not None
+                and not p.is_finished()
+                and p.spec.node_name in draining
+            ]
+            if drain_live:
+                self.recorder.warning(
+                    job, ev.REASON_JOB_PREEMPTED,
+                    f"host(s) {sorted({p.spec.node_name for p in drain_live})} "
+                    "draining under preemption notice; gang restarting "
+                    "(checkpoint-resumed, not counted against backoff)",
+                )
+                self._restart_gang(
+                    job, gang, observed, exp_key, cause=CAUSE_PREEMPTION
+                )
+                return
 
         # ALWAYS policy also restarts gang members that *succeeded*? No —
         # Always applies to failures and external deletions; a cleanly
@@ -645,8 +707,8 @@ class TPUJobController:
                 policy = self._policy_for(job, p)
                 if policy in (RestartPolicy.ALWAYS, RestartPolicy.ON_FAILURE) or (
                     policy is RestartPolicy.EXIT_CODE
-                    and classify_exit_code(p.status.exit_code or 0, p.status.oom_killed)
-                    is ExitClass.RETRYABLE
+                    # retryable OR preemption-retryable (exit 130/143)
+                    and is_retryable(p.status.exit_code or 0, p.status.oom_killed)
                 ):
                     self.expectations.expect_deletions(exp_key, 1)
                     try:
@@ -711,6 +773,11 @@ class TPUJobController:
         port = self._rendezvous_port(job)
         chief_type, chief_idx = self._chief_role(job)
         chief_name = self._process_name(job, chief_type, chief_idx)
+        # Warm-restart discovery, once per create batch: the latest step
+        # already checkpointed under the job's checkpoint_dir (0 if none /
+        # no checkpointing). A cheap filesystem scan — no orbax import.
+        ckpt_dir = job.spec.workload.get("checkpoint_dir")
+        resume_step = latest_checkpoint_step(str(ckpt_dir)) if ckpt_dir else 0
 
         # Build every Process object first so the chief's host can be
         # resolved once and injected into ALL members' coordinator address —
@@ -753,6 +820,13 @@ class TPUJobController:
             )
             if job.spec.topology.dcn_mesh_axes:
                 env[ENV_DCN_MESH_AXES] = json.dumps(job.spec.topology.dcn_mesh_axes)
+            if ckpt_dir:
+                # Warm-restart contract (rendezvous/env.py): a recreated
+                # gang is told the directory and the step it will resume
+                # from; 0 marks the cold first incarnation. The trainer's
+                # authoritative resume stays latest_step() on disk.
+                env[ENV_CHECKPOINT_DIR] = str(ckpt_dir)
+                env[ENV_RESUME_STEP] = str(resume_step)
             chips = rs.template.chips_per_process or job.spec.topology.chips_per_host
             procs.append(
                 Process(
@@ -802,7 +876,8 @@ class TPUJobController:
                         bound_slots[i % want_hosts] = live.spec.node_name
                 try:
                     placement = self.scheduler.place_gang(
-                        job, procs, ranks=ranks, bound_slots=bound_slots
+                        job, procs, ranks=ranks, bound_slots=bound_slots,
+                        ttl=self._job_heartbeat_ttl(job),
                     )
                 except SchedulingError as exc:
                     self.recorder.warning(
@@ -917,9 +992,15 @@ class TPUJobController:
         gang: List[Tuple[ReplicaType, int]],
         observed: Dict[Tuple[str, int], Process],
         exp_key: str,
+        cause: str = CAUSE_FAILURE,
     ) -> None:
         """Whole-gang restart: delete every existing gang process; the next
-        sync (after deletions are observed) recreates them."""
+        sync (after deletions are observed) recreates them.
+
+        ``cause`` distinguishes graceful preemption restarts (host drain:
+        counted in status.preemption_count, exempt from backoff_limit) from
+        failure/node-lost restarts (counted in restart_count, which feeds
+        backoff_limit)."""
         targets = [observed[(r[0].value, r[1])] for r in gang if (r[0].value, r[1]) in observed]
         # Escalate to a FULL gang restart even with gang_restart=False when
         # (a) the chief died — every member's coordinator address points at
@@ -928,30 +1009,44 @@ class TPUJobController:
         # (b) any failure is a declared loss (NodeLost / agent restart):
         # the "failed" process may still be ALIVE as a zombie, and a
         # partial restart would hand its replacement the same rendezvous
-        # port and rank, letting both join the live chief's gang.
+        # port and rank, letting both join the live chief's gang — or
+        # (c) a preemption drain: the gang moves off the draining host
+        # atomically, so every member relocates together.
         chief = self._chief_role(job)
         full = (
             job.spec.run_policy.gang_restart
+            or cause is CAUSE_PREEMPTION
             or _failed(observed.get((chief[0].value, chief[1])))
             or any(_failed(p) and p.status.node_lost for p in targets)
         )
         if not full:
             targets = [p for p in targets if _failed(p)]
-        # restart_count was freshened against the store by _reconcile just
-        # before the backoff_limit check; only the increment happens here.
-        job.status.restart_count += 1
+        job.status.last_restart_cause = cause
+        if cause is CAUSE_PREEMPTION:
+            job.status.preemption_count += 1
+            n = job.status.preemption_count
+            message = (
+                f"gang preemption restart #{n} (checkpoint-resumed, "
+                "not counted against backoff)"
+            )
+            reason = ev.REASON_JOB_PREEMPTED
+        else:
+            # restart_count was freshened against the store by _reconcile
+            # just before the backoff_limit check; only the increment
+            # happens here.
+            job.status.restart_count += 1
+            message = f"gang restart #{job.status.restart_count} ({cause})"
+            reason = ev.REASON_JOB_RESTARTING
         self.metrics.inc("tpujob_gang_restarts_total")
+        self.metrics.inc(
+            "tpujob_gang_restarts_by_cause_total", labels={"cause": cause}
+        )
         set_condition(
             job.status,
-            new_condition(
-                ConditionType.RESTARTING, ev.REASON_JOB_RESTARTING,
-                f"gang restart #{job.status.restart_count}",
-            ),
+            new_condition(ConditionType.RESTARTING, reason, message),
         )
         self.recorder.normal(
-            job, ev.REASON_JOB_RESTARTING,
-            f"gang restart #{job.status.restart_count} "
-            f"({len(targets)} processes)",
+            job, reason, f"{message} ({len(targets)} processes)"
         )
         if targets:
             self.expectations.expect_deletions(exp_key, len(targets))
@@ -1020,14 +1115,17 @@ class TPUJobController:
                 == _annotations_except_port(job.metadata.annotations)
             ):
                 return False  # no change — avoid a MODIFIED->enqueue->sync loop
-            # restart_count is monotonic: a sync that started from a stale
-            # informer snapshot must never roll back restarts recorded by
-            # a sync that raced ahead of the cache. eval_metrics belongs to
-            # the evaluator's API writes — always keep the store's copy.
+            # restart_count/preemption_count are monotonic: a sync that
+            # started from a stale informer snapshot must never roll back
+            # restarts recorded by a sync that raced ahead of the cache.
+            # eval_metrics belongs to the evaluator's API writes — always
+            # keep the store's copy.
             count = max(fresh.status.restart_count, job.status.restart_count)
+            pcount = max(fresh.status.preemption_count, job.status.preemption_count)
             eval_metrics = fresh.status.eval_metrics
             fresh.status = job.status
             fresh.status.restart_count = count
+            fresh.status.preemption_count = pcount
             fresh.status.eval_metrics = eval_metrics
             # The rendezvous-port annotation is managed store-side
             # (_rendezvous_port persists it, _clear_rendezvous removes it);
@@ -1044,6 +1142,25 @@ class TPUJobController:
 
 def _failed(p: Optional[Process]) -> bool:
     return p is not None and p.status.phase is ProcessPhase.FAILED
+
+
+def _restart_cause(gang_failed: List[Process]) -> str:
+    """Classify a retryable gang failure into a restart cause.
+
+    Priority: a declared loss anywhere means the fenced node-lost path
+    (zombies may live); otherwise the restart is a preemption only when
+    EVERY failure is eviction-shaped (exit 130/143, the graceful-kill
+    signals) — a genuine crash racing a drain still consumes backoff;
+    everything else is a plain retryable failure."""
+    if any(p.status.node_lost for p in gang_failed):
+        return CAUSE_NODE_LOST
+    if gang_failed and all(
+        classify_exit_code(p.status.exit_code or 0, p.status.oom_killed)
+        is ExitClass.PREEMPTED
+        for p in gang_failed
+    ):
+        return CAUSE_PREEMPTION
+    return CAUSE_FAILURE
 
 
 def _annotations_except_port(annotations: Dict[str, str]) -> Dict[str, str]:

@@ -145,7 +145,9 @@ async function viewJob(ns, name){
     ['Phase', j.phase||''], ['Created', fmtTime(j.metadata.creation_timestamp)],
     ['Started', fmtTime(j.status.start_time)],
     ['Completed', fmtTime(j.status.completion_time)],
-    ['Gang restarts', String(j.status.restart_count||0)],
+    ['Gang restarts', String(j.status.restart_count||0)
+       + (j.status.preemption_count ? ' (+'+j.status.preemption_count+' preempted)' : '')
+       + (j.status.last_restart_cause ? ' — last: '+j.status.last_restart_cause : '')],
     ['Slice', j.spec.topology.slice_type ||
        (j.spec.topology.num_hosts+'x'+j.spec.topology.chips_per_host+' chips')],
     ['Mesh', JSON.stringify(j.spec.topology.mesh_axes||{})],

@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict
 
 from tf_operator_tpu.rendezvous.env import (
+    ENV_CHECKPOINT_DIR,
     ENV_CHIPS,
     ENV_COORDINATOR_ADDRESS,
     ENV_DCN_MESH_AXES,
@@ -27,6 +28,7 @@ from tf_operator_tpu.rendezvous.env import (
     ENV_PROCESS_ID,
     ENV_REPLICA_INDEX,
     ENV_REPLICA_TYPE,
+    ENV_RESUME_STEP,
     ENV_WORKLOAD,
 )
 
@@ -51,6 +53,12 @@ class JobContext:
     chips: int = 0
     port: int = 0  # rendezvous port (nonzero on the coordinator process)
     entrypoint: str = ""
+    # Warm-restart contract (rendezvous/env.py): > 0 means the controller
+    # recreated this gang after a restart with checkpoints on disk — the
+    # trainer resumes from latest_step(); streams fast-forward past
+    # resume_step batches. 0 on a cold first incarnation.
+    resume_step: int = 0
+    checkpoint_dir: str = ""
 
     @staticmethod
     def from_env(env: Dict[str, str] | None = None) -> "JobContext":
@@ -69,6 +77,8 @@ class JobContext:
             chips=int(e.get(ENV_CHIPS, "0") or 0),
             port=int(e.get(ENV_PORT, "0") or 0),
             entrypoint=e.get(ENV_ENTRYPOINT, ""),
+            resume_step=int(e.get(ENV_RESUME_STEP, "0") or 0),
+            checkpoint_dir=e.get(ENV_CHECKPOINT_DIR, ""),
         )
 
     # -- device plane helpers (used by workloads after rendezvous) --------

@@ -53,6 +53,21 @@ ENV_WORKLOAD = "TPUJOB_WORKLOAD"
 # eval scores into TPUJobStatus.eval_metrics.
 ENV_API_SERVER = "TPUJOB_API_SERVER"
 
+# Warm-restart contract (controller → recreated gang). When the job's
+# workload declares a checkpoint_dir, every created gang member gets:
+#
+# - ``TPUJOB_CHECKPOINT_DIR`` — the job's checkpoint directory
+# - ``TPUJOB_RESUME_STEP``    — latest checkpointed step at creation time
+#                               (0 on the first, cold incarnation)
+#
+# The trainer's authoritative resume point stays ``latest_step()`` read
+# from the directory itself (a checkpoint may land between creation and
+# restore); the env is the controller's declaration that this incarnation
+# is a warm restart — workloads use it to fast-forward data streams, and
+# soak/chaos harnesses assert on it without parsing logs.
+ENV_CHECKPOINT_DIR = "TPUJOB_CHECKPOINT_DIR"
+ENV_RESUME_STEP = "TPUJOB_RESUME_STEP"
+
 
 def identity_env(spec: "ProcessSpec", namespace: str) -> Dict[str, str]:
     """Identity env derived from a ProcessSpec; the backend injects this so

@@ -59,6 +59,10 @@ def main(argv=None) -> int:
         ctx.entrypoint, ctx.namespace, ctx.job_name, ctx.replica_type,
         ctx.replica_index, ctx.process_id, ctx.num_processes, ctx.coordinator_address,
     )
+    if ctx.resume_step:
+        # Warm restart (rendezvous/env.py contract): the controller saw
+        # checkpoints at creation; the trainer resumes from latest_step().
+        log.info("warm restart: controller-declared resume step %d", ctx.resume_step)
     try:
         fn(ctx)
     except RetryableFailure as exc:

@@ -77,6 +77,11 @@ class HostAgent:
         # the daemon wrapper (cli/agent.py) exits nonzero. A dead watch
         # thread behind a live heartbeat would mask NodeLost forever.
         self.fatal: Optional[str] = None
+        # Preemption notice received: the Host is DRAINING. Sticky across
+        # re-registration — an admin deleting the Host object mid-drain
+        # must not resurrect it as Ready (the scheduler would place a
+        # fresh gang onto a host about to vanish).
+        self._draining = False
 
     # -- lifecycle --------------------------------------------------------
 
@@ -108,15 +113,38 @@ class HostAgent:
         for t in self._threads:
             t.join(timeout=5)
 
+    def notify_preemption(self, message: str = "preemption notice received") -> None:
+        """Deliver a preemption notice: mark this Host DRAINING.
+
+        The host stays alive — heartbeats continue, already-running
+        children keep running — but the scheduler stops placing onto it
+        and the controller gracefully gang-restarts members bound here
+        (checkpoint-resumed on surviving hosts, cause=preemption, not
+        counted against backoff_limit). The deletion of each binding
+        reaches this agent through the watch and SIGTERMs the child
+        (exit 143, the preemption-retryable code). Infrastructure later
+        reclaims the machine: stop() or heartbeat loss finishes the
+        Ready → Draining → gone lifecycle."""
+        self._draining = True
+        log.warning("agent %s: preemption notice — draining", self.name)
+        self._set_phase(HostPhase.DRAINING, message)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
     # -- host object ------------------------------------------------------
 
     def _register(self) -> None:
+        # Drain is sticky across (re-)registration: an admin deleting the
+        # Host object mid-drain must not resurrect it Ready.
+        phase = HostPhase.DRAINING if self._draining else HostPhase.READY
         while True:
             host = Host(
                 metadata=ObjectMeta(name=self.name, namespace="default"),
                 spec=self.spec,
             )
-            host.status.phase = HostPhase.READY
+            host.status.phase = phase
             host.status.heartbeat_time = time.time()
             try:
                 self.store.create(host)
@@ -133,10 +161,11 @@ class HostAgent:
                     return
                 continue
 
-            # Re-registration after restart: adopt, refresh spec + Ready.
+            # Re-registration after restart: adopt, refresh spec + phase
+            # (Ready, or Draining when a preemption notice is in effect).
             def adopt(cur):
                 cur.spec = self.spec
-                cur.status.phase = HostPhase.READY
+                cur.status.phase = phase
                 cur.status.heartbeat_time = time.time()
                 cur.status.message = "agent re-registered"
 

@@ -119,10 +119,18 @@ class Endpoint:
 
 
 class HostPhase(str, enum.Enum):
-    """Node-condition analogue: Ready hosts accept placements."""
+    """Node-condition analogue: Ready hosts accept placements.
+
+    DRAINING is the preemption-notice state (cloud TPU maintenance/spot
+    eviction): the host is still alive and heartbeating, but the scheduler
+    stops placing onto it and the reconciler gracefully gang-restarts any
+    members bound to it (checkpoint-resumed, not counted against
+    backoff_limit). Lifecycle: Ready → Draining → gone (NotReady or
+    heartbeat-TTL NodeLost when the machine is actually reclaimed)."""
 
     READY = "Ready"
     NOT_READY = "NotReady"
+    DRAINING = "Draining"
 
 
 @dataclass

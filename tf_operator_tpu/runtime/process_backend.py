@@ -194,6 +194,24 @@ class LocalProcessControl(ProcessControl):
         with self._lock:
             return set(self._children)
 
+    def signal_local(self, namespace: str, name: str, signum: int) -> bool:
+        """Deliver ``signum`` to the supervised child for ns/name WITHOUT
+        dropping supervision: the monitor thread stays attached and reports
+        the resulting exit status (e.g. SIGKILL → 137) through the normal
+        path. The fault-injection seam (chaos/injector.py) — a chaos crash
+        must look exactly like a real one to the controller. Returns False
+        when no launched child is tracked under that key."""
+        with self._lock:
+            entry = self._children.get(f"{namespace}/{name}")
+            child = entry[1] if entry is not None else None
+        if child is None or child.poll() is not None:
+            return False
+        try:
+            os.kill(child.pid, signum)
+        except OSError:
+            return False
+        return True
+
     def kill_local(self, namespace: str, name: str) -> None:
         """Terminate the local child for ns/name without touching the store
         (the store object is already gone when the agent observes DELETED)."""

@@ -57,30 +57,60 @@ class GangScheduler:
         """True when any Host object exists — multi-host mode."""
         return bool(self.store.list(KIND_HOST))
 
-    def ready_hosts(self, now: Optional[float] = None) -> List[Host]:
+    def ready_hosts(
+        self, now: Optional[float] = None, ttl: Optional[float] = None
+    ) -> List[Host]:
+        """Ready, fresh-heartbeat hosts. ``ttl`` overrides the controller
+        default per call (per-job run_policy.heartbeat_ttl_seconds).
+        DRAINING hosts are never ready: a preemption notice means stop
+        placing here — members already bound get gracefully restarted."""
         now = time.time() if now is None else now
+        ttl = self.heartbeat_ttl if ttl is None else ttl
         out = []
         for h in self.store.list(KIND_HOST):
             if h.status.phase is not HostPhase.READY:
                 continue
-            if h.status.heartbeat_time and (
-                now - h.status.heartbeat_time > self.heartbeat_ttl
-            ):
+            if h.status.heartbeat_time and (now - h.status.heartbeat_time > ttl):
                 continue
             out.append(h)
         return out
 
-    def lost_hosts(self, now: Optional[float] = None) -> List[Host]:
+    def lost_hosts(
+        self, now: Optional[float] = None, ttl: Optional[float] = None
+    ) -> List[Host]:
         """Hosts whose agent stopped heartbeating (NodeLost)."""
         now = time.time() if now is None else now
+        ttl = self.heartbeat_ttl if ttl is None else ttl
         return [
             h
             for h in self.store.list(KIND_HOST)
-            if h.status.heartbeat_time
-            and now - h.status.heartbeat_time > self.heartbeat_ttl
+            if h.status.heartbeat_time and now - h.status.heartbeat_time > ttl
         ]
 
-    def _states(self, job_slice: str, now: Optional[float] = None) -> List[_HostState]:
+    def draining_hosts(
+        self, now: Optional[float] = None, ttl: Optional[float] = None
+    ) -> List[Host]:
+        """Hosts under a preemption notice (DRAINING) whose agent is still
+        heartbeating. A draining host that stops heartbeating has been
+        reclaimed — it appears in lost_hosts instead, and the harsher
+        NodeLost path (declare + fence) takes over."""
+        now = time.time() if now is None else now
+        ttl = self.heartbeat_ttl if ttl is None else ttl
+        return [
+            h
+            for h in self.store.list(KIND_HOST)
+            if h.status.phase is HostPhase.DRAINING
+            and not (
+                h.status.heartbeat_time and now - h.status.heartbeat_time > ttl
+            )
+        ]
+
+    def _states(
+        self,
+        job_slice: str,
+        now: Optional[float] = None,
+        ttl: Optional[float] = None,
+    ) -> List[_HostState]:
         fam = _family(job_slice)
         # Chips already promised to live processes, by node.
         used: Dict[str, int] = {}
@@ -91,7 +121,7 @@ class GangScheduler:
                 used[node] = used.get(node, 0) + max(p.spec.chips, 0)
                 count[node] = count.get(node, 0) + 1
         states = []
-        for h in self.ready_hosts(now):
+        for h in self.ready_hosts(now, ttl):
             if fam and h.spec.slice_type and _family(h.spec.slice_type) != fam:
                 continue
             free = h.spec.total_chips - used.get(h.metadata.name, 0)
@@ -111,6 +141,7 @@ class GangScheduler:
         now: Optional[float] = None,
         ranks: Optional[Dict[str, int]] = None,
         bound_slots: Optional[Dict[int, str]] = None,
+        ttl: Optional[float] = None,
     ) -> Dict[str, Host]:
         """Atomically choose a Host for every process in ``procs``.
 
@@ -127,7 +158,7 @@ class GangScheduler:
         nothing in that case.
         """
         want_hosts = max(1, job.spec.topology.num_hosts)
-        states = self._states(job.spec.topology.slice_type, now)
+        states = self._states(job.spec.topology.slice_type, now, ttl)
         by_name = {s.host.metadata.name: s for s in states}
 
         # Slot → host assignment. Slots pinned by live members keep their

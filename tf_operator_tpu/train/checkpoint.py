@@ -44,6 +44,30 @@ log = logging.getLogger("tpujob.checkpoint")
 _STEP_DIR = re.compile(r"^step_(\d+)$")
 
 
+def latest_checkpoint_step(directory: str) -> int:
+    """Latest checkpointed step under ``directory``, 0 when none.
+
+    Dependency-free filesystem scan (no orbax import, no manager
+    construction): the control plane calls this on every gang (re)create
+    to stamp the warm-restart env (``TPUJOB_RESUME_STEP``), so it must be
+    cheap and must not pull jax/orbax into the controller process. Handles
+    both on-disk layouts: the npy backend's ``step_N/manifest.json`` and
+    orbax's bare numeric step directories (in-flight ``*.orbax-*-tmp-*``
+    dirs are non-numeric and skipped)."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return 0
+    best = 0
+    for name in names:
+        m = _STEP_DIR.match(name)
+        if m and os.path.exists(os.path.join(directory, name, "manifest.json")):
+            best = max(best, int(m.group(1)))
+        elif name.isdigit() and os.path.isdir(os.path.join(directory, name)):
+            best = max(best, int(name))
+    return best
+
+
 def _to_tree(state: Any) -> Any:
     """TrainState -> plain dict pytree (checkpoint wire format)."""
     from tf_operator_tpu.train.trainer import TrainState

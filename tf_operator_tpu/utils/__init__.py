@@ -7,6 +7,7 @@ from tf_operator_tpu.utils.exit_codes import (  # noqa: F401
     ExitClass,
     classify_exit_code,
     is_permanent,
+    is_preemption,
     is_retryable,
 )
 from tf_operator_tpu.utils.naming import gen_name, gen_runtime_id, rand_string  # noqa: F401

@@ -20,13 +20,23 @@ import enum
 class ExitClass(enum.Enum):
     SUCCEEDED = "Succeeded"
     RETRYABLE = "Retryable"
+    # Preemption-retryable: the process was evicted by infrastructure
+    # (SIGTERM during a host drain, SIGINT eviction). Restarted like
+    # RETRYABLE, but the restart is a *preemption* restart — it carries a
+    # distinct cause in status and does not count against backoff_limit
+    # (crash-looping workloads consume backoff; being evicted must not).
+    PREEMPTED = "Preempted"
     PERMANENT = "Permanent"
 
 
 # Semantics preserved from train_util.go:18-53. Retryable codes are
-# 128+signal for external kill/eviction signals INT, KILL, TERM.
+# 128+signal for external kill/eviction signals INT, KILL, TERM; the
+# graceful-eviction pair (INT, TERM) classifies as PREEMPTED — a drained
+# host SIGTERMs its children (exit 143) and that is infrastructure's
+# doing, not the workload's.
 PERMANENT_CODES = frozenset({1, 2, 126, 127, 128, 139})
-RETRYABLE_CODES = frozenset(128 + sig for sig in (2, 9, 15))  # {130, 137, 143}
+PREEMPTION_CODES = frozenset(128 + sig for sig in (2, 15))  # {130, 143}
+RETRYABLE_CODES = frozenset({128 + 9})  # {137}: SIGKILL-class infra loss
 USER_RETRYABLE_CODE = 138  # 128 + SIGUSR1: workload asks to be restarted
 
 
@@ -44,6 +54,8 @@ def classify_exit_code(code: int, oom_killed: bool = False) -> ExitClass:
         code = 128 + (-code)
     if code == USER_RETRYABLE_CODE:
         return ExitClass.RETRYABLE
+    if code in PREEMPTION_CODES:
+        return ExitClass.PREEMPTED
     if code in RETRYABLE_CODES:
         return ExitClass.RETRYABLE
     if code in PERMANENT_CODES:
@@ -54,7 +66,15 @@ def classify_exit_code(code: int, oom_killed: bool = False) -> ExitClass:
 
 
 def is_retryable(code: int, oom_killed: bool = False) -> bool:
-    return classify_exit_code(code, oom_killed) is ExitClass.RETRYABLE
+    """True for any restartable failure — plain retryable OR preemption."""
+    return classify_exit_code(code, oom_killed) in (
+        ExitClass.RETRYABLE,
+        ExitClass.PREEMPTED,
+    )
+
+
+def is_preemption(code: int, oom_killed: bool = False) -> bool:
+    return classify_exit_code(code, oom_killed) is ExitClass.PREEMPTED
 
 
 def is_permanent(code: int, oom_killed: bool = False) -> bool:

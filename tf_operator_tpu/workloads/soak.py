@@ -1,0 +1,73 @@
+"""Chaos-soak workload: control-plane-faithful, data-plane-minimal.
+
+The chaos soak (chaos/soak.py) exercises crash / preemption / drain /
+warm-restart mechanics in the CONTROL plane; the data plane only needs to
+make progress observable and resumable. This workload does exactly that
+with no cross-process collectives (CI containers without a gloo-capable
+jax cannot run multi-process SPMD — the real-collectives soak uses the lm
+workload instead, selectable via ``chaos.soak --data-plane lm``):
+
+- every gang member paces ``steps`` wall-clock steps of ``step_sleep_s``
+  (long enough for faults to land mid-run);
+- the chief (worker 0 / coordinator) drives the real checkpoint
+  subsystem — ``train.checkpoint.CheckpointManager`` saves every
+  ``checkpoint_every`` steps into ``checkpoint_dir`` and a resumed
+  incarnation continues from ``latest_step()`` instead of step 0,
+  logging the same "resumed from checkpoint at step N" line the
+  restart-recovery e2e pins.
+
+The warm-restart env contract is asserted here, not just logged: the
+controller's declared ``TPUJOB_RESUME_STEP`` must never exceed what is
+actually on disk (it may lag it — a checkpoint can land between creation
+and restore, and the controller fences nothing on it)."""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from tf_operator_tpu.rendezvous.context import JobContext
+
+log = logging.getLogger("tpujob.soakwl")
+
+
+def main(ctx: JobContext) -> None:
+    wl = ctx.workload
+    steps = int(wl.get("steps", 8))
+    sleep_s = float(wl.get("step_sleep_s", 0.25))
+    is_chief = ctx.replica_type == "Coordinator" or (
+        ctx.replica_type == "Worker" and ctx.replica_index == 0
+    )
+
+    if not (is_chief and wl.get("checkpoint_dir")):
+        # Non-chief members just pace the same wall clock; gang restart /
+        # drain semantics act on them via signals, not their own logic.
+        for _ in range(steps):
+            time.sleep(sleep_s)
+        return
+
+    import numpy as np
+
+    from tf_operator_tpu.train.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(
+        wl["checkpoint_dir"], keep=int(wl.get("checkpoint_keep", 3))
+    )
+    every = int(wl.get("checkpoint_every", 2))
+    start = mgr.latest_step() or 0
+    if start:
+        log.info("resumed from checkpoint at step %d", start)
+    if ctx.resume_step > start:
+        raise AssertionError(
+            f"controller declared resume step {ctx.resume_step} but disk "
+            f"has only {start} — the warm-restart env over-promised"
+        )
+    state = {"step": np.asarray(start)}
+    for s in range(start + 1, steps + 1):
+        time.sleep(sleep_s)
+        state = {"step": np.asarray(s)}
+        if every and s % every == 0:
+            mgr.save(s, state)
+    mgr.save(steps, state, wait=True)  # final save (no-op if step exists)
+    mgr.close()
+    log.info("soak workload done: steps=%d (resumed from %d)", steps, start)
