@@ -1,0 +1,356 @@
+"""Control-plane scale-out tests (r6 tentpole).
+
+Pins the structures that keep list/watch/reconcile cost proportional to
+the changed set instead of the live population:
+
+- store index correctness under concurrent create/update/delete churn,
+  with watch delivery seen exactly once and in order per key;
+- the list-cost regression contract: a label-selector list visits ONLY
+  the selected index bucket (Store.list_stats is the oracle);
+- bounded per-watch queues: a non-draining consumer's watch closes with
+  ``overflowed`` instead of buffering forever, and the informer recovers
+  by re-list+watching;
+- workqueue dedup/rate-limit semantics (a key enqueued N times while
+  syncing runs once more, not N times);
+- resync enqueues only jobs with work left;
+- ``_write_status`` performs zero store reads/writes for a no-change sync.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tf_operator_tpu.api.types import (
+    KIND_PROCESS,
+    KIND_TPUJOB,
+    LABEL_JOB_NAME,
+    ObjectMeta,
+    ReplicaType,
+)
+from tf_operator_tpu.controller.informer import Informer
+from tf_operator_tpu.controller.status import new_condition, set_condition
+from tf_operator_tpu.api.types import ConditionType
+from tf_operator_tpu.controller.workqueue import RateLimitingQueue
+from tf_operator_tpu.runtime import Process, ProcessPhase, ProcessSpec, Store
+from tf_operator_tpu.runtime.store import WatchEventType
+
+from tests.test_reconciler import Harness, make_job, make_process
+
+
+def proc(name, ns="default", labels=None):
+    return Process(
+        metadata=ObjectMeta(name=name, namespace=ns, labels=labels or {}),
+        spec=ProcessSpec(job_name="j", replica_type="Worker", replica_index=0),
+    )
+
+
+# ---- index correctness + list cost ----------------------------------------
+
+
+def test_label_selector_list_touches_only_selected_index():
+    """The regression contract: listing by the indexed job-name label
+    must not visit objects outside that label's bucket, however large
+    the rest of the population is."""
+    s = Store()
+    for i in range(100):
+        s.create(proc(f"other-{i}", labels={LABEL_JOB_NAME: "big-job"}))
+    for i in range(3):
+        s.create(proc(f"mine-{i}", labels={LABEL_JOB_NAME: "small-job"}))
+    before = s.list_stats()
+    out = s.list(KIND_PROCESS, label_selector={LABEL_JOB_NAME: "small-job"})
+    after = s.list_stats()
+    assert [p.metadata.name for p in out] == ["mine-0", "mine-1", "mine-2"]
+    assert after["calls"] - before["calls"] == 1
+    # scanned exactly the selected bucket — not the 103-object population
+    assert after["scanned"] - before["scanned"] == 3
+    assert after["returned"] - before["returned"] == 3
+
+
+def test_kind_and_namespace_lists_use_their_indices():
+    s = Store()
+    for i in range(50):
+        s.create(proc(f"p-{i}", ns="busy"))
+    s.create(proc("lone", ns="quiet"))
+    before = s.list_stats()["scanned"]
+    assert len(s.list(KIND_PROCESS, namespace="quiet")) == 1
+    assert s.list_stats()["scanned"] - before == 1  # (kind, ns) bucket only
+    # a kind with no objects scans nothing
+    before = s.list_stats()["scanned"]
+    assert s.list("Host") == []
+    assert s.list_stats()["scanned"] - before == 0
+
+
+def test_label_update_moves_object_between_index_buckets():
+    s = Store()
+    s.create(proc("p", labels={LABEL_JOB_NAME: "a"}))
+    got = s.get(KIND_PROCESS, "default", "p")
+    got.metadata.labels[LABEL_JOB_NAME] = "b"
+    s.update(got)
+    assert s.list(KIND_PROCESS, label_selector={LABEL_JOB_NAME: "a"}) == []
+    assert [
+        p.metadata.name
+        for p in s.list(KIND_PROCESS, label_selector={LABEL_JOB_NAME: "b"})
+    ] == ["p"]
+    s.delete(KIND_PROCESS, "default", "p")
+    assert s.list(KIND_PROCESS, label_selector={LABEL_JOB_NAME: "b"}) == []
+
+
+def test_unindexed_selector_still_filters_correctly():
+    s = Store()
+    s.create(proc("a", labels={"color": "red"}))
+    s.create(proc("b", labels={"color": "blue"}))
+    assert [
+        p.metadata.name
+        for p in s.list(KIND_PROCESS, label_selector={"color": "red"})
+    ] == ["a"]
+
+
+def test_index_and_watch_consistency_under_concurrent_churn():
+    """8 writer threads create/update/delete against one kind while a
+    watch consumes: every event is seen exactly once (unique resource
+    version per key-event), per-key order holds (ADDED first, rising
+    resource versions, DELETED last), and the final indexed lists agree
+    with replaying the event stream."""
+    s = Store()
+    w = s.watch(kinds=[KIND_PROCESS])
+    errs = []
+
+    def churn(i):
+        try:
+            label = {LABEL_JOB_NAME: f"job-{i % 2}"}
+            for j in range(30):
+                name = f"p-{i}-{j}"
+                s.create(proc(name, labels=dict(label)))
+                got = s.get(KIND_PROCESS, "default", name)
+                got.status.phase = ProcessPhase.RUNNING
+                s.update(got)
+                if j % 3 == 0:
+                    s.delete(KIND_PROCESS, "default", name)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=churn, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    w.stop()
+
+    replayed = {}
+    seen_rv = set()
+    per_key_last_rv = {}
+    for ev in w:  # Watch iteration ends on the stop sentinel
+        key = (ev.obj.metadata.namespace, ev.obj.metadata.name)
+        rv = ev.obj.metadata.resource_version
+        assert (key, ev.type, rv) not in seen_rv  # exactly once
+        seen_rv.add((key, ev.type, rv))
+        if ev.type is WatchEventType.ADDED:
+            assert key not in replayed
+            replayed[key] = ev.obj
+        elif ev.type is WatchEventType.MODIFIED:
+            assert key in replayed
+            assert rv > per_key_last_rv[key]  # in order
+            replayed[key] = ev.obj
+        else:
+            assert key in replayed
+            del replayed[key]
+        per_key_last_rv[key] = rv
+
+    store_now = {
+        (p.metadata.namespace, p.metadata.name): p
+        for p in s.list(KIND_PROCESS)
+    }
+    assert set(store_now) == set(replayed)
+    # and the label buckets partition the survivors exactly
+    by_label = {
+        (p.metadata.namespace, p.metadata.name)
+        for lbl in ("job-0", "job-1")
+        for p in s.list(KIND_PROCESS, label_selector={LABEL_JOB_NAME: lbl})
+    }
+    assert by_label == set(store_now)
+
+
+def test_snapshot_isolation_still_holds_with_indices():
+    s = Store()
+    s.create(proc("p", labels={LABEL_JOB_NAME: "x"}))
+    got = s.list(KIND_PROCESS, label_selector={LABEL_JOB_NAME: "x"})[0]
+    got.metadata.labels[LABEL_JOB_NAME] = "mutated"
+    assert (
+        s.list(KIND_PROCESS, label_selector={LABEL_JOB_NAME: "x"})[0]
+        .metadata.labels[LABEL_JOB_NAME]
+        == "x"
+    )
+
+
+# ---- bounded watch queues -------------------------------------------------
+
+
+def test_overflowed_watch_is_closed_not_unbounded():
+    s = Store()
+    w = s.watch(kinds=[KIND_PROCESS], maxsize=5)
+    for i in range(20):
+        s.create(proc(f"p-{i}"))
+    # the watch was closed once its consumer (nobody) fell 5 events behind
+    assert w.overflowed
+    drained = list(w)  # iteration ends on the overflow-close sentinel
+    assert len(drained) <= 6
+    # a healthy watch created afterwards replays current state fine
+    w2 = s.watch(kinds=[KIND_PROCESS])
+    assert w2.queue.qsize() == 20
+    w2.stop()
+
+
+def test_informer_recovers_from_watch_overflow():
+    """An informer whose watch is closed for overflow must re-list+watch
+    and converge (synthetic deletes reconcile removals it missed)."""
+    s = Store()
+    inf = Informer(s, KIND_PROCESS)
+    # tiny bound: force overflow while the consumer thread is blocked by
+    # a slow handler
+    inf._subscribe = lambda: s.watch(
+        kinds=[KIND_PROCESS], mark_replay=True, maxsize=4
+    )
+    gate = threading.Event()
+    inf.add_event_handler(on_add=lambda obj: gate.wait(0.05))
+    inf.run()
+    deadline = time.time() + 5
+    while not inf.has_synced() and time.time() < deadline:
+        time.sleep(0.01)
+    for i in range(50):
+        s.create(proc(f"p-{i}"))
+    s.delete(KIND_PROCESS, "default", "p-0")
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        names = {p.metadata.name for p in inf.list()}
+        if names == {f"p-{i}" for i in range(1, 50)}:
+            break
+        time.sleep(0.05)
+    inf.stop()
+    assert {p.metadata.name for p in inf.list()} == {
+        f"p-{i}" for i in range(1, 50)
+    }
+
+
+def test_informer_label_index_list():
+    s = Store()
+    inf = Informer(s, KIND_PROCESS)
+    inf.seed(
+        [proc(f"p-{i}", labels={LABEL_JOB_NAME: f"job-{i % 3}"}) for i in range(9)]
+    )
+    out = inf.list(label_selector={LABEL_JOB_NAME: "job-1"})
+    assert [p.metadata.name for p in out] == ["p-1", "p-4", "p-7"]
+    # namespace + selector compose
+    assert inf.list(namespace="nope", label_selector={LABEL_JOB_NAME: "job-1"}) == []
+
+
+# ---- workqueue dedup/rate-limit semantics ---------------------------------
+
+
+def test_adds_while_processing_coalesce_to_one_rerun():
+    q = RateLimitingQueue()
+    q.add("job")
+    item = q.get(timeout=1)
+    for _ in range(10):
+        q.add("job")  # N enqueues while syncing...
+    q.done(item)
+    assert q.get(timeout=1) == "job"  # ...run once
+    q.done("job")
+    assert q.get(timeout=0.05) is None  # and only once
+
+
+def test_rate_limited_adds_dedup_against_queued_key():
+    q = RateLimitingQueue(base_delay=0.01)
+    q.add("k")
+    q.add_rate_limited("k")  # delayed duplicate of an already-queued key
+    assert q.get(timeout=1) == "k"
+    q.done("k")
+    time.sleep(0.05)  # let the timer fire into the empty queue
+    got = q.get(timeout=0.2)
+    # the timer re-add may deliver the key once more at most — never twice
+    if got is not None:
+        q.done(got)
+        assert q.get(timeout=0.05) is None
+
+
+# ---- coalesced reconcile --------------------------------------------------
+
+
+def _finish_job(job):
+    set_condition(
+        job.status, new_condition(ConditionType.SUCCEEDED, "Done", "done")
+    )
+    job.status.completion_time = time.time()
+    return job
+
+
+def test_resync_skips_drained_terminal_jobs():
+    h = Harness(make_job(name="live", workers=1))
+    done = make_job(name="done", workers=1)
+    _finish_job(done)
+    stored_done = h.store.create(done)
+    h.ctl.job_informer.seed([stored_done])
+    assert h.ctl.resync_once() == 1  # only the live job enqueued
+    assert h.ctl.queue.get(timeout=1) == "default/live"
+    assert h.ctl.queue.get(timeout=0.05) is None
+
+
+def test_resync_keeps_terminal_jobs_with_active_children():
+    h = Harness(make_job(name="drain", workers=1))
+    job = h.stored_job()
+    _finish_job(job)
+    # finished but a replica counter still shows an active child
+    from tf_operator_tpu.controller.status import initialize_replica_statuses
+
+    initialize_replica_statuses(job.status, [ReplicaType.WORKER])
+    job.status.replica_statuses[ReplicaType.WORKER].active = 1
+    h.store.update(job)
+    h.ctl.job_informer.seed([h.stored_job()])
+    assert h.ctl.resync_once() == 1  # still work left: enqueued
+
+
+class _CountingStore(Store):
+    def __init__(self):
+        super().__init__()
+        self.job_gets = 0
+        self.job_updates = 0
+
+    def get(self, kind, namespace, name):
+        if kind == KIND_TPUJOB:
+            self.job_gets += 1
+        return super().get(kind, namespace, name)
+
+    def update(self, obj, check_version=False):
+        if obj.kind == KIND_TPUJOB:
+            self.job_updates += 1
+        return super().update(obj, check_version=check_version)
+
+
+def test_write_status_no_op_sync_does_zero_job_store_io():
+    """Second sync of an unchanged running job: the informer-cache fast
+    path must skip BOTH the PUT and the GET (the old mutate-returns-False
+    path still paid a GET per no-op sync — a network RTT in HA mode)."""
+    from tf_operator_tpu.controller import TPUJobController
+    from tf_operator_tpu.runtime import FakeProcessControl
+
+    store = _CountingStore()
+    job = make_job(workers=1)
+    ctl = TPUJobController(store, FakeProcessControl(), port_allocator=lambda: 1)
+    stored = store.create(job)
+    procs = [
+        make_process(stored, ReplicaType.COORDINATOR, 0, ProcessPhase.RUNNING),
+        make_process(stored, ReplicaType.WORKER, 0, ProcessPhase.RUNNING),
+    ]
+    for p in procs:
+        store.create(p)
+    ctl.job_informer.seed([stored])
+    ctl.process_informer.seed(store.list(KIND_PROCESS))
+    ctl.sync_job(stored.key())  # first sync writes Running conditions
+    # refresh the informer cache with the written status (what the watch
+    # would have delivered), then sync again: nothing changed
+    ctl.job_informer.seed([store.get(KIND_TPUJOB, "default", "trainer")])
+    gets, updates = store.job_gets, store.job_updates
+    ctl.sync_job(stored.key())
+    assert store.job_updates == updates  # no PUT
+    assert store.job_gets == gets  # and no GET either

@@ -328,6 +328,7 @@ def test_eval_scores_real_memmap_holdout(tmp_path, caplog):
             "checkpoint_dir": str(ckpt),
             "data": "memmap",
             "corpus": corpus,
+            "seq_len": 32,  # the trainer geometry the holdout is carved in
             "holdout_windows": 8,
             "train_steps": 2,
             "eval_batch_size": 4,
@@ -374,6 +375,7 @@ def test_eval_memmap_rejects_oversized_ask(tmp_path):
             "checkpoint_dir": str(ckpt),
             "data": "memmap",
             "corpus": corpus,
+            "seq_len": 32,  # the trainer geometry the holdout is carved in
             "holdout_windows": 4,
             "eval_batch_size": 4,
             "eval_seq_len": 32,

@@ -126,8 +126,10 @@ class ChaosStore:
         self._perturb()
         return self._inner.list(kind, namespace=namespace, label_selector=label_selector)
 
-    def watch(self, kinds=None):
-        return self._inner.watch(kinds=kinds)
+    def watch(self, kinds=None, **kw):
+        # Pass mark_replay/maxsize through: the informer's replay-aware
+        # loop needs the inner store's replay framing under chaos too.
+        return self._inner.watch(kinds=kinds, **kw)
 
     def update_with_retry(self, kind, namespace, name, mutate):
         if kind == KIND_HOST and self._knobs.heartbeat_blocked(name):

@@ -41,6 +41,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="reconciler sync loop period seconds (reference 15s)")
     p.add_argument("--port", type=int, default=8080, help="dashboard/API port")
     p.add_argument("--host", default="127.0.0.1", help="dashboard/API bind host")
+    p.add_argument("--api-workers", type=int, default=64,
+                   help="max concurrently-served API connections (bounded "
+                        "handler threads; watch streams hold a slot each — "
+                        "size above the agent count)")
     p.add_argument("--json-log-format", action="store_true",
                    help="structured JSON logs (reference: logrus JSON for Stackdriver)")
     p.add_argument("--log-dir", default=os.path.join(os.getcwd(), "tpujob-logs"),
@@ -192,7 +196,7 @@ def main(argv=None) -> int:
             sys.exit("--store-only hosts the store; it conflicts with --store-server")
         dashboard = DashboardServer(
             store, host=args.host, port=args.port, auth_token=auth_token,
-            auth_reads=args.auth_reads,
+            auth_reads=args.auth_reads, max_workers=args.api_workers,
         )
         stop = threading.Event()
         signal.signal(signal.SIGTERM, lambda *_: stop.set())
@@ -233,6 +237,7 @@ def main(argv=None) -> int:
     dashboard = DashboardServer(
         store, host=args.host, port=args.port, metrics=controller.metrics,
         auth_token=auth_token, auth_reads=args.auth_reads,
+        max_workers=args.api_workers,
     )
     chaos = ChaosMonkey(store, args.chaos_level, args.chaos_interval)
 

@@ -7,6 +7,18 @@ store's list+watch stream on a background thread, maintains a read-only
 cache (the lister), and dispatches add/update/delete callbacks — the same
 callbacks that do expectations bookkeeping and enqueue job keys in the
 reference (controller_pod.go:285-412).
+
+r6 scale notes: one loop consumes both the in-process Watch and the
+RemoteWatch — both now frame replays with REPLAY_START/SYNCED control
+events, so reconnect reconciliation (replay ADD of a cached key ⇒
+MODIFIED; cached key absent from the replay ⇒ synthetic DELETED) is a
+single code path. The lister is indexed like the store: per namespace
+and per indexed-label value (the job-name label), so ``list`` visits —
+and deepcopies — only the selected set, not the whole cache
+(`_claim_processes` calls it once per job sync; a flat scan made every
+resync pass O(jobs²)). A local watch closed by the store for overflow
+(consumer fell DEFAULT_WATCH_QUEUE_SIZE events behind) is transparently
+re-subscribed, with the replay markers driving cache reconciliation.
 """
 
 from __future__ import annotations
@@ -16,7 +28,12 @@ import logging
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from tf_operator_tpu.runtime.store import Store, WatchEventType
+from tf_operator_tpu.runtime.store import (
+    INDEXED_LABELS,
+    Store,
+    WatchEvent,
+    WatchEventType,
+)
 
 log = logging.getLogger(__name__)
 
@@ -33,12 +50,17 @@ class Informer:
         self.kind = kind
         self._lock = threading.RLock()
         self._cache: Dict[Tuple[str, str], Any] = {}  # (ns, name) -> obj
+        # Lister indices (mirror the store's): ns -> keys, and
+        # (label_key, label_value) -> keys for the indexed labels.
+        self._by_ns: Dict[str, set] = {}
+        self._by_label: Dict[Tuple[str, str], set] = {}
         self._on_add: List[Handler] = []
         self._on_update: List[UpdateHandler] = []
         self._on_delete: List[Handler] = []
         self._thread: Optional[threading.Thread] = None
         self._watch = None
         self._synced = threading.Event()
+        self._stopped = False
         # Permanent watch failure (rejected credentials): reason string.
         # has_synced() raises on it so cache-sync waiters fail fast.
         self.failed: Optional[str] = None
@@ -69,12 +91,28 @@ class Informer:
         self, namespace: Optional[str] = None, label_selector: Optional[Dict[str, str]] = None
     ) -> List[Any]:
         with self._lock:
+            keys = None
+            residual = dict(label_selector) if label_selector else None
+            if residual:
+                for lk in INDEXED_LABELS:
+                    if lk in residual:
+                        keys = self._by_label.get((lk, residual.pop(lk)), set())
+                        break
+            if keys is None:
+                keys = (
+                    self._by_ns.get(namespace, set())
+                    if namespace is not None
+                    else self._cache.keys()
+                )
             out = []
-            for (ns, _), obj in self._cache.items():
-                if namespace is not None and ns != namespace:
+            for key in keys:
+                obj = self._cache.get(key)
+                if obj is None:
                     continue
-                if label_selector and not all(
-                    obj.metadata.labels.get(k) == v for k, v in label_selector.items()
+                if namespace is not None and key[0] != namespace:
+                    continue
+                if residual and not all(
+                    obj.metadata.labels.get(k) == v for k, v in residual.items()
                 ):
                     continue
                 out.append(copy.deepcopy(obj))
@@ -95,8 +133,44 @@ class Informer:
         with self._lock:
             for obj in objs:
                 meta = obj.metadata
-                self._cache[(meta.namespace, meta.name)] = copy.deepcopy(obj)
+                self._cache_put((meta.namespace, meta.name), copy.deepcopy(obj))
         self._synced.set()
+
+    # -- cache + index maintenance (callers hold _lock) -------------------
+
+    def _label_keys(self, obj: Any) -> List[Tuple[str, str]]:
+        labels = obj.metadata.labels or {}
+        return [(lk, labels[lk]) for lk in INDEXED_LABELS if lk in labels]
+
+    def _cache_put(self, key: Tuple[str, str], obj: Any) -> None:
+        old = self._cache.get(key)
+        if old is not None:
+            for b in self._label_keys(old):
+                bucket = self._by_label.get(b)
+                if bucket is not None:
+                    bucket.discard(key)
+                    if not bucket:
+                        del self._by_label[b]
+        self._cache[key] = obj
+        self._by_ns.setdefault(key[0], set()).add(key)
+        for b in self._label_keys(obj):
+            self._by_label.setdefault(b, set()).add(key)
+
+    def _cache_pop(self, key: Tuple[str, str]) -> None:
+        old = self._cache.pop(key, None)
+        if old is None:
+            return
+        bucket = self._by_ns.get(key[0])
+        if bucket is not None:
+            bucket.discard(key)
+            if not bucket:
+                del self._by_ns[key[0]]
+        for b in self._label_keys(old):
+            lbucket = self._by_label.get(b)
+            if lbucket is not None:
+                lbucket.discard(key)
+                if not lbucket:
+                    del self._by_label[b]
 
     # -- lifecycle --------------------------------------------------------
 
@@ -104,23 +178,39 @@ class Informer:
         """Start consuming the watch on a daemon thread."""
         if self._thread is not None:
             return
-        self._watch = self._store.watch(kinds=[self.kind])
-        # The watch replays existing objects as ADDED before live events, so
-        # draining it keeps cache population and handler dispatch in order.
+        self._watch = self._subscribe()
         self._thread = threading.Thread(
             target=self._loop, name=f"informer-{self.kind}", daemon=True
         )
         self._thread.start()
 
+    def _subscribe(self):
+        try:
+            # In-process store: ask for the replay markers so the one
+            # replay-reconciling loop below serves local watches too.
+            return self._store.watch(kinds=[self.kind], mark_replay=True)
+        except TypeError:
+            # Store-compatible object without mark_replay (RemoteStore —
+            # its RemoteWatch frames every (re)connect's replay itself).
+            return self._store.watch(kinds=[self.kind])
+
     def _loop(self) -> None:
         from tf_operator_tpu.runtime.remote_store import UnauthorizedError
 
-        assert self._watch is not None
         try:
-            if hasattr(self._watch, "queue"):
-                self._loop_local()
-            else:
-                self._loop_remote()
+            while True:
+                self._consume(self._watch)
+                # The iterator ended: deliberate stop, or the store closed
+                # an overflowed local watch. Only the latter re-subscribes
+                # (RemoteWatch reconnects internally and only ever ends on
+                # stop()).
+                if self._stopped or not getattr(self._watch, "overflowed", False):
+                    return
+                log.warning(
+                    "informer %s: watch overflowed (consumer lagged); "
+                    "re-listing", self.kind,
+                )
+                self._watch = self._subscribe()
         except UnauthorizedError as exc:
             # Permanent credential rejection: record it and unblock sync
             # waiters LOUDLY (has_synced raises) rather than letting the
@@ -130,36 +220,17 @@ class Informer:
                          self.kind, exc)
             self._synced.set()
 
-    def _loop_local(self) -> None:
-        # Synced once the replayed backlog drains: either the queue empties
-        # after a dispatch or the first 50ms poll comes up empty.
-        import queue as _queue
-
-        while True:
-            try:
-                ev = self._watch.queue.get(timeout=0.05)
-            except _queue.Empty:
-                self._synced.set()
-                continue
-            if ev is None:
-                self._synced.set()
-                return
-            self._dispatch(ev)
-            if self._watch.queue.empty():
-                self._synced.set()
-
-    def _loop_remote(self) -> None:
-        """RemoteWatch consumption (the HA --store-server controller): an
-        auto-reconnecting ITERABLE that brackets each (re)connect's replay
-        with REPLAY_START/SYNCED control events instead of exposing a
-        queue. On SYNCED the cache reconciles against the replayed set —
-        deletions that happened while disconnected are never replayed, so
-        anything cached but absent from the replay gets a synthetic
-        DELETED (the informer-side analogue of the agent's orphan reap)."""
-        from tf_operator_tpu.runtime.store import WatchEvent
-
+    def _consume(self, watch) -> None:
+        """Drain one watch subscription: replay-aware cache maintenance +
+        handler dispatch. Replays (bracketed by REPLAY_START/SYNCED) are
+        reconciled against the cache: an ADD for a cached key is a
+        MODIFIED (the DeltaFIFO re-list rule — replay ADDs would otherwise
+        re-fire creation_observed on the expectations cache and let a
+        concurrent sync trust a stale view), and anything cached but
+        absent from the replay gets a synthetic DELETED on SYNCED
+        (deletions during a disconnect are never replayed)."""
         replay_seen: Optional[set] = None
-        for ev in self._watch:
+        for ev in watch:
             if ev.type is WatchEventType.REPLAY_START:
                 replay_seen = set()
                 continue
@@ -179,11 +250,6 @@ class Informer:
                 meta = ev.obj.metadata
                 key = (meta.namespace, meta.name)
                 replay_seen.add(key)
-                # DeltaFIFO rule: a re-list ADD for an object we already
-                # cache is a MODIFIED, not a new ADDED — replay ADDs would
-                # otherwise re-fire creation_observed on the expectations
-                # cache and let a concurrent sync trust a stale view (the
-                # exact staleness the expectations machinery guards).
                 if ev.type is WatchEventType.ADDED and key in self._cache:
                     ev = WatchEvent(WatchEventType.MODIFIED, ev.obj)
             self._dispatch(ev)
@@ -195,9 +261,9 @@ class Informer:
         with self._lock:
             old = self._cache.get(key)
             if ev.type is WatchEventType.DELETED:
-                self._cache.pop(key, None)
+                self._cache_pop(key)
             else:
-                self._cache[key] = ev.obj
+                self._cache_put(key, ev.obj)
         try:
             if ev.type is WatchEventType.ADDED:
                 for h in self._on_add:
@@ -212,6 +278,7 @@ class Informer:
             log.exception("informer handler failed for %s %s", self.kind, key)
 
     def stop(self) -> None:
+        self._stopped = True
         if self._watch is not None:
             self._watch.stop()
         if self._thread is not None:

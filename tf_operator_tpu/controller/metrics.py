@@ -36,6 +36,17 @@ class ControllerMetrics:
         ),
     }
 
+    # Reconcile-latency histogram bounds (seconds). Healthy syncs on the
+    # indexed store sit in the first few buckets; the tail buckets are
+    # where the pre-index O(population) scans lived — the knee's signature.
+    SYNC_BUCKETS = (
+        0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+        0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    )
+    # Raw-sample cap for quantile estimation (the bench's p50/p99 oracle);
+    # a 500-job run produces ~10-20k syncs, well under it.
+    MAX_SYNC_SAMPLES = 200_000
+
     def __init__(self, store=None, queue=None) -> None:
         self.store = store
         self.queue = queue
@@ -45,6 +56,8 @@ class ControllerMetrics:
         self._labeled: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._sync_seconds_sum = 0.0
         self._sync_seconds_count = 0
+        self._sync_bucket_counts = [0] * (len(self.SYNC_BUCKETS) + 1)  # +Inf
+        self._sync_samples: List[float] = []
 
     # -- writers (reconciler) ---------------------------------------------
 
@@ -66,6 +79,25 @@ class ControllerMetrics:
                 self._counters["tpujob_sync_errors_total"] += 1
             self._sync_seconds_sum += seconds
             self._sync_seconds_count += 1
+            i = 0
+            while i < len(self.SYNC_BUCKETS) and seconds > self.SYNC_BUCKETS[i]:
+                i += 1
+            self._sync_bucket_counts[i] += 1
+            if len(self._sync_samples) < self.MAX_SYNC_SAMPLES:
+                self._sync_samples.append(seconds)
+
+    def sync_latency_quantiles(self, qs=(0.5, 0.99)) -> Dict[float, float]:
+        """Empirical sync-latency quantiles from the raw samples (the
+        bench artifact's p50/p99 source — exact, unlike bucket
+        interpolation). Empty history returns 0s."""
+        with self._lock:
+            samples = sorted(self._sync_samples)
+        if not samples:
+            return {q: 0.0 for q in qs}
+        return {
+            q: samples[min(len(samples) - 1, int(q * len(samples)))]
+            for q in qs
+        }
 
     # -- scrape -----------------------------------------------------------
 
@@ -75,6 +107,7 @@ class ControllerMetrics:
             counters = dict(self._counters)
             labeled = dict(self._labeled)
             s_sum, s_count = self._sync_seconds_sum, self._sync_seconds_count
+            buckets = list(self._sync_bucket_counts)
         # .17g: %g's 6 significant digits would freeze a counter past ~1e6
         # (consecutive increments render identically and rate() reads 0).
         for name, value in sorted(counters.items()):
@@ -92,8 +125,15 @@ class ControllerMetrics:
                     continue
                 rendered = ",".join(f'{k}="{v}"' for k, v in lbls)
                 out.append(f"{name}{{{rendered}}} {value:.17g}")
+        # Reconcile latency as a HISTOGRAM (r6): the knee was inferred
+        # from throughput before; the tail buckets make it observable.
         out.append("# HELP tpujob_sync_duration_seconds Reconcile sync wall time.")
-        out.append("# TYPE tpujob_sync_duration_seconds summary")
+        out.append("# TYPE tpujob_sync_duration_seconds histogram")
+        cum = 0
+        for le, n in zip(self.SYNC_BUCKETS, buckets):
+            cum += n
+            out.append(f'tpujob_sync_duration_seconds_bucket{{le="{le:g}"}} {cum}')
+        out.append(f'tpujob_sync_duration_seconds_bucket{{le="+Inf"}} {s_count}')
         out.append(f"tpujob_sync_duration_seconds_sum {s_sum:.17g}")
         out.append(f"tpujob_sync_duration_seconds_count {s_count}")
 
@@ -104,7 +144,30 @@ class ControllerMetrics:
 
         if self.store is not None:
             out.extend(self._store_gauges())
+            out.extend(self._list_cost_counters())
         return "\n".join(out) + "\n"
+
+    def _list_cost_counters(self) -> List[str]:
+        """Store list-cost counters (Store.list_stats): scanned tracking
+        returned is the index doing its job; scanned diverging from
+        returned means some selector is falling back to a wide scan —
+        the exact regression the store-index tests pin."""
+        stats_fn = getattr(self.store, "list_stats", None)
+        if stats_fn is None:
+            return []
+        stats = stats_fn()
+        out = []
+        help_ = {
+            "calls": "Store.list calls served.",
+            "scanned": "Index candidates visited across all Store.list calls.",
+            "returned": "Objects returned across all Store.list calls.",
+        }
+        for k in ("calls", "scanned", "returned"):
+            name = f"tpujob_store_list_{k}_total"
+            out.append(f"# HELP {name} {help_[k]}")
+            out.append(f"# TYPE {name} counter")
+            out.append(f"{name} {stats[k]}")
+        return out
 
     def _store_gauges(self) -> List[str]:
         out: List[str] = []

@@ -255,10 +255,29 @@ class TPUJobController:
         self._workers.clear()
 
     def _resync_loop(self) -> None:
-        """Periodic full resync (ReconcilerSyncLoopPeriod, controller.go:63-78)."""
+        """Periodic resync (ReconcilerSyncLoopPeriod, controller.go:63-78).
+
+        Enqueues only jobs that still have work: non-terminal ones, plus
+        finished ones whose replica counters haven't drained to zero yet
+        (their children are still exiting/being GC'd and the CleanUp →
+        Done/Failed phase transition depends on observing that). A done,
+        drained job is pure noise to re-sync — at 500 live jobs the old
+        enqueue-everything pass made every resync O(population) syncs,
+        each a no-op costing child lists and status diffs."""
         while not self._stop.wait(self.resync_period):
-            for job in self.job_informer.list():
-                self.queue.add(job.key())
+            self.resync_once()
+
+    def resync_once(self) -> int:
+        """One resync pass; returns the number of jobs enqueued."""
+        n = 0
+        for job in self.job_informer.list():
+            if is_finished(job.status) and not any(
+                rs.active for rs in job.status.replica_statuses.values()
+            ):
+                continue
+            self.queue.add(job.key())
+            n += 1
+        return n
 
     def _worker_loop(self) -> None:
         while self.process_next_item():
@@ -1107,7 +1126,25 @@ class TPUJobController:
         controller_status.go:123-126) with optimistic retry. The
         last_reconcile_time heartbeat is excluded from the change check —
         stamping it every sync would otherwise make every write produce a
-        MODIFIED event that re-enqueues the job: a hot loop."""
+        MODIFIED event that re-enqueues the job: a hot loop.
+
+        Coalescing fast path: when the informer's cached copy already
+        matches the computed status (ignoring the heartbeat), skip the
+        store round-trip entirely — the mutate-returns-False path below
+        avoids the PUT but still pays a GET per sync (a network RTT in
+        --store-server mode, a lock acquisition locally), which at
+        hundreds of no-op resyncs per pass was pure overhead. Staleness
+        is safe: if the cache lags a store-side difference, the pending
+        MODIFIED event re-enqueues the job and the next sync writes."""
+        cached = self.job_informer.get(job.metadata.namespace, job.metadata.name)
+        if (
+            cached is not None
+            and _status_equal_ignoring_heartbeat(cached.status, job.status)
+            and _annotations_except_port(cached.metadata.annotations)
+            == _annotations_except_port(job.metadata.annotations)
+        ):
+            return
+
         def mutate(fresh):
             if (
                 _status_equal_ignoring_heartbeat(fresh.status, job.status)

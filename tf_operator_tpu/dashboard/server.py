@@ -322,23 +322,41 @@ class _Handler(BaseHTTPRequestHandler):
             # period writes a PING, so a silently-dead client (power loss,
             # no FIN) fails the write and this handler+watch get reaped
             # instead of leaking until the next real event.
-            while True:
+            # Batched delivery: drain everything already queued and write
+            # it as ONE buffered chunk with one flush — during a burst
+            # (gang create, resync) the per-event write+flush syscalls
+            # were the stream's dominant cost. The queue is also the
+            # watch's backpressure bound: draining it promptly keeps the
+            # store from closing this watch as overflowed.
+            import queue as _queue
+
+            stopped = False
+            while not stopped:
                 try:
                     ev = w.queue.get(timeout=self.watch_ping_interval)
                 except Exception:
                     self.wfile.write(b'{"type": "PING"}\n')
                     self.wfile.flush()
                     continue
-                if ev is None:
-                    break  # watch stopped
-                line = json.dumps(
-                    {"type": ev.type.value, "kind": ev.obj.kind, "object": to_doc(ev.obj)}
-                )
-                self.wfile.write(line.encode() + b"\n")
-                sent += 1
-                if sent == replay_n:
-                    self.wfile.write(b'{"type": "SYNCED"}\n')
-                self.wfile.flush()
+                chunk = bytearray()
+                while True:
+                    if ev is None:
+                        stopped = True  # watch stopped; send what we have
+                        break
+                    chunk += json.dumps(
+                        {"type": ev.type.value, "kind": ev.obj.kind, "object": to_doc(ev.obj)}
+                    ).encode()
+                    chunk += b"\n"
+                    sent += 1
+                    if sent == replay_n:
+                        chunk += b'{"type": "SYNCED"}\n'
+                    try:
+                        ev = w.queue.get_nowait()
+                    except _queue.Empty:
+                        break
+                if chunk:
+                    self.wfile.write(chunk)
+                    self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass  # client went away
         finally:
@@ -448,6 +466,38 @@ class _Handler(BaseHTTPRequestHandler):
         self._json(200, {"deleted": f"{ns}/{name}"})
 
 
+class _BoundedThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a bounded handler-thread count.
+
+    The stock server spawns one unbounded thread per connection — under a
+    submit burst (500 sequential creates, plus pollers, plus long-lived
+    watch streams) that is an unbounded thread population on the store's
+    lock. ``max_workers`` caps concurrently-served connections; the
+    accept loop blocks on the semaphore once saturated, which is
+    backpressure on clients (their connects queue in the listen backlog)
+    instead of memory/thread growth in the operator. Watch streams hold a
+    permit for their lifetime — size the bound above the expected agent
+    count (default 64 ≫ any tested topology)."""
+
+    def __init__(self, addr, handler, max_workers: int = 64):
+        self._permits = threading.BoundedSemaphore(max_workers)
+        super().__init__(addr, handler)
+
+    def process_request(self, request, client_address):
+        self._permits.acquire()
+        try:
+            super().process_request(request, client_address)
+        except BaseException:
+            self._permits.release()
+            raise
+
+    def process_request_thread(self, request, client_address):
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            self._permits.release()
+
+
 class DashboardServer:
     def __init__(
         self,
@@ -458,6 +508,7 @@ class DashboardServer:
         watch_ping_interval: float = 15.0,
         auth_token: Optional[str] = None,
         auth_reads: bool = False,
+        max_workers: int = 64,
     ) -> None:
         """``auth_token``: shared secret (utils.auth) required on mutating
         routes and the /api/v1 surface; None serves anonymously (tests,
@@ -489,7 +540,9 @@ class DashboardServer:
                 "_watch_closed": self._watch_closed,
             },
         )
-        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd = _BoundedThreadingHTTPServer(
+            (host, port), handler, max_workers=max_workers
+        )
         self._thread: Optional[threading.Thread] = None
 
     @property

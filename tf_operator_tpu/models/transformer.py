@@ -585,14 +585,34 @@ _REMAT_SAVE_SETS: Dict[str, tuple] = {
 }
 
 
+# Every checkpoint_name tag the model actually emits (flash q/k/v from
+# ops/flash_attention.FLASH_SAVE_NAMES + the layer-body tags above) —
+# the validation domain for user "save:" policies.
+KNOWN_SAVE_NAMES = frozenset(
+    {"flash_q", "flash_k", "flash_v", "resid_mid", "mlp_gate", "mlp_up"}
+)
+
+
 def remat_save_names(remat) -> Optional[tuple]:
     """The activation names a remat mode saves (None for non-name modes).
-    Accepts the _REMAT_SAVE_SETS aliases or ``"save:name1,name2"``."""
+    Accepts the _REMAT_SAVE_SETS aliases or ``"save:name1,name2"``.
+    Unknown names in a ``save:`` policy are rejected: a typo
+    (save:resid_mld) would otherwise save NOTHING and silently degrade
+    to full remat — the opposite of what the user asked for."""
     if isinstance(remat, str):
         if remat in _REMAT_SAVE_SETS:
             return _REMAT_SAVE_SETS[remat]
         if remat.startswith("save:"):
-            return tuple(n.strip() for n in remat[5:].split(",") if n.strip())
+            names = tuple(n.strip() for n in remat[5:].split(",") if n.strip())
+            unknown = sorted(set(names) - KNOWN_SAVE_NAMES)
+            if unknown:
+                raise ValueError(
+                    f"remat policy {remat!r}: unknown activation name(s) "
+                    f"{unknown} — no such checkpoint_name tag exists, so "
+                    "they would save nothing (silent full remat); known "
+                    f"names: {sorted(KNOWN_SAVE_NAMES)}"
+                )
+            return names
     return None
 
 

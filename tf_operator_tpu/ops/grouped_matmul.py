@@ -96,7 +96,15 @@ def _gmm_call(x, w, block_expert, block_rows, block_cols, interpret):
         raise ValueError(f"contraction mismatch: x k={k} vs w k={k2}")
     if R % block_rows:
         raise ValueError(f"rows {R} not divisible by block_rows {block_rows}")
-    bn = _auto_cols(n, k, 2) if block_cols is None else _pick_cols(n, block_cols)
+    # Budget on the INPUT's element size (not a hardcoded bf16 2): an f32
+    # x/w would otherwise get a [k, bn] weight tile 2x the 4 MB budget
+    # and fail VMEM-exceeded at compile (the dw path already budgets on
+    # its f32 accumulator's 4 bytes).
+    bn = (
+        _auto_cols(n, k, x.dtype.itemsize)
+        if block_cols is None
+        else _pick_cols(n, block_cols)
+    )
     nb = R // block_rows
 
     grid_spec = pltpu.PrefetchScalarGridSpec(

@@ -148,6 +148,11 @@ class LocalProcessControl(ProcessControl):
         controller instead of making them). No-op if this backend already
         tracks the key (watch replays deliver duplicates)."""
         if self._log_dir and self.LOG_ANNOTATION not in stored.metadata.annotations:
+            # ``stored`` may be a shared watch-event snapshot (read-only by
+            # the store's fanout contract): copy before annotating.
+            import copy as _copy
+
+            stored = _copy.deepcopy(stored)
             path = self._log_path(stored.metadata)
             stored.metadata.annotations[self.LOG_ANNOTATION] = path
             self._annotate_log_path(stored, path)

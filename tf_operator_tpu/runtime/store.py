@@ -8,6 +8,32 @@ CRUD calls. Snapshot isolation is by deepcopy on every boundary crossing —
 callers never share memory with the store, the same guarantee the apiserver's
 serialization boundary provides (and the reason the reference DeepCopies
 before mutating, controller.v2/controller.go:357-361).
+
+Scale model (r6): list/watch cost is proportional to the *selected* set,
+not the live population. Three indices back ``list``:
+
+- per kind (``list("Host")`` with 5 000 events in the store touches 0
+  events),
+- per (kind, namespace),
+- per (kind, indexed-label-key, value) for ``INDEXED_LABELS`` — the
+  job-name label, the one hot selector: the reconciler lists children by
+  job labels every sync (replicas.go:434-485 analogue), which was
+  O(all processes) per job and O(jobs²) per resync pass on a flat map.
+
+Objects the caller filters OUT are never deepcopied (they are never even
+visited when an index applies); ``list_stats()`` exposes scanned-vs-
+returned counters so the proportionality is observable (controller
+metrics render them as ``tpujob_store_list_*``).
+
+Watch fanout: one snapshot deepcopy per event, SHARED by every watch —
+the old per-watch deepcopy made each write O(watches × object size)
+inside the store lock. Consequence: **watch events are read-only**;
+a consumer that wants to mutate must copy (informers already deepcopy
+on cache reads; the agent copies before annotating). Per-watch queues
+are bounded: a consumer that stops draining has its watch closed with
+``overflowed=True`` (the k8s too-slow-watcher semantics) instead of
+growing memory without bound; informers re-subscribe and reconcile
+through the replay markers below.
 """
 
 from __future__ import annotations
@@ -21,6 +47,15 @@ import time
 import uuid
 from dataclasses import dataclass
 from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+# Label keys indexed by default (api.types.LABEL_JOB_NAME — not imported:
+# runtime sits below api in the layering).
+INDEXED_LABELS: Tuple[str, ...] = ("tpu_job_name",)
+
+# A watch whose consumer falls this many events behind is closed
+# (overflowed) rather than buffering forever. Far above any healthy
+# consumer's lag; a wedged consumer thread is the only thing that hits it.
+DEFAULT_WATCH_QUEUE_SIZE = 10_000
 
 
 class NotFoundError(KeyError):
@@ -48,11 +83,13 @@ class WatchEventType(str, enum.Enum):
     ADDED = "ADDED"
     MODIFIED = "MODIFIED"
     DELETED = "DELETED"
-    # Remote-watch control events (the in-process store never emits them):
-    # REPLAY_START opens each (re)connection's replay, SYNCED closes it —
-    # consumers reconcile local state against the replayed set on SYNCED,
-    # because deletions that happened while disconnected are never
-    # replayed (obj is None for both).
+    # Watch control events bracketing a replay of existing objects:
+    # REPLAY_START opens it, SYNCED closes it — consumers reconcile local
+    # state against the replayed set on SYNCED, because deletions that
+    # happened while disconnected (or while an overflowed local watch was
+    # closed) are never replayed (obj is None for both). RemoteWatch emits
+    # them on every (re)connect; the in-process store emits them for
+    # watches created with ``mark_replay=True``.
     REPLAY_START = "REPLAY_START"
     SYNCED = "SYNCED"
 
@@ -60,16 +97,29 @@ class WatchEventType(str, enum.Enum):
 @dataclass
 class WatchEvent:
     type: WatchEventType
-    obj: Any  # deepcopy of the stored object
+    obj: Any  # READ-ONLY snapshot, shared across watches — copy to mutate
 
 
 class Watch:
-    """A subscription to store changes. Iterate or poll ``queue``."""
+    """A subscription to store changes. Iterate or poll ``queue``.
 
-    def __init__(self, store: "Store", kinds: Optional[Tuple[str, ...]]):
+    ``overflowed`` is set when the store closed this watch because its
+    consumer fell more than ``maxsize`` events behind; the consumer must
+    re-subscribe (list+watch) to reconverge."""
+
+    def __init__(
+        self,
+        store: "Store",
+        kinds: Optional[Tuple[str, ...]],
+        maxsize: int = DEFAULT_WATCH_QUEUE_SIZE,
+    ):
         self._store = store
         self.kinds = kinds
+        # Bound enforced by the store at enqueue time (not queue.Queue's
+        # blocking maxsize: the sentinel must always be deliverable).
+        self.maxsize = maxsize
         self.queue: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        self.overflowed = False
         self._stopped = False
 
     def stop(self) -> None:
@@ -91,11 +141,74 @@ def _key(kind: str, namespace: str, name: str) -> Tuple[str, str, str]:
 
 
 class Store:
-    def __init__(self) -> None:
+    def __init__(
+        self, indexed_labels: Iterable[str] = INDEXED_LABELS
+    ) -> None:
         self._lock = threading.RLock()
         self._objects: Dict[Tuple[str, str, str], Any] = {}
         self._rv = itertools.count(1)
         self._watches: List[Watch] = []
+        # Indices (all guarded by _lock; values alias _objects entries —
+        # the stored objects are replaced, never mutated in place, so the
+        # aliasing is safe):
+        self._indexed_labels = tuple(indexed_labels)
+        self._by_kind: Dict[str, Dict[Tuple[str, str, str], Any]] = {}
+        self._by_kind_ns: Dict[Tuple[str, str], Dict[Tuple[str, str, str], Any]] = {}
+        # (kind, label_key, label_value) -> {key: obj}
+        self._by_label: Dict[Tuple[str, str, str], Dict[Tuple[str, str, str], Any]] = {}
+        # list-cost telemetry: candidates visited vs objects returned.
+        self._list_calls = 0
+        self._list_scanned = 0
+        self._list_returned = 0
+
+    # ---- index maintenance (callers hold _lock) -------------------------
+
+    def _label_buckets(self, obj: Any) -> List[Tuple[str, str, str]]:
+        labels = obj.metadata.labels or {}
+        return [
+            (obj.kind, lk, labels[lk])
+            for lk in self._indexed_labels
+            if lk in labels
+        ]
+
+    def _index_add(self, k: Tuple[str, str, str], obj: Any) -> None:
+        self._by_kind.setdefault(k[0], {})[k] = obj
+        self._by_kind_ns.setdefault((k[0], k[1]), {})[k] = obj
+        for b in self._label_buckets(obj):
+            self._by_label.setdefault(b, {})[k] = obj
+
+    def _index_remove(self, k: Tuple[str, str, str], obj: Any) -> None:
+        for table, tk in (
+            (self._by_kind, k[0]),
+            (self._by_kind_ns, (k[0], k[1])),
+        ):
+            bucket = table.get(tk)
+            if bucket is not None:
+                bucket.pop(k, None)
+                if not bucket:
+                    del table[tk]
+        for b in self._label_buckets(obj):
+            bucket = self._by_label.get(b)
+            if bucket is not None:
+                bucket.pop(k, None)
+                if not bucket:
+                    del self._by_label[b]
+
+    def _index_replace(self, k: Tuple[str, str, str], old: Any, new: Any) -> None:
+        # kind/ns buckets just swap the value; label buckets may move
+        # (an update can change labels).
+        self._by_kind[k[0]][k] = new
+        self._by_kind_ns[(k[0], k[1])][k] = new
+        old_b, new_b = self._label_buckets(old), self._label_buckets(new)
+        for b in old_b:
+            if b not in new_b:
+                bucket = self._by_label.get(b)
+                if bucket is not None:
+                    bucket.pop(k, None)
+                    if not bucket:
+                        del self._by_label[b]
+        for b in new_b:
+            self._by_label.setdefault(b, {})[k] = new
 
     # ---- CRUD ----------------------------------------------------------
 
@@ -111,6 +224,7 @@ class Store:
             stored.metadata.resource_version = next(self._rv)
             stored.metadata.creation_timestamp = time.time()
             self._objects[k] = stored
+            self._index_add(k, stored)
             out = copy.deepcopy(stored)
             self._notify(WatchEventType.ADDED, stored)
             return out
@@ -142,6 +256,7 @@ class Store:
             stored.metadata.creation_timestamp = current.metadata.creation_timestamp
             stored.metadata.resource_version = next(self._rv)
             self._objects[k] = stored
+            self._index_replace(k, current, stored)
             out = copy.deepcopy(stored)
             self._notify(WatchEventType.MODIFIED, stored)
             return out
@@ -165,6 +280,7 @@ class Store:
             if k not in self._objects:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
             stored = self._objects.pop(k)
+            self._index_remove(k, stored)
             stored.metadata.deletion_timestamp = time.time()
             out = copy.deepcopy(stored)
             self._notify(WatchEventType.DELETED, stored)
@@ -178,32 +294,80 @@ class Store:
     ) -> List[Any]:
         """List objects of ``kind``, optionally filtered by namespace and
         exact-match labels (the reference lists children by job labels,
-        replicas.go:434-485)."""
+        replicas.go:434-485). Served from the narrowest applicable index:
+        an indexed label selector key wins (its bucket is the selected
+        set), then (kind, namespace), then kind — never a scan of the
+        whole population, and never a deepcopy of a non-match."""
         with self._lock:
+            candidates = None
+            residual = dict(label_selector) if label_selector else None
+            if residual:
+                for lk in self._indexed_labels:
+                    if lk in residual:
+                        candidates = self._by_label.get(
+                            (kind, lk, residual.pop(lk)), {}
+                        )
+                        break
+            if candidates is None:
+                if namespace is not None:
+                    candidates = self._by_kind_ns.get((kind, namespace), {})
+                else:
+                    candidates = self._by_kind.get(kind, {})
             out = []
-            for (k_kind, k_ns, _), obj in self._objects.items():
-                if k_kind != kind:
-                    continue
+            self._list_calls += 1
+            self._list_scanned += len(candidates)
+            for (_, k_ns, _), obj in candidates.items():
                 if namespace is not None and k_ns != namespace:
                     continue
-                if label_selector and not _labels_match(obj.metadata.labels, label_selector):
+                if residual and not _labels_match(obj.metadata.labels, residual):
                     continue
                 out.append(copy.deepcopy(obj))
+            self._list_returned += len(out)
             out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
             return out
 
+    def list_stats(self) -> Dict[str, int]:
+        """Cumulative list-cost counters: calls, candidates scanned,
+        objects returned. scanned ≈ returned is the index working;
+        scanned ≫ returned is a selector no index covers."""
+        with self._lock:
+            return {
+                "calls": self._list_calls,
+                "scanned": self._list_scanned,
+                "returned": self._list_returned,
+            }
+
     # ---- watches -------------------------------------------------------
 
-    def watch(self, kinds: Optional[Iterable[str]] = None) -> Watch:
+    def watch(
+        self,
+        kinds: Optional[Iterable[str]] = None,
+        mark_replay: bool = False,
+        maxsize: int = DEFAULT_WATCH_QUEUE_SIZE,
+    ) -> Watch:
         """Subscribe to changes; ADDED events for existing objects are
-        replayed first (list+watch semantics, the informer's contract)."""
+        replayed first (list+watch semantics, the informer's contract).
+        With ``mark_replay`` the replay is bracketed by REPLAY_START /
+        SYNCED control events — the same framing RemoteWatch emits — so
+        replay-reconciling consumers work identically against both."""
         with self._lock:
-            w = Watch(self, tuple(kinds) if kinds else None)
-            for obj in self._objects.values():
-                if w.kinds is None or obj.kind in w.kinds:
-                    w.queue.put(WatchEvent(WatchEventType.ADDED, copy.deepcopy(obj)))
+            w = Watch(self, tuple(kinds) if kinds else None, maxsize=maxsize)
+            if mark_replay:
+                w.queue.put(WatchEvent(WatchEventType.REPLAY_START, None))
+            for obj in self._iter_kinds(w.kinds):
+                w.queue.put(WatchEvent(WatchEventType.ADDED, copy.deepcopy(obj)))
+            if mark_replay:
+                w.queue.put(WatchEvent(WatchEventType.SYNCED, None))
             self._watches.append(w)
             return w
+
+    def _iter_kinds(self, kinds: Optional[Tuple[str, ...]]):
+        if kinds is None:
+            return list(self._objects.values())
+        out = []
+        for kind in kinds:
+            out.extend(self._by_kind.get(kind, {}).values())
+        return out
 
     def _remove_watch(self, w: Watch) -> None:
         with self._lock:
@@ -211,9 +375,27 @@ class Store:
                 self._watches.remove(w)
 
     def _notify(self, etype: WatchEventType, stored: Any) -> None:
+        # One snapshot per event, shared by every interested watch (events
+        # are read-only by contract). Enqueue stays under the store lock —
+        # that is what guarantees every watch sees the same total order —
+        # but the per-watch work is a queue append, not a deepcopy.
+        ev = None
+        overflowed: List[Watch] = []
         for w in self._watches:
-            if w.kinds is None or stored.kind in w.kinds:
-                w.queue.put(WatchEvent(etype, copy.deepcopy(stored)))
+            if w.kinds is not None and stored.kind not in w.kinds:
+                continue
+            if ev is None:
+                ev = WatchEvent(etype, copy.deepcopy(stored))
+            if w.queue.qsize() >= w.maxsize:
+                w.overflowed = True
+                overflowed.append(w)
+                continue
+            w.queue.put(ev)
+        for w in overflowed:
+            # Too-slow consumer: close its watch (sentinel) instead of
+            # buffering unboundedly; it must re-list+watch to reconverge.
+            self._watches.remove(w)
+            w.queue.put(None)
 
 
 def _labels_match(labels: Dict[str, str], selector: Dict[str, str]) -> bool:

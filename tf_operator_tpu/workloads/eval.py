@@ -93,19 +93,51 @@ def _lm_scorer(wl):
                 "— without it the trainer holds out nothing and eval would "
                 "score trained-on windows)"
             )
+        # Disjointness is defined in the TRAINER's window geometry:
+        # holdout_windows counts windows of the trainer's seq_len
+        # (workloads/lm.py). Windowing the corpus with eval_seq_len here
+        # would move the tail boundary — with eval_seq_len > seq_len the
+        # "holdout" would span tokens the trainer trained on and score
+        # memorization as generalization (ADVICE r5 #1). So: carve the
+        # tail with the trainer's seq_len, then cut each reserved window
+        # into eval_seq_len pieces (requiring eval_seq_len <= seq_len —
+        # anything longer cannot fit inside the reserved region's
+        # geometry and is refused loudly).
+        train_seq = int(wl.get("seq_len", 512))
+        if seq > train_seq:
+            raise ValueError(
+                f"eval_seq_len={seq} > trainer seq_len={train_seq}: eval "
+                "windows would extend past the reserved holdout tail into "
+                "trained-on tokens; use eval_seq_len <= seq_len"
+            )
         ds = TokenMemmapDataset(
-            wl["corpus"], batch, seq, split="holdout", holdout=holdout,
+            wl["corpus"], 1, train_seq, split="holdout", holdout=holdout,
             shuffle=False, process_shard=False,
         )
-        if len(ds) < n_batches:
+        per_window = train_seq // seq
+        need_windows = -(-n_batches * batch // per_window)  # ceil
+        if len(ds) < need_windows:
             raise ValueError(
-                f"holdout_windows={holdout} yields {len(ds)} eval batches "
-                f"of {batch}; eval_batches={n_batches} asked for more"
+                f"holdout_windows={holdout} yields {len(ds)} reserved "
+                f"trainer windows = {len(ds) * per_window} eval windows of "
+                f"{seq}; eval_batches={n_batches} x batch={batch} needs "
+                f"{n_batches * batch}"
             )
         it = ds.epoch(0)
+        flat = []
+        while len(flat) < n_batches * batch:
+            w = next(it)["tokens"][0]  # one trainer-sized holdout window
+            flat.extend(
+                w[i * seq:(i + 1) * seq] for i in range(per_window)
+            )
+        import numpy as np
+
         eval_batches = [
-            jax.device_put(next(it)["tokens"], trainer.batch_sharding)
-            for _ in range(n_batches)
+            jax.device_put(
+                np.stack(flat[i * batch:(i + 1) * batch]),
+                trainer.batch_sharding,
+            )
+            for i in range(n_batches)
         ]
     else:
         # Synthetic fallback: a seed stream disjoint from the trainers'

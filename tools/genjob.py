@@ -1,4 +1,4 @@
-"""TPUJob load generator.
+"""TPUJob load generator + control-plane bench.
 
 Reference parity: hack/genjob/genjob.go — templated job generation for
 controller load/gang-scheduling experiments (``--nr-tfjobs``,
@@ -6,9 +6,20 @@ controller load/gang-scheduling experiments (``--nr-tfjobs``,
 so one command can put O(100) concurrent jobs on the operator (the
 reference's design scale target, tf_job_design_doc.md:24-26).
 
+``--bench`` (r6) is the control-plane scale oracle: for each level in
+``--bench-levels`` it deploys a FRESH operator daemon, submits that many
+concurrent no-op jobs over HTTP, waits for every job to reach a terminal
+state, scrapes /metrics for the reconcile-latency histogram, and emits a
+one-line JSON artifact (jobs/min + p50/p99 sync latency per level) —
+the checked-in ``artifacts/controlplane_r*.json`` format. Exit is
+nonzero if ANY job at ANY level fails or never finishes, which is what
+lets CI run a small level as a correctness gate.
+
 Usage:
     python -m tools.genjob --nr-jobs 20 --out-dir /tmp/jobs        # write specs
     python -m tools.genjob --nr-jobs 20 --submit --server http://… # submit
+    python -m tools.genjob --bench --bench-levels 50,200,500 \
+        --bench-out artifacts/controlplane_r6.json                 # bench
 """
 
 from __future__ import annotations
@@ -17,6 +28,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 from tf_operator_tpu.api.types import (
     ObjectMeta,
@@ -28,6 +40,12 @@ from tf_operator_tpu.api.types import (
     TopologySpec,
 )
 from tf_operator_tpu.api.types import _to_jsonable
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The r5 baseline this round's tentpole is measured against
+# (BASELINE.md "500 concurrent" row): 189.4 jobs/min, submit 60.8 s.
+R5_BASELINE_500 = 189.4
 
 
 def build_job(
@@ -56,6 +74,204 @@ def build_job(
     return TPUJob(metadata=ObjectMeta(name=name), spec=spec)
 
 
+def wait_for_terminal(client, jobs, timeout: float, t0: float) -> dict:
+    """Poll the job list until every submitted job is terminal (or the
+    deadline passes); returns the load report the --wait path prints.
+    One LIST per round (not a GET per job): polling must not load the
+    very server whose throughput is being measured, and one transient
+    HTTP error must not abort the test."""
+    terminal = {"Done", "Failed"}
+    pending = {j.metadata.name for j in jobs}
+    done: dict = {}
+    deadline = time.time() + timeout
+    while pending and time.time() < deadline:
+        try:
+            listed = client.list("default")
+        except Exception:
+            time.sleep(0.5)
+            continue
+        for j in listed:
+            name = j.metadata.name
+            if name in pending:
+                phase = j.status.phase().value
+                if phase in terminal:
+                    done[name] = phase
+                    pending.discard(name)
+        if pending:
+            time.sleep(0.5)
+    wall_s = time.perf_counter() - t0
+    succeeded = sum(1 for v in done.values() if v == "Done")
+    return {
+        "metric": "controller_jobs_per_min",
+        "value": round(len(done) / wall_s * 60.0, 1) if wall_s else 0.0,
+        "unit": "jobs/min",
+        "jobs": len(jobs),
+        "succeeded": succeeded,
+        "failed": len(done) - succeeded,
+        "unfinished": len(pending),
+        "wall_s": round(wall_s, 2),
+    }
+
+
+# ---- --bench: the control-plane scale oracle ----------------------------
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _histogram_quantile(buckets, total: int, q: float) -> float:
+    """Estimate a quantile (seconds) from cumulative Prometheus buckets
+    [(le_seconds, cumulative_count)] by linear interpolation within the
+    containing bucket — the standard histogram_quantile() estimate."""
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    prev_le, prev_cum = 0.0, 0
+    for le, cum in buckets:
+        if cum >= rank:
+            span = cum - prev_cum
+            frac = (rank - prev_cum) / span if span else 1.0
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_cum = le, cum
+    return prev_le  # rank beyond the last finite bucket: clamp
+
+
+def _scrape_sync_latency(server: str) -> dict:
+    """Read the reconcile-latency histogram from /metrics → p50/p99 ms."""
+    import re
+    import urllib.request
+
+    with urllib.request.urlopen(server + "/metrics", timeout=10) as resp:
+        text = resp.read().decode()
+    buckets = []
+    total = 0
+    for line in text.splitlines():
+        m = re.match(
+            r'tpujob_sync_duration_seconds_bucket\{le="([^"]+)"\} (\d+)', line
+        )
+        if m:
+            le = m.group(1)
+            if le != "+Inf":
+                buckets.append((float(le), int(m.group(2))))
+            continue
+        m = re.match(r"tpujob_sync_duration_seconds_count (\d+)", line)
+        if m:
+            total = int(m.group(1))
+    return {
+        "syncs": total,
+        "sync_p50_ms": round(_histogram_quantile(buckets, total, 0.5) * 1e3, 2),
+        "sync_p99_ms": round(_histogram_quantile(buckets, total, 0.99) * 1e3, 2),
+    }
+
+
+def _bench_level(n_jobs: int, args) -> dict:
+    """One bench level: fresh operator daemon → submit n_jobs no-op jobs
+    → wait terminal → scrape latency → tear down."""
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    from tf_operator_tpu.dashboard.client import TPUJobClient
+
+    port = _free_port()
+    server = f"http://127.0.0.1:{port}"
+    workdir = tempfile.mkdtemp(prefix=f"tpujob-bench-{n_jobs}-")
+    log_path = os.path.join(workdir, "operator.log")
+    cmd = [
+        sys.executable, "-m", "tf_operator_tpu.cli.operator",
+        "--port", str(port),
+        "--log-dir", os.path.join(workdir, "process-logs"),
+        "--backend", args.bench_backend,
+    ]
+    with open(log_path, "ab") as log:
+        operator = subprocess.Popen(
+            cmd, stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True, cwd=REPO_ROOT,
+        )
+    try:
+        deadline = time.time() + 30
+        while True:
+            try:
+                with urllib.request.urlopen(server + "/healthz", timeout=2):
+                    break
+            except OSError:
+                if operator.poll() is not None or time.time() > deadline:
+                    raise RuntimeError(
+                        f"operator never became healthy; see {log_path}"
+                    )
+                time.sleep(0.2)
+
+        jobs = [
+            build_job(
+                f"bench{n_jobs}-{i}", args.workers, args.steps,
+                "tf_operator_tpu.workloads.noop:main", args.topology, True,
+            )
+            for i in range(n_jobs)
+        ]
+        client = TPUJobClient(server)
+        t0 = time.perf_counter()
+        for job in jobs:
+            client.create(job)
+        submit_s = time.perf_counter() - t0
+        report = wait_for_terminal(client, jobs, args.timeout, t0)
+        latency = _scrape_sync_latency(server)
+        row = {
+            "jobs": n_jobs,
+            "jobs_per_min": report["value"],
+            "succeeded": report["succeeded"],
+            "failed": report["failed"],
+            "unfinished": report["unfinished"],
+            "submit_s": round(submit_s, 2),
+            "wall_s": report["wall_s"],
+            **latency,
+        }
+        print(json.dumps(row), flush=True)
+        return row
+    finally:
+        if operator.poll() is None:
+            operator.send_signal(signal.SIGTERM)
+            try:
+                operator.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                operator.kill()
+                operator.wait()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def run_bench(args) -> int:
+    levels = [int(s) for s in str(args.bench_levels).split(",") if s.strip()]
+    rows = [_bench_level(n, args) for n in levels]
+    artifact = {
+        "metric": "controlplane_bench",
+        "unit": "jobs/min",
+        "backend": args.bench_backend,
+        "workers_per_job": args.workers,
+        "payload": "tf_operator_tpu.workloads.noop:main",
+        "levels": rows,
+        "baseline_r5_jobs_per_min_500": R5_BASELINE_500,
+    }
+    line = json.dumps(artifact)
+    print(line)
+    if args.bench_out:
+        os.makedirs(os.path.dirname(args.bench_out) or ".", exist_ok=True)
+        with open(args.bench_out, "w") as f:
+            f.write(line + "\n")
+    # Correctness gate (the CI stage's contract): every job at every
+    # level must have Succeeded.
+    bad = [
+        r for r in rows
+        if r["failed"] or r["unfinished"] or r["succeeded"] != r["jobs"]
+    ]
+    return 1 if bad else 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpujob-genjob")
     p.add_argument("--nr-jobs", type=int, default=1)
@@ -77,7 +293,25 @@ def main(argv=None) -> int:
     p.add_argument("--timeout", type=float, default=900.0)
     p.add_argument("--cleanup", action="store_true",
                    help="delete the generated jobs after the report")
+    p.add_argument("--bench", action="store_true",
+                   help="self-contained control-plane bench: per level in "
+                        "--bench-levels, deploy a fresh operator, submit "
+                        "that many concurrent no-op jobs, report jobs/min "
+                        "+ p50/p99 sync latency as one JSON line; exit "
+                        "nonzero unless every job Succeeded")
+    p.add_argument("--bench-levels", default="50,200,500",
+                   help="comma-separated concurrent-job counts")
+    p.add_argument("--bench-out", default=None,
+                   help="also write the bench JSON line to this path "
+                        "(the artifacts/controlplane_r*.json format)")
+    p.add_argument("--bench-backend", choices=("native", "local"),
+                   default="native",
+                   help="process backend for the benched operator "
+                        "(native = C++ supervisor, the deploy default)")
     args = p.parse_args(argv)
+
+    if args.bench:
+        return run_bench(args)
 
     jobs = [
         build_job(
@@ -96,8 +330,6 @@ def main(argv=None) -> int:
         print(f"wrote {len(jobs)} specs to {args.out_dir}")
 
     if args.submit:
-        import time
-
         from tf_operator_tpu.dashboard.client import TPUJobClient
 
         client = TPUJobClient(args.server)
@@ -108,48 +340,16 @@ def main(argv=None) -> int:
         print(f"submitted {len(jobs)} jobs to {args.server} in {submit_s:.2f}s")
 
         if args.wait:
-            terminal = {"Done", "Failed"}
-            pending = {j.metadata.name for j in jobs}
-            done: dict = {}
-            deadline = time.time() + args.timeout
-            while pending and time.time() < deadline:
-                # One LIST per round (not a GET per job): polling must not
-                # load the very server whose throughput is being measured,
-                # and one transient HTTP error must not abort the test.
-                try:
-                    listed = client.list("default")
-                except Exception:
-                    time.sleep(0.5)
-                    continue
-                for j in listed:
-                    name = j.metadata.name
-                    if name in pending:
-                        phase = j.status.phase().value
-                        if phase in terminal:
-                            done[name] = phase
-                            pending.discard(name)
-                if pending:
-                    time.sleep(0.5)
-            wall_s = time.perf_counter() - t0
-            succeeded = sum(1 for v in done.values() if v == "Done")
-            print(json.dumps({
-                "metric": "controller_jobs_per_min",
-                "value": round(len(done) / wall_s * 60.0, 1),
-                "unit": "jobs/min",
-                "jobs": len(jobs),
-                "succeeded": succeeded,
-                "failed": len(done) - succeeded,
-                "unfinished": len(pending),
-                "submit_s": round(submit_s, 2),
-                "wall_s": round(wall_s, 2),
-            }))
+            report = wait_for_terminal(client, jobs, args.timeout, t0)
+            report["submit_s"] = round(submit_s, 2)
+            print(json.dumps(report))
             if args.cleanup:
                 for job in jobs:
                     try:
                         client.delete("default", job.metadata.name)
                     except Exception:
                         pass
-            if pending or succeeded != len(jobs):
+            if report["unfinished"] or report["succeeded"] != len(jobs):
                 return 1
     elif not args.out_dir:
         for job in jobs:

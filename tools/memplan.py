@@ -169,13 +169,17 @@ def plan(preset_name: str, mesh_axes: dict, batch: int, seq: int,
     # q, and r3's repeat-free attention keeps them that size end to end.
     kv = cfg.n_kv_heads * cfg.head_dim
     # Selective-remat name policies (r5): saved width per token per layer
-    # on TOP of the full-remat layer-input save. flash residuals store the
-    # kernel-layout q/k/v/o (bf16) plus the compact f32 lse (n_heads
-    # values/token → 4/dtype_bytes in dtype units).
+    # on TOP of the full-remat layer-input save, in dtype units. All of
+    # these activations shard over tp — q/k/v over heads, gate/up over
+    # d_ff — so every width divides by tp (r6: flash widths previously
+    # didn't, over-counting flash-saving plans at tp>1). The flash
+    # custom-vjp's own (o, lse) residuals are rebuilt in the backward
+    # regardless of the save set (FLASH_SAVE_NAMES note) and are NOT part
+    # of a name policy's saved bytes.
     from tf_operator_tpu.models.transformer import remat_save_names
 
     _name_width = {
-        "flash_q": d, "flash_k": kv, "flash_v": kv,
+        "flash_q": d // tp, "flash_k": kv // tp, "flash_v": kv // tp,
         "resid_mid": d, "mlp_gate": f // tp, "mlp_up": f // tp,
     }
     save_names = remat_save_names(cfg.remat)

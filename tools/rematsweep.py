@@ -171,9 +171,6 @@ def main(argv=None) -> int:
             row = run_cell(policy, batch, args.seq, args.steps, args.timeout)
             rows.append(row)
             print(json.dumps(row), flush=True)
-            # larger batches of the same policy only OOM harder
-            if row.get("status") == "OOM":
-                continue
     if args.out:
         with open(args.out, "w") as f:
             json.dump(rows, f, indent=1)
