@@ -1,0 +1,169 @@
+"""Controller telemetry satellites: Prometheus label-value escaping,
+sync-quantile decimation (no freeze at the sample cap), the lifecycle
+histograms, and the lock-narrowed EventRecorder."""
+
+import threading
+
+from tf_operator_tpu.controller.events import EventRecorder
+from tf_operator_tpu.controller.metrics import ControllerMetrics, _escape_label_value
+from tf_operator_tpu.runtime import Store
+from tf_operator_tpu.runtime.store import AlreadyExistsError
+
+
+class _Involved:
+    kind = "TPUJob"
+
+    class metadata:  # noqa: N801 — duck-typed ObjectMeta subset
+        name = "job-a"
+        namespace = "default"
+
+
+# ---- label-value escaping (exposition text-format spec) ------------------
+
+
+def test_escape_label_value_spec():
+    assert _escape_label_value('say "hi"') == 'say \\"hi\\"'
+    assert _escape_label_value("a\\b") == "a\\\\b"
+    assert _escape_label_value("line1\nline2") == "line1\\nline2"
+
+
+def test_render_escapes_labeled_counter_values():
+    m = ControllerMetrics()
+    m.inc(
+        "tpujob_gang_restarts_by_cause_total",
+        labels={"cause": 'exit "137"\nbackslash \\ end'},
+    )
+    text = m.render()
+    line = next(
+        ln for ln in text.splitlines()
+        if ln.startswith("tpujob_gang_restarts_by_cause_total{")
+    )
+    assert '\\"137\\"' in line
+    assert "\\n" in line and "\n" not in line[:-1].replace("\\n", "")
+    assert "\\\\" in line
+    # still exactly one physical exposition line
+    assert line.count('cause="') == 1
+
+
+# ---- sync-quantile decimation (no freeze at the cap) ---------------------
+
+
+def test_sync_quantiles_track_whole_run_past_sample_cap():
+    m = ControllerMetrics()
+    m.MAX_SYNC_SAMPLES = 100  # instance override; keeps the test fast
+    # Phase 1: fast syncs fill the reservoir.
+    for _ in range(100):
+        m.observe_sync(0.001, error=False)
+    # The old behavior froze here: every later observation was dropped.
+    # Phase 2: the run degrades 100x for 4x as many syncs.
+    for _ in range(400):
+        m.observe_sync(0.1, error=False)
+    q = m.sync_latency_quantiles((0.5, 0.99))
+    assert q[0.5] == 0.1, "median must follow the degraded phase"
+    assert q[0.99] == 0.1
+    # memory stays bounded and the kept set covers both phases
+    assert len(m._sync_samples) <= m.MAX_SYNC_SAMPLES
+    assert min(m._sync_samples) == 0.001
+
+
+def test_sync_quantile_decimation_is_deterministic():
+    def run():
+        m = ControllerMetrics()
+        m.MAX_SYNC_SAMPLES = 64
+        for i in range(1000):
+            m.observe_sync(i / 1000.0, error=False)
+        return list(m._sync_samples)
+
+    assert run() == run()
+
+
+# ---- lifecycle histograms -----------------------------------------------
+
+
+def test_observe_hist_renders_per_label_series():
+    m = ControllerMetrics()
+    m.observe_hist("tpujob_restart_downtime_seconds", 3.0, labels={"cause": "preemption"})
+    m.observe_hist("tpujob_restart_downtime_seconds", 0.2, labels={"cause": "preemption"})
+    m.observe_hist(
+        "tpujob_restart_downtime_seconds", 7.0, labels={"cause": "node-lost"}
+    )
+    m.observe_hist("tpujob_time_to_first_step_seconds", 1.2)
+    text = m.render()
+    assert "# TYPE tpujob_restart_downtime_seconds histogram" in text
+    assert 'tpujob_restart_downtime_seconds_bucket{cause="preemption",le="+Inf"} 2' in text
+    assert 'tpujob_restart_downtime_seconds_bucket{cause="node-lost",le="+Inf"} 1' in text
+    assert 'tpujob_restart_downtime_seconds_count{cause="preemption"} 2' in text
+    # unlabeled family renders bare-suffix series
+    assert 'tpujob_time_to_first_step_seconds_bucket{le="+Inf"} 1' in text
+    assert "tpujob_time_to_first_step_seconds_count 1" in text
+    # cumulative buckets are monotone
+    cums = [
+        int(ln.rsplit(" ", 1)[1])
+        for ln in text.splitlines()
+        if ln.startswith('tpujob_restart_downtime_seconds_bucket{cause="preemption"')
+    ]
+    assert cums == sorted(cums)
+
+
+# ---- EventRecorder: aggregation, onset anchor, no global lock ------------
+
+
+def test_event_aggregation_keeps_first_timestamp():
+    store = Store()
+    rec = EventRecorder(store)
+    rec.normal(_Involved, "TPUJobCreated", "first")
+    first = store.get("Event", "default", "job-a.tpujobcreated")
+    assert first.count == 1
+    assert first.first_timestamp > 0
+    assert first.first_timestamp == first.timestamp
+    rec.normal(_Involved, "TPUJobCreated", "again")
+    again = store.get("Event", "default", "job-a.tpujobcreated")
+    assert again.count == 2
+    assert again.message == "again"
+    # aggregation refreshes timestamp but the onset anchor is immutable
+    assert again.first_timestamp == first.first_timestamp
+    assert again.timestamp >= first.timestamp
+
+
+def test_event_create_race_falls_into_update_path():
+    """Two recorders racing the first occurrence: the loser's create hits
+    AlreadyExists and must fold into the winner's count — no lock, no
+    lost event, no crash."""
+    store = Store()
+    rec = EventRecorder(store)
+    real_create = store.create
+    state = {"raced": False}
+
+    def racing_create(obj):
+        if not state["raced"] and obj.kind == "Event":
+            state["raced"] = True
+            real_create(obj)  # the "other" recorder wins the race
+            raise AlreadyExistsError(obj.metadata.name)
+        return real_create(obj)
+
+    store.create = racing_create
+    rec.normal(_Involved, "TPUJobRunning", "msg")
+    ev = store.get("Event", "default", "job-a.tpujobrunning")
+    assert ev.count == 2  # winner's create + loser folded in
+
+
+def test_event_recorder_concurrent_emission():
+    """The recorder no longer serializes emission behind one global lock:
+    concurrent emitters on distinct reasons make progress and every
+    occurrence is accounted for."""
+    store = Store()
+    rec = EventRecorder(store)
+    n_threads, n_each = 8, 25
+
+    def emit(i):
+        for _ in range(n_each):
+            rec.normal(_Involved, f"Reason{i % 4}", f"from {i}")
+
+    threads = [threading.Thread(target=emit, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events = store.list("Event")
+    assert sum(e.count for e in events) == n_threads * n_each
+    assert len(events) == 4

@@ -1,0 +1,404 @@
+"""End-to-end job lifecycle tracing (obs/): span recording across the
+reconciler/scheduler/agent/trainer, trace-context propagation, ordering
+and parenting invariants, the Chrome trace-event export, and the derived
+TTFS / restart-downtime metrics."""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from tf_operator_tpu.api.types import (
+    API_GROUP,
+    KIND_SPAN,
+    LABEL_GROUP,
+    LABEL_JOB_NAME,
+    ConditionType,
+    ObjectMeta,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    TopologySpec,
+    TPUJob,
+    TPUJobSpec,
+)
+from tf_operator_tpu.controller import TPUJobController
+from tf_operator_tpu.controller.status import has_condition
+from tf_operator_tpu.obs.export import derive_timings, to_chrome_trace
+from tf_operator_tpu.obs.spans import (
+    COMPONENT_TRAINER,
+    Span,
+    SpanRecorder,
+    first_step_span_name,
+    job_trace,
+    span_labels,
+)
+from tf_operator_tpu.rendezvous.context import JobContext
+from tf_operator_tpu.rendezvous.env import ENV_API_SERVER, ENV_TRACE_ID
+from tf_operator_tpu.runtime import FakeProcessControl, Store
+from tf_operator_tpu.runtime.objects import (
+    Process,
+    ProcessPhase,
+    ProcessSpec,
+    ProcessStatus,
+)
+
+
+def make_job(name="traced", workers=2, **run_policy_kwargs):
+    job = TPUJob(
+        metadata=ObjectMeta(name=name, uid=f"uid-{name}"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=workers, template=ProcessTemplate(entrypoint="wl.m:f")
+                )
+            },
+            topology=TopologySpec(num_hosts=1, chips_per_host=4),
+        ),
+    )
+    for k, v in run_policy_kwargs.items():
+        setattr(job.spec.run_policy, k, v)
+    return job
+
+
+def make_process(job, index, phase, exit_code=None):
+    name = f"{job.metadata.name}-worker-{index}"
+    return Process(
+        metadata=ObjectMeta(
+            name=name,
+            namespace=job.metadata.namespace,
+            labels={LABEL_GROUP: API_GROUP, LABEL_JOB_NAME: job.metadata.name},
+            owner_uid=job.metadata.uid,
+            owner_kind="TPUJob",
+            owner_name=job.metadata.name,
+        ),
+        spec=ProcessSpec(
+            job_name=job.metadata.name, replica_type="Worker", replica_index=index
+        ),
+        status=ProcessStatus(phase=phase, exit_code=exit_code),
+    )
+
+
+class Harness:
+    def __init__(self, job, processes=()):
+        self.store = Store()
+        self.fake = FakeProcessControl()
+        self.ctl = TPUJobController(self.store, self.fake, port_allocator=lambda: 12345)
+        self.job = self.store.create(job)
+        for p in processes:
+            self.store.create(p)
+        self.reseed()
+
+    def reseed(self, processes=None):
+        self.ctl.job_informer.seed([self.stored_job()])
+        self.ctl.process_informer.seed(
+            self.store.list("Process") if processes is None else processes
+        )
+
+    def set_processes(self, processes):
+        """Replace the store's Process population (simulating the watch
+        having observed deletions + recreations) and clear expectations.
+        seed() only adds, so stale cache entries are evicted first."""
+        for p in self.store.list("Process"):
+            self.store.delete("Process", p.metadata.namespace, p.metadata.name)
+        for key in list(self.ctl.process_informer._cache):
+            self.ctl.process_informer._cache_pop(key)
+        for p in processes:
+            self.store.create(p)
+        self.ctl.expectations.delete_expectations(
+            self.ctl._exp_key(self.job.key())
+        )
+        self.reseed()
+
+    def sync(self):
+        self.ctl.sync_job(self.job.key())
+
+    def stored_job(self):
+        return self.store.get("TPUJob", "default", self.job.metadata.name)
+
+    def spans(self):
+        return job_trace(self.store, "default", self.job.metadata.name)
+
+    def span(self, op):
+        got = [s for s in self.spans() if s.op == op]
+        return got[0] if got else None
+
+
+# ---- trace-context propagation ------------------------------------------
+
+
+def test_trace_env_propagated_to_gang():
+    h = Harness(make_job())
+    h.sync()
+    assert h.fake.created, "gang not created"
+    for p in h.fake.created:
+        assert p.spec.env[ENV_TRACE_ID] == h.job.metadata.uid
+
+
+def test_trace_env_stable_across_gang_restart():
+    job = make_job()
+    h = Harness(
+        job,
+        [
+            make_process(job, 0, ProcessPhase.FAILED, exit_code=137),
+            make_process(job, 1, ProcessPhase.RUNNING),
+        ],
+    )
+    h.sync()  # gang restart: both deleted
+    assert has_condition(h.stored_job().status, ConditionType.RESTARTING)
+    # watch observed the deletions; recreate on the next sync
+    h.set_processes([])
+    h.sync()
+    recreated = [p for p in h.fake.created]
+    assert len(recreated) == 2
+    for p in recreated:
+        # same trace id: the timeline spans the job, not one incarnation
+        assert p.spec.env[ENV_TRACE_ID] == h.job.metadata.uid
+
+
+# ---- lifecycle spans: ordering + parenting invariants --------------------
+
+
+def run_job_to_completion(h):
+    """Drive submit -> scheduled -> running -> first-step -> succeeded."""
+    h.sync()  # creates gang; admission + scheduled spans
+    procs = [
+        make_process(h.job, 0, ProcessPhase.RUNNING),
+        make_process(h.job, 1, ProcessPhase.RUNNING),
+    ]
+    h.set_processes(procs)
+    h.sync()  # RUNNING condition + running mark
+    # the workload reports its first step through the store seam
+    now = time.time()
+    SpanRecorder(h.store, component=COMPONENT_TRAINER).record(
+        "default", h.job.metadata.name, h.job.metadata.uid,
+        "first-step", now, now,
+        name=first_step_span_name(h.job.metadata.name, h.job.metadata.uid),
+    )
+    done = [
+        make_process(h.job, 0, ProcessPhase.SUCCEEDED, exit_code=0),
+        make_process(h.job, 1, ProcessPhase.SUCCEEDED, exit_code=0),
+    ]
+    h.set_processes(done)
+    h.sync()  # chief succeeded -> _finish -> root span + TTFS
+
+
+def test_span_ordering_and_parenting_invariants():
+    h = Harness(make_job())
+    run_job_to_completion(h)
+    spans = h.spans()
+    uid = h.job.metadata.uid
+    assert all(s.trace_id == uid for s in spans)
+
+    admission = h.span("admission")
+    scheduled = h.span("scheduled")
+    first_step = h.span("first-step")
+    running = h.span("running")
+    root = h.span("job")
+    assert None not in (admission, scheduled, first_step, running, root)
+
+    submit = h.stored_job().metadata.creation_timestamp
+    # submit <= scheduled <= running <= first-step-report <= terminal
+    assert admission.start_time == submit == root.start_time == scheduled.start_time
+    assert submit <= scheduled.end_time <= running.start_time
+    assert running.start_time <= first_step.start_time <= root.end_time
+    assert root.attrs["phase"] == "Succeeded"
+
+    # parenting: the root's span id IS the trace id; everything else
+    # nests under it.
+    assert root.span_id == uid and root.parent_id == ""
+    for s in spans:
+        if s.op != "job":
+            assert s.parent_id == uid, f"{s.op} not parented to the root"
+
+    # derived timings agree with the span boundaries
+    timings = derive_timings(spans, submit_ts=submit)
+    assert timings["time_to_scheduled_s"] == pytest.approx(
+        scheduled.end_time - submit
+    )
+    assert timings["time_to_first_step_s"] == pytest.approx(
+        first_step.start_time - submit
+    )
+
+
+def test_ttfs_and_scheduled_histograms_observed():
+    h = Harness(make_job())
+    run_job_to_completion(h)
+    text = h.ctl.metrics.render()
+    assert "tpujob_time_to_scheduled_seconds_count 1" in text
+    assert "tpujob_time_to_first_step_seconds_count 1" in text
+
+
+def test_restart_span_opens_closes_and_feeds_downtime_metric():
+    job = make_job()
+    h = Harness(
+        job,
+        [
+            make_process(job, 0, ProcessPhase.FAILED, exit_code=137),
+            make_process(job, 1, ProcessPhase.RUNNING),
+        ],
+    )
+    h.sync()  # restart decision: span opens
+    restart = h.span("restart")
+    assert restart is not None
+    assert restart.end_time == 0.0  # open: the gang is down
+    assert restart.attrs["cause"] == "retryable-failure"
+    assert restart.parent_id == job.metadata.uid  # nests under the trace
+
+    h.set_processes(
+        [
+            make_process(job, 0, ProcessPhase.RUNNING),
+            make_process(job, 1, ProcessPhase.RUNNING),
+        ]
+    )
+    h.sync()  # gang back up: RUNNING re-set closes the restart span
+    restart = h.span("restart")
+    assert restart.end_time >= restart.start_time > 0
+    text = h.ctl.metrics.render()
+    assert 'tpujob_restart_downtime_seconds_bucket{cause="retryable-failure",le="+Inf"} 1' in text
+    assert "tpujob_restart_downtime_seconds_count" in text
+
+
+def test_spans_survive_completion_but_not_deletion():
+    h = Harness(make_job())
+    run_job_to_completion(h)
+    assert h.spans(), "completed job must keep its trace"
+    # deletion: cascade GC includes the trace
+    h.store.delete("TPUJob", "default", h.job.metadata.name)
+    h.ctl.job_informer._cache.clear()
+    h.sync()
+    assert h.spans() == []
+
+
+# ---- Chrome trace export -------------------------------------------------
+
+
+def _mkspan(name, op, component, start, end, trace="t-1", attrs=None):
+    return Span(
+        metadata=ObjectMeta(name=name, labels=span_labels("j")),
+        trace_id=trace, span_id=name, parent_id=trace, op=op,
+        component=component, start_time=start, end_time=end,
+        attrs=dict(attrs or {}),
+    )
+
+
+def test_to_chrome_trace_event_shapes():
+    spans = [
+        _mkspan("a", "scheduled", "controller", 100.0, 101.5),
+        _mkspan("b", "first-step", "trainer", 103.0, 103.0),  # instant
+        _mkspan("c", "restart", "controller", 104.0, 0.0),  # open
+    ]
+    doc = to_chrome_trace(spans)
+    events = {
+        (e["ph"], e["name"]): e for e in doc["traceEvents"] if e["ph"] != "M"
+    }
+    x = events[("X", "scheduled")]
+    assert x["dur"] == pytest.approx(1.5e6)
+    assert x["ts"] == pytest.approx(0.0)  # t0 anchored at earliest span
+    inst = events[("i", "first-step")]
+    assert inst["s"] == "p" and "dur" not in inst
+    open_ev = events[("X", "restart")]
+    assert open_ev["dur"] == 0 and open_ev["args"]["open"] == "true"
+    # one process_name metadata event per component
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M" and e["name"] == "process_name"]
+    assert {e["args"]["name"] for e in meta} == {"controller", "trainer"}
+
+
+def test_trace_endpoint_serves_golden_chrome_schema():
+    from tf_operator_tpu.dashboard import DashboardServer
+    from tools.trace_smoke import validate_chrome_trace
+
+    h = Harness(make_job(name="served"))
+    run_job_to_completion(h)
+    srv = DashboardServer(h.store, port=0)
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+            srv.url + "/api/tpujob/default/served/trace", timeout=10
+        ) as resp:
+            assert resp.headers["Content-Type"] == "application/json"
+            doc = json.loads(resp.read())
+        assert validate_chrome_trace(doc) == []
+        other = doc["otherData"]
+        assert other["trace_id"] == h.job.metadata.uid
+        assert other["job"] == "default/served"
+        assert other["time_to_first_step_s"] >= 0
+        assert other["time_to_scheduled_s"] >= 0
+        # spans from the controller at minimum; missing job -> 404
+        assert "controller" in other["components"]
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                srv.url + "/api/tpujob/default/absent/trace", timeout=10
+            )
+        assert exc.value.code == 404
+    finally:
+        srv.stop()
+
+
+# ---- the API seam: trainer-side recording --------------------------------
+
+
+def test_jobcontext_marks_first_step_through_the_api(monkeypatch):
+    from tf_operator_tpu.dashboard import DashboardServer
+
+    store = Store()
+    srv = DashboardServer(store, port=0)
+    srv.start()
+    try:
+        monkeypatch.setenv(ENV_API_SERVER, srv.url)
+        ctx = JobContext(
+            job_name="apijob", namespace="default", trace_id="uid-apijob",
+            process_id=1,
+        )
+        assert ctx.mark_first_step(5) is True
+        spans = job_trace(store, "default", "apijob")
+        assert [s.op for s in spans] == ["first-step"]
+        assert spans[0].component == COMPONENT_TRAINER
+        assert spans[0].attrs["step"] == "5"
+        # gang-wide dedupe: a second rank's mark is a no-op
+        assert ctx.mark_first_step(5) is False
+        assert len(job_trace(store, "default", "apijob")) == 1
+    finally:
+        srv.stop()
+
+
+def test_jobcontext_recording_is_noop_without_trace_context(monkeypatch):
+    monkeypatch.delenv(ENV_API_SERVER, raising=False)
+    ctx = JobContext(job_name="j", trace_id="t")
+    assert ctx.mark_first_step() is False  # no API server: silently skipped
+
+
+# ---- agent/backend spans -------------------------------------------------
+
+
+@pytest.mark.skipif(os.name != "posix", reason="needs /bin sh tools")
+def test_backend_records_spawn_to_exit_span():
+    from tf_operator_tpu.runtime.process_backend import LocalProcessControl
+
+    store = Store()
+    backend = LocalProcessControl(store, command_builder=lambda p: ["true"])
+    proc = Process(
+        metadata=ObjectMeta(name="t-worker-0", labels={LABEL_JOB_NAME: "t"}),
+        spec=ProcessSpec(
+            job_name="t", replica_type="Worker", replica_index=0,
+            env={ENV_TRACE_ID: "uid-t"},
+        ),
+    )
+    backend.create_process(proc)
+    deadline = time.time() + 10
+    spans = []
+    while time.time() < deadline:
+        spans = store.list(KIND_SPAN, label_selector={LABEL_JOB_NAME: "t"})
+        if spans:
+            break
+        time.sleep(0.05)
+    backend.shutdown()
+    assert len(spans) == 1
+    s = spans[0]
+    assert s.op == "process" and s.component == "agent"
+    assert s.trace_id == "uid-t"
+    assert s.attrs["exit_code"] == "0"
+    assert s.attrs["exit_class"] == "Succeeded"
+    assert s.end_time >= s.start_time > 0

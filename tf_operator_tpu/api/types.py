@@ -42,6 +42,7 @@ KIND_ENDPOINT = "Endpoint"
 KIND_EVENT = "Event"
 KIND_HOST = "Host"
 KIND_LEASE = "Lease"
+KIND_SPAN = "Span"
 
 # Default port the coordinator's jax.distributed service listens on
 # (replaces the reference's TF gRPC port 2222, v1alpha1/types.go:30).

@@ -20,6 +20,13 @@ the recovery invariants the whole subsystem exists to guarantee:
    ``backoff_limit``.
 5. **Reproducibility** — the applied fault sequence matches the schedule,
    and the schedule is a pure function of the seed.
+6. **Bounded recovery downtime, from the trace** — every preemption
+   restart span in the job's timeline (obs/: opened when the controller
+   tears the gang down, closed when the recreated gang reports RUNNING)
+   is closed, and its width — the measured gang downtime — stays under
+   ``downtime_bound_s``. Previously recovery latency could only be
+   inferred indirectly; now it is read off the same trace ``tpujob
+   trace`` exports.
 
 Runnable standalone (the CI ``chaos-soak`` stage)::
 
@@ -55,6 +62,8 @@ from tf_operator_tpu.chaos.faults import FaultSchedule
 from tf_operator_tpu.chaos.injector import ChaosInjector
 from tf_operator_tpu.controller import TPUJobController
 from tf_operator_tpu.controller.status import has_condition, is_finished
+from tf_operator_tpu.obs.export import derive_timings
+from tf_operator_tpu.obs.spans import job_trace
 from tf_operator_tpu.rendezvous.env import ENV_RESUME_STEP
 from tf_operator_tpu.runtime import (
     FakeProcessControl,
@@ -100,6 +109,11 @@ class SoakResult:
     partial_gang_violations: List[str] = field(default_factory=list)
     applied: List[dict] = field(default_factory=list)
     schedule: Optional[FaultSchedule] = None
+    # Trace-derived restart windows (obs.export.derive_timings "restarts"
+    # rows: cause / start / end / downtime_s) and the bound invariant 6
+    # checks them against.
+    restart_windows: List[dict] = field(default_factory=list)
+    downtime_bound_s: float = 60.0
 
     def check(self) -> List[str]:
         """Invariant failures, empty when the soak passed."""
@@ -124,6 +138,29 @@ class SoakResult:
             self.preemption_count < 1
         ):
             errs.append("preemption applied but preemption_count is 0")
+        # Invariant 6: recovery downtime measured FROM THE TRACE. Every
+        # preemption restart span must have closed (the gang came back
+        # RUNNING) within the bound.
+        preempt_windows = [
+            w for w in self.restart_windows if w.get("cause") == "preemption"
+        ]
+        if any(a["kind"] == "preempt" for a in self.applied):
+            if not preempt_windows:
+                errs.append(
+                    "preemption applied but the trace has no preemption "
+                    f"restart span (windows: {self.restart_windows})"
+                )
+        for w in preempt_windows:
+            if w.get("downtime_s") is None:
+                errs.append(
+                    f"preemption restart span never closed (gang did not "
+                    f"return to RUNNING): {w}"
+                )
+            elif w["downtime_s"] > self.downtime_bound_s:
+                errs.append(
+                    f"preemption recovery downtime {w['downtime_s']:.1f}s "
+                    f"exceeds bound {self.downtime_bound_s:.0f}s: {w}"
+                )
         return errs
 
 
@@ -272,6 +309,7 @@ def run_soak(
     heartbeat_ttl: float = 3.0,
     data_plane: str = "light",
     step_sleep_s: float = 1.0,
+    downtime_bound_s: float = 60.0,
 ) -> SoakResult:
     """Run one seeded soak; returns the observations (see SoakResult.check).
 
@@ -343,6 +381,12 @@ def run_soak(
     result.resume_steps = list(watcher.resume_steps)
     result.partial_gang_violations = list(watcher.violations)
     result.applied = list(injector.applied)
+    # Invariant 6 input: restart windows read off the job's trace — the
+    # same spans `tpujob trace` exports, not log inference.
+    result.downtime_bound_s = downtime_bound_s
+    result.restart_windows = derive_timings(
+        job_trace(store, "default", job_name)
+    ).get("restarts", [])
     if fake.created:
         result.partial_gang_violations.append(
             "controller launched through its own backend in managed mode: "
@@ -371,6 +415,10 @@ def main(argv=None) -> int:
     p.add_argument("--step-sleep", type=float, default=1.0,
                    help="light data plane: seconds per step (the fault "
                         "landing window)")
+    p.add_argument("--downtime-bound", type=float, default=60.0,
+                   help="max allowed preemption recovery downtime "
+                        "(seconds), asserted from the trace's restart "
+                        "spans (invariant 6)")
     args = p.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
@@ -383,13 +431,18 @@ def main(argv=None) -> int:
         checkpoint_every=args.checkpoint_every,
         backoff_limit=args.backoff_limit, timeout=args.timeout,
         workdir=args.workdir, data_plane=args.data_plane,
-        step_sleep_s=args.step_sleep,
+        step_sleep_s=args.step_sleep, downtime_bound_s=args.downtime_bound,
     )
+    downtimes = [
+        round(w["downtime_s"], 2) if w.get("downtime_s") is not None else None
+        for w in result.restart_windows
+    ]
     print(
         f"soak seed={args.seed}: succeeded={result.succeeded} "
         f"restarts={result.restart_count} preemptions={result.preemption_count} "
         f"last_cause={result.last_restart_cause!r} "
-        f"resume_steps={result.resume_steps} applied={result.applied}"
+        f"resume_steps={result.resume_steps} applied={result.applied} "
+        f"trace_downtimes_s={downtimes}"
     )
     errors = result.check()
     for e in errors:

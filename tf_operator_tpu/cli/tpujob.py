@@ -50,6 +50,15 @@ def build_parser() -> argparse.ArgumentParser:
     lp = sub.add_parser("logs", help="fetch a process's logs")
     lp.add_argument("namespace")
     lp.add_argument("process_name")
+    tp = sub.add_parser(
+        "trace",
+        help="export a job's lifecycle trace as Chrome trace-event JSON "
+             "(load it in Perfetto / chrome://tracing)",
+    )
+    tp.add_argument("namespace_or_name",
+                    help="namespace (with NAME following) or, alone, a "
+                         "job name in the default namespace")
+    tp.add_argument("name", nargs="?", default=None)
     ep = sub.add_parser("events")
     ep.add_argument("--namespace", default=None)
     return p
@@ -98,6 +107,14 @@ def main(argv=None) -> int:
             return 0 if phase == "Done" else 3
         elif args.cmd == "logs":
             sys.stdout.write(client.logs(args.namespace, args.process_name))
+        elif args.cmd == "trace":
+            # `tpujob trace <job>` assumes the default namespace;
+            # `tpujob trace <ns> <job>` is explicit.
+            if args.name is None:
+                ns, name = "default", args.namespace_or_name
+            else:
+                ns, name = args.namespace_or_name, args.name
+            print(json.dumps(client.trace(ns, name), indent=2))
         elif args.cmd == "events":
             for e in client.events(args.namespace):
                 print(f"{e['type']:<8} {e['reason']:<28} x{e['count']:<4} {e['message']}")

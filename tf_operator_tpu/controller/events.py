@@ -10,12 +10,11 @@ API, and repeats aggregate into a count like k8s event compaction.
 
 from __future__ import annotations
 
-import threading
 import time
 
 from tf_operator_tpu.api.types import ObjectMeta
 from tf_operator_tpu.runtime.objects import Event, EventType
-from tf_operator_tpu.runtime.store import NotFoundError, Store
+from tf_operator_tpu.runtime.store import AlreadyExistsError, Store
 
 # Stable event reasons (reference: SuccessfulCreate/FailedCreate etc.).
 REASON_SUCCESSFUL_CREATE = "SuccessfulCreateProcess"
@@ -39,8 +38,6 @@ class EventRecorder:
     def __init__(self, store: Store, component: str = "tpujob-controller") -> None:
         self._store = store
         self._component = component
-        self._lock = threading.Lock()
-        self._seq = 0
 
     def event(
         self,
@@ -49,31 +46,47 @@ class EventRecorder:
         reason: str,
         message: str,
     ) -> None:
+        """Record one occurrence; repeats aggregate into count++ on the
+        same (object, reason) Event.
+
+        Lock-free by design: the old recorder held ONE process-wide lock
+        across the whole get/update/create round-trip, serializing every
+        event emission from every sync worker behind store latency (a
+        network RTT each in --store-server mode). The store's own
+        optimistic concurrency is sufficient: repeats go through
+        update_with_retry (conflicts re-apply), and the create/create
+        race on a brand-new event resolves through AlreadyExists into
+        the update path."""
         meta = involved.metadata
-        # Aggregate repeats: one Event object per (object, reason), count++.
         name = f"{meta.name}.{reason.lower()}"
-        with self._lock:
-            try:
-                existing = self._store.get("Event", meta.namespace, name)
-                existing.count += 1
-                existing.message = message
-                existing.timestamp = time.time()
-                self._store.update(existing)
-                return
-            except NotFoundError:
-                pass
-            self._seq += 1
-            ev = Event(
-                metadata=ObjectMeta(name=name, namespace=meta.namespace),
-                type=etype,
-                reason=reason,
-                message=message,
-                involved_kind=involved.kind,
-                involved_name=meta.name,
-                involved_namespace=meta.namespace,
-                timestamp=time.time(),
-            )
+
+        def bump(cur):
+            cur.count += 1
+            cur.message = message
+            cur.timestamp = time.time()
+            if not cur.first_timestamp:
+                # events recorded before first_timestamp existed
+                cur.first_timestamp = cur.timestamp
+
+        if self._store.update_with_retry("Event", meta.namespace, name, bump):
+            return
+        now = time.time()
+        ev = Event(
+            metadata=ObjectMeta(name=name, namespace=meta.namespace),
+            type=etype,
+            reason=reason,
+            message=message,
+            involved_kind=involved.kind,
+            involved_name=meta.name,
+            involved_namespace=meta.namespace,
+            timestamp=now,
+            first_timestamp=now,
+        )
+        try:
             self._store.create(ev)
+        except AlreadyExistsError:
+            # Lost the first-occurrence race: fold into the winner.
+            self._store.update_with_retry("Event", meta.namespace, name, bump)
 
     def normal(self, involved, reason: str, message: str) -> None:
         self.event(involved, EventType.NORMAL, reason, message)

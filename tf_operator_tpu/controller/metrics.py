@@ -36,6 +36,27 @@ class ControllerMetrics:
         ),
     }
 
+    # Lifecycle-latency histograms derived from trace-span boundaries
+    # (obs/): the reconciler observes them as it records the spans, so
+    # /metrics and the exported trace always agree. Buckets span "local
+    # no-op job" (tens of ms) through "real slice bring-up" (minutes).
+    LIFECYCLE_BUCKETS = (
+        0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+    )
+    HIST_HELP = {
+        "tpujob_time_to_scheduled_seconds": (
+            "Submit -> gang placement decided (the scheduled span's end)."
+        ),
+        "tpujob_time_to_first_step_seconds": (
+            "Submit -> first training step (TTFS; the first-step span "
+            "reported by the workload)."
+        ),
+        "tpujob_restart_downtime_seconds": (
+            "Gang restart decided -> gang RUNNING again (MTTR), by "
+            "restart cause."
+        ),
+    }
+
     # Reconcile-latency histogram bounds (seconds). Healthy syncs on the
     # indexed store sit in the first few buckets; the tail buckets are
     # where the pre-index O(population) scans lived — the knee's signature.
@@ -58,6 +79,16 @@ class ControllerMetrics:
         self._sync_seconds_count = 0
         self._sync_bucket_counts = [0] * (len(self.SYNC_BUCKETS) + 1)  # +Inf
         self._sync_samples: List[float] = []
+        # Deterministic decimation state: once the sample list hits
+        # MAX_SYNC_SAMPLES it is thinned to every 2nd sample and the
+        # keep-stride doubles, so quantiles keep tracking the WHOLE run
+        # (the old behavior froze them at the first 200k syncs).
+        self._sync_sample_stride = 1
+        self._sync_observations = 0
+        # (name, (("label","value"), ...)) -> [bucket_counts, sum, count]
+        self._hists: Dict[
+            Tuple[str, Tuple[Tuple[str, str], ...]], list
+        ] = {}
 
     # -- writers (reconciler) ---------------------------------------------
 
@@ -83,8 +114,33 @@ class ControllerMetrics:
             while i < len(self.SYNC_BUCKETS) and seconds > self.SYNC_BUCKETS[i]:
                 i += 1
             self._sync_bucket_counts[i] += 1
-            if len(self._sync_samples) < self.MAX_SYNC_SAMPLES:
+            # Keep-every-Nth with doubling stride: every observation has a
+            # deterministic fate, the kept set always covers the whole run,
+            # and memory stays bounded at MAX_SYNC_SAMPLES.
+            if self._sync_observations % self._sync_sample_stride == 0:
                 self._sync_samples.append(seconds)
+                if len(self._sync_samples) >= self.MAX_SYNC_SAMPLES:
+                    self._sync_samples = self._sync_samples[::2]
+                    self._sync_sample_stride *= 2
+            self._sync_observations += 1
+
+    def observe_hist(
+        self, name: str, seconds: float, labels: Optional[Dict[str, str]] = None
+    ) -> None:
+        """Observe one value into a lifecycle-latency histogram family
+        (HIST_HELP). Label sets create their series on first use."""
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = [[0] * (len(self.LIFECYCLE_BUCKETS) + 1), 0.0, 0]
+                self._hists[key] = h
+            i = 0
+            while i < len(self.LIFECYCLE_BUCKETS) and seconds > self.LIFECYCLE_BUCKETS[i]:
+                i += 1
+            h[0][i] += 1
+            h[1] += seconds
+            h[2] += 1
 
     def sync_latency_quantiles(self, qs=(0.5, 0.99)) -> Dict[float, float]:
         """Empirical sync-latency quantiles from the raw samples (the
@@ -108,6 +164,9 @@ class ControllerMetrics:
             labeled = dict(self._labeled)
             s_sum, s_count = self._sync_seconds_sum, self._sync_seconds_count
             buckets = list(self._sync_bucket_counts)
+            hists = {
+                k: [list(v[0]), v[1], v[2]] for k, v in self._hists.items()
+            }
         # .17g: %g's 6 significant digits would freeze a counter past ~1e6
         # (consecutive increments render identically and rate() reads 0).
         for name, value in sorted(counters.items()):
@@ -123,8 +182,28 @@ class ControllerMetrics:
             for (n, lbls), value in sorted(labeled.items()):
                 if n != name:
                     continue
-                rendered = ",".join(f'{k}="{v}"' for k, v in lbls)
+                rendered = _render_labels(lbls)
                 out.append(f"{name}{{{rendered}}} {value:.17g}")
+        # Lifecycle-latency histograms (trace-span-derived): one
+        # HELP/TYPE block per family, one bucket series per label set.
+        for name in sorted({k[0] for k in hists}):
+            out.append(f"# HELP {name} {self.HIST_HELP.get(name, name)}")
+            out.append(f"# TYPE {name} histogram")
+            for (n, lbls), (bkts, h_sum, h_count) in sorted(hists.items()):
+                if n != name:
+                    continue
+                base = _render_labels(lbls)
+                sep = "," if base else ""
+                cum = 0
+                for le, cnt in zip(self.LIFECYCLE_BUCKETS, bkts):
+                    cum += cnt
+                    out.append(
+                        f'{name}_bucket{{{base}{sep}le="{le:g}"}} {cum}'
+                    )
+                out.append(f'{name}_bucket{{{base}{sep}le="+Inf"}} {h_count}')
+                suffix = f"{{{base}}}" if base else ""
+                out.append(f"{name}_sum{suffix} {h_sum:.17g}")
+                out.append(f"{name}_count{suffix} {h_count}")
         # Reconcile latency as a HISTOGRAM (r6): the knee was inferred
         # from throughput before; the tail buckets make it observable.
         out.append("# HELP tpujob_sync_duration_seconds Reconcile sync wall time.")
@@ -203,6 +282,22 @@ class ControllerMetrics:
             out.append("# TYPE tpujob_hosts_draining gauge")
             out.append(f"tpujob_hosts_draining {draining}")
         return out
+
+
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-exposition label-value escaping: backslash, double
+    quote and newline must be escaped or the whole scrape is unparseable
+    (one restart message with a quote used to poison /metrics)."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _render_labels(lbls: Tuple[Tuple[str, str], ...]) -> str:
+    return ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in lbls)
 
 
 def _job_phase(job) -> str:

@@ -41,6 +41,7 @@ from tf_operator_tpu.api.types import (
     KIND_ENDPOINT,
     KIND_HOST,
     KIND_PROCESS,
+    KIND_SPAN,
     KIND_TPUJOB,
     LABEL_GROUP,
     LABEL_JOB_NAME,
@@ -70,6 +71,12 @@ from tf_operator_tpu.controller.status import (
     update_replica_status,
 )
 from tf_operator_tpu.controller.workqueue import RateLimitingQueue
+from tf_operator_tpu.obs.spans import (
+    COMPONENT_SCHEDULER,
+    SpanRecorder,
+    first_step_span_name,
+    trace8,
+)
 from tf_operator_tpu.rendezvous.env import (
     ENV_API_SERVER,
     ENV_CHECKPOINT_DIR,
@@ -79,6 +86,7 @@ from tf_operator_tpu.rendezvous.env import (
     ENV_NUM_PROCESSES,
     ENV_PROCESS_ID,
     ENV_RESUME_STEP,
+    ENV_TRACE_ID,
     ENV_WORKLOAD,
 )
 from tf_operator_tpu.runtime.objects import (
@@ -166,6 +174,18 @@ class TPUJobController:
         # promised the same free chips.
         self.scheduler = GangScheduler(store)
         self._sched_lock = threading.Lock()
+        # Lifecycle tracing (obs/): the reconciler records the controller-
+        # and scheduler-side spans of every job's timeline and derives the
+        # TTFS / time-to-scheduled / restart-downtime histograms from the
+        # same boundaries. All best-effort — a failed span write never
+        # fails a sync. Keyed by trace id (job uid); the workqueue's
+        # single-flight-per-key guarantee means no two workers touch the
+        # same job's entries concurrently.
+        self.tracer = SpanRecorder(store)
+        self._sched_observed: set = set()  # uids with a scheduled span
+        self._ttfs_observed: set = set()  # uids whose TTFS hit the histogram
+        self._open_restart: Dict[str, Dict[str, Any]] = {}  # uid -> span info
+        self._open_schedwait: Dict[str, Dict[str, Any]] = {}
 
         self.job_informer = Informer(store, KIND_TPUJOB)
         self.process_informer = Informer(store, KIND_PROCESS)
@@ -311,8 +331,10 @@ class TPUJobController:
         if cached is None:
             # Job deleted: cascade-delete children (the reference leans on
             # k8s GC via owner refs; our store has no GC, so the controller
-            # is the GC).
+            # is the GC). The job's trace goes with it — spans survive job
+            # COMPLETION (they are the timeline) but not deletion.
             self._delete_children(namespace, name, cleanup=CleanupPolicy.ALL)
+            self._delete_spans(namespace, name)
             self.expectations.delete_expectations(self._exp_key(key))
             return
 
@@ -444,6 +466,21 @@ class TPUJobController:
             except NotFoundError:
                 pass
 
+    def _delete_spans(self, namespace: str, job_name: str) -> None:
+        """GC a deleted job's trace spans (indexed list by job label)."""
+        try:
+            spans = self.store.list(
+                KIND_SPAN, namespace=namespace,
+                label_selector={LABEL_JOB_NAME: job_name},
+            )
+        except Exception:  # noqa: BLE001 — GC of telemetry is best-effort
+            return
+        for s in spans:
+            try:
+                self.store.delete(KIND_SPAN, namespace, s.metadata.name)
+            except NotFoundError:
+                pass
+
     def _refresh_terminal_counters(self, job: TPUJob) -> None:
         """Recompute replica counters for a FINISHED job from the children
         still in the store (no adoption — a terminal job claims nothing),
@@ -560,6 +597,12 @@ class TPUJobController:
                 ),
             )
             self.recorder.normal(job, ev.REASON_JOB_CREATED, f"TPUJob {key} created")
+            # Trace: admission = submit (store creation) -> first sync.
+            self.tracer.record(
+                job.metadata.namespace, job.metadata.name, job.metadata.uid,
+                "admission", job.metadata.creation_timestamp, time.time(),
+                name=self._span_name(job, "admission"),
+            )
 
         # -- active deadline (RunPolicy) ---------------------------------
         rp = job.spec.run_policy
@@ -718,6 +761,16 @@ class TPUJobController:
                     ),
                 )
                 self.recorder.normal(job, ev.REASON_JOB_RUNNING, "TPUJob running")
+                now = time.time()
+                # Trace: the gang is (back) up — close any open restart
+                # span; its width IS the recovery downtime (MTTR).
+                self._close_restart_span(job, now)
+                self.tracer.record(
+                    job.metadata.namespace, job.metadata.name,
+                    job.metadata.uid, "running", now, now,
+                    attrs={"track": "running"},
+                    name=self._span_name(job, "running"),
+                )
 
         # -- evaluator restarts (per-replica, not gang) -------------------
         for r in evaluators:
@@ -751,6 +804,73 @@ class TPUJobController:
 
         job.status.last_reconcile_time = time.time()
         self._write_status(job)
+
+    # ---- tracing helpers (obs/) -----------------------------------------
+
+    @staticmethod
+    def _span_name(job: TPUJob, op: str) -> str:
+        """Deterministic per-(job-incarnation, op) span name: recording is
+        create-once — a re-sync or controller restart can never duplicate
+        a lifecycle span, because the store dedupes on the name."""
+        return f"{job.metadata.name}-{trace8(job.metadata.uid)}-{op}"
+
+    def _mark_scheduled(self, job: TPUJob, now: float) -> None:
+        """First successful placement decision for this job: record the
+        submit->scheduled span and observe tpujob_time_to_scheduled_seconds
+        — exactly once per job (store-name dedupe backs the in-memory
+        set across controller restarts)."""
+        uid = job.metadata.uid
+        wait = self._open_schedwait.pop(uid, None)
+        if wait is not None:
+            self.tracer.close(wait["ns"], wait["name"], now)
+        if uid in self._sched_observed:
+            return
+        self._sched_observed.add(uid)
+        span = self.tracer.record(
+            job.metadata.namespace, job.metadata.name, uid,
+            "scheduled", job.metadata.creation_timestamp, now,
+            name=self._span_name(job, "scheduled"),
+        )
+        if span is not None:
+            self.metrics.observe_hist(
+                "tpujob_time_to_scheduled_seconds",
+                max(0.0, now - job.metadata.creation_timestamp),
+            )
+
+    def _close_restart_span(self, job: TPUJob, now: float) -> None:
+        """Close the open restart span (opened by _restart_gang) and
+        observe its width as recovery downtime, labeled by cause."""
+        info = self._open_restart.pop(job.metadata.uid, None)
+        if info is None:
+            return
+        self.tracer.close(info["ns"], info["name"], now)
+        self.metrics.observe_hist(
+            "tpujob_restart_downtime_seconds",
+            max(0.0, now - info["start"]),
+            labels={"cause": info["cause"]},
+        )
+
+    def _observe_first_step(self, job: TPUJob) -> None:
+        """Fold the workload-reported first-step span into the TTFS
+        histogram (once per job, at the terminal transition — the span
+        arrives through the API seam while the job runs)."""
+        uid = job.metadata.uid
+        if uid in self._ttfs_observed:
+            return
+        try:
+            span = self.store.get(
+                KIND_SPAN, job.metadata.namespace,
+                first_step_span_name(job.metadata.name, uid),
+            )
+        except NotFoundError:
+            return
+        except Exception:  # noqa: BLE001 — telemetry read is best-effort
+            return
+        self._ttfs_observed.add(uid)
+        self.metrics.observe_hist(
+            "tpujob_time_to_first_step_seconds",
+            max(0.0, span.start_time - job.metadata.creation_timestamp),
+        )
 
     # ---- actions --------------------------------------------------------
 
@@ -839,6 +959,10 @@ class TPUJobController:
             )
             if job.spec.topology.dcn_mesh_axes:
                 env[ENV_DCN_MESH_AXES] = json.dumps(job.spec.topology.dcn_mesh_axes)
+            # Trace context: the job uid is the trace id, stable across
+            # gang restarts — agent/backend and workload spans join the
+            # same timeline the controller writes into (obs/).
+            env[ENV_TRACE_ID] = job.metadata.uid
             if ckpt_dir:
                 # Warm-restart contract (rendezvous/env.py): a recreated
                 # gang is told the directory and the step it will resume
@@ -878,6 +1002,7 @@ class TPUJobController:
         placement: Dict[str, Any] = {}
         with self._sched_lock:
             managed = self.scheduler.managed()
+            t_place = time.time()
             if managed:
                 # Rank-keyed placement: a member's host slot is its gang
                 # rank mod num_hosts, and slots already holding LIVE bound
@@ -902,9 +1027,39 @@ class TPUJobController:
                     self.recorder.warning(
                         job, ev.REASON_FAILED_SCHEDULING, str(exc)
                     )
+                    # Trace: open ONE scheduling-wait span on the first
+                    # failed placement; it stays open (visible in the
+                    # timeline as "the job is waiting for capacity")
+                    # until a later placement succeeds.
+                    uid = job.metadata.uid
+                    if uid not in self._open_schedwait:
+                        name = self._span_name(job, "scheduling-wait")
+                        self.tracer.record(
+                            job.metadata.namespace, job.metadata.name, uid,
+                            "scheduling-wait", t_place, 0.0,
+                            attrs={"reason": str(exc)[:200]},
+                            name=name, component=COMPONENT_SCHEDULER,
+                        )
+                        self._open_schedwait[uid] = {
+                            "ns": job.metadata.namespace, "name": name,
+                        }
                     raise  # rate-limited requeue retries the gang later
                 for p in procs:
                     p.spec.node_name = placement[p.metadata.name].metadata.name
+                # Trace: the placement decision itself (scheduler span).
+                self.tracer.record(
+                    job.metadata.namespace, job.metadata.name,
+                    job.metadata.uid, "placement", t_place, time.time(),
+                    attrs={
+                        "hosts": ",".join(sorted(
+                            {h.metadata.name for h in placement.values()}
+                        )),
+                        "processes": str(len(procs)),
+                        "track": "placement",
+                    },
+                    component=COMPONENT_SCHEDULER,
+                )
+            self._mark_scheduled(job, time.time())
 
             # Chief host: prefer the existing rendezvous Endpoint (the chief
             # may already be running and we are only recreating lost
@@ -958,6 +1113,7 @@ class TPUJobController:
 
             self.expectations.expect_creations(exp_key, len(procs))
             created = 0
+            t_create = time.time()
             try:
                 for proc in procs:
                     try:
@@ -986,6 +1142,18 @@ class TPUJobController:
                     self.expectations.creation_failed(exp_key)
                 self.recorder.warning(job, ev.REASON_FAILED_CREATE, str(exc))
                 raise
+            if created:
+                # Trace: one gang-create span per create batch (restarts
+                # produce one each; the warm-restart step is an attr).
+                self.tracer.record(
+                    job.metadata.namespace, job.metadata.name,
+                    job.metadata.uid, "gang-create", t_create, time.time(),
+                    attrs={
+                        "processes": str(created),
+                        "resume_step": str(resume_step),
+                        "track": "gang-create",
+                    },
+                )
 
     def _ensure_endpoint(self, job: TPUJob, target: str, host: str, port: int) -> None:
         name = f"{job.metadata.name}-rendezvous"
@@ -1060,6 +1228,24 @@ class TPUJobController:
         self.metrics.inc(
             "tpujob_gang_restarts_by_cause_total", labels={"cause": cause}
         )
+        # Trace: open the restart span NOW — the gang is going down; it
+        # closes when the recreated gang reports RUNNING again, so its
+        # width is the job's actual recovery downtime (MTTR), by cause.
+        now = time.time()
+        n = job.status.restart_count + job.status.preemption_count
+        span_name = self._span_name(job, f"restart-{n}")
+        if job.metadata.uid not in self._open_restart:
+            if self.tracer.record(
+                job.metadata.namespace, job.metadata.name, job.metadata.uid,
+                "restart", now, 0.0,
+                attrs={"cause": cause, "full": str(full).lower(),
+                       "track": "restart"},
+                name=span_name,
+            ) is not None:
+                self._open_restart[job.metadata.uid] = {
+                    "ns": job.metadata.namespace, "name": span_name,
+                    "start": now, "cause": cause,
+                }
         set_condition(
             job.status,
             new_condition(ConditionType.RESTARTING, reason, message),
@@ -1117,6 +1303,40 @@ class TPUJobController:
     def _finish(self, job: TPUJob) -> None:
         """Terminal transition: persist status, then clean up children."""
         self._write_status(job)
+        # Trace: seal the timeline. The root span (span_id = trace id —
+        # what every other span parents to) covers submit -> completion;
+        # its create-once name makes the whole block idempotent, so the
+        # derived TTFS observation happens exactly once per job.
+        now = time.time()
+        end = job.status.completion_time or now
+        phase = (
+            "Succeeded"
+            if has_condition(job.status, ConditionType.SUCCEEDED)
+            else "Failed"
+        )
+        uid = job.metadata.uid
+        root = self.tracer.record(
+            job.metadata.namespace, job.metadata.name, uid,
+            "job", job.metadata.creation_timestamp, end,
+            attrs={
+                "phase": phase,
+                "restarts": str(job.status.restart_count),
+                "preemptions": str(job.status.preemption_count),
+                "track": "job",
+            },
+            name=self._span_name(job, "job"),
+            span_id=uid, parent_id="",
+        )
+        if root is not None:
+            # A restart still open at terminal (the gang never came back)
+            # closes at completion time — bounded, not dangling.
+            self._close_restart_span(job, end)
+            wait = self._open_schedwait.pop(uid, None)
+            if wait is not None:
+                self.tracer.close(wait["ns"], wait["name"], end)
+            self._observe_first_step(job)
+            self._sched_observed.discard(uid)
+            self._ttfs_observed.discard(uid)
         self._delete_children(
             job.metadata.namespace, job.metadata.name, job.spec.run_policy.cleanup_policy
         )

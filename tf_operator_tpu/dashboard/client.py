@@ -84,6 +84,11 @@ class TPUJobClient:
     def delete(self, namespace: str, name: str) -> None:
         self._request("DELETE", f"/api/tpujob/{namespace}/{name}")
 
+    def trace(self, namespace: str, name: str) -> Dict[str, Any]:
+        """The job's lifecycle trace as Chrome trace-event JSON
+        (Perfetto-loadable: traceEvents + derived timings in otherData)."""
+        return self._request("GET", f"/api/tpujob/{namespace}/{name}/trace")
+
     def logs(self, namespace: str, process_name: str) -> str:
         raw = self._request("GET", f"/api/process/{namespace}/{process_name}/logs")
         return raw if isinstance(raw, str) else raw.decode(errors="replace")

@@ -6,6 +6,8 @@ Routes (reference: dashboard/backend/handler/api_handler.go:74-113):
 - POST   /api/tpujob                      — submit a job (JSON body)
 - GET    /api/tpujob/{ns}/{name}          — job detail + processes + endpoints
 - DELETE /api/tpujob/{ns}/{name}          — delete job (controller GCs children)
+- GET    /api/tpujob/{ns}/{name}/trace    — the job's lifecycle trace as
+  Chrome trace-event JSON (Perfetto-loadable; obs/export.py)
 - GET    /api/process/{ns}/{name}/logs    — process logs (kubelet-log analogue)
 - GET    /api/events?namespace=           — events (the test oracle surface)
 - GET    /api/namespaces                  — namespaces in use
@@ -67,6 +69,7 @@ from tf_operator_tpu.runtime.store import (
 from tf_operator_tpu.dashboard.ui import UI_HTML as _UI_HTML
 
 _JOB_RE = re.compile(r"^/api/tpujob/([^/]+)/([^/]+)$")
+_TRACE_RE = re.compile(r"^/api/tpujob/([^/]+)/([^/]+)/trace$")
 _LOGS_RE = re.compile(r"^/api/process/([^/]+)/([^/]+)/logs$")
 _OBJ_KIND_RE = re.compile(r"^/api/v1/([A-Za-z]+)$")
 _OBJ_RE = re.compile(r"^/api/v1/([A-Za-z]+)/([^/]+)/([^/]+)$")
@@ -190,6 +193,24 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/api/events":
             evs = self.store.list(KIND_EVENT, namespace=ns)
             return self._json(200, {"items": [_to_jsonable(e) for e in evs]})
+
+        m = _TRACE_RE.match(path)
+        if m:
+            segs = _decode_segments(m)
+            if segs is None:
+                return self._error(400, "invalid name in path (empty or contains '/')")
+            tns, tname = segs
+            from tf_operator_tpu.obs.export import to_chrome_trace
+            from tf_operator_tpu.obs.spans import job_trace
+
+            try:
+                job = self.store.get(KIND_TPUJOB, tns, tname)
+            except NotFoundError:
+                job = None
+            spans = job_trace(self.store, tns, tname)
+            if job is None and not spans:
+                return self._error(404, f"no trace for tpujob {tns}/{tname}")
+            return self._json(200, to_chrome_trace(spans, job=job))
 
         m = _JOB_RE.match(path)
         if m:

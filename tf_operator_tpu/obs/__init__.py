@@ -1,0 +1,40 @@
+"""Observability: per-job lifecycle tracing (spans) and trace export.
+
+The reference operator has no tracing story at all (SURVEY.md §5:
+per-sync latency logs only). This package is the first-class version:
+every component — reconciler, gang scheduler, per-host agent/backend,
+trainer/workloads — records :class:`Span` objects into the SAME store
+the rest of the control plane already shares, keyed by the job's trace
+id (its uid) and labeled with the job-name label so the indexed store
+serves a whole trace in one bucket read. ``export`` renders a job's
+spans as Chrome trace-event JSON (Perfetto-loadable) and derives the
+cross-component timings — submit→scheduled, submit→first-step (TTFS),
+restart downtime (MTTR) — that BASELINE.md names as north-star metrics.
+"""
+
+from tf_operator_tpu.obs.spans import (
+    COMPONENT_AGENT,
+    COMPONENT_CONTROLLER,
+    COMPONENT_SCHEDULER,
+    COMPONENT_TRAINER,
+    Span,
+    SpanRecorder,
+    first_step_span_name,
+    job_trace,
+    span_labels,
+)
+from tf_operator_tpu.obs.export import derive_timings, to_chrome_trace
+
+__all__ = [
+    "COMPONENT_AGENT",
+    "COMPONENT_CONTROLLER",
+    "COMPONENT_SCHEDULER",
+    "COMPONENT_TRAINER",
+    "Span",
+    "SpanRecorder",
+    "first_step_span_name",
+    "job_trace",
+    "span_labels",
+    "derive_timings",
+    "to_chrome_trace",
+]

@@ -1,0 +1,200 @@
+"""Chrome trace-event export + derived cross-component timings.
+
+``to_chrome_trace`` renders a job's spans in the Chrome trace-event JSON
+format (the ``traceEvents`` array of "X"/"i"/"M" events that
+chrome://tracing and Perfetto load directly): one Perfetto *process* row
+per component (controller / scheduler / agent / trainer), one *thread*
+row per track within it, microsecond timestamps relative to job submit.
+
+``derive_timings`` is the span-boundary arithmetic behind the first-class
+metrics (controller/metrics.py histograms) and the chaos soak's
+recovery-downtime assertion: submit→scheduled, submit→first-step (TTFS),
+and per-restart downtime windows (MTTR) all fall straight out of the
+timeline instead of being inferred from logs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from tf_operator_tpu.obs.spans import (
+    COMPONENT_AGENT,
+    COMPONENT_CONTROLLER,
+    COMPONENT_SCHEDULER,
+    COMPONENT_TRAINER,
+    Span,
+)
+
+# Stable Perfetto process-row order; unknown components append after.
+COMPONENT_ORDER = (
+    COMPONENT_CONTROLLER,
+    COMPONENT_SCHEDULER,
+    COMPONENT_AGENT,
+    COMPONENT_TRAINER,
+)
+
+
+def _track(span: Span) -> str:
+    """The thread row a span renders on. Distinct tracks per op (and per
+    process for agent/trainer spans) keep partially-overlapping spans —
+    e.g. ``scheduled`` and ``admission`` both anchored at submit — from
+    sharing a row, which Chrome would mis-nest."""
+    return span.attrs.get("track") or span.op
+
+
+def derive_timings(spans: List[Span], submit_ts: Optional[float] = None) -> Dict[str, Any]:
+    """Span-boundary metrics for one trace.
+
+    ``submit_ts`` anchors the latencies (the job's creation timestamp);
+    when absent it falls back to the root ``job`` span's start, then the
+    earliest span start.
+    """
+    by_op: Dict[str, List[Span]] = {}
+    for s in spans:
+        by_op.setdefault(s.op, []).append(s)
+
+    def first(op: str) -> Optional[Span]:
+        got = by_op.get(op)
+        return min(got, key=lambda s: s.start_time) if got else None
+
+    root = first("job")
+    if submit_ts is None:
+        if root is not None:
+            submit_ts = root.start_time
+        elif spans:
+            submit_ts = min(s.start_time for s in spans)
+
+    out: Dict[str, Any] = {"submit": submit_ts}
+    sched = first("scheduled")
+    if sched is not None and sched.end_time and submit_ts:
+        out["time_to_scheduled_s"] = max(0.0, sched.end_time - submit_ts)
+    fs = first("first-step")
+    if fs is not None and submit_ts:
+        out["time_to_first_step_s"] = max(0.0, fs.start_time - submit_ts)
+    restarts = []
+    for s in sorted(by_op.get("restart", ()), key=lambda s: s.start_time):
+        restarts.append(
+            {
+                "cause": s.attrs.get("cause", ""),
+                "start": s.start_time,
+                "end": s.end_time or None,
+                "downtime_s": s.duration(),
+            }
+        )
+    out["restarts"] = restarts
+    return out
+
+
+def to_chrome_trace(spans: List[Span], job: Any = None) -> Dict[str, Any]:
+    """Render spans as a Chrome trace-event JSON document.
+
+    ``job`` (a TPUJob, optional) anchors t=0 at submit and contributes
+    the summary block; without it t=0 is the earliest span start.
+    """
+    submit_ts = None
+    if job is not None and job.metadata.creation_timestamp:
+        submit_ts = job.metadata.creation_timestamp
+    t0 = submit_ts
+    if t0 is None and spans:
+        t0 = min(s.start_time for s in spans if s.start_time > 0)
+    t0 = t0 or 0.0
+
+    def us(t: float) -> float:
+        return round((t - t0) * 1e6, 1)
+
+    components = [c for c in COMPONENT_ORDER if any(s.component == c for s in spans)]
+    components += sorted(
+        {s.component for s in spans} - set(components) - {""}
+    )
+    pid_of = {c: i + 1 for i, c in enumerate(components)}
+
+    events: List[Dict[str, Any]] = []
+    for c in components:
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid_of[c],
+                "tid": 0,
+                "args": {"name": c},
+            }
+        )
+
+    tid_of: Dict[tuple, int] = {}
+    for span in sorted(spans, key=lambda s: (s.start_time, s.metadata.name)):
+        pid = pid_of.get(span.component or "", 0) or 1
+        tkey = (pid, _track(span))
+        if tkey not in tid_of:
+            tid_of[tkey] = sum(1 for k in tid_of if k[0] == pid) + 1
+            events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tid_of[tkey],
+                    "args": {"name": _track(span)},
+                }
+            )
+        tid = tid_of[tkey]
+        args = {
+            "trace_id": span.trace_id,
+            "span_id": span.span_id,
+            "parent_id": span.parent_id,
+            **span.attrs,
+        }
+        if span.end_time and span.end_time > span.start_time:
+            events.append(
+                {
+                    "name": span.op,
+                    "cat": span.component or "span",
+                    "ph": "X",
+                    "ts": us(span.start_time),
+                    "dur": round((span.end_time - span.start_time) * 1e6, 1),
+                    "pid": pid,
+                    "tid": tid,
+                    "args": args,
+                }
+            )
+        elif span.end_time:  # instantaneous mark (start == end)
+            events.append(
+                {
+                    "name": span.op,
+                    "cat": span.component or "span",
+                    "ph": "i",
+                    "s": "p",
+                    "ts": us(span.start_time),
+                    "pid": pid,
+                    "tid": tid,
+                    "args": args,
+                }
+            )
+        else:  # still open — zero-duration slice flagged open
+            events.append(
+                {
+                    "name": span.op,
+                    "cat": span.component or "span",
+                    "ph": "X",
+                    "ts": us(span.start_time),
+                    "dur": 0,
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {**args, "open": "true"},
+                }
+            )
+
+    other: Dict[str, Any] = {
+        "spans": len(spans),
+        "components": components,
+        **derive_timings(spans, submit_ts=submit_ts),
+    }
+    if spans:
+        other["trace_id"] = spans[0].trace_id
+    if job is not None:
+        other["job"] = job.metadata.key()
+        other["phase"] = job.status.phase().value
+
+    return {
+        "displayTimeUnit": "ms",
+        "traceEvents": events,
+        "otherData": other,
+    }

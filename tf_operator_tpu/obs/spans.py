@@ -1,0 +1,193 @@
+"""Span: one timed operation in a job's lifecycle timeline.
+
+Dependency-free tracing built on the store itself: a Span is just
+another store object kind (serialized by runtime.serialize, served by
+the generic /api/v1 API, watchable), so multi-host gangs report into
+the same timeline through the exact seam everything else already uses
+— an agent over a RemoteStore and the in-process reconciler write
+spans identically.
+
+Model (deliberately smaller than OpenTelemetry):
+
+- ``trace_id`` is the job uid — propagated to gang members via
+  ``TPUJOB_TRACE_ID`` (rendezvous/env.py) next to the warm-restart env.
+- ``span_id`` defaults to the object name (unique per namespace); the
+  trace ROOT span (op ``job``) uses the trace id itself as its span id,
+  so every component can parent to the root without a lookup.
+- ``end_time == 0`` marks a span still open (e.g. a restart whose gang
+  has not come back RUNNING yet).
+- Deterministic names make recording idempotent: lifecycle spans that
+  must exist once per job (``scheduled``, ``first-step``) use a
+  ``{job}-{trace8}-{op}`` name, so a duplicate create is an
+  AlreadyExists no-op — the store is the dedupe, not caller locks.
+
+Recording is ALWAYS best-effort: a failed span write must never break
+the control plane or a training step; :class:`SpanRecorder` swallows
+store errors and returns None.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from tf_operator_tpu.api.types import (
+    API_GROUP,
+    KIND_SPAN,
+    LABEL_GROUP,
+    LABEL_JOB_NAME,
+    ObjectMeta,
+)
+
+# NOTE: no module-level import from tf_operator_tpu.runtime here — the
+# runtime package imports this module (process_backend records agent
+# spans), so the dependency must stay one-way at import time; store
+# exception types are resolved lazily inside the recorder.
+
+log = logging.getLogger("tpujob.obs")
+
+# Span components — who recorded it (one Perfetto process row each).
+COMPONENT_CONTROLLER = "controller"
+COMPONENT_SCHEDULER = "scheduler"
+COMPONENT_AGENT = "agent"
+COMPONENT_TRAINER = "trainer"
+
+
+@dataclass
+class Span:
+    """One timed operation inside a job's trace (store object)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    trace_id: str = ""  # job uid
+    span_id: str = ""
+    parent_id: str = ""  # "" = root
+    op: str = ""  # scheduled / gang-create / restart / process / first-step…
+    component: str = ""  # controller / scheduler / agent / trainer
+    start_time: float = 0.0  # wall-clock seconds
+    end_time: float = 0.0  # 0.0 = still open
+    attrs: Dict[str, str] = field(default_factory=dict)
+    kind: str = KIND_SPAN
+
+    def key(self) -> str:
+        return self.metadata.key()
+
+    def duration(self) -> Optional[float]:
+        """Seconds, or None while the span is still open."""
+        if not self.end_time:
+            return None
+        return max(0.0, self.end_time - self.start_time)
+
+
+def span_labels(job_name: str) -> Dict[str, str]:
+    """Labels stamped on every span: the job-name label is INDEXED by the
+    store, so listing a whole trace is one bucket read, not a scan."""
+    return {LABEL_GROUP: API_GROUP, LABEL_JOB_NAME: job_name}
+
+
+def trace8(trace_id: str) -> str:
+    return (trace_id or "")[:8]
+
+
+def first_step_span_name(job_name: str, trace_id: str) -> str:
+    """Deterministic gang-wide name: every rank may mark its first step,
+    the store's AlreadyExists keeps exactly the EARLIEST write — which is
+    precisely the job's first step."""
+    return f"{job_name}-{trace8(trace_id)}-first-step"
+
+
+class SpanRecorder:
+    """Best-effort span writer for one component.
+
+    ``store`` is anything with the Store CRUD surface (Store, RemoteStore,
+    ChaosStore). Every method swallows store failures: tracing must never
+    take down the path it observes.
+    """
+
+    def __init__(self, store: Any, component: str = COMPONENT_CONTROLLER) -> None:
+        self._store = store
+        self.component = component
+
+    def record(
+        self,
+        namespace: str,
+        job_name: str,
+        trace_id: str,
+        op: str,
+        start: float,
+        end: float,
+        attrs: Optional[Dict[str, str]] = None,
+        name: Optional[str] = None,
+        parent_id: Optional[str] = None,
+        span_id: Optional[str] = None,
+        component: Optional[str] = None,
+    ) -> Optional[Span]:
+        """Create one span (complete when ``end`` > 0, open when 0).
+
+        Returns the stored Span, or None when the write failed OR a span
+        of the same (deterministic) name already exists — callers use
+        that to dedupe derived-metric observations.
+        """
+        if not trace_id:
+            return None
+        if name is None:
+            name = (
+                f"{job_name}-{trace8(trace_id)}-{op}-{uuid.uuid4().hex[:6]}"
+            )
+        span = Span(
+            metadata=ObjectMeta(
+                name=name, namespace=namespace, labels=span_labels(job_name)
+            ),
+            trace_id=trace_id,
+            span_id=span_id if span_id is not None else name,
+            parent_id=parent_id if parent_id is not None else trace_id,
+            op=op,
+            component=component or self.component,
+            start_time=start,
+            end_time=end,
+            attrs=dict(attrs or {}),
+        )
+        try:
+            return self._store.create(span)
+        except Exception as exc:  # noqa: BLE001 — tracing is best-effort
+            from tf_operator_tpu.runtime.store import AlreadyExistsError
+
+            if not isinstance(exc, AlreadyExistsError):
+                log.debug("span %s/%s not recorded: %s", namespace, name, exc)
+            return None
+
+    def close(
+        self,
+        namespace: str,
+        name: str,
+        end: Optional[float] = None,
+        attrs: Optional[Dict[str, str]] = None,
+    ) -> Optional[Span]:
+        """Close an open span (idempotent: an already-closed span is left
+        untouched). Returns the closed Span or None."""
+        end = time.time() if end is None else end
+
+        def mutate(cur):
+            if cur.end_time:
+                return False  # already closed — first closer wins
+            cur.end_time = end
+            if attrs:
+                cur.attrs.update(attrs)
+
+        try:
+            return self._store.update_with_retry(KIND_SPAN, namespace, name, mutate)
+        except Exception as exc:  # noqa: BLE001 — tracing is best-effort
+            log.debug("span %s/%s not closed: %s", namespace, name, exc)
+            return None
+
+
+def job_trace(store: Any, namespace: str, job_name: str) -> List[Span]:
+    """Every span of a job's trace, ordered by start time (ties: name).
+    Served from the store's job-name label index."""
+    spans = store.list(
+        KIND_SPAN, namespace=namespace, label_selector={LABEL_JOB_NAME: job_name}
+    )
+    spans.sort(key=lambda s: (s.start_time, s.metadata.name))
+    return spans

@@ -11,10 +11,12 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict
 
 from tf_operator_tpu.rendezvous.env import (
+    ENV_API_SERVER,
     ENV_CHECKPOINT_DIR,
     ENV_CHIPS,
     ENV_COORDINATOR_ADDRESS,
@@ -29,6 +31,7 @@ from tf_operator_tpu.rendezvous.env import (
     ENV_REPLICA_INDEX,
     ENV_REPLICA_TYPE,
     ENV_RESUME_STEP,
+    ENV_TRACE_ID,
     ENV_WORKLOAD,
 )
 
@@ -59,6 +62,10 @@ class JobContext:
     # resume_step batches. 0 on a cold first incarnation.
     resume_step: int = 0
     checkpoint_dir: str = ""
+    # Trace context (obs/): the job's trace id (its uid), injected by the
+    # controller so workload-recorded spans (first-step, checkpoint
+    # save/restore) join the controller/scheduler/agent timeline.
+    trace_id: str = ""
 
     @staticmethod
     def from_env(env: Dict[str, str] | None = None) -> "JobContext":
@@ -79,6 +86,7 @@ class JobContext:
             entrypoint=e.get(ENV_ENTRYPOINT, ""),
             resume_step=int(e.get(ENV_RESUME_STEP, "0") or 0),
             checkpoint_dir=e.get(ENV_CHECKPOINT_DIR, ""),
+            trace_id=e.get(ENV_TRACE_ID, ""),
         )
 
     # -- device plane helpers (used by workloads after rendezvous) --------
@@ -123,6 +131,50 @@ class JobContext:
     @property
     def is_coordinator(self) -> bool:
         return self.process_id == 0
+
+    # -- tracing (obs/) ----------------------------------------------------
+
+    def record_span(
+        self,
+        op: str,
+        start: float,
+        end: float,
+        attrs: Dict[str, str] | None = None,
+        name: str | None = None,
+    ) -> bool:
+        """Record one span into the job's timeline through the operator
+        API (ENV_API_SERVER + ENV_TRACE_ID, both controller-injected).
+        Component ``trainer``. Best effort by design: tracing must never
+        fail a training step — returns False when nothing was recorded
+        (no API server / no trace context / transport failure)."""
+        base = os.environ.get(ENV_API_SERVER, "")
+        if not base or not self.trace_id or not self.job_name:
+            return False
+        from tf_operator_tpu.obs.spans import COMPONENT_TRAINER, SpanRecorder
+        from tf_operator_tpu.runtime.remote_store import RemoteStore
+
+        full_attrs = {"rank": str(self.process_id), **(attrs or {})}
+        recorder = SpanRecorder(RemoteStore(base), component=COMPONENT_TRAINER)
+        return (
+            recorder.record(
+                self.namespace, self.job_name, self.trace_id, op,
+                start, end, attrs=full_attrs, name=name,
+            )
+            is not None
+        )
+
+    def mark_first_step(self, step: int = 0) -> bool:
+        """Mark the job's first training step (the TTFS boundary). Every
+        rank may call this — the deterministic gang-wide span name means
+        the store keeps exactly the earliest mark."""
+        from tf_operator_tpu.obs.spans import first_step_span_name
+
+        now = time.time()
+        return self.record_span(
+            "first-step", now, now,
+            attrs={"step": str(step), "track": "first-step"},
+            name=first_step_span_name(self.job_name, self.trace_id),
+        )
 
     # -- result reporting --------------------------------------------------
 

@@ -68,6 +68,15 @@ ENV_API_SERVER = "TPUJOB_API_SERVER"
 ENV_CHECKPOINT_DIR = "TPUJOB_CHECKPOINT_DIR"
 ENV_RESUME_STEP = "TPUJOB_RESUME_STEP"
 
+# Trace context (obs/): the job's trace id — its uid — injected by the
+# controller into every created gang member (alongside the warm-restart
+# env above) so spans recorded by the agent/backend and by the workload
+# itself (``JobContext.record_span`` / ``mark_first_step`` over
+# ENV_API_SERVER) land in the SAME per-job timeline the controller and
+# scheduler write into. Stable across gang restarts: the timeline spans
+# the job, not one incarnation.
+ENV_TRACE_ID = "TPUJOB_TRACE_ID"
+
 
 def identity_env(spec: "ProcessSpec", namespace: str) -> Dict[str, str]:
     """Identity env derived from a ProcessSpec; the backend injects this so

@@ -18,6 +18,7 @@ from __future__ import annotations
 import importlib
 import logging
 import sys
+import time
 import traceback
 
 from tf_operator_tpu.rendezvous.context import JobContext, RetryableFailure
@@ -63,22 +64,46 @@ def main(argv=None) -> int:
         # Warm restart (rendezvous/env.py contract): the controller saw
         # checkpoints at creation; the trainer resumes from latest_step().
         log.info("warm restart: controller-declared resume step %d", ctx.resume_step)
+
+    # Trace (obs/): one trainer-component span per workload run, whatever
+    # the workload is — the timeline shows entrypoint-entry -> exit with
+    # the outcome, even for workloads that never mark a first step.
+    t0 = time.time()
+
+    def _span(outcome: str) -> None:
+        ctx.record_span(
+            "workload", t0, time.time(),
+            attrs={
+                "outcome": outcome,
+                "entrypoint": ctx.entrypoint,
+                "track": f"workload {ctx.replica_type}/{ctx.replica_index}",
+            },
+            name=f"{ctx.job_name}-{ctx.trace_id[:8]}-workload-"
+                 f"{ctx.replica_type.lower()}-{ctx.replica_index}-"
+                 f"{int(t0 * 1e3) % 100000:05d}",
+        )
+
     try:
         fn(ctx)
     except RetryableFailure as exc:
         log.warning("workload requested retry: %s", exc)
+        _span("retryable")
         return USER_RETRYABLE_CODE
     except SystemExit as exc:
         if exc.code is None:
+            _span("ok")
             return 0
         if isinstance(exc.code, int):
+            _span("ok" if exc.code == 0 else f"exit:{exc.code}")
             return exc.code
         log.error("workload exited: %s", exc.code)
+        _span("error")
         return 1
     except KeyboardInterrupt:
         # SIGINT is infrastructure eviction: re-raise so the interpreter
         # exits 130, which the taxonomy classifies as retryable — returning
         # 1 here would turn every preemption into a permanent failure.
+        _span("preempted")
         raise
     except Exception as exc:
         if _is_infrastructure_error(exc):
@@ -87,9 +112,12 @@ def main(argv=None) -> int:
             # retryable, or the first surviving peer to be observed would
             # convert a retryable preemption into a permanent job failure.
             log.warning("distributed runtime failure (retryable):\n%s", traceback.format_exc())
+            _span("infra-retryable")
             return USER_RETRYABLE_CODE
         log.error("workload failed:\n%s", traceback.format_exc())
+        _span("error")
         return 1
+    _span("ok")
     return 0
 
 

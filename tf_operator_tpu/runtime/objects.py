@@ -228,4 +228,8 @@ class Event:
     involved_namespace: str = ""
     count: int = 1
     timestamp: float = 0.0
+    # Onset of the FIRST occurrence: aggregation (count++) refreshes
+    # ``timestamp`` but never this, so a repeated event keeps its original
+    # anchor — usable as a span/timeline reference (k8s firstTimestamp).
+    first_timestamp: float = 0.0
     kind: str = KIND_EVENT

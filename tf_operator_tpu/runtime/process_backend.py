@@ -22,7 +22,8 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from tf_operator_tpu.api.types import KIND_PROCESS
-from tf_operator_tpu.rendezvous.env import identity_env
+from tf_operator_tpu.obs.spans import COMPONENT_AGENT, SpanRecorder
+from tf_operator_tpu.rendezvous.env import ENV_TRACE_ID, identity_env
 from tf_operator_tpu.runtime.objects import Process, ProcessPhase
 from tf_operator_tpu.runtime.store import ConflictError, NotFoundError, Store
 
@@ -121,6 +122,10 @@ class LocalProcessControl(ProcessControl):
         # the child as soon as Popen returns instead of leaking an orphan.
         self._tombstones: set = set()
         self._shutting_down = False
+        # Lifecycle tracing (obs/): one spawn->exit span per supervised
+        # incarnation, into the job timeline named by the controller-
+        # injected TPUJOB_TRACE_ID. Best-effort by contract.
+        self._tracer = SpanRecorder(store, component=COMPONENT_AGENT)
 
     # -- ProcessControl ---------------------------------------------------
 
@@ -276,6 +281,37 @@ class LocalProcessControl(ProcessControl):
         if entry is not None and entry[0] == uid:
             self._children.pop(key)
 
+    def _record_proc_span(
+        self, process: Process, start: float, end: float,
+        exit_code: Optional[int], oom: bool = False, note: str = "",
+    ) -> None:
+        """One agent-component span per supervised incarnation: spawn ->
+        exit, classified by the exit taxonomy. Skipped (not failed) when
+        the process carries no trace context."""
+        trace_id = process.spec.env.get(ENV_TRACE_ID) or (
+            process.metadata.owner_uid or ""
+        )
+        if not trace_id:
+            return
+        from tf_operator_tpu.utils.exit_codes import classify_exit_code
+
+        attrs = {
+            "node": process.spec.node_name or "local",
+            "replica": f"{process.spec.replica_type}/{process.spec.replica_index}",
+            "track": f"proc {process.metadata.name}",
+        }
+        if exit_code is not None:
+            attrs["exit_code"] = str(exit_code)
+            attrs["exit_class"] = classify_exit_code(exit_code, oom).value
+        if note:
+            attrs["note"] = note[:200]
+        self._tracer.record(
+            process.metadata.namespace,
+            process.spec.job_name or process.metadata.name,
+            trace_id, "process", start, end, attrs=attrs,
+            name=f"{process.metadata.name}-{process.metadata.uid}-proc",
+        )
+
     def _launch_and_monitor(self, process: Process) -> None:
         key = process.key()
         uid = process.metadata.uid
@@ -285,6 +321,7 @@ class LocalProcessControl(ProcessControl):
         env.update(identity_env(process.spec, process.metadata.namespace))
         env.update(process.spec.env)
         log_path = process.metadata.annotations.get(self.LOG_ANNOTATION)
+        spawn_t = time.time()
         try:
             child = self._spawn(process, env, log_path)
         except OSError as exc:
@@ -294,6 +331,9 @@ class LocalProcessControl(ProcessControl):
                 self._pop_if_mine(key, uid)
                 self._tombstones.discard(uid)
             self._patch_status(process, ProcessPhase.FAILED, exit_code=127, message=str(exc))
+            self._record_proc_span(
+                process, spawn_t, time.time(), 127, note=str(exc)
+            )
             return
         with self._lock:
             doomed = uid in self._tombstones or self._shutting_down
@@ -312,6 +352,7 @@ class LocalProcessControl(ProcessControl):
         oom = _was_oom_killed(code)
         phase = ProcessPhase.SUCCEEDED if code == 0 else ProcessPhase.FAILED
         self._patch_status(process, phase, exit_code=code, oom_killed=oom)
+        self._record_proc_span(process, spawn_t, time.time(), code, oom=oom)
 
     def _patch_status(
         self,

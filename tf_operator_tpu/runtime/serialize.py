@@ -18,11 +18,13 @@ from tf_operator_tpu.api.types import (
     KIND_HOST,
     KIND_LEASE,
     KIND_PROCESS,
+    KIND_SPAN,
     KIND_TPUJOB,
     ObjectMeta,
     TPUJob,
     _to_jsonable,
 )
+from tf_operator_tpu.obs.spans import Span
 from tf_operator_tpu.runtime.objects import (
     Endpoint,
     EndpointAddress,
@@ -88,12 +90,18 @@ def _lease_from_doc(doc: Dict[str, Any]) -> Lease:
     return Lease(metadata=_meta(doc), **d)
 
 
+def _span_from_doc(doc: Dict[str, Any]) -> Span:
+    d = {k: v for k, v in doc.items() if k not in ("metadata", "kind")}
+    return Span(metadata=_meta(doc), **d)
+
+
 _DECODERS = {
     KIND_PROCESS: _process_from_doc,
     KIND_HOST: _host_from_doc,
     KIND_ENDPOINT: _endpoint_from_doc,
     KIND_EVENT: _event_from_doc,
     KIND_LEASE: _lease_from_doc,
+    KIND_SPAN: _span_from_doc,
     KIND_TPUJOB: lambda doc: TPUJob.from_dict(doc),
 }
 

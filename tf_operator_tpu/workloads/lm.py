@@ -129,8 +129,14 @@ def main(ctx: JobContext) -> None:
     # device_loop=1 (see WorkloadCheckpointer.run_loop).
     fail_at = int(wl.get("fail_at_step", 0))
     marker = wl.get("fail_marker")
+    first_step_marked = []
 
     def on_step(step: int) -> None:
+        if not first_step_marked:
+            # TTFS boundary (obs/): the first completed training step of
+            # this run — includes rendezvous, restore and compile time.
+            first_step_marked.append(step)
+            ctx.mark_first_step(step)
         if fail_at and marker and step >= fail_at:
             import os
 

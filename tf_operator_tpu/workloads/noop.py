@@ -18,6 +18,10 @@ from tf_operator_tpu.rendezvous.context import JobContext
 
 
 def main(ctx: JobContext) -> None:
+    # TTFS boundary for the control-plane bench: a no-op payload's "first
+    # step" is workload code running at all — submit -> here is exactly
+    # the control-plane share of time-to-first-step.
+    ctx.mark_first_step(0)
     sleep_s = float(ctx.workload.get("sleep_s", 0))
     if sleep_s:
         time.sleep(sleep_s)

@@ -58,6 +58,9 @@ def main(ctx: JobContext) -> None:
         time.sleep(sleep_s)
 
     total = float(checksum(make_ones(), make_ones()))
+    # First real device work done: the TTFS boundary (obs/) — covers
+    # rendezvous + mesh bring-up + the first compiled computation.
+    ctx.mark_first_step(0)
     expected = float(n_dev) * dim**3
     # fp32 accumulation is inexact for large dims; a relative tolerance
     # still catches any dead device or broken link (whole blocks missing).

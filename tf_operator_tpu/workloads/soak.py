@@ -42,8 +42,10 @@ def main(ctx: JobContext) -> None:
     if not (is_chief and wl.get("checkpoint_dir")):
         # Non-chief members just pace the same wall clock; gang restart /
         # drain semantics act on them via signals, not their own logic.
-        for _ in range(steps):
+        for i in range(steps):
             time.sleep(sleep_s)
+            if i == 0:
+                ctx.mark_first_step(1)
         return
 
     import numpy as np
@@ -66,8 +68,15 @@ def main(ctx: JobContext) -> None:
     for s in range(start + 1, steps + 1):
         time.sleep(sleep_s)
         state = {"step": np.asarray(s)}
+        if s == start + 1:
+            ctx.mark_first_step(s)
         if every and s % every == 0:
+            t_save = time.time()
             mgr.save(s, state)
+            ctx.record_span(
+                "checkpoint-save", t_save, time.time(),
+                attrs={"step": str(s), "track": "checkpoint"},
+            )
     mgr.save(steps, state, wait=True)  # final save (no-op if step exists)
     mgr.close()
     log.info("soak workload done: steps=%d (resumed from %d)", steps, start)

@@ -141,32 +141,45 @@ def _histogram_quantile(buckets, total: int, q: float) -> float:
     return prev_le  # rank beyond the last finite bucket: clamp
 
 
-def _scrape_sync_latency(server: str) -> dict:
-    """Read the reconcile-latency histogram from /metrics → p50/p99 ms."""
+def _parse_histogram(text: str, family: str) -> tuple:
+    """Extract ([(le_seconds, cumulative)], count) for one unlabeled
+    Prometheus histogram family from exposition text."""
     import re
-    import urllib.request
 
-    with urllib.request.urlopen(server + "/metrics", timeout=10) as resp:
-        text = resp.read().decode()
     buckets = []
     total = 0
     for line in text.splitlines():
-        m = re.match(
-            r'tpujob_sync_duration_seconds_bucket\{le="([^"]+)"\} (\d+)', line
-        )
+        m = re.match(rf'{family}_bucket\{{le="([^"]+)"\}} (\d+)', line)
         if m:
             le = m.group(1)
             if le != "+Inf":
                 buckets.append((float(le), int(m.group(2))))
             continue
-        m = re.match(r"tpujob_sync_duration_seconds_count (\d+)", line)
+        m = re.match(rf"{family}_count (\d+)", line)
         if m:
             total = int(m.group(1))
-    return {
+    return buckets, total
+
+
+def _scrape_sync_latency(server: str) -> dict:
+    """Read the reconcile-latency + TTFS histograms from /metrics →
+    p50/p99 ms. TTFS (submit→first-step, trace-span-derived) is the
+    cross-component number the whole framework is graded on."""
+    import urllib.request
+
+    with urllib.request.urlopen(server + "/metrics", timeout=10) as resp:
+        text = resp.read().decode()
+    buckets, total = _parse_histogram(text, "tpujob_sync_duration_seconds")
+    out = {
         "syncs": total,
         "sync_p50_ms": round(_histogram_quantile(buckets, total, 0.5) * 1e3, 2),
         "sync_p99_ms": round(_histogram_quantile(buckets, total, 0.99) * 1e3, 2),
     }
+    tb, tn = _parse_histogram(text, "tpujob_time_to_first_step_seconds")
+    out["ttfs_jobs"] = tn
+    out["ttfs_p50_ms"] = round(_histogram_quantile(tb, tn, 0.5) * 1e3, 1)
+    out["ttfs_p99_ms"] = round(_histogram_quantile(tb, tn, 0.99) * 1e3, 1)
+    return out
 
 
 def _bench_level(n_jobs: int, args) -> dict:
