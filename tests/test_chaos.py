@@ -71,8 +71,11 @@ def test_sigterm_and_sigint_classify_preempted():
 def test_preempted_is_still_retryable():
     assert is_retryable(143) and is_preemption(143)
     assert is_retryable(137) and not is_preemption(137)
-    # OOM overrides even the preemption codes
-    assert classify_exit_code(143, oom_killed=True) is ExitClass.PERMANENT
+    # OOM overrides even the preemption codes: distinct class (r8 — an OOM
+    # must never be mistaken for preemption churn), permanent semantics.
+    assert classify_exit_code(143, oom_killed=True) is ExitClass.OOM
+    assert not is_retryable(143, oom_killed=True)
+    assert not is_preemption(143, oom_killed=True)
 
 
 # ---------------------------------------------------------------------------
@@ -91,6 +94,68 @@ def test_schedule_same_seed_identical():
 def test_schedule_roundtrips_through_dict():
     sched = FaultSchedule.generate(3, crashes=1, preemptions=1, store_blips=2)
     assert FaultSchedule.from_dict(sched.to_dict()) == sched
+
+
+def test_schedule_operator_crash_sequencing():
+    sched = FaultSchedule.generate(5, crashes=1, preemptions=1, operator_crashes=1)
+    kinds = [f.kind for f in sched.faults]
+    # Between the process crash and the preemption: the RESTARTED
+    # controller must execute the drain.
+    assert kinds == [FaultKind.CRASH, FaultKind.OPERATOR_CRASH, FaultKind.PREEMPT]
+    # Killing the control plane is not a job restart: the preemption's
+    # gate counts only the process crash's restart, not the operator's.
+    assert sched.faults[1].after_restarts == 1  # after the crash restart
+    assert sched.faults[2].after_restarts == 1  # operator crash not counted
+    assert FaultSchedule.from_dict(sched.to_dict()) == sched
+    assert sched == FaultSchedule.generate(
+        5, crashes=1, preemptions=1, operator_crashes=1
+    )
+
+
+class _FakeOperator:
+    def __init__(self):
+        self.restarts = 0
+
+    def restart(self):
+        self.restarts += 1
+
+
+def test_operator_crash_fires_through_handle_only_when_gang_running():
+    store = Store()
+    sched = FaultSchedule(faults=(Fault(FaultKind.OPERATOR_CRASH),))
+    op = _FakeOperator()
+    inj = ChaosInjector(sched, store, job_name="j", operator=op)
+    # No RUNNING gang yet: the fault is not eligible (retried next poll).
+    store.create(Process(
+        metadata=ObjectMeta(name="j-worker-0", namespace="default"),
+        spec=ProcessSpec(job_name="j"),
+        status=ProcessStatus(phase=ProcessPhase.PENDING),
+    ))
+    assert inj._fire(sched.faults[0]) is False
+    assert op.restarts == 0
+
+    def run(cur):
+        cur.status.phase = ProcessPhase.RUNNING
+
+    store.update_with_retry(KIND_PROCESS, "default", "j-worker-0", run)
+    assert inj._fire(sched.faults[0]) is True
+    assert op.restarts == 1
+    assert inj.applied[0]["kind"] == "operator-crash"
+
+
+def test_operator_crash_without_handle_is_loud():
+    store = Store()
+    store.create(Process(
+        metadata=ObjectMeta(name="j-worker-0", namespace="default"),
+        spec=ProcessSpec(job_name="j"),
+        status=ProcessStatus(phase=ProcessPhase.RUNNING),
+    ))
+    inj = ChaosInjector(
+        FaultSchedule(faults=(Fault(FaultKind.OPERATOR_CRASH),)),
+        store, job_name="j",
+    )
+    with pytest.raises(ValueError, match="operator handle"):
+        inj._fire(inj.schedule.faults[0])
 
 
 # ---------------------------------------------------------------------------
@@ -467,6 +532,137 @@ def test_latest_checkpoint_step_scans_both_layouts(tmp_path):
     (tmp_path / "step_2").mkdir()
     (tmp_path / "step_2" / "manifest.json").write_text("{}")
     (tmp_path / "step_9").mkdir()  # no manifest: in-flight, ignored
-    (tmp_path / "6").mkdir()  # orbax numeric step dir
+    (tmp_path / "6").mkdir()  # orbax numeric step dir, finalized
+    (tmp_path / "6" / "_CHECKPOINT_METADATA").write_text("{}")
     (tmp_path / "7.orbax-checkpoint-tmp-123").mkdir()  # in-flight, ignored
+    (tmp_path / "8").mkdir()  # numeric but NO commit marker: torn, ignored
     assert latest_checkpoint_step(str(tmp_path)) == 6
+
+
+# ---------------------------------------------------------------------------
+# OOM cause accounting (r8): distinct from preemption in restarts/metrics
+# ---------------------------------------------------------------------------
+
+
+def _oom_member(job, index, node=""):
+    p = _member(job, index, ProcessPhase.FAILED, node=node, exit_code=137)
+    p.status.oom_killed = True
+    return p
+
+
+def test_oom_under_exit_code_policy_fails_job_permanently():
+    job = _job(workers=2, backoff_limit=5)
+    procs = [
+        _oom_member(job, 0),
+        _member(job, 1, ProcessPhase.RUNNING),
+    ]
+    h = DrainHarness(job, procs)
+    h.sync()
+    st = h.stored().status
+    assert has_condition(st, ConditionType.FAILED)
+    assert st.restart_count == 0
+    cond = get_condition(st, ConditionType.FAILED)
+    assert "oom-killed" in cond.message
+
+
+def test_oom_under_on_failure_policy_restarts_with_oom_cause():
+    from tf_operator_tpu.api.types import RestartPolicy
+    from tf_operator_tpu.controller.reconciler import CAUSE_OOM
+
+    job = _job(workers=2, backoff_limit=5)
+    job.spec.replica_specs[ReplicaType.WORKER].restart_policy = (
+        RestartPolicy.ON_FAILURE
+    )
+    procs = [
+        _oom_member(job, 0),
+        _member(job, 1, ProcessPhase.RUNNING),
+    ]
+    h = DrainHarness(job, procs)
+    h.sync()
+    st = h.stored().status
+    # restarted (counted against backoff), with the OOM cause — never
+    # mistakable for preemption churn despite the SIGKILL-shaped exit
+    assert not has_condition(st, ConditionType.FAILED)
+    assert st.restart_count == 1
+    assert st.preemption_count == 0
+    assert st.last_restart_cause == CAUSE_OOM
+    assert 'cause="oom"' in h.ctl.metrics.render()
+
+
+# ---------------------------------------------------------------------------
+# controller restart recovery (r8): re-adoption pass over a recovered store
+# ---------------------------------------------------------------------------
+
+
+def test_record_recovery_adopts_children_and_records_restart():
+    from tf_operator_tpu.api.types import KIND_SPAN
+    from tf_operator_tpu.runtime.persist import RecoveryInfo
+
+    job = _job(name="recovered", workers=2)
+    procs = [
+        _member(job, 0, ProcessPhase.RUNNING),
+        _member(job, 1, ProcessPhase.RUNNING),
+    ]
+    # One child lost its owner stamp (half-written adoption pre-crash).
+    procs[1].metadata.owner_uid = None
+    procs[1].metadata.owner_kind = None
+    procs[1].metadata.owner_name = None
+    h = DrainHarness(job, procs)
+    n = h.ctl.record_recovery(RecoveryInfo(recovered=True, resource_version=42))
+    assert n == 1
+    # The orphan was re-adopted by uid...
+    got = h.store.get(KIND_PROCESS, "default", f"{job.metadata.name}-worker-1")
+    assert got.metadata.owner_uid == job.metadata.uid
+    # ...the restart is visible in the job's trace and as an event...
+    spans = h.store.list(KIND_SPAN, label_selector={LABEL_JOB_NAME: job.metadata.name})
+    assert any(s.op == "controller-restart" for s in spans)
+    restart_span = next(s for s in spans if s.op == "controller-restart")
+    assert restart_span.attrs["recovered_rv"] == "42"
+    assert "ControllerRestarted" in [e.reason for e in h.store.list("Event")]
+    # ...and counted.
+    assert "tpujob_controller_restarts_total 1" in h.ctl.metrics.render()
+    # The enqueued sync then finds the full recovered gang: no creates.
+    h.sync()
+    assert h.fake.created == []
+
+
+def test_record_recovery_skips_finished_jobs():
+    from tf_operator_tpu.api.types import KIND_SPAN
+    from tf_operator_tpu.controller.status import new_condition, set_condition
+    from tf_operator_tpu.runtime.persist import RecoveryInfo
+
+    job = _job(name="done", workers=1)
+    set_condition(job.status, new_condition(ConditionType.SUCCEEDED, "x", "y"))
+    h = DrainHarness(job)
+    assert h.ctl.record_recovery(RecoveryInfo(recovered=True, resource_version=7)) == 0
+    assert h.store.list(KIND_SPAN) == []
+
+
+def test_record_recovery_rearms_open_restart_span_for_mttr():
+    """A restart span opened by the DEAD incarnation closes when THIS
+    incarnation sees the gang RUNNING — MTTR stays trace-accurate across
+    operator restarts."""
+    from tf_operator_tpu.api.types import KIND_SPAN
+    from tf_operator_tpu.obs.spans import Span
+    from tf_operator_tpu.runtime.persist import RecoveryInfo
+    from tf_operator_tpu.obs.spans import span_labels
+
+    job = _job(name="midrestart", workers=1)
+    procs = [_member(job, 0, ProcessPhase.RUNNING)]
+    h = DrainHarness(job, procs)
+    # The dead incarnation's open restart span, as recovered from disk.
+    h.store.create(Span(
+        metadata=ObjectMeta(
+            name="midrestart-open-restart", namespace="default",
+            labels=span_labels(job.metadata.name),
+        ),
+        trace_id=job.metadata.uid, span_id="midrestart-open-restart",
+        op="restart", start_time=time.time() - 5.0, end_time=0.0,
+        attrs={"cause": CAUSE_FAILURE},
+    ))
+    h.ctl.record_recovery(RecoveryInfo(recovered=True, resource_version=9))
+    assert job.metadata.uid in h.ctl._open_restart
+    h.sync()  # gang fully RUNNING -> RUNNING condition -> span closes
+    got = h.store.get(KIND_SPAN, "default", "midrestart-open-restart")
+    assert got.end_time > 0
+    assert "tpujob_restart_downtime_seconds" in h.ctl.metrics.render()

@@ -44,3 +44,42 @@ def test_seeded_soak_crash_and_preemption_recover_warm(tmp_path):
     assert result.preemption_count >= 1
     # warm restart: the post-fault gang resumed past step 0
     assert max(result.resume_steps) > 0
+
+
+def test_crash_schedule_is_pure_function_of_seed():
+    assert default_schedule(SEED, operator_crash=True) == default_schedule(
+        SEED, operator_crash=True
+    )
+    # the operator-crash fault is part of the derived schedule, not a
+    # runtime decision
+    kinds = [f.kind.value for f in default_schedule(SEED, operator_crash=True).faults]
+    assert kinds == ["crash", "operator-crash", "preempt"]
+
+
+def test_seeded_soak_operator_crash_recovers_and_readopts(tmp_path):
+    """The control-plane half of the acceptance bar: the operator
+    (durable store + controller + API) is killed and restarted mid-run
+    between a process crash and a preemption, while agents ride
+    RemoteStore retries. The job must still reach Succeeded with zero
+    duplicate gang-member creates, monotonic warm resumes, and the
+    restart visible as a controller-restart span in the trace."""
+    result = run_soak(
+        seed=11,
+        steps=8,
+        checkpoint_every=2,
+        backoff_limit=2,
+        workdir=str(tmp_path),
+        timeout=420.0,
+        operator_crash=True,
+    )
+    errors = result.check()
+    assert not errors, (
+        f"{errors}\nresult: restarts={result.restart_count} "
+        f"preemptions={result.preemption_count} "
+        f"operator_restarts={result.operator_restarts} "
+        f"incarnations={result.gang_incarnations} "
+        f"resume={result.resume_steps} applied={result.applied} "
+        f"conditions={result.conditions}"
+    )
+    assert result.operator_restarts == 1
+    assert result.trace_ops.count("controller-restart") >= 1

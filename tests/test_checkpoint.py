@@ -417,3 +417,41 @@ def test_init_and_step_matches_init_then_step():
     # and the normal step program continues from the fused state
     s_next, m_next = t2.step(s_fused, batch)
     assert float(m_next["loss"]) < float(m_fused["loss"])
+
+
+# ---------------------------------------------------------------------------
+# crash-mid-save (r8): a torn orbax step dir is never a resume point
+# ---------------------------------------------------------------------------
+
+
+def test_crash_mid_save_never_becomes_resume_point(tmp_path):
+    """A bare numeric step dir without orbax's commit marker is a save
+    cut by a crash: discovery must fall back to the newest COMPLETE step
+    instead of handing the warm-restart env a corrupt checkpoint."""
+    from tf_operator_tpu.train.checkpoint import latest_checkpoint_step
+
+    d = tmp_path / "ckpt"
+    mgr = CheckpointManager(str(d), backend="orbax")
+    mgr.save(2, {"a": np.ones(3)}, wait=True)
+    mgr.close()
+    assert latest_checkpoint_step(str(d)) == 2
+    # Crash mid-save at step 4: the dir exists (renamed into place or
+    # partially written) but the commit marker never landed.
+    torn = d / "4"
+    torn.mkdir()
+    (torn / "default").mkdir()
+    assert latest_checkpoint_step(str(d)) == 2, "torn step 4 must not win"
+    # Commit marker appears (the save finalizes): now it is the latest.
+    (torn / "_CHECKPOINT_METADATA").write_text("{}")
+    assert latest_checkpoint_step(str(d)) == 4
+
+
+def test_npy_step_without_manifest_is_not_a_resume_point(tmp_path):
+    from tf_operator_tpu.train.checkpoint import latest_checkpoint_step
+
+    d = tmp_path / "ckpt"
+    d.mkdir()
+    (d / "step_3").mkdir()
+    (d / "step_3" / "manifest.json").write_text("{}")
+    (d / "step_5").mkdir()  # no manifest: torn npy save
+    assert latest_checkpoint_step(str(d)) == 3

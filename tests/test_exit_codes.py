@@ -25,9 +25,13 @@ def test_user_defined_retryable_138():
 
 
 def test_oom_always_permanent():
-    # training.go:193-206: OOMKilled overrides even retryable codes.
-    assert classify_exit_code(137, oom_killed=True) is ExitClass.PERMANENT
-    assert classify_exit_code(0, oom_killed=True) is ExitClass.PERMANENT
+    # training.go:193-206: OOMKilled overrides even retryable codes. The
+    # class is OOM (distinct from PERMANENT for cause accounting, r8) but
+    # is_permanent — the restart decision — treats them identically.
+    assert classify_exit_code(137, oom_killed=True) is ExitClass.OOM
+    assert classify_exit_code(0, oom_killed=True) is ExitClass.OOM
+    assert is_permanent(137, oom_killed=True)
+    assert is_permanent(0, oom_killed=True)
 
 
 def test_negative_signal_codes():

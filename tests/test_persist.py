@@ -1,0 +1,247 @@
+"""Durable store internals (runtime/persist.py): WAL torn-tail
+truncation, checksum rejection, snapshot compaction equivalence, and
+resource_version monotonicity across recovery — plus the acceptance pin:
+a fresh store pointed at the same data-dir recovers the IDENTICAL object
+set and resource_version as the pre-crash store."""
+
+import json
+import os
+
+import pytest
+
+from tf_operator_tpu.api.types import KIND_TPUJOB, ObjectMeta, TPUJob
+from tf_operator_tpu.runtime.objects import Host, Process
+from tf_operator_tpu.runtime.persist import (
+    PersistenceError,
+    open_store,
+    recover,
+)
+from tf_operator_tpu.runtime.serialize import to_doc
+from tf_operator_tpu.runtime.store import ConflictError, WatchEventType
+
+
+def _populate(store, n_procs=6):
+    """A representative mutation mix across kinds: creates, an update, a
+    delete. Returns the job as last-written."""
+    job = store.create(TPUJob(metadata=ObjectMeta(name="j1")))
+    store.create(Host(metadata=ObjectMeta(name="h1")))
+    for i in range(n_procs):
+        store.create(
+            Process(
+                metadata=ObjectMeta(
+                    name=f"p{i}", labels={"tpu_job_name": "j1"}
+                )
+            )
+        )
+    store.delete("Process", "default", "p0")
+    job = store.get(KIND_TPUJOB, "default", "j1")
+    job.status.restart_count = 2
+    return store.update(job, check_version=True)
+
+
+def _dump(store):
+    """Canonical object-set image: every kind, as wire docs, sorted."""
+    docs = []
+    for kind in ("TPUJob", "Process", "Host", "Endpoint", "Event", "Span", "Lease"):
+        for obj in store.list(kind):
+            docs.append(to_doc(obj))
+    return sorted(json.dumps(d, sort_keys=True) for d in docs)
+
+
+def _wal_segments(data_dir):
+    return sorted(
+        os.path.join(data_dir, n)
+        for n in os.listdir(data_dir)
+        if n.startswith("wal-")
+    )
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pin: identical object set + resource_version post-recovery
+# ---------------------------------------------------------------------------
+
+
+def test_recovery_reproduces_identical_object_set_and_rv(tmp_path):
+    d = str(tmp_path / "store")
+    s1, info1 = open_store(d)
+    assert not info1.recovered
+    job = _populate(s1)
+    image = _dump(s1)
+
+    s2, info2 = open_store(d)
+    assert info2.recovered
+    assert _dump(s2) == image  # identical objects, uids, rvs, timestamps
+    # The counter continues exactly where the dead incarnation stopped:
+    # the very next allocation is recovered_rv + 1.
+    p = s2.create(Process(metadata=ObjectMeta(name="post")))
+    assert p.metadata.resource_version == info2.resource_version + 1
+    assert p.metadata.resource_version > job.metadata.resource_version
+    # uid survives recovery — what re-adoption keys on.
+    assert s2.get(KIND_TPUJOB, "default", "j1").metadata.uid == job.metadata.uid
+
+
+def test_optimistic_cas_behaves_identically_post_restart(tmp_path):
+    d = str(tmp_path / "store")
+    s1, _ = open_store(d)
+    _populate(s1)
+    s2, _ = open_store(d)
+    stale = s2.get(KIND_TPUJOB, "default", "j1")
+    s2.update(stale)  # bumps the stored version
+    with pytest.raises(ConflictError):
+        s2.update(stale, check_version=True)
+
+
+def test_deletes_are_durable_and_indices_rebuilt(tmp_path):
+    d = str(tmp_path / "store")
+    s1, _ = open_store(d)
+    _populate(s1)
+    s2, _ = open_store(d)
+    names = {p.metadata.name for p in s2.list("Process")}
+    assert "p0" not in names and "p1" in names
+    # Label index rebuilt: the job-name selector serves from its bucket.
+    by_label = s2.list("Process", label_selector={"tpu_job_name": "j1"})
+    assert {p.metadata.name for p in by_label} == names
+
+
+def test_watch_replays_recovered_objects(tmp_path):
+    d = str(tmp_path / "store")
+    s1, _ = open_store(d)
+    _populate(s1, n_procs=2)
+    s2, _ = open_store(d)
+    w = s2.watch(kinds=["Process"])
+    w.stop()
+    replayed = [ev for ev in iter(w.queue.get, None)]
+    assert {e.obj.metadata.name for e in replayed
+            if e.type is WatchEventType.ADDED} == {"p1"}
+
+
+# ---------------------------------------------------------------------------
+# WAL damage: torn tail truncated, mid-file corruption refused
+# ---------------------------------------------------------------------------
+
+
+def test_torn_tail_is_truncated_and_recovery_proceeds(tmp_path):
+    d = str(tmp_path / "store")
+    s1, _ = open_store(d)
+    _populate(s1)
+    image = _dump(s1)
+    seg = _wal_segments(d)[-1]
+    with open(seg, "ab") as f:
+        f.write(b'{"rv": 999, "op": "create", "truncated mid-wri')
+    size_with_tear = os.path.getsize(seg)
+
+    s2, info = open_store(d)
+    assert info.truncated_tail
+    assert _dump(s2) == image
+    assert os.path.getsize(seg) < size_with_tear
+
+
+def test_torn_tail_with_bad_checksum_is_truncated(tmp_path):
+    # A complete-looking final line whose checksum fails (partial sector
+    # write) is also a torn tail — nothing follows it.
+    d = str(tmp_path / "store")
+    s1, _ = open_store(d)
+    _populate(s1)
+    image = _dump(s1)
+    seg = _wal_segments(d)[-1]
+    with open(seg, "ab") as f:
+        f.write(b'{"rv": 999, "op": "create", "kind": "Host", "ns": "default",'
+                b' "name": "x", "obj": null, "crc": 1}\n')
+    s2, info = open_store(d)
+    assert info.truncated_tail
+    assert _dump(s2) == image
+
+
+def test_midfile_checksum_corruption_is_refused(tmp_path):
+    d = str(tmp_path / "store")
+    s1, _ = open_store(d)
+    _populate(s1)
+    seg = _wal_segments(d)[-1]
+    lines = open(seg, "rb").read().splitlines(keepends=True)
+    assert len(lines) >= 3
+    # Flip a byte inside an EARLY record's payload: later good records
+    # prove this is corruption, not a crash artifact.
+    doc = json.loads(lines[1])
+    doc["name"] = doc["name"] + "-tampered"
+    lines[1] = json.dumps(doc, sort_keys=True).encode() + b"\n"
+    with open(seg, "wb") as f:
+        f.writelines(lines)
+    with pytest.raises(PersistenceError):
+        recover(d)
+
+
+def test_recovery_after_torn_tail_can_keep_appending(tmp_path):
+    d = str(tmp_path / "store")
+    s1, _ = open_store(d)
+    _populate(s1)
+    with open(_wal_segments(d)[-1], "ab") as f:
+        f.write(b"garbage-no-newline")
+    s2, _ = open_store(d)
+    s2.create(Process(metadata=ObjectMeta(name="after-tear")))
+    s3, _ = open_store(d)
+    assert "after-tear" in {p.metadata.name for p in s3.list("Process")}
+
+
+# ---------------------------------------------------------------------------
+# snapshot compaction: snapshot + WAL-suffix replay ≡ full WAL replay
+# ---------------------------------------------------------------------------
+
+
+def _mutation_sequence(store):
+    for i in range(17):
+        store.create(Process(metadata=ObjectMeta(name=f"m{i}")))
+    for i in range(0, 17, 3):
+        store.delete("Process", "default", f"m{i}")
+    for i in range(1, 17, 3):  # never a deleted (multiple-of-3) name
+        p = store.get("Process", "default", f"m{i}")
+        p.status.message = f"updated-{i}"
+        store.update(p)
+
+
+def test_snapshot_compaction_equivalent_to_full_replay(tmp_path):
+    compacted, _ = open_store(str(tmp_path / "a"), snapshot_every=4)
+    full, _ = open_store(str(tmp_path / "b"), snapshot_every=10**9)
+    _mutation_sequence(compacted)
+    _mutation_sequence(full)
+
+    # Compaction actually happened (snapshots + rotated segments)...
+    snaps = [n for n in os.listdir(str(tmp_path / "a")) if n.startswith("snapshot-")]
+    assert snaps, "snapshot_every=4 over ~30 mutations must have compacted"
+    assert not [
+        n for n in os.listdir(str(tmp_path / "b")) if n.startswith("snapshot-")
+    ]
+
+    ra, ia = open_store(str(tmp_path / "a"))
+    rb, ib = open_store(str(tmp_path / "b"))
+    # ...and is unobservable: identical object set; identical rv counter
+    # (uids differ across the two stores, so compare names/rvs).
+    assert ia.resource_version == ib.resource_version
+    assert [
+        (p.metadata.name, p.metadata.resource_version, p.status.message)
+        for p in ra.list("Process")
+    ] == [
+        (p.metadata.name, p.metadata.resource_version, p.status.message)
+        for p in rb.list("Process")
+    ]
+
+
+def test_compaction_garbage_collects_superseded_files(tmp_path):
+    d = str(tmp_path / "store")
+    s, _ = open_store(d, snapshot_every=5)
+    for i in range(26):
+        s.create(Process(metadata=ObjectMeta(name=f"g{i}")))
+    snaps = sorted(n for n in os.listdir(d) if n.startswith("snapshot-"))
+    segs = _wal_segments(d)
+    assert len(snaps) == 1, f"old snapshots must be GC'd: {snaps}"
+    assert len(segs) == 1, f"superseded WAL segments must be GC'd: {segs}"
+
+
+def test_rv_monotonic_across_many_recoveries(tmp_path):
+    d = str(tmp_path / "store")
+    seen = []
+    for i in range(4):
+        s, info = open_store(d, snapshot_every=3)
+        obj = s.create(Process(metadata=ObjectMeta(name=f"r{i}")))
+        seen.append(obj.metadata.resource_version)
+        assert obj.metadata.resource_version > info.resource_version
+    assert seen == sorted(seen) and len(set(seen)) == len(seen)

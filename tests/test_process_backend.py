@@ -265,3 +265,62 @@ def test_native_registry_does_not_leak():
     for c in children:
         assert c.wait() == 0
     assert sup.tracked_count() == before
+
+
+# ---------------------------------------------------------------------------
+# OOM oracle (r8): SIGKILL exits promote to oom_killed only when the
+# supervising cgroup's oom_kill counter advanced across the child's life
+# ---------------------------------------------------------------------------
+
+
+def test_sigkill_with_oom_counter_delta_reports_oom_killed():
+    import itertools
+
+    store = Store()
+    ctl = LocalProcessControl(
+        store,
+        command_builder=script_builder("import os, signal; os.kill(os.getpid(), signal.SIGKILL)"),
+    )
+    # Oracle stub: the cgroup counter ticks once between spawn and exit.
+    ctl._oom_kills_reader = itertools.count().__next__
+    ctl.create_process(proc("oomer"))
+    assert wait_for(
+        lambda: store.get("Process", "default", "oomer").status.phase
+        is ProcessPhase.FAILED
+    )
+    st = store.get("Process", "default", "oomer").status
+    assert st.exit_code in (137, -9)
+    assert st.oom_killed is True
+
+
+def test_sigkill_without_oracle_stays_plain_retryable():
+    store = Store()
+    ctl = LocalProcessControl(
+        store,
+        command_builder=script_builder("import os, signal; os.kill(os.getpid(), signal.SIGKILL)"),
+    )
+    ctl._oom_kills_reader = lambda: None  # no cgroup oracle available
+    ctl.create_process(proc("killed"))
+    assert wait_for(
+        lambda: store.get("Process", "default", "killed").status.phase
+        is ProcessPhase.FAILED
+    )
+    st = store.get("Process", "default", "killed").status
+    assert st.oom_killed is False  # conservative: never a guessed OOM
+
+
+def test_clean_exit_ignores_oom_counter_noise():
+    # A sibling's OOM (counter delta) must not taint a clean exit.
+    import itertools
+
+    store = Store()
+    ctl = LocalProcessControl(
+        store, command_builder=script_builder("import sys; sys.exit(0)")
+    )
+    ctl._oom_kills_reader = itertools.count().__next__
+    ctl.create_process(proc("clean"))
+    assert wait_for(
+        lambda: store.get("Process", "default", "clean").status.phase
+        is ProcessPhase.SUCCEEDED
+    )
+    assert store.get("Process", "default", "clean").status.oom_killed is False

@@ -427,3 +427,89 @@ def test_informer_over_remote_watch_replay_semantics():
         server.stop()  # no-op if already stopped
         if server2 is not None:
             server2.stop()
+
+
+# ---------------------------------------------------------------------------
+# watch reconnect backoff (r8): a flapping server must not be busy-spun
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_grows_exponentially_caps_and_resets():
+    import random
+
+    from tf_operator_tpu.runtime.remote_store import Backoff
+
+    b = Backoff(initial=0.2, cap=3.0, factor=2.0, rng=random.Random(0))
+    raw = [0.2, 0.4, 0.8, 1.6, 3.0, 3.0]  # pre-jitter schedule, capped
+    delays = [b.next_delay() for _ in range(len(raw))]
+    for d, r in zip(delays, raw):
+        assert r / 2 <= d <= r, (d, r)  # jitter stays within [d/2, d]
+    b.reset()
+    d = b.next_delay()
+    assert 0.1 <= d <= 0.2  # back to the initial rung
+
+
+def test_flapping_server_is_not_busy_spun():
+    """A server that accepts and immediately drops connections: the watch
+    must pace its reconnects by backoff — bounded attempts in a window —
+    instead of a hot connect loop, and surface the reconnect count."""
+    import random
+    import socket
+    import threading as _threading
+
+    from tf_operator_tpu.runtime.remote_store import Backoff, RemoteWatch
+
+    accepted = []
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(16)
+    port = srv.getsockname()[1]
+    stop_srv = _threading.Event()
+
+    def flap():
+        srv.settimeout(0.1)
+        while not stop_srv.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            accepted.append(time.monotonic())
+            conn.close()  # drop before any response: a flap
+
+    t = _threading.Thread(target=flap, daemon=True)
+    t.start()
+    watch = RemoteWatch(
+        f"http://127.0.0.1:{port}", kinds=None, connect_timeout=1.0,
+        backoff=Backoff(initial=0.2, cap=2.0, rng=random.Random(1)),
+    )
+    consumer = _threading.Thread(
+        target=lambda: [None for _ in watch], daemon=True
+    )
+    consumer.start()
+    time.sleep(1.5)
+    watch.stop()
+    stop_srv.set()
+    consumer.join(timeout=5)
+    t.join(timeout=5)
+    srv.close()
+    # Backoff schedule 0.2/0.4/0.8... jittered down to half: at most ~6
+    # connects fit in 1.5s; a hot loop would rack up hundreds.
+    assert 1 <= len(accepted) <= 8, f"{len(accepted)} connects in 1.5s"
+    assert watch.reconnects >= 1
+
+
+def test_remote_store_aggregates_watch_reconnects(remote):
+    store, rs = remote
+    w = rs.watch(kinds=[KIND_HOST])
+    events = []
+
+    def consume():
+        for ev in w:
+            events.append(ev)
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    assert wait_for(lambda: len(events) >= 1, timeout=10)  # REPLAY_START
+    assert rs.watch_reconnects_total == 0  # healthy stream: no reconnects
+    w.stop()
+    t.join(timeout=5)

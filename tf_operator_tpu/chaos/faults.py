@@ -17,6 +17,9 @@ preemptions) — and *what* it does:
 - ``STORE_LATENCY``    inject ``latency_s`` per store op for ``duration_s``
 - ``STORE_ERROR``      make the next ``errors`` store ops raise
                        TransientStoreError (operator-restart blip)
+- ``OPERATOR_CRASH``   kill and restart the operator itself (durable
+                       store + controller + API server); requires the
+                       injector to hold an operator handle
 
 Faults fire strictly in schedule order (a fault waits for its
 predecessors), so the *sequence* is deterministic even though wall-clock
@@ -41,6 +44,12 @@ class FaultKind(str, enum.Enum):
     STALL_HEARTBEAT = "stall-heartbeat"
     STORE_LATENCY = "store-latency"
     STORE_ERROR = "store-error"
+    # Kill and restart the OPERATOR itself (durable store + controller +
+    # API) mid-run — the control-plane half of the failure matrix. Agents
+    # ride RemoteStore retries/watch reconnects across the outage; the
+    # restarted operator recovers from its --data-dir and re-adopts the
+    # live gang (runtime/persist.py + controller.record_recovery).
+    OPERATOR_CRASH = "operator-crash"
 
 
 @dataclass(frozen=True)
@@ -100,6 +109,7 @@ class FaultSchedule:
         preemptions: int = 1,
         stalls: int = 0,
         store_blips: int = 0,
+        operator_crashes: int = 0,
         first_step: int = 2,
         spread_s: float = 20.0,
     ) -> "FaultSchedule":
@@ -109,10 +119,14 @@ class FaultSchedule:
         first_step``) so recovery is always *warm*: a crash before the
         first checkpoint would legitimately resume from step 0 and the
         soak's resume-step assertions would be vacuous. Crashes come
-        first, then preemptions (each gated one restart later so they hit
-        the post-crash gang), then stalls/blips. Same seed ⇒ identical
-        schedule; that plus in-order firing is the reproducibility
-        contract."""
+        first, then operator crashes (the control plane dies over a live
+        gang — deliberately before the preemptions so the RESTARTED
+        controller must execute the graceful drain), then preemptions
+        (each gated one restart later so they hit the post-crash gang),
+        then stalls/blips. Operator crashes do not advance the restart
+        gate: killing the control plane must not restart the job. Same
+        seed ⇒ identical schedule; that plus in-order firing is the
+        reproducibility contract."""
         rng = random.Random(seed)
         faults = []
         restarts_so_far = 0
@@ -129,6 +143,15 @@ class FaultSchedule:
                 )
             )
             restarts_so_far += 1
+        for _ in range(operator_crashes):
+            faults.append(
+                Fault(
+                    FaultKind.OPERATOR_CRASH,
+                    at_s=rng.uniform(0.0, spread_s),
+                    at_step=first_step,
+                    after_restarts=restarts_so_far,
+                )
+            )
         for _ in range(preemptions):
             faults.append(
                 Fault(

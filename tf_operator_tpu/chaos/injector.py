@@ -17,6 +17,11 @@ Wraps the three seams the runtime exposes:
   victim (the monitor thread reports the exit like a real crash), then
   ``os.kill`` by pid, then a direct store status write for store-only
   rigs (unit tests over FakeProcessControl).
+- **Operator** — OPERATOR_CRASH kills and restarts the control plane
+  itself through an ``operator`` handle (``restart()``): the soak's
+  restartable operator tears down its API server + controller and
+  recovers a fresh incarnation from the durable store (--data-dir),
+  while agents ride RemoteStore retries across the outage.
 
 Faults fire strictly in schedule order; a fault whose conditions hold but
 whose target does not exist yet (e.g. a preemption scheduled against the
@@ -158,7 +163,12 @@ class ChaosInjector:
         agents: Optional[Dict[str, Any]] = None,
         checkpoint_dir: Optional[str] = None,
         poll_interval: float = 0.1,
+        operator: Optional[Any] = None,
     ) -> None:
+        """``operator``: handle with a ``restart()`` method (kill + recover
+        the control plane) — required only when the schedule contains an
+        OPERATOR_CRASH fault. The injector's own ``store`` should be a
+        RemoteStore in that rig so its trigger reads survive the outage."""
         self.schedule = schedule
         self.store = store
         self.job_name = job_name
@@ -166,6 +176,7 @@ class ChaosInjector:
         self.agents: Dict[str, Any] = dict(agents or {})
         self.checkpoint_dir = checkpoint_dir
         self.poll_interval = poll_interval
+        self.operator = operator
         self.knobs = _Knobs()
         # Applied faults, in order: {"kind", "target", "t_s", ...detail}.
         self.applied: List[Dict[str, Any]] = []
@@ -279,7 +290,33 @@ class ChaosInjector:
                 self.knobs.error_budget += fault.errors
             self._record(fault, "store", errors=fault.errors)
             return True
+        if fault.kind is FaultKind.OPERATOR_CRASH:
+            return self._fire_operator_crash(fault)
         raise ValueError(f"unknown fault kind {fault.kind!r}")
+
+    def _fire_operator_crash(self, fault: Fault) -> bool:
+        """Kill + restart the control plane over a live gang. Gated on a
+        fully RUNNING gang (like preemption): crashing the operator while
+        a gang recreate is in flight would test a different, racier
+        scenario each run and break sequence reproducibility."""
+        if self.operator is None:
+            raise ValueError(
+                "schedule contains OPERATOR_CRASH but the injector has no "
+                "operator handle (pass operator= to ChaosInjector)"
+            )
+        running = [
+            p for p in self._live_processes()
+            if p.status.phase is ProcessPhase.RUNNING
+        ]
+        gang = self._gang_size()
+        if not running or (gang and len(running) < gang):
+            return False
+        self.operator.restart()
+        self._record(
+            fault, "operator",
+            restarts=getattr(self.operator, "restarts", None),
+        )
+        return True
 
     def _fire_crash(self, fault: Fault) -> bool:
         # Victims must be observably RUNNING: killing a Pending member

@@ -27,10 +27,22 @@ the recovery invariants the whole subsystem exists to guarantee:
    ``downtime_bound_s``. Previously recovery latency could only be
    inferred indirectly; now it is read off the same trace ``tpujob
    trace`` exports.
+7. **Zero duplicate gang-member creates** — distinct incarnations
+   (uids) per gang name never exceed 1 + restart_count +
+   preemption_count: no sync — least of all a RESTARTED controller's
+   first — ever re-created a child it should have re-adopted.
+8. **Control-plane crash recovery** (``--operator-crash``) — the rig
+   becomes the real multi-process topology: a RestartableOperator
+   (durable store via runtime/persist.py + controller + HTTP API) with
+   agents and the injector on RemoteStore. A scheduled OPERATOR_CRASH
+   kills and recovers the whole control plane mid-run; the job must
+   still satisfy every invariant above, and the outage must be VISIBLE
+   as a ``controller-restart`` span in the job's trace.
 
-Runnable standalone (the CI ``chaos-soak`` stage)::
+Runnable standalone (the CI ``chaos-soak`` / ``crash-soak`` stages)::
 
     python -m tf_operator_tpu.chaos.soak --seed 7 --steps 8
+    python -m tf_operator_tpu.chaos.soak --seed 11 --steps 8 --operator-crash
 
 Exits nonzero when any invariant is violated.
 """
@@ -45,7 +57,7 @@ import tempfile
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from tf_operator_tpu.api.types import (
     KIND_PROCESS,
@@ -58,7 +70,7 @@ from tf_operator_tpu.api.types import (
     TPUJob,
     TPUJobSpec,
 )
-from tf_operator_tpu.chaos.faults import FaultSchedule
+from tf_operator_tpu.chaos.faults import FaultKind, FaultSchedule
 from tf_operator_tpu.chaos.injector import ChaosInjector
 from tf_operator_tpu.controller import TPUJobController
 from tf_operator_tpu.controller.status import has_condition, is_finished
@@ -69,9 +81,10 @@ from tf_operator_tpu.runtime import (
     FakeProcessControl,
     HostAgent,
     LocalProcessControl,
+    RemoteStore,
     Store,
 )
-from tf_operator_tpu.runtime.store import WatchEventType
+from tf_operator_tpu.runtime.store import TransientStoreError, WatchEventType
 
 log = logging.getLogger("tpujob.soak")
 
@@ -87,13 +100,102 @@ DATAPLANE_ENV = {
 }
 
 
-def default_schedule(seed: int) -> FaultSchedule:
+def default_schedule(seed: int, operator_crash: bool = False) -> FaultSchedule:
     """The acceptance recipe: one mid-run crash (after the first
     checkpoint exists, so recovery is warm) then one preemption notice
-    delivered to the post-restart gang. Pure function of the seed."""
+    delivered to the post-restart gang. With ``operator_crash``, the
+    control plane itself is killed+recovered between the two — so the
+    preemption drain is executed by the RESTARTED controller over
+    re-adopted state. Pure function of the seed."""
     return FaultSchedule.generate(
-        seed, crashes=1, preemptions=1, first_step=2, spread_s=0.0
+        seed, crashes=1, preemptions=1,
+        operator_crashes=1 if operator_crash else 0,
+        first_step=2, spread_s=0.0,
     )
+
+
+class RestartableOperator:
+    """The OPERATOR_CRASH target: a full in-process operator — durable
+    store (``runtime/persist.py`` WAL + snapshots under ``data_dir``),
+    reconciling controller, and the HTTP API server agents connect to —
+    that can be killed and brought back on the SAME port mid-soak.
+
+    ``restart()`` is the crash: the API server dies first (agents'
+    RemoteStore calls start failing and their watches drop), then the
+    controller threads, and the store object is simply dropped — nothing
+    is flushed or handed over beyond what the WAL already captured per
+    mutation, which is exactly the SIGKILL contract. The new incarnation
+    recovers from disk, re-runs informers, and executes the controller's
+    re-adoption pass (record_recovery)."""
+
+    def __init__(
+        self,
+        data_dir: str,
+        heartbeat_ttl: float,
+        resync_period: float = 0.5,
+        snapshot_every: int = 50,
+    ) -> None:
+        self.data_dir = data_dir
+        self.heartbeat_ttl = heartbeat_ttl
+        self.resync_period = resync_period
+        self.snapshot_every = snapshot_every
+        self.port = 0  # first start picks an ephemeral port, then pins it
+        self.restarts = 0
+        # One FakeProcessControl per incarnation: in managed mode every
+        # gang member is host-bound, so ANY create through a controller's
+        # own backend — any incarnation's — is a leak the soak reports.
+        self.fakes: List[FakeProcessControl] = []
+        self.store: Optional[Store] = None
+        self.controller = None
+        self.dashboard = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> None:
+        from tf_operator_tpu.dashboard import DashboardServer
+        from tf_operator_tpu.runtime.persist import open_store
+
+        store, info = open_store(
+            self.data_dir, snapshot_every=self.snapshot_every
+        )
+        fake = FakeProcessControl()
+        ctl = TPUJobController(store, fake, resync_period=self.resync_period)
+        ctl.scheduler.heartbeat_ttl = self.heartbeat_ttl
+        dashboard = DashboardServer(store, host="127.0.0.1", port=self.port)
+        dashboard.start()
+        self.port = dashboard.port
+        ctl.api_url = dashboard.url
+        ctl.run(workers=2)
+        if info.recovered:
+            ctl.record_recovery(info)
+        self.store, self.controller, self.dashboard = store, ctl, dashboard
+        self.fakes.append(fake)
+        log.warning(
+            "operator up on %s (recovered=%s objects=%d rv=%d)",
+            self.url, info.recovered, info.objects, info.resource_version,
+        )
+
+    def crash(self) -> None:
+        """Tear the control plane down ungracefully-in-spirit: no drain,
+        no handoff — durability must come from the WAL alone."""
+        self.dashboard.stop()
+        self.controller.stop()
+        self.store = None
+
+    def restart(self) -> None:
+        self.restarts += 1
+        log.warning("chaos: killing the operator (restart #%d)", self.restarts)
+        self.crash()
+        self.start()
+
+    def created_through_controller(self) -> List[str]:
+        """Process names any incarnation's controller launched through its
+        OWN backend — must be empty in managed mode."""
+        return [
+            p.metadata.name for fake in self.fakes for p in fake.created
+        ]
 
 
 @dataclass
@@ -103,8 +205,9 @@ class SoakResult:
     preemption_count: int = 0
     last_restart_cause: str = ""
     conditions: List[tuple] = field(default_factory=list)
-    # Controller-declared resume steps, one per created gang process, in
-    # creation (watch ADDED) order.
+    # Controller-declared resume steps, one per created gang process
+    # INCARNATION (deduped by uid — remote watch replays redeliver), in
+    # first-observed (creation) order.
     resume_steps: List[int] = field(default_factory=list)
     partial_gang_violations: List[str] = field(default_factory=list)
     applied: List[dict] = field(default_factory=list)
@@ -114,6 +217,15 @@ class SoakResult:
     # checks them against.
     restart_windows: List[dict] = field(default_factory=list)
     downtime_bound_s: float = 60.0
+    # Distinct uids created per gang-member name (watch ADDED, deduped):
+    # invariant 7 pins this to 1 + restart_count + preemption_count —
+    # an operator restart that double-created gang members would exceed it.
+    gang_incarnations: Dict[str, int] = field(default_factory=dict)
+    # Control-plane crash bookkeeping (invariant 8): how many times the
+    # operator was killed+recovered, and every span op in the job's trace
+    # (the restart must be VISIBLE as a controller-restart span).
+    operator_restarts: int = 0
+    trace_ops: List[str] = field(default_factory=list)
 
     def check(self) -> List[str]:
         """Invariant failures, empty when the soak passed."""
@@ -161,6 +273,32 @@ class SoakResult:
                     f"preemption recovery downtime {w['downtime_s']:.1f}s "
                     f"exceeds bound {self.downtime_bound_s:.0f}s: {w}"
                 )
+        # Invariant 7: zero duplicate gang-member creates. Every create of
+        # a gang name is accounted for by exactly one fault-driven gang
+        # restart (+1 for the original) — a controller that restarted and
+        # re-created children it should have re-adopted shows up here.
+        expected_incarnations = 1 + self.restart_count + self.preemption_count
+        for name, n in sorted(self.gang_incarnations.items()):
+            if n > expected_incarnations:
+                errs.append(
+                    f"duplicate gang-member creates: {name} created {n}x "
+                    f"but only {expected_incarnations} incarnations are "
+                    f"accounted for ({self.restart_count} restarts + "
+                    f"{self.preemption_count} preemptions + the original)"
+                )
+        # Invariant 8: an operator crash actually happened when scheduled,
+        # and the restart is visible in the job trace as a
+        # controller-restart span (the recovery pass records one per live
+        # job — obs/ is how an SRE sees the control-plane outage inline
+        # with the job's own timeline).
+        if any(a["kind"] == "operator-crash" for a in self.applied):
+            if self.operator_restarts < 1:
+                errs.append("operator-crash applied but the operator never restarted")
+            if "controller-restart" not in self.trace_ops:
+                errs.append(
+                    "operator crashed+recovered but the job trace has no "
+                    f"controller-restart span (ops: {sorted(set(self.trace_ops))})"
+                )
         return errs
 
 
@@ -170,9 +308,17 @@ class _InvariantWatcher:
     Partial-gang detection is persistence-based: sequential store
     creates/deletes make instantaneous strict subsets unavoidable, so a
     violation is a strict nonempty subset that survives ``grace_s``
-    continuously — the steady state the atomic scheduler must foreclose."""
+    continuously — the steady state the atomic scheduler must foreclose.
 
-    def __init__(self, store: Store, job_name: str, gang_names: List[str],
+    Works against a local Store OR a RemoteStore (the operator-crash
+    rig): remote watches reconnect and REPLAY existing objects, so every
+    observation dedupes by uid — a replayed ADDED is the same
+    incarnation, not a new create. List polls during an operator outage
+    raise TransientStoreError; the poll loop skips those ticks (the
+    partial-gang clock also resets: with the store dark there is no
+    evidence either way)."""
+
+    def __init__(self, store: Any, job_name: str, gang_names: List[str],
                  grace_s: float = 10.0) -> None:
         self.store = store
         self.job_name = job_name
@@ -180,6 +326,10 @@ class _InvariantWatcher:
         self.grace_s = grace_s
         self.violations: List[str] = []
         self.resume_steps: List[int] = []
+        # name -> set of uids observed for it (distinct incarnations
+        # actually created; the duplicate-create oracle).
+        self.created_uids: Dict[str, set] = {}
+        self._seen_uids: set = set()
         self._partial_since: Optional[float] = None
         self._stop = threading.Event()
         self._watch = store.watch(kinds=[KIND_PROCESS])
@@ -207,18 +357,31 @@ class _InvariantWatcher:
             if ev.type is not WatchEventType.ADDED or ev.obj is None:
                 continue
             p = ev.obj
-            if p.metadata.name in self.gang_names:
-                self.resume_steps.append(
-                    int(p.spec.env.get(ENV_RESUME_STEP, "0") or 0)
-                )
+            if p.metadata.name not in self.gang_names:
+                continue
+            if p.metadata.uid in self._seen_uids:
+                continue  # watch-reconnect replay of a known incarnation
+            self._seen_uids.add(p.metadata.uid)
+            self.created_uids.setdefault(p.metadata.name, set()).add(
+                p.metadata.uid
+            )
+            self.resume_steps.append(
+                int(p.spec.env.get(ENV_RESUME_STEP, "0") or 0)
+            )
 
     def _poll_loop(self) -> None:
+        from tf_operator_tpu.runtime.store import TransientStoreError
+
         while not self._stop.wait(0.2):
-            live = {
-                p.metadata.name
-                for p in self.store.list(KIND_PROCESS, namespace="default")
-                if p.metadata.name in self.gang_names and not p.is_finished()
-            }
+            try:
+                live = {
+                    p.metadata.name
+                    for p in self.store.list(KIND_PROCESS, namespace="default")
+                    if p.metadata.name in self.gang_names and not p.is_finished()
+                }
+            except TransientStoreError:
+                self._partial_since = None  # store dark (operator outage)
+                continue
             if live and live != self.gang_names:
                 now = time.monotonic()
                 if self._partial_since is None:
@@ -310,19 +473,49 @@ def run_soak(
     data_plane: str = "light",
     step_sleep_s: float = 1.0,
     downtime_bound_s: float = 60.0,
+    operator_crash: bool = False,
 ) -> SoakResult:
     """Run one seeded soak; returns the observations (see SoakResult.check).
 
     ``hosts`` > ``num_hosts`` leaves spare capacity so a preempted gang has
-    somewhere to move — a drained host is not schedulable."""
-    schedule = schedule if schedule is not None else default_schedule(seed)
+    somewhere to move — a drained host is not schedulable.
+
+    ``operator_crash`` (or a schedule containing OPERATOR_CRASH) switches
+    the rig to the crash-recovery topology: the operator is a
+    :class:`RestartableOperator` (durable store under ``workdir/store`` +
+    controller + HTTP API), agents and the injector talk to it over
+    RemoteStore, and the scheduled fault kills+recovers the whole control
+    plane mid-run while the data plane keeps training."""
+    schedule = (
+        schedule if schedule is not None
+        else default_schedule(seed, operator_crash=operator_crash)
+    )
+    crash_mode = any(
+        f.kind is FaultKind.OPERATOR_CRASH for f in schedule.faults
+    )
     tmp = workdir or tempfile.mkdtemp(prefix="tpujob-soak-")
     ckpt_dir = os.path.join(tmp, "ckpt")
     job_name = "soak-lm"
 
-    store = Store()
+    operator: Optional[RestartableOperator] = None
+    if crash_mode:
+        # Operator downtime must never masquerade as host loss: the
+        # recovered Host records carry pre-crash heartbeats, and agents
+        # need a beat to reconnect before the TTL reaper runs — a
+        # NodeLost fence during the outage would gang-restart a healthy
+        # gang and fail the duplicate-create invariant for the wrong
+        # reason.
+        heartbeat_ttl = max(heartbeat_ttl, 10.0)
+        operator = RestartableOperator(
+            os.path.join(tmp, "store"), heartbeat_ttl=heartbeat_ttl
+        )
+        operator.start()
+        store: Any = RemoteStore(operator.url, timeout=5.0)
+    else:
+        store = Store()
     injector = ChaosInjector(
         schedule, store, job_name=job_name, checkpoint_dir=ckpt_dir,
+        operator=operator,
     )
     agents = [
         HostAgent(
@@ -337,18 +530,23 @@ def run_soak(
         for i in range(hosts)
     ]
     injector.agents = {a.name: a for a in agents}
-    # The controller's own process control must stay idle in managed mode
-    # (every gang member is host-bound); a fake makes a leak loud.
-    fake = FakeProcessControl()
-    ctl = TPUJobController(store, fake, resync_period=0.5)
-    ctl.scheduler.heartbeat_ttl = heartbeat_ttl
+    if crash_mode:
+        ctl = None
+        fake = None
+    else:
+        # The controller's own process control must stay idle in managed
+        # mode (every gang member is host-bound); a fake makes a leak loud.
+        fake = FakeProcessControl()
+        ctl = TPUJobController(store, fake, resync_period=0.5)
+        ctl.scheduler.heartbeat_ttl = heartbeat_ttl
 
     gang_names = [f"{job_name}-worker-{i}" for i in range(workers)]
     watcher = _InvariantWatcher(store, job_name, gang_names)
     result = SoakResult(schedule=schedule)
     for a in agents:
         a.start()
-    ctl.run(workers=2)
+    if ctl is not None:
+        ctl.run(workers=2)
     watcher.start()
     try:
         store.create(
@@ -358,8 +556,13 @@ def run_soak(
         )
         injector.arm()
         deadline = time.monotonic() + timeout
+        st = None
         while time.monotonic() < deadline:
-            st = store.get("TPUJob", "default", job_name).status
+            try:
+                st = store.get("TPUJob", "default", job_name).status
+            except TransientStoreError:
+                time.sleep(0.25)  # operator mid-restart
+                continue
             if is_finished(st) and injector.done:
                 break
             time.sleep(0.25)
@@ -371,26 +574,38 @@ def run_soak(
         result.conditions = [
             (c.type.value, c.reason, c.message) for c in st.conditions
         ]
+        # Invariant 6/8 input: the trace — read while the store is still
+        # up. Same spans `tpujob trace` exports, not log inference.
+        trace = job_trace(store, "default", job_name)
+        result.restart_windows = derive_timings(trace).get("restarts", [])
+        result.trace_ops = [s.op for s in trace]
     finally:
         injector.stop()
         watcher.stop()
-        ctl.stop()
+        if ctl is not None:
+            ctl.stop()
         for a in agents:
             a.stop()
-        fake.clear()
+        if operator is not None:
+            operator.crash()  # agents stopped; tear the API down last
+        if fake is not None:
+            fake.clear()
     result.resume_steps = list(watcher.resume_steps)
     result.partial_gang_violations = list(watcher.violations)
     result.applied = list(injector.applied)
-    # Invariant 6 input: restart windows read off the job's trace — the
-    # same spans `tpujob trace` exports, not log inference.
     result.downtime_bound_s = downtime_bound_s
-    result.restart_windows = derive_timings(
-        job_trace(store, "default", job_name)
-    ).get("restarts", [])
-    if fake.created:
+    result.gang_incarnations = {
+        name: len(uids) for name, uids in watcher.created_uids.items()
+    }
+    if operator is not None:
+        result.operator_restarts = operator.restarts
+        leaked = operator.created_through_controller()
+    else:
+        leaked = [p.metadata.name for p in fake.created]
+    if leaked:
         result.partial_gang_violations.append(
             "controller launched through its own backend in managed mode: "
-            f"{[p.metadata.name for p in fake.created]}"
+            f"{leaked}"
         )
     return result
 
@@ -419,6 +634,13 @@ def main(argv=None) -> int:
                    help="max allowed preemption recovery downtime "
                         "(seconds), asserted from the trace's restart "
                         "spans (invariant 6)")
+    p.add_argument("--operator-crash", action="store_true",
+                   help="crash-recovery mode: the operator (durable store "
+                        "+ controller + API) is killed and restarted "
+                        "mid-run by a scheduled OPERATOR_CRASH fault while "
+                        "agents ride RemoteStore retries; adds the "
+                        "zero-duplicate-creates and restart-in-trace "
+                        "invariants")
     args = p.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
@@ -432,6 +654,7 @@ def main(argv=None) -> int:
         backoff_limit=args.backoff_limit, timeout=args.timeout,
         workdir=args.workdir, data_plane=args.data_plane,
         step_sleep_s=args.step_sleep, downtime_bound_s=args.downtime_bound,
+        operator_crash=args.operator_crash,
     )
     downtimes = [
         round(w["downtime_s"], 2) if w.get("downtime_s") is not None else None
@@ -442,7 +665,9 @@ def main(argv=None) -> int:
         f"restarts={result.restart_count} preemptions={result.preemption_count} "
         f"last_cause={result.last_restart_cause!r} "
         f"resume_steps={result.resume_steps} applied={result.applied} "
-        f"trace_downtimes_s={downtimes}"
+        f"trace_downtimes_s={downtimes} "
+        f"operator_restarts={result.operator_restarts} "
+        f"gang_incarnations={result.gang_incarnations}"
     )
     errors = result.check()
     for e in errors:

@@ -59,6 +59,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "one — HA mode: several operators on different "
                         "machines share one store, leader-elect through it, "
                         "and exactly one reconciles")
+    p.add_argument("--data-dir", default=None,
+                   help="durable store state under this directory (WAL + "
+                        "compacted snapshots, runtime/persist.py): a "
+                        "restarted operator recovers the identical object "
+                        "set and resource_version and re-adopts its "
+                        "children instead of double-creating them. Unset = "
+                        "classic in-memory store (state dies with the "
+                        "process). Conflicts with --store-server (the "
+                        "remote store owns durability there).")
+    p.add_argument("--snapshot-every", type=int, default=1000,
+                   help="mutations between WAL compactions (snapshot + "
+                        "segment rotation) when --data-dir is set")
+    p.add_argument("--wal-fsync", action="store_true",
+                   help="fsync the WAL per mutation (and snapshots): "
+                        "survives machine/power loss, not just operator "
+                        "crashes, at a large per-write cost. Default off: "
+                        "per-record flush() already survives any operator "
+                        "process death.")
     p.add_argument("--store-only", action="store_true",
                    help="host only the store + dashboard/API (the apiserver "
                         "analogue) with no controller — the shared substrate "
@@ -182,10 +200,30 @@ def main(argv=None) -> int:
 
         os.environ[ENV_AUTH_TOKEN] = auth_token
 
+    recovery = None
     if args.store_server:
+        if args.data_dir:
+            sys.exit("--data-dir conflicts with --store-server: durability "
+                     "belongs to the process hosting the store")
         from tf_operator_tpu.runtime.remote_store import RemoteStore
 
         store = RemoteStore(args.store_server, token=auth_token)
+    elif args.data_dir:
+        from tf_operator_tpu.runtime.persist import open_store
+
+        store, recovery = open_store(
+            args.data_dir,
+            snapshot_every=args.snapshot_every,
+            fsync=args.wal_fsync,
+        )
+        if recovery.recovered:
+            log.warning(
+                "recovered durable store from %s: %d objects at rv %d "
+                "(snapshot rv %d + %d WAL records%s)",
+                args.data_dir, recovery.objects, recovery.resource_version,
+                recovery.snapshot_rv, recovery.replayed,
+                ", torn tail truncated" if recovery.truncated_tail else "",
+            )
     else:
         store = Store()
 
@@ -278,6 +316,14 @@ def main(argv=None) -> int:
 
     def start_controller():
         controller.run(workers=args.threadiness)
+        if recovery is not None and recovery.recovered:
+            # Restart re-adoption: claim recovered children, stamp a
+            # controller-restart span/event into every live job's trace,
+            # and enqueue them — expectations are empty post-restart, so
+            # the first syncs trust the recovered cache and must find the
+            # existing gang members instead of double-creating them.
+            n = controller.record_recovery(recovery)
+            log.info("controller restart recovery: re-adopted %d live jobs", n)
         chaos.start()
         log.info("controller running (%d workers)", args.threadiness)
 

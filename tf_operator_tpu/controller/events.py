@@ -32,6 +32,9 @@ REASON_NODE_LOST = "NodeLost"
 # Preemption drain: a host under a preemption notice forced a graceful
 # (checkpoint-resumed, backoff-exempt) gang restart.
 REASON_JOB_PREEMPTED = "TPUJobPreempted"
+# Control-plane crash-recovery: a restarted operator recovered this job
+# from the durable store and re-adopted its children (record_recovery).
+REASON_CONTROLLER_RESTARTED = "ControllerRestarted"
 
 
 class EventRecorder:

@@ -27,12 +27,16 @@ class ControllerMetrics:
         "tpujob_processes_created_total": "Child processes created.",
         "tpujob_processes_deleted_total": "Child processes deleted.",
         "tpujob_node_lost_total": "Processes declared lost (host/agent gone).",
+        "tpujob_controller_restarts_total": (
+            "Controller restarts that recovered state from the durable "
+            "store (WAL + snapshot) and re-adopted live jobs."
+        ),
     }
 
     LABELED_HELP = {
         "tpujob_gang_restarts_by_cause_total": (
             "Gang restarts by cause (preemption / retryable-failure / "
-            "node-lost)."
+            "node-lost / oom)."
         ),
     }
 

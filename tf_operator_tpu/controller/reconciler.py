@@ -75,6 +75,7 @@ from tf_operator_tpu.obs.spans import (
     COMPONENT_SCHEDULER,
     SpanRecorder,
     first_step_span_name,
+    job_trace,
     trace8,
 )
 from tf_operator_tpu.rendezvous.env import (
@@ -120,6 +121,11 @@ ANNOTATION_PORT = "tpujob.dev/rendezvous-port"
 CAUSE_PREEMPTION = "preemption"
 CAUSE_FAILURE = "retryable-failure"
 CAUSE_NODE_LOST = "node-lost"
+# OOM kills restart only under ALWAYS/ON_FAILURE policies (the taxonomy
+# classifies OOM permanent for EXIT_CODE: retrying on identical hardware
+# just OOMs again) — but when they do restart, the cause must say so:
+# an OOM loop and a preemption storm need different operator responses.
+CAUSE_OOM = "oom"
 
 
 def _default_host_resolver(process: Process) -> str:
@@ -273,6 +279,111 @@ class TPUJobController:
         for t in self._workers:
             t.join(timeout=5)
         self._workers.clear()
+
+    def record_recovery(self, info) -> int:
+        """Post-restart re-adoption pass (call once, after :meth:`run`,
+        when the store was recovered from durable state — cli/operator.py
+        does when ``--data-dir`` found existing WAL/snapshot data).
+
+        The in-memory expectations died with the previous incarnation, so
+        the first syncs will trust the informer cache — which, because the
+        informers replayed the RECOVERED store, already holds every child
+        that survived the crash. This pass makes the re-adoption explicit
+        and observable: for every live (non-terminal) job it claims the
+        recovered Process children (stamping owner_uid on any orphan whose
+        job-name label matches — the ClaimPods half the reference leans on
+        after a controller restart), records a ``controller-restart`` span
+        into the job's trace plus a ControllerRestarted event, bumps
+        ``tpujob_controller_restarts_total``, and enqueues the job so the
+        next sync reconciles recovered state against the data plane
+        (agents re-register and resync orphans on their own). Returns the
+        number of live jobs recovered. ``info`` is a persist.RecoveryInfo;
+        its resource_version uniquely names this restart's spans."""
+        self.metrics.inc("tpujob_controller_restarts_total")
+        t0 = time.time()
+        n = 0
+        for job in self.store.list(KIND_TPUJOB):
+            if is_finished(job.status):
+                continue
+            n += 1
+            try:
+                claimed = self._claim_processes(job)
+                adopted = len(claimed)
+            except Exception:
+                log.exception("recovery claim failed for %s", job.key())
+                claimed, adopted = [], -1
+            # Controller-supervised (unbound) children: the dead
+            # incarnation's OS children are orphans THIS backend does not
+            # supervise — no monitor thread will ever report their exit,
+            # so the job would sit Running forever. Declare them lost
+            # (the exact mirror of the agent-restart rule,
+            # runtime/agent.py) and let the fenced gang restart recover
+            # warm. Host-bound children are untouched: their agents kept
+            # supervising right through the operator outage.
+            tracks = getattr(self.process_control, "tracks", None)
+            if tracks is not None:
+                for p in claimed:
+                    if p.spec.node_name or p.is_finished():
+                        continue
+                    if tracks(p.metadata.namespace, p.metadata.name):
+                        continue
+                    if declare_lost(
+                        self.store, p,
+                        "operator restarted; controller-supervised "
+                        "process no longer tracked",
+                    ) is not None:
+                        self.metrics.inc("tpujob_node_lost_total")
+                        log.warning(
+                            "recovery: declared %s lost (untracked after "
+                            "operator restart)", p.key(),
+                        )
+            self._rearm_open_spans(job)
+            self.tracer.record(
+                job.metadata.namespace, job.metadata.name, job.metadata.uid,
+                "controller-restart", t0, time.time(),
+                attrs={
+                    "recovered_rv": str(info.resource_version),
+                    "adopted": str(adopted),
+                    "track": "controller",
+                },
+                name=f"{job.metadata.name}-{trace8(job.metadata.uid)}"
+                     f"-ctl-restart-{info.resource_version}",
+            )
+            self.recorder.normal(
+                job, ev.REASON_CONTROLLER_RESTARTED,
+                f"controller restarted; recovered store at rv "
+                f"{info.resource_version}, re-adopted {adopted} children",
+            )
+            self.queue.add(job.key())
+        return n
+
+    def _rearm_open_spans(self, job: TPUJob) -> None:
+        """Re-register the job's still-open restart / scheduling-wait
+        spans (read back from the durable trace) in the recovered
+        controller's in-memory maps, so the span a DEAD incarnation
+        opened is closed by THIS one when the gang returns to RUNNING —
+        keeping MTTR trace-accurate across operator restarts instead of
+        leaving the span dangling until job completion."""
+        uid = job.metadata.uid
+        try:
+            spans = job_trace(
+                self.store, job.metadata.namespace, job.metadata.name
+            )
+        except Exception:  # noqa: BLE001 — telemetry read is best-effort
+            return
+        for s in spans:
+            if s.end_time or s.trace_id != uid:
+                continue
+            if s.op == "restart" and uid not in self._open_restart:
+                self._open_restart[uid] = {
+                    "ns": s.metadata.namespace, "name": s.metadata.name,
+                    "start": s.start_time,
+                    "cause": s.attrs.get("cause", CAUSE_FAILURE),
+                }
+            elif s.op == "scheduling-wait" and uid not in self._open_schedwait:
+                self._open_schedwait[uid] = {
+                    "ns": s.metadata.namespace, "name": s.metadata.name,
+                }
 
     def _resync_loop(self) -> None:
         """Periodic resync (ReconcilerSyncLoopPeriod, controller.go:63-78).
@@ -661,10 +772,12 @@ class TPUJobController:
                 permanent_msgs.append(
                     f"{p.metadata.name} exited {p.status.exit_code} (policy Never)"
                 )
-            elif policy is RestartPolicy.EXIT_CODE and cls is ExitClass.PERMANENT:
+            elif policy is RestartPolicy.EXIT_CODE and cls in (
+                ExitClass.PERMANENT, ExitClass.OOM
+            ):
                 permanent_msgs.append(
-                    f"{p.metadata.name} exited {p.status.exit_code} (permanent"
-                    f"{', oom' if p.status.oom_killed else ''})"
+                    f"{p.metadata.name} exited {p.status.exit_code} "
+                    f"({'oom-killed' if cls is ExitClass.OOM else 'permanent'})"
                 )
             else:  # ALWAYS, ON_FAILURE, or retryable/preempted EXIT_CODE
                 retry_needed = True
@@ -1232,6 +1345,16 @@ class TPUJobController:
         # closes when the recreated gang reports RUNNING again, so its
         # width is the job's actual recovery downtime (MTTR), by cause.
         now = time.time()
+        open_info = self._open_restart.get(job.metadata.uid)
+        if open_info is not None and open_info["cause"] != cause:
+            # A differently-caused restart supersedes the open window: a
+            # preemption landing mid crash-recovery (or vs.) must appear
+            # as its own window in the trace, not be silently folded into
+            # the first cause's downtime. Close the old window here —
+            # its recovery never completed on its own terms — and let the
+            # new cause open a fresh span below. Same-cause repeats (a
+            # crash loop) stay one window: the outage never ended.
+            self._close_restart_span(job, now)
         n = job.status.restart_count + job.status.preemption_count
         span_name = self._span_name(job, f"restart-{n}")
         if job.metadata.uid not in self._open_restart:
@@ -1375,14 +1498,26 @@ class TPUJobController:
             # restart_count/preemption_count are monotonic: a sync that
             # started from a stale informer snapshot must never roll back
             # restarts recorded by a sync that raced ahead of the cache.
-            # eval_metrics belongs to the evaluator's API writes — always
-            # keep the store's copy.
+            # The CAUSE travels with the counters: whichever side recorded
+            # more restarts named the latest one — a stale snapshot (or a
+            # freshly-recovered controller's first syncs) must not blank
+            # or regress last_restart_cause while the max() keeps its
+            # count. eval_metrics belongs to the evaluator's API writes —
+            # always keep the store's copy.
             count = max(fresh.status.restart_count, job.status.restart_count)
             pcount = max(fresh.status.preemption_count, job.status.preemption_count)
+            if (
+                fresh.status.restart_count + fresh.status.preemption_count
+                > job.status.restart_count + job.status.preemption_count
+            ):
+                cause = fresh.status.last_restart_cause
+            else:
+                cause = job.status.last_restart_cause or fresh.status.last_restart_cause
             eval_metrics = fresh.status.eval_metrics
             fresh.status = job.status
             fresh.status.restart_count = count
             fresh.status.preemption_count = pcount
+            fresh.status.last_restart_cause = cause
             fresh.status.eval_metrics = eval_metrics
             # The rendezvous-port annotation is managed store-side
             # (_rendezvous_port persists it, _clear_rendezvous removes it);
@@ -1405,12 +1540,21 @@ def _restart_cause(gang_failed: List[Process]) -> str:
     """Classify a retryable gang failure into a restart cause.
 
     Priority: a declared loss anywhere means the fenced node-lost path
-    (zombies may live); otherwise the restart is a preemption only when
-    EVERY failure is eviction-shaped (exit 130/143, the graceful-kill
-    signals) — a genuine crash racing a drain still consumes backoff;
-    everything else is a plain retryable failure."""
+    (zombies may live); an OOM kill anywhere means the restart — which
+    only happens under ALWAYS/ON_FAILURE policies — is an oom restart,
+    never mistakable for a preemption (both can present as SIGKILL);
+    otherwise the restart is a preemption only when EVERY failure is
+    eviction-shaped (exit 130/143, the graceful-kill signals) — a genuine
+    crash racing a drain still consumes backoff; everything else is a
+    plain retryable failure."""
     if any(p.status.node_lost for p in gang_failed):
         return CAUSE_NODE_LOST
+    if any(
+        classify_exit_code(p.status.exit_code or 0, p.status.oom_killed)
+        is ExitClass.OOM
+        for p in gang_failed
+    ):
+        return CAUSE_OOM
     if gang_failed and all(
         classify_exit_code(p.status.exit_code or 0, p.status.oom_killed)
         is ExitClass.PREEMPTED

@@ -9,6 +9,10 @@ package supplies TPU-native equivalents that work on a bare host or a slice:
 - ``store``            — thread-safe object store with resource versions and
                          watch streams (apiserver analogue; the informer feeds
                          from it)
+- ``persist``          — opt-in durability for the store (per-mutation WAL +
+                         compacted snapshots; ``open_store(data_dir)`` recovers
+                         the identical object set and resource_version after an
+                         operator crash — etcd's job in the reference)
 - ``process_backend``  — ``ProcessControl`` seam with a real subprocess
                          launcher and a fake that records intended actions
                          (reference: RealPodControl pod_control.go:54-165 and
@@ -43,6 +47,11 @@ from tf_operator_tpu.runtime.remote_store import (  # noqa: F401
 from tf_operator_tpu.runtime.scheduler import (  # noqa: F401
     GangScheduler,
     SchedulingError,
+)
+from tf_operator_tpu.runtime.persist import (  # noqa: F401
+    PersistenceError,
+    RecoveryInfo,
+    open_store,
 )
 from tf_operator_tpu.runtime.store import (  # noqa: F401
     AlreadyExistsError,

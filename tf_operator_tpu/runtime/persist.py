@@ -1,0 +1,365 @@
+"""Durable store state: per-mutation WAL + compacted snapshots.
+
+The reference operator gets durability for free — every TFJob/Pod record
+lives in etcd behind the apiserver, so a controller restart is a pure
+cache-rebuild (list+watch) over state that never went away. Our Store is
+in-memory; without this module, killing the operator evaporates every
+TPUJob/Host/Process record while the real training processes keep
+running — the worst kind of partial failure. This module closes that gap
+with the classic two-piece recipe every durable KV store uses:
+
+- **WAL** (``wal-<start_rv>.jsonl``): one JSON record appended per store
+  mutation, in resource-version order (the store calls :meth:`append`
+  while holding its lock, so WAL order IS apply order). Each record
+  carries a CRC32 over its canonical encoding; replay verifies it.
+  A torn tail — the final record of the final segment cut mid-write by
+  a crash — is truncated away on recovery (it was never acknowledged
+  to any watcher-visible state that survives either). A bad checksum
+  anywhere *else* is corruption, not a crash artifact, and recovery
+  refuses it loudly rather than silently dropping history.
+- **Snapshots** (``snapshot-<rv>.json``): every ``snapshot_every``
+  mutations the full object set is written to a temp file and atomically
+  renamed, the WAL rotates to a fresh segment, and older segments/
+  snapshots are deleted. Recovery = load newest snapshot, replay the WAL
+  suffix (records with rv > snapshot rv), restore the resource_version
+  counter to max(rv)+1 — so optimistic CAS, watch ordering, and
+  uid-keyed adoption behave identically post-restart.
+
+fsync policy: WAL appends are ``flush()``-ed per record — an operator
+*process* crash (SIGKILL, OOM, panic) loses nothing, because the bytes
+are in the kernel before the mutation's watch event fans out. ``fsync=
+True`` additionally fsyncs per append (and the snapshot + directory on
+rotation), extending the guarantee to machine/power loss at a large
+per-write cost. Deliberately NOT durable: watch subscriptions, informer
+caches, controller expectations, metrics counters, and the live OS
+processes themselves (agents re-register and resync orphans; the
+reconciler re-adopts recovered children — see controller.record_recovery).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import zlib
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+log = logging.getLogger("tpujob.persist")
+
+_SNAPSHOT_RE = re.compile(r"^snapshot-(\d+)\.json$")
+_SEGMENT_RE = re.compile(r"^wal-(\d+)\.jsonl$")
+
+DEFAULT_SNAPSHOT_EVERY = 1000
+
+OP_CREATE = "create"
+OP_UPDATE = "update"
+OP_DELETE = "delete"
+
+
+class PersistenceError(RuntimeError):
+    """Durable state is corrupt beyond what crash semantics explain
+    (mid-file checksum mismatch, unreadable snapshot). Recovery refuses
+    to guess: silently dropping acknowledged history is worse than
+    stopping."""
+
+
+def _canonical(record: Dict[str, Any]) -> bytes:
+    """Stable encoding the CRC is computed over (crc field excluded)."""
+    body = {k: v for k, v in record.items() if k != "crc"}
+    return json.dumps(body, sort_keys=True, separators=(",", ":")).encode()
+
+
+def _checksum(record: Dict[str, Any]) -> int:
+    return zlib.crc32(_canonical(record)) & 0xFFFFFFFF
+
+
+@dataclass
+class RecoveryInfo:
+    """What recovery found — the operator logs it and the controller's
+    re-adoption pass (record_recovery) stamps it into restart spans."""
+
+    recovered: bool = False  # pre-existing durable state was found
+    resource_version: int = 0  # counter restored to this (next alloc is +1)
+    objects: int = 0
+    snapshot_rv: int = 0
+    replayed: int = 0  # WAL records applied on top of the snapshot
+    truncated_tail: bool = False  # a torn final record was dropped
+
+
+class StorePersister:
+    """Writes one WAL record per store mutation; compacts periodically.
+
+    All methods are called by the Store WHILE HOLDING its lock — that is
+    the ordering guarantee (WAL order == apply order == watch order), and
+    it makes the snapshot a consistent cut for free. The persister reads
+    the store's object map directly during a snapshot for the same
+    reason.
+    """
+
+    def __init__(
+        self,
+        data_dir: str,
+        snapshot_every: int = DEFAULT_SNAPSHOT_EVERY,
+        fsync: bool = False,
+        segment_start: int = 1,
+    ) -> None:
+        self.data_dir = os.path.abspath(data_dir)
+        self.snapshot_every = max(1, int(snapshot_every))
+        self.fsync = bool(fsync)
+        os.makedirs(self.data_dir, exist_ok=True)
+        self._store: Any = None
+        self._since_snapshot = 0
+        self._segment_path = os.path.join(
+            self.data_dir, f"wal-{segment_start}.jsonl"
+        )
+        self._wal = open(self._segment_path, "ab")
+
+    def bind(self, store: Any) -> None:
+        """Attach the store whose object map snapshots read (open_store
+        wires this; the store holds the persister symmetrically)."""
+        self._store = store
+
+    # -- write path (store lock held) -------------------------------------
+
+    def append(self, op: str, obj: Any, rv: int) -> None:
+        from tf_operator_tpu.runtime.serialize import to_doc
+
+        meta = obj.metadata
+        record: Dict[str, Any] = {
+            "rv": rv,
+            "op": op,
+            "kind": obj.kind,
+            "ns": meta.namespace,
+            "name": meta.name,
+            "obj": None if op == OP_DELETE else to_doc(obj),
+        }
+        record["crc"] = _checksum(record)
+        self._wal.write(json.dumps(record, sort_keys=True).encode() + b"\n")
+        self._wal.flush()
+        if self.fsync:
+            os.fsync(self._wal.fileno())
+        self._since_snapshot += 1
+        if self._since_snapshot >= self.snapshot_every:
+            self._snapshot(rv)
+
+    def _snapshot(self, rv: int) -> None:
+        """Write the full object set at ``rv`` (atomic tmp+rename), rotate
+        the WAL, and GC segments/snapshots the new snapshot supersedes."""
+        from tf_operator_tpu.runtime.serialize import to_doc
+
+        assert self._store is not None, "persister not bound to a store"
+        docs = [to_doc(o) for o in self._store._objects.values()]
+        body = {"rv": rv, "objects": docs}
+        body["crc"] = _checksum(body)
+        final = os.path.join(self.data_dir, f"snapshot-{rv}.json")
+        tmp = final + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(body, f, sort_keys=True)
+            f.flush()
+            if self.fsync:
+                os.fsync(f.fileno())
+        os.rename(tmp, final)
+        # Rotate: records after this point carry rv > snapshot rv, so the
+        # old segment is fully covered by the snapshot.
+        self._wal.close()
+        self._segment_path = os.path.join(self.data_dir, f"wal-{rv + 1}.jsonl")
+        self._wal = open(self._segment_path, "ab")
+        if self.fsync:
+            fd = os.open(self.data_dir, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        self._since_snapshot = 0
+        # GC: everything the new snapshot supersedes. A crash between the
+        # rename above and here just leaves extra files; recovery skips
+        # records with rv <= snapshot rv, so they are harmless.
+        for name in os.listdir(self.data_dir):
+            path = os.path.join(self.data_dir, name)
+            if path == self._segment_path:
+                continue
+            m = _SNAPSHOT_RE.match(name) or _SEGMENT_RE.match(name)
+            if m and int(m.group(1)) <= rv and name != f"snapshot-{rv}.json":
+                _unlink_quiet(path)
+
+    def close(self) -> None:
+        try:
+            self._wal.flush()
+            if self.fsync:
+                os.fsync(self._wal.fileno())
+        finally:
+            self._wal.close()
+
+
+def _unlink_quiet(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+# ---- recovery -----------------------------------------------------------
+
+
+def _load_snapshot(data_dir: str) -> Tuple[int, List[Dict[str, Any]]]:
+    """Newest snapshot's (rv, object docs); (0, []) when none exists.
+    Snapshots are atomic-renamed, so a present file is complete — a
+    parse/checksum failure is real corruption and raises."""
+    best_rv, best_path = 0, None
+    try:
+        names = os.listdir(data_dir)
+    except OSError:
+        return 0, []
+    for name in names:
+        m = _SNAPSHOT_RE.match(name)
+        if m and int(m.group(1)) > best_rv:
+            best_rv, best_path = int(m.group(1)), os.path.join(data_dir, name)
+    if best_path is None:
+        return 0, []
+    try:
+        with open(best_path) as f:
+            body = json.load(f)
+    except (OSError, ValueError) as exc:
+        raise PersistenceError(f"snapshot {best_path} unreadable: {exc}") from exc
+    crc = body.get("crc")
+    if crc is not None and crc != _checksum(body):
+        raise PersistenceError(f"snapshot {best_path} failed its checksum")
+    return int(body["rv"]), list(body.get("objects", []))
+
+
+def _segments(data_dir: str) -> List[Tuple[int, str]]:
+    out = []
+    for name in os.listdir(data_dir):
+        m = _SEGMENT_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(data_dir, name)))
+    out.sort()
+    return out
+
+
+def _replay_segment(
+    path: str, is_last_segment: bool
+) -> Tuple[List[Dict[str, Any]], bool]:
+    """Parse one WAL segment's records, verifying checksums.
+
+    Returns (records, truncated). A malformed/mismatched record at the
+    very TAIL of the LAST segment is a torn write — the only damage a
+    crash can produce, because appends are sequential: the file is
+    truncated back to the last good record and recovery proceeds. The
+    same defect anywhere else (good records follow it, or a non-final
+    segment) means acknowledged history is damaged — raise."""
+    with open(path, "rb") as f:
+        data = f.read()
+    records: List[Dict[str, Any]] = []
+    good_end = pos = 0
+    bad: Optional[str] = None
+    while pos < len(data):
+        nl = data.find(b"\n", pos)
+        end = len(data) if nl == -1 else nl + 1
+        stripped = data[pos:end].strip()
+        if stripped:
+            try:
+                record = json.loads(stripped)
+            except ValueError:
+                record = None
+            if (
+                nl == -1  # final record cut mid-write (no newline)
+                or not isinstance(record, dict)
+                or record.get("crc") != _checksum(record)
+            ):
+                bad = "torn/unparseable or checksum-mismatched record"
+                break
+            records.append(record)
+        pos = good_end = end
+    if bad is None:
+        return records, False
+    torn_tail = is_last_segment and not data[end:].strip()
+    if not torn_tail:
+        raise PersistenceError(
+            f"WAL {path}: {bad} at offset {pos} with later records present "
+            "— corruption, not a crash artifact; refusing to drop history"
+        )
+    log.warning("WAL %s: %s at offset %d; truncating torn tail", path, bad, pos)
+    with open(path, "r+b") as f:
+        f.truncate(good_end)
+    return records, True
+
+
+def recover(data_dir: str) -> Tuple[Dict[Tuple[str, str, str], Any], RecoveryInfo]:
+    """Rebuild (objects-by-key, RecoveryInfo) from snapshot + WAL suffix."""
+    from tf_operator_tpu.runtime.serialize import from_doc
+
+    info = RecoveryInfo()
+    if not os.path.isdir(data_dir):
+        return {}, info
+    snap_rv, snap_docs = _load_snapshot(data_dir)
+    segments = _segments(data_dir)
+    if snap_rv == 0 and not segments:
+        return {}, info
+
+    objects: Dict[Tuple[str, str, str], Any] = {}
+    for doc in snap_docs:
+        obj = from_doc(doc["kind"], doc)
+        objects[(obj.kind, obj.metadata.namespace, obj.metadata.name)] = obj
+    info.snapshot_rv = snap_rv
+    max_rv = snap_rv
+
+    for i, (_, path) in enumerate(segments):
+        records, truncated = _replay_segment(path, i == len(segments) - 1)
+        info.truncated_tail = info.truncated_tail or truncated
+        for record in records:
+            rv = int(record["rv"])
+            if rv <= snap_rv:
+                continue  # already folded into the snapshot
+            max_rv = max(max_rv, rv)
+            key = (record["kind"], record["ns"], record["name"])
+            if record["op"] == OP_DELETE:
+                objects.pop(key, None)
+            else:
+                objects[key] = from_doc(record["kind"], record["obj"])
+            info.replayed += 1
+
+    info.recovered = True
+    info.resource_version = max_rv
+    info.objects = len(objects)
+    return objects, info
+
+
+def open_store(
+    data_dir: str,
+    snapshot_every: int = DEFAULT_SNAPSHOT_EVERY,
+    fsync: bool = False,
+    indexed_labels=None,
+):
+    """The one entry point: recover (or initialize) durable state under
+    ``data_dir`` and return ``(Store, RecoveryInfo)`` with persistence
+    attached — every subsequent mutation is WAL-logged. A fresh operator
+    pointed at an existing data-dir reconstructs the identical object set
+    and resource_version the previous incarnation last acknowledged."""
+    from tf_operator_tpu.runtime.store import INDEXED_LABELS, Store
+
+    objects, info = recover(data_dir)
+    store = Store(
+        indexed_labels=INDEXED_LABELS if indexed_labels is None else indexed_labels
+    )
+    if objects:
+        store.restore_objects(objects.values(), next_rv=info.resource_version + 1)
+    elif info.recovered:
+        store.restore_objects([], next_rv=info.resource_version + 1)
+    persister = StorePersister(
+        data_dir,
+        snapshot_every=snapshot_every,
+        fsync=fsync,
+        segment_start=info.resource_version + 1,
+    )
+    store.attach_persister(persister)
+    log.info(
+        "durable store at %s: recovered=%s objects=%d rv=%d "
+        "(snapshot rv %d + %d WAL records%s)",
+        data_dir, info.recovered, info.objects, info.resource_version,
+        info.snapshot_rv, info.replayed,
+        ", torn tail truncated" if info.truncated_tail else "",
+    )
+    return store, info

@@ -26,6 +26,7 @@ from tf_operator_tpu.obs.spans import COMPONENT_AGENT, SpanRecorder
 from tf_operator_tpu.rendezvous.env import ENV_TRACE_ID, identity_env
 from tf_operator_tpu.runtime.objects import Process, ProcessPhase
 from tf_operator_tpu.runtime.store import ConflictError, NotFoundError, Store
+from tf_operator_tpu.utils.exit_codes import read_cgroup_oom_kills, was_oom_killed
 
 
 _NO_CHILD = object()  # sentinel: key absent from _children entirely
@@ -98,6 +99,11 @@ class LocalProcessControl(ProcessControl):
     GRACE_SECONDS = 5.0
 
     LOG_ANNOTATION = "tpujob.dev/log-path"
+
+    # OOM oracle seam (tests stub it): returns the supervising cgroup's
+    # cumulative oom_kill count, or None when no oracle exists — in which
+    # case SIGKILL exits stay plain retryable, never guessed OOM.
+    _oom_kills_reader = staticmethod(read_cgroup_oom_kills)
 
     def __init__(
         self,
@@ -322,6 +328,10 @@ class LocalProcessControl(ProcessControl):
         env.update(process.spec.env)
         log_path = process.metadata.annotations.get(self.LOG_ANNOTATION)
         spawn_t = time.time()
+        # OOM oracle: snapshot the supervising cgroup's oom_kill counter
+        # around the child's lifetime (utils.exit_codes.was_oom_killed
+        # promotes SIGKILL-shaped exits to OOM only on a counter delta).
+        oom_kills_before = self._oom_kills_reader()
         try:
             child = self._spawn(process, env, log_path)
         except OSError as exc:
@@ -349,7 +359,7 @@ class LocalProcessControl(ProcessControl):
         code = child.wait()
         with self._lock:
             self._pop_if_mine(key, uid)
-        oom = _was_oom_killed(code)
+        oom = was_oom_killed(code, oom_kills_before, self._oom_kills_reader())
         phase = ProcessPhase.SUCCEEDED if code == 0 else ProcessPhase.FAILED
         self._patch_status(process, phase, exit_code=code, oom_killed=oom)
         self._record_proc_span(process, spawn_t, time.time(), code, oom=oom)
@@ -434,10 +444,3 @@ class NativeProcessControl(LocalProcessControl):
             super()._terminate(child)
 
 
-def _was_oom_killed(code: int) -> bool:
-    """Best-effort OOM detection: killed by SIGKILL is how the kernel's OOM
-    killer presents. The reference reads the runtime's OOMKilled reason; a
-    bare host has no such oracle, so this stays conservative (False) unless
-    a platform oracle is wired in. Kept as a hook point."""
-    del code
-    return False

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import logging
+import random
 import threading
 import urllib.error
 import urllib.parse
@@ -48,19 +49,58 @@ class UnauthorizedError(Exception):
     nonzero; the informer records failure instead of claiming sync."""
 
 
+class Backoff:
+    """Exponential backoff with full-range jitter and a cap — reconnect
+    pacing for watch streams. A flapping server (accepts then drops, or
+    refuses outright) must cost the client exponentially-spaced attempts,
+    not a busy-spin; jitter keeps a fleet of agents from reconnecting in
+    lockstep after an operator restart. ``reset()`` is called once a
+    stream proves healthy (delivered data), so a genuine one-off blip
+    still reconnects fast."""
+
+    def __init__(
+        self,
+        initial: float = 0.5,
+        cap: float = 30.0,
+        factor: float = 2.0,
+        rng: Optional[random.Random] = None,
+    ) -> None:
+        self.initial = initial
+        self.cap = cap
+        self.factor = factor
+        self._rng = rng or random.Random()
+        self._attempt = 0
+
+    def next_delay(self) -> float:
+        """The next sleep: jittered into [d/2, d] where d doubles per
+        consecutive failure up to the cap."""
+        d = min(self.cap, self.initial * self.factor ** self._attempt)
+        self._attempt += 1
+        return d * (0.5 + 0.5 * self._rng.random())
+
+    def reset(self) -> None:
+        self._attempt = 0
+
+
 class RemoteWatch:
     """Iterable of WatchEvents from the server's ndjson stream.
 
     Auto-reconnects on connection loss: the server replays existing
     objects as ADDED on every (re)connect — the list+watch contract —
     and consumers (agents, informers) are already replay-tolerant.
+    Reconnects are paced by :class:`Backoff` (reset once a stream
+    delivers data) and counted in ``reconnects`` — the old behavior
+    reconnected a dropped stream immediately in a tight loop, which
+    against a flapping server was a busy-spin of TCP connects.
 
     Uses a raw HTTPConnection (not urllib) so ``stop()`` can
     ``shutdown()`` the socket: closing a buffered response from another
     thread deadlocks on the reader lock the blocked consumer holds."""
 
     def __init__(self, base: str, kinds, connect_timeout: float = 10.0,
-                 token: Optional[str] = None) -> None:
+                 token: Optional[str] = None,
+                 backoff: Optional[Backoff] = None,
+                 reconnect_counter: Optional[Any] = None) -> None:
         u = urllib.parse.urlsplit(base)
         self._host = u.hostname
         self._port = u.port or (443 if u.scheme == "https" else 80)
@@ -71,6 +111,12 @@ class RemoteWatch:
         self._stopped = threading.Event()
         self._sock = None
         self._lock = threading.Lock()
+        self.backoff = backoff or Backoff()
+        # (Re)connection attempts after the first — surfaced per watch,
+        # and aggregated on the owning RemoteStore when it passed a
+        # shared counter.
+        self.reconnects = 0
+        self._shared_counter = reconnect_counter
 
     def stop(self) -> None:
         self._stopped.set()
@@ -119,17 +165,29 @@ class RemoteWatch:
         sock.settimeout(None)
         return sock, resp
 
+    def _note_reconnect(self) -> None:
+        self.reconnects += 1
+        if self._shared_counter is not None:
+            self._shared_counter.inc()
+
     def __iter__(self):
         import http.client
 
+        first_attempt = True
         while not self._stopped.is_set():
+            if not first_attempt:
+                self._note_reconnect()
+            first_attempt = False
             try:
                 sock, resp = self._connect()
             except (OSError, http.client.HTTPException) as exc:
                 if self._stopped.is_set():
                     return
-                log.warning("watch connect failed (%s); retrying", exc)
-                if self._stopped.wait(1.0):
+                delay = self.backoff.next_delay()
+                log.warning(
+                    "watch connect failed (%s); retrying in %.1fs", exc, delay
+                )
+                if self._stopped.wait(delay):
                     return
                 continue
             with self._lock:
@@ -141,10 +199,17 @@ class RemoteWatch:
             # their per-connection seen-set; on SYNCED they reconcile
             # (deletions during a disconnect are never replayed).
             yield WatchEvent(WatchEventType.REPLAY_START, None)
+            got_data = False
             try:
                 for raw in resp:
                     if self._stopped.is_set():
                         return
+                    if not got_data:
+                        # The stream is live (data or keep-alive arrived):
+                        # this connection was real, not a flap — reconnect
+                        # fast if it drops later.
+                        got_data = True
+                        self.backoff.reset()
                     if not raw.strip():
                         continue
                     d = json.loads(raw)
@@ -158,7 +223,21 @@ class RemoteWatch:
             except (OSError, ValueError, http.client.HTTPException) as exc:
                 if self._stopped.is_set():
                     return
-                log.warning("watch stream dropped (%s); reconnecting", exc)
+                delay = self.backoff.next_delay()
+                log.warning(
+                    "watch stream dropped (%s); reconnecting in %.1fs",
+                    exc, delay,
+                )
+                if self._stopped.wait(delay):
+                    return
+            else:
+                # Clean EOF. After a healthy stream (data flowed) an
+                # immediate reconnect is right — the server restarted.
+                # An accept-then-close flap (no data ever) must still
+                # pay backoff or the loop is a busy-spin of connects.
+                if not self._stopped.is_set() and not got_data:
+                    if self._stopped.wait(self.backoff.next_delay()):
+                        return
             finally:
                 with self._lock:
                     if self._sock is sock:
@@ -167,6 +246,18 @@ class RemoteWatch:
                     resp.close()
                 except Exception:
                     pass
+
+
+class _Counter:
+    """Tiny thread-safe counter shared by a RemoteStore's watches."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def inc(self) -> None:
+        with self._lock:
+            self.value += 1
 
 
 class RemoteStore:
@@ -184,6 +275,13 @@ class RemoteStore:
         self.base = base_url.rstrip("/")
         self.timeout = timeout
         self.token = token if token is not None else resolve_token()
+        # Aggregated watch reconnect-attempt count across every watch
+        # this store created (per-watch counts live on the RemoteWatch).
+        self._watch_reconnects = _Counter()
+
+    @property
+    def watch_reconnects_total(self) -> int:
+        return self._watch_reconnects.value
 
     # -- plumbing ---------------------------------------------------------
 
@@ -276,7 +374,8 @@ class RemoteStore:
         # its socket timeout (a watch is long-lived and silent between
         # events).
         return RemoteWatch(
-            self.base, kinds, connect_timeout=self.timeout, token=self.token
+            self.base, kinds, connect_timeout=self.timeout, token=self.token,
+            reconnect_counter=self._watch_reconnects,
         )
 
     def update_with_retry(self, kind: str, namespace: str, name: str, mutate: Any):

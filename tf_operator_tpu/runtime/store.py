@@ -25,6 +25,13 @@ visited when an index applies); ``list_stats()`` exposes scanned-vs-
 returned counters so the proportionality is observable (controller
 metrics render them as ``tpujob_store_list_*``).
 
+Durability (r8, opt-in): ``persist.open_store(data_dir)`` attaches a
+:class:`~tf_operator_tpu.runtime.persist.StorePersister` — every mutation
+appends one checksummed WAL record (under the store lock, so WAL order is
+apply order) with periodic compacted snapshots; recovery reconstructs the
+identical object set and resource_version counter, which is what lets a
+restarted operator re-adopt its children instead of double-creating them.
+
 Watch fanout: one snapshot deepcopy per event, SHARED by every watch —
 the old per-watch deepcopy made each write O(watches × object size)
 inside the store lock. Consequence: **watch events are read-only**;
@@ -160,6 +167,35 @@ class Store:
         self._list_calls = 0
         self._list_scanned = 0
         self._list_returned = 0
+        # Optional durability (runtime/persist.py): one WAL record per
+        # mutation, appended while _lock is held so WAL order == apply
+        # order == watch order. None = classic in-memory store.
+        self._persister = None
+
+    # ---- durability (runtime/persist.py) --------------------------------
+
+    def attach_persister(self, persister) -> None:
+        """Attach a StorePersister: every subsequent create/update/delete
+        is WAL-logged (and periodically snapshotted). Call before any
+        mutations/watches — open_store() is the normal entry point."""
+        with self._lock:
+            self._persister = persister
+            persister.bind(self)
+
+    def restore_objects(self, objects: Iterable[Any], next_rv: int) -> None:
+        """Install recovered objects verbatim (uid / resource_version /
+        creation_timestamp preserved) and restore the resource_version
+        counter so post-restart allocations continue monotonically —
+        watchers and optimistic CAS behave identically to an operator
+        that never died. Recovery-only: runs before watches or a
+        persister exist, so no events fan out and nothing re-logs."""
+        with self._lock:
+            assert not self._watches and self._persister is None
+            for obj in objects:
+                k = _key(obj.kind, obj.metadata.namespace, obj.metadata.name)
+                self._objects[k] = obj
+                self._index_add(k, obj)
+            self._rv = itertools.count(max(next_rv, 1))
 
     # ---- index maintenance (callers hold _lock) -------------------------
 
@@ -225,6 +261,10 @@ class Store:
             stored.metadata.creation_timestamp = time.time()
             self._objects[k] = stored
             self._index_add(k, stored)
+            if self._persister is not None:
+                self._persister.append(
+                    "create", stored, stored.metadata.resource_version
+                )
             out = copy.deepcopy(stored)
             self._notify(WatchEventType.ADDED, stored)
             return out
@@ -257,6 +297,10 @@ class Store:
             stored.metadata.resource_version = next(self._rv)
             self._objects[k] = stored
             self._index_replace(k, current, stored)
+            if self._persister is not None:
+                self._persister.append(
+                    "update", stored, stored.metadata.resource_version
+                )
             out = copy.deepcopy(stored)
             self._notify(WatchEventType.MODIFIED, stored)
             return out
@@ -282,6 +326,11 @@ class Store:
             stored = self._objects.pop(k)
             self._index_remove(k, stored)
             stored.metadata.deletion_timestamp = time.time()
+            if self._persister is not None:
+                # Deletes consume an rv purely as their WAL sequence
+                # number (replay order / monotonicity); rv density was
+                # never part of the store's contract.
+                self._persister.append("delete", stored, next(self._rv))
             out = copy.deepcopy(stored)
             self._notify(WatchEventType.DELETED, stored)
             return out

@@ -43,17 +43,36 @@ log = logging.getLogger("tpujob.checkpoint")
 
 _STEP_DIR = re.compile(r"^step_(\d+)$")
 
+# Completeness markers orbax leaves in a FINALIZED step directory:
+# `_CHECKPOINT_METADATA` (modern orbax, written at commit) or
+# `commit_success.txt` (the multihost/GCS-era marker). A bare numeric dir
+# without either is a save torn mid-crash — orbax renames its tmp dir
+# into place before the final metadata write, so "directory exists" alone
+# is NOT a commit. Resuming from a torn step bricks the warm restart
+# (restore raises, or worse, loads garbage), so discovery requires a
+# marker and falls back to the newest COMPLETE step.
+_ORBAX_COMMIT_MARKERS = ("_CHECKPOINT_METADATA", "commit_success.txt")
+
+
+def _orbax_step_complete(step_dir: str) -> bool:
+    return any(
+        os.path.exists(os.path.join(step_dir, m)) for m in _ORBAX_COMMIT_MARKERS
+    )
+
 
 def latest_checkpoint_step(directory: str) -> int:
-    """Latest checkpointed step under ``directory``, 0 when none.
+    """Latest COMPLETE checkpointed step under ``directory``, 0 when none.
 
     Dependency-free filesystem scan (no orbax import, no manager
     construction): the control plane calls this on every gang (re)create
     to stamp the warm-restart env (``TPUJOB_RESUME_STEP``), so it must be
     cheap and must not pull jax/orbax into the controller process. Handles
-    both on-disk layouts: the npy backend's ``step_N/manifest.json`` and
-    orbax's bare numeric step directories (in-flight ``*.orbax-*-tmp-*``
-    dirs are non-numeric and skipped)."""
+    both on-disk layouts: the npy backend's ``step_N/manifest.json``
+    (atomically renamed, so presence of the manifest is the commit) and
+    orbax's bare numeric step directories, which count only when their
+    commit marker exists (``_ORBAX_COMMIT_MARKERS``) — a save torn by a
+    crash mid-write must never become a resume point; the newest complete
+    step wins instead."""
     try:
         names = os.listdir(directory)
     except OSError:
@@ -63,7 +82,11 @@ def latest_checkpoint_step(directory: str) -> int:
         m = _STEP_DIR.match(name)
         if m and os.path.exists(os.path.join(directory, name, "manifest.json")):
             best = max(best, int(m.group(1)))
-        elif name.isdigit() and os.path.isdir(os.path.join(directory, name)):
+        elif (
+            name.isdigit()
+            and os.path.isdir(os.path.join(directory, name))
+            and _orbax_step_complete(os.path.join(directory, name))
+        ):
             best = max(best, int(name))
     return best
 

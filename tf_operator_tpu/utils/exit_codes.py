@@ -15,6 +15,7 @@ the reference's pod eviction.
 from __future__ import annotations
 
 import enum
+import os
 
 
 class ExitClass(enum.Enum):
@@ -26,6 +27,12 @@ class ExitClass(enum.Enum):
     # distinct cause in status and does not count against backoff_limit
     # (crash-looping workloads consume backoff; being evicted must not).
     PREEMPTED = "Preempted"
+    # Killed by the kernel OOM killer. Permanent under EXIT_CODE policy
+    # (retrying on identical hardware just OOMs again, training.go:193-206)
+    # but a distinct class: OOM presents as SIGKILL, exactly like
+    # infrastructure loss, and conflating the two would let a memory-leaking
+    # workload masquerade as preemption churn in every restart metric.
+    OOM = "OOMKilled"
     PERMANENT = "Permanent"
 
 
@@ -44,10 +51,11 @@ def classify_exit_code(code: int, oom_killed: bool = False) -> ExitClass:
     """Classify a process exit code.
 
     ``oom_killed`` mirrors the reference's OOMKilled-reason override
-    (training.go:193-206): permanent regardless of code.
+    (training.go:193-206): OOM regardless of code — permanent for restart
+    decisions (is_permanent is True), distinct for cause accounting.
     """
     if oom_killed:
-        return ExitClass.PERMANENT
+        return ExitClass.OOM
     if code == 0:
         return ExitClass.SUCCEEDED
     if code < 0:  # Python subprocess convention: -N means killed by signal N
@@ -78,4 +86,58 @@ def is_preemption(code: int, oom_killed: bool = False) -> bool:
 
 
 def is_permanent(code: int, oom_killed: bool = False) -> bool:
-    return classify_exit_code(code, oom_killed) is ExitClass.PERMANENT
+    return classify_exit_code(code, oom_killed) in (
+        ExitClass.PERMANENT,
+        ExitClass.OOM,
+    )
+
+
+# ---- OOM detection -------------------------------------------------------
+# The kernel's OOM killer delivers SIGKILL, so an OOM exit is
+# indistinguishable from infrastructure loss (exit 137) by code alone. The
+# reference reads the container runtime's OOMKilled reason; a bare host's
+# nearest oracle is the supervising cgroup's memory.events counter — the
+# backend snapshots it around each child's lifetime and promotes
+# SIGKILL-shaped exits to OOM only when the counter advanced.
+
+def read_cgroup_oom_kills() -> "int | None":
+    """Cumulative ``oom_kill`` count of this process's cgroup (v2 unified
+    hierarchy), or None when no oracle is available (cgroup v1, non-Linux,
+    masked /sys). Children spawned without cgroup delegation share the
+    parent's cgroup, so a delta across a child's lifetime implicates it —
+    best-effort (a sibling's OOM in the same cgroup also advances it), but
+    strictly better than the code-only guess."""
+    try:
+        with open("/proc/self/cgroup") as f:
+            path = ""
+            for line in f:
+                # v2 unified entry: "0::/<path>"
+                if line.startswith("0::"):
+                    path = line.split("::", 1)[1].strip()
+                    break
+        events = os.path.join("/sys/fs/cgroup", path.lstrip("/"), "memory.events")
+        with open(events) as f:
+            for line in f:
+                if line.startswith("oom_kill "):
+                    return int(line.split()[1])
+    except (OSError, ValueError, IndexError):
+        return None
+    return None
+
+
+def was_oom_killed(
+    code: int,
+    oom_kills_before: "int | None" = None,
+    oom_kills_after: "int | None" = None,
+) -> bool:
+    """The SIGKILL→OOM promotion, in the taxonomy proper: an exit counts
+    as OOM-killed iff it is SIGKILL-shaped (the only signal the OOM killer
+    sends) AND the supervising cgroup's oom_kill counter advanced across
+    the child's lifetime. Without an oracle (either count None) it stays
+    conservative: False — a bare SIGKILL remains retryable infrastructure
+    loss, never a guessed OOM."""
+    if code not in (137, -9):
+        return False
+    if oom_kills_before is None or oom_kills_after is None:
+        return False
+    return oom_kills_after > oom_kills_before
