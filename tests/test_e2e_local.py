@@ -10,6 +10,10 @@ import os
 
 import pytest
 
+# e2e tier (r6): real multi-process gangs + operator stacks. CI runs this
+# tier in its own stage; the sharded unit stage excludes it.
+pytestmark = pytest.mark.e2e
+
 from tf_operator_tpu.api.types import (
     ConditionType,
     ObjectMeta,

@@ -1,0 +1,115 @@
+"""Kernel-level units for ops/grouped_matmul (r6): sentinel blocks, the
+fused combine epilogue (row_scale), and the regridded dw accumulation —
+all through the Pallas interpreter against dense references, including
+gradients (the custom_vjp is hand-derived; these pins are what license
+the ep-sharded dispatch to trust it)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tf_operator_tpu.ops.grouped_matmul import gmm
+
+B = 8  # small block quantum so tests exercise multi-block experts cheaply
+
+
+def _mk(seed=0, R=64, k=16, n=32, E=4):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    x = jax.random.normal(ks[0], (R, k), jnp.float32)
+    w = jax.random.normal(ks[1], (E, k, n), jnp.float32) * 0.1
+    s = jax.nn.sigmoid(jax.random.normal(ks[2], (R,), jnp.float32))
+    return x, w, s
+
+
+def _ref(x, w, be, s=None):
+    """Dense reference: per-block matmul, zeros for sentinel blocks."""
+    R, n = x.shape[0], w.shape[-1]
+    out = []
+    for i, e in enumerate(np.asarray(be)):
+        xr = x[i * B:(i + 1) * B]
+        if e < 0:
+            out.append(jnp.zeros((B, n)))
+            continue
+        y = xr @ w[e]
+        if s is not None:
+            y = y * s[i * B:(i + 1) * B, None]
+        out.append(y)
+    return jnp.concatenate(out)
+
+
+def test_sentinel_blocks_write_zeros_not_garbage():
+    x, w, _ = _mk()
+    be = jnp.array([0, 0, 1, -1, 2, 2, -1, 3], jnp.int32)
+    y = gmm(x, w, be, block_rows=B, interpret=True)
+    np.testing.assert_allclose(y, _ref(x, w, be), rtol=1e-5, atol=1e-5)
+    # the sentinel rows specifically: exact zeros (uninitialized output
+    # memory here would poison any downstream transpose/gather)
+    np.testing.assert_array_equal(np.asarray(y[3 * B:4 * B]), 0.0)
+    np.testing.assert_array_equal(np.asarray(y[6 * B:7 * B]), 0.0)
+
+
+def test_row_scale_epilogue_matches_post_multiply():
+    x, w, s = _mk()
+    be = jnp.array([0, 1, 1, 2, 2, 2, 3, 0], jnp.int32)
+    got = gmm(x, w, be, row_scale=s, block_rows=B, interpret=True)
+    want = gmm(x, w, be, block_rows=B, interpret=True) * s[:, None]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("scaled", [False, True])
+def test_grads_match_dense_reference(scaled):
+    x, w, s = _mk()
+    be = jnp.array([0, 0, 1, -1, 2, 2, -1, 3], jnp.int32)
+
+    def loss_gmm(x, w, s):
+        y = gmm(x, w, be, row_scale=s if scaled else None, block_rows=B,
+                interpret=True)
+        return jnp.sum(y ** 2)
+
+    def loss_ref(x, w, s):
+        return jnp.sum(_ref(x, w, be, s if scaled else None) ** 2)
+
+    got = jax.grad(loss_gmm, argnums=(0, 1, 2))(x, w, s)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(x, w, s)
+    for a, b, name in zip(got, want, "xws"):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_unvisited_expert_dw_is_exact_zero():
+    """The regridded dw kernel zeroes every (expert, col-tile) output at
+    walk step 0, so an expert no block maps to gets dw == 0 — not
+    uninitialized kernel output memory. (The r5 grid only wrote tiles a
+    step visited; parallel.moe had to allocate garbage blocks to paper
+    over that. r6 makes the guarantee kernel-level.)"""
+    x, w, _ = _mk()
+    be = jnp.zeros((x.shape[0] // B,), jnp.int32)  # everything on expert 0
+    gw = jax.grad(
+        lambda w: jnp.sum(gmm(x, w, be, block_rows=B, interpret=True) ** 2)
+    )(w)
+    assert np.isfinite(np.asarray(gw)).all()
+    np.testing.assert_array_equal(np.asarray(gw[1:]), 0.0)
+    assert np.abs(np.asarray(gw[0])).sum() > 0  # the visited one is real
+
+
+def test_noncontiguous_same_expert_blocks_accumulate():
+    """The dw walk follows per-expert block LISTS, so an expert whose
+    blocks are interleaved with other experts' still accumulates every
+    one of them (the list, not block adjacency, defines the walk)."""
+    x, w, s = _mk()
+    be = jnp.array([0, 1, 0, 1, 0, 1, 0, 1], jnp.int32)  # interleaved
+
+    def loss_gmm(w):
+        return jnp.sum(gmm(x, w, be, block_rows=B, interpret=True) ** 2)
+
+    def loss_ref(w):
+        return jnp.sum(_ref(x, w, be) ** 2)
+
+    np.testing.assert_allclose(
+        jax.grad(loss_gmm)(w), jax.grad(loss_ref)(w), rtol=1e-4, atol=1e-5)
+
+
+def test_row_count_must_divide_block_rows():
+    x, w, _ = _mk(R=60)  # 60 % 8 != 0
+    with pytest.raises(ValueError, match="divisible"):
+        gmm(x, w, jnp.zeros((8,), jnp.int32), block_rows=B, interpret=True)

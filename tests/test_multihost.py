@@ -13,6 +13,10 @@ import time
 
 import pytest
 
+# e2e tier (r6): simulated multi-host cluster with real gloo gangs. CI
+# runs this tier in its own stage; the sharded unit stage excludes it.
+pytestmark = pytest.mark.e2e
+
 from conftest import wait_for
 from tf_operator_tpu.api.types import (
     ConditionType,

@@ -67,10 +67,11 @@ class TransformerConfig:
     # all_to_all layout), "einsum" (one-hot oracle), "ragged" (r5 —
     # lax.ragged_dot over actual per-expert counts; measured SLOWER than
     # the padded vmap on v5e — kept as the negative-result receipt), or
-    # "gmm" (r5 — the Pallas grouped-matmul kernel: block-granular
-    # padding only, no drops; ops/grouped_matmul.py). ragged/gmm engage
-    # on the no-ep path and fall back to sort under ep sharding
-    # (parallel.moe.moe_apply).
+    # "gmm" (r5/r6 — the Pallas grouped-matmul kernel: block-granular
+    # padding only, no drops; ops/grouped_matmul.py). r6: gmm runs under
+    # ep sharding too (count-exchange + block-quantum all_to_all
+    # buffers, parallel.moe._moe_local_gmm) including ep-inside-pipeline;
+    # only "ragged" still falls back to sort under ep.
     moe_dispatch: str = "sort"
     # Router auxiliary losses — without them top-k routing collapses onto a
     # few experts under real training. moe_aux_weight scales the Switch
@@ -150,9 +151,12 @@ PRESETS: Dict[str, TransformerConfig] = {
     ),
     # Mixtral-class sparse config (8 experts, top-1 routing): total params
     # ~8x the dense MLP stack, active params per token ~ the dense model.
+    # r6: the grouped-matmul dispatch is the default (it beat the r4
+    # capacity path at zero drops in the r5 capture; BENCH_MOE_DISPATCH
+    # still overrides for A/Bs against sort/ragged).
     "moe-small": TransformerConfig(
         vocab=32000, d_model=768, n_layers=12, n_heads=12, n_kv_heads=12, d_ff=3072,
-        max_seq=1024, n_experts=8,
+        max_seq=1024, n_experts=8, moe_dispatch="gmm",
     ),
     # BERT-base as bidirectional encoder (MLM-style head)
     "bert-base": TransformerConfig(
@@ -196,10 +200,14 @@ PRESETS: Dict[str, TransformerConfig] = {
     # over ep on their expert dim AND over fsdp on their embed dim
     # (DEFAULT_RULES "expert"/"embed"), so expert weights no longer
     # replicate per dp replica — the memplan-closing layout for a
-    # v5p-256 pod (examples/mixtral_8x7b_v5p256.json).
+    # v5p-256 pod (examples/mixtral_8x7b_v5p256.json). r6: the default
+    # dispatch is the padding-free grouped-matmul kernel — it now runs
+    # UNDER the ep axis (count-exchange + block-quantum a2a buffers), so
+    # the flagship no longer pays cf× padding FLOPs or drops tokens.
     "mixtral-8x7b": TransformerConfig(
         vocab=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
         d_ff=14336, max_seq=4096, n_experts=8, moe_top_k=2,
+        moe_dispatch="gmm",
     ),
 }
 
@@ -363,7 +371,9 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
         # shards over dp/fsdp and heads over tp with no collectives. A
         # sequence-sharded (cp) mesh needs ring attention instead.
         if mesh is not None and mesh.devices.size > 1:
-            from jax import shard_map
+            from tf_operator_tpu.parallel.collectives import (
+                shard_map_compat as shard_map,
+            )
             from jax.sharding import PartitionSpec as P
 
             batch = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
@@ -384,7 +394,6 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
                 mesh=mesh,
                 in_specs=(spec, spec, spec),
                 out_specs=spec,
-                check_vma=False,
             )
             return fn(q, k, v)
         return flash_attention(q, k, v, causal=cfg.causal)
@@ -394,6 +403,29 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
     from tf_operator_tpu.ops.flash_attention import reference_attention
 
     return reference_attention(q, k, v, causal=cfg.causal)
+
+
+def _anchored_gamma(gamma, cfg: TransformerConfig, mesh):
+    """Read an rms-norm gamma through a replicated constraint on MoE
+    multi-axis meshes. ZeRO shards even the [d] norm scales over fsdp —
+    on the dp×fsdp×ep mesh that is a TRANSPOSED tile assignment, and the
+    broadcast multiply pulls the (batch-anchored) layer-scan carry and
+    its backward cotangent toward that d-over-fsdp layout; GSPMD can
+    only reconcile differently ORDERED assignments with an involuntary
+    full rematerialization of the carry, once per layer per step. A [d]
+    all-gather is noise; the carry remat is not. No-op for dense configs
+    and single-axis meshes (propagation is already consistent there),
+    and for pipeline/shard_map callers (mesh is None inside the stage
+    body — manual axes can't take auto sharding constraints anyway)."""
+    if not (cfg.n_experts and mesh is not None
+            and getattr(mesh, "devices", None) is not None
+            and cfg.ep_axis in getattr(mesh, "axis_names", ())):
+        return gamma
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.lax.with_sharding_constraint(
+        gamma, NamedSharding(mesh, P(*(None,) * gamma.ndim))
+    )
 
 
 def _layer(x, layer_params, cfg: TransformerConfig, mesh, tp_axis=None,
@@ -431,7 +463,30 @@ def _layer(x, layer_params, cfg: TransformerConfig, mesh, tp_axis=None,
     wq = layer_params["wq"].astype(x.dtype)
     wk = layer_params["wk"].astype(x.dtype)
     wv = layer_params["wv"].astype(x.dtype)
-    h = _rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
+    gamma_attn = _anchored_gamma(layer_params["attn_norm"], cfg, mesh)
+    gamma_mlp = _anchored_gamma(layer_params["mlp_norm"], cfg, mesh)
+
+    def anchor_tokens(a):
+        # companion to _anchored_gamma (same scope): keeps the normed
+        # activations — and, through the constraint's transpose, their
+        # COTANGENTS arriving from the ZeRO-sharded qkv/router matmul
+        # transposes — in the batch layout the layer-scan carry is
+        # pinned to, so no d-over-fsdp pressure reaches the while
+        # boundary
+        if not (cfg.n_experts and mesh is not None
+                and getattr(mesh, "devices", None) is not None
+                and cfg.ep_axis in getattr(mesh, "axis_names", ())):
+            return a
+        data_axes = tuple(ax for ax in ("dp", "fsdp") if ax in mesh.axis_names)
+        if not data_axes:
+            return a
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.lax.with_sharding_constraint(
+            a, NamedSharding(mesh, P(data_axes, *(None,) * (a.ndim - 1)))
+        )
+
+    h = anchor_tokens(_rms_norm(x, gamma_attn, cfg.norm_eps))
     if tp_axis is not None:
         h = enter(h)
     q = (h @ wq).reshape(b, t, wq.shape[-1] // hd, hd)
@@ -447,7 +502,7 @@ def _layer(x, layer_params, cfg: TransformerConfig, mesh, tp_axis=None,
     # attention → wo to rebuild it (see _remat_wrap).
     x = checkpoint_name(x + proj, "resid_mid")
 
-    h = _rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps)
+    h = anchor_tokens(_rms_norm(x, gamma_mlp, cfg.norm_eps))
     if cfg.n_experts:
         moe_out, aux = _moe_mlp(h, layer_params, cfg, mesh,
                                 local_ep_axis=local_ep_axis)
@@ -505,7 +560,13 @@ def _moe_mlp(h, layer_params, cfg: TransformerConfig, mesh,
     }
     if local_ep_axis is not None:
         # same capacity rule as moe_apply's sharded branch: flat is
-        # already the per-shard token slice
+        # already the per-shard token slice. dispatch follows
+        # cfg.moe_dispatch with moe_apply's ladder semantics: gmm runs
+        # padding-free in-stage (r6); ragged/einsum degrade to sort (the
+        # einsum inbox layout is identical, sort is the cheap form).
+        import os
+
+        local_impl = "gmm" if cfg.moe_dispatch == "gmm" else "sort"
         capacity = expert_capacity(
             cfg.capacity_factor, cfg.moe_top_k, flat.shape[0], cfg.n_experts
         )
@@ -513,6 +574,8 @@ def _moe_mlp(h, layer_params, cfg: TransformerConfig, mesh,
             flat, gate_logits, expert_params, expert_fn,
             axis_name=local_ep_axis, capacity=capacity, dropped="zero",
             k_top=cfg.moe_top_k, stat_axes=(local_ep_axis,),
+            dispatch_impl=local_impl,
+            block_rows=int(os.environ.get("TPUJOB_GMM_BLOCK_ROWS", "256")),
         )
     else:
         from tf_operator_tpu.parallel.moe import ragged_swiglu
@@ -550,7 +613,30 @@ def _moe_mlp(h, layer_params, cfg: TransformerConfig, mesh,
         "expert_load": stats["expert_load"],
         "drop_frac": stats["drop_frac"],
     }
-    return out.reshape(b, t, d), aux
+    out = out.reshape(b, t, d)
+    if local_ep_axis is None and mesh is not None and getattr(
+        mesh, "devices", None
+    ) is not None and cfg.ep_axis in getattr(mesh, "axis_names", ()):
+        # Re-anchor the layer output to the model's canonical activation
+        # layout (batch over the data axes, ep REPLICATED). moe_apply's
+        # shard_map constrains its flat tokens to P((dp, fsdp, ep)) —
+        # correct inside the ep exchange, but without this anchor that
+        # 8-way token sharding propagates OUT into the layer-scan carry
+        # while the rest of the loop body (attention, residual adds)
+        # settles on the (dp, fsdp)-only layout, and GSPMD reconciles
+        # the conflicting while-carry specs with an "involuntary full
+        # rematerialization" (replicate + re-slice of the carry AND the
+        # downstream fused-CE block walk) on every layer iteration of
+        # the ep×fsdp×dp flagship pass. Same anchoring rule as the
+        # pipeline's microbatch split (parallel/pipeline.py).
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+        if data_axes:
+            out = jax.lax.with_sharding_constraint(
+                out, NamedSharding(mesh, P(data_axes, None, None))
+            )
+    return out, aux
 
 
 # Selective-remat policy ladder (r5, VERDICT r4 #1): named-activation sets
@@ -832,15 +918,92 @@ def transformer_hidden(params, tokens, cfg: TransformerConfig, mesh=None,
     if _use_pipeline(cfg, mesh):
         h, aux = transformer_hidden_pp(params, tokens, cfg, mesh)
         return (h, aux) if with_aux else h
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    # Pin the layer-scan carry to the canonical activation layout (batch
+    # over the data axes) for MoE configs. A while-loop carry must keep
+    # ONE sharding across init/body-input/body-output; the MoE body
+    # contains moe_apply's shard_map, whose in/out specs constrain the
+    # flat token slab to P((dp, fsdp, ep)) — that 8-way sharding
+    # propagates through the entry/exit reshapes onto the carry, while
+    # the embedding gather hands the INIT a d-over-fsdp layout (the ZeRO
+    # table sharding) and the rest of the body settles on (dp, fsdp)
+    # batch sharding. GSPMD reconciles the disagreeing carry specs with
+    # an "involuntary full rematerialization" (replicate + re-slice) of
+    # the carry every iteration — the moe-fsdp warning pair the r5
+    # verdict pinned. Two anchors fix the disagreement at its sources:
+    # the embedding TABLE is read through a replicated constraint (the
+    # all-gather ZeRO pays at first use anyway, made explicit so the
+    # gather's output is batch-sharded like the loop), and the body
+    # output re-anchors after the MoE layer (see _moe_mlp's matching
+    # anchor). Dense configs are unaffected.
+    carry_anchor = None
+    if mesh is not None and getattr(mesh, "devices", None) is not None:
+        data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+        # scoped to MoE-on-ep-mesh: only moe_apply's shard_map injects
+        # the competing token spec; elsewhere propagation is already
+        # consistent and anchors would just constrain it for nothing
+        if (cfg.n_experts and data_axes
+                and cfg.ep_axis in mesh.axis_names):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            carry_anchor = NamedSharding(mesh, P(data_axes, None, None))
+    et = params["embed"].astype(cfg.dtype)
+    if carry_anchor is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        et = jax.lax.with_sharding_constraint(
+            et, NamedSharding(mesh, P(None, None))
+        )
+    x = et[tokens]
+    if carry_anchor is not None:
+        # The token-embedding-gradient scatter-add (this gather's
+        # transpose) accumulates into the table's layout; handing it the
+        # batch-sharded backward cotangent makes GSPMD replicate +
+        # re-slice it INVOLUNTARILY (the last remat warning of the
+        # moe-fsdp pass). The movement is unavoidable — the cotangent
+        # genuinely changes layout axes — so do the same replicate
+        # explicitly in the backward only: identity forward, cotangent
+        # constrained replicated. Same bytes on the wire, zero warnings,
+        # and the forward pays nothing.
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rep = NamedSharding(mesh, P(*(None,) * x.ndim))
+
+        @jax.custom_vjp
+        def _bwd_replicate(a):
+            return a
+
+        def _br_fwd(a):
+            return a, None
+
+        def _br_bwd(_, g):
+            return (jax.lax.with_sharding_constraint(g, rep),)
+
+        _bwd_replicate.defvjp(_br_fwd, _br_bwd)
+        x = _bwd_replicate(x)
 
     layer_fn = _remat_wrap(partial(_layer, cfg=cfg, mesh=mesh), cfg)
 
     def scan_body(x, layer_params):
-        return layer_fn(x, layer_params)  # (new_x, per-layer aux or None)
+        if carry_anchor is not None:
+            # input-side: without this, the moe shard_map's 8-way token
+            # spec back-propagates through rms_norm/reshape onto the
+            # while-body PARAMETER and outvotes the output-side anchor
+            x = jax.lax.with_sharding_constraint(x, carry_anchor)
+        new_x, aux = layer_fn(x, layer_params)  # (new_x, per-layer aux or None)
+        if carry_anchor is not None:
+            new_x = jax.lax.with_sharding_constraint(new_x, carry_anchor)
+        return new_x, aux
 
     x, aux_stack = jax.lax.scan(scan_body, x, params["layers"])
-    h = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if carry_anchor is not None:
+        # exit anchor: pins the BACKWARD scan's carry init too — the
+        # transpose of this constraint re-anchors the loss head's
+        # incoming cotangent before it becomes the reverse while carry,
+        # so the fused-CE block walk and the backward loop agree on the
+        # batch layout instead of full-rematerializing per layer
+        x = jax.lax.with_sharding_constraint(x, carry_anchor)
+    h = _rms_norm(x, _anchored_gamma(params["final_norm"], cfg, mesh),
+                  cfg.norm_eps)
     if not with_aux:
         return h
     if aux_stack is None:
@@ -878,6 +1041,36 @@ def lm_loss_and_metrics(params, tokens, cfg: TransformerConfig, mesh=None, key=N
     def _hidden(inp):
         return transformer_hidden(params, inp, cfg, mesh, with_aux=True)
 
+    def _ce_operands(flat_h, embed):
+        # MoE on a multi-axis mesh (r6): pin the fused-CE block walk to
+        # the batch-sharded layout with the EMBED all-gathered. Left to
+        # propagation, the ZeRO-sharded embed (d over fsdp, a TRANSPOSED
+        # device order on the dp×fsdp×ep mesh) pulls the CE loop's xs/dx
+        # carries toward d-over-fsdp while the anchored hidden states
+        # arrive batch-sharded — and converting between differently
+        # ORDERED tile assignments is exactly what GSPMD can only do by
+        # involuntary full rematerialization, once per block per layer.
+        # On the single-axis fsdp mesh propagation picks one consistent
+        # d-sharded assignment and none of this is needed (no warnings
+        # there at the seed); the anchor is scoped to ep meshes. The
+        # all-gathered embed transient is vocab·d·dtype — at mixtral
+        # shapes ~256 MB bf16, far below the [b·t, vocab] psum the
+        # d-sharded assignment pays instead.
+        if not (cfg.n_experts and mesh is not None
+                and getattr(mesh, "devices", None) is not None
+                and cfg.ep_axis in getattr(mesh, "axis_names", ())):
+            return flat_h, embed
+        data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+        if not data_axes:
+            return flat_h, embed
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        flat_h = jax.lax.with_sharding_constraint(
+            flat_h, NamedSharding(mesh, P(data_axes, None)))
+        embed = jax.lax.with_sharding_constraint(
+            embed, NamedSharding(mesh, P(None, None)))
+        return flat_h, embed
+
     if cfg.causal:
         if cfg.fused_xent:
             from tf_operator_tpu.ops.fused_cross_entropy import fused_cross_entropy
@@ -886,7 +1079,8 @@ def lm_loss_and_metrics(params, tokens, cfg: TransformerConfig, mesh=None, key=N
             h = h[:, :-1]
             b, t, d = h.shape
             ce = fused_cross_entropy(
-                h.reshape(b * t, d), params["embed"], tokens[:, 1:].reshape(b * t)
+                *_ce_operands(h.reshape(b * t, d), params["embed"]),
+                tokens[:, 1:].reshape(b * t),
             )
         else:
             h, aux = _hidden(tokens)
@@ -907,8 +1101,7 @@ def lm_loss_and_metrics(params, tokens, cfg: TransformerConfig, mesh=None, key=N
 
             b, t, d = h.shape
             ce = fused_cross_entropy(
-                h.reshape(b * t, d),
-                params["embed"],
+                *_ce_operands(h.reshape(b * t, d), params["embed"]),
                 tokens.reshape(b * t),
                 weights=mask.reshape(b * t),
             )

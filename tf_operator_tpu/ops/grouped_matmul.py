@@ -20,15 +20,39 @@ kernel is the Megablocks-style alternative the VERDICT asked for:
   no [NB, k, n] gathered-weight materialization (the XLA block-diagonal
   einsum formulation measured slower than the padded vmap for exactly
   that traffic).
-- dw runs as a second kernel with the row-blocks INNERMOST: consecutive
-  grid steps that share an expert revisit the same output tile, which is
-  the TPU-legal accumulation pattern (same rule the flash kernels use
-  for their carried scratch); the first block of each expert zeroes the
-  tile.
+- r6, ep sharding: ``block_expert`` entries may be ``-1`` — SENTINEL
+  blocks. The static grid still visits them (XLA needs static shapes;
+  the ep all_to_all hands each shard a worst-case-sized buffer whose
+  occupancy is data-dependent) but the kernel skips the dot and writes
+  zeros, so sentinel blocks cost a VMEM zero-fill instead of MXU FLOPs
+  — compute scales with OCCUPIED blocks, not the static bound.
+- r6, fused combine epilogue: ``row_scale`` (one f32 per row) multiplies
+  the output rows INSIDE the kernel. The MoE combine is
+  out[t] = Σ_k w[t,k]·expert(x)[slot[t,k]]; scaling the down-projection's
+  output rows by their gate weight in the epilogue turns the combine
+  into a pure gather+sum and retires the separate f32 [T,k,d]
+  weighted-reduction pass the einsum combine paid per layer.
+- r6, dw grid: (expert, col-tile, block-walk) with scalar-prefetched
+  per-expert block LISTS, so the output tile's index map depends only on
+  grid indices — the f32 [k, bn] accumulator stays resident in VMEM
+  across an expert's whole block walk. The previous grid steered the
+  output window by ``block_expert[i]`` per step, which is data-dependent:
+  the pipeline must conservatively round-trip the accumulator tile
+  HBM↔VMEM at every step (k=768, bn=3072 ⇒ ~9 MB x2 per 256-row block —
+  the dw walk the r5 roofline named as the kernel's remaining headroom).
+  Walk steps beyond an expert's real block count are skipped
+  (``l < nblocks[e]``) and their input index maps repeat the last valid
+  block so the window doesn't change (no re-DMA); every (expert,
+  col-tile) tile is ZEROED at walk step 0, so an expert with zero blocks
+  gets an exact-zero gradient rather than uninitialized output memory.
 
 Everything is differentiable through a custom_vjp: dx is the same kernel
-with transposed weights, dw the accumulation kernel. The sort/pad
-bookkeeping lives in parallel.moe (_moe_single_gmm).
+with transposed weights (sentinel blocks write zero cotangents, which
+keeps the upstream gather/scatter transposes clean), dw the accumulation
+kernel, and row_scale's cotangent reuses the dx kernel's unscaled
+product (ds[r] = x[r]·(dy[r]@Wᵀ) = dy[r]·(x[r]@W) — no extra matmul).
+The sort/pad bookkeeping lives in parallel.moe (_moe_single_gmm /
+_moe_local_gmm).
 """
 
 from __future__ import annotations
@@ -37,14 +61,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-
-
-def _gmm_fwd_kernel(be_ref, x_ref, w_ref, o_ref):
-    o_ref[...] = jax.lax.dot_general(
-        x_ref[...], w_ref[0],
-        (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ).astype(o_ref.dtype)
 
 
 def _pick_cols(n: int, target: int) -> int:
@@ -67,26 +83,89 @@ def _auto_cols(n: int, k: int, elem_bytes: int) -> int:
     return _pick_cols(n, max(128, (4 * 2**20) // (elem_bytes * k)))
 
 
-def gmm(x, w, block_expert, *, block_rows: int = 256,
+def gmm(x, w, block_expert, *, row_scale=None, block_rows: int = 256,
         block_cols: int | None = None, interpret: bool = False):
-    """y[r] = x[r] @ w[block_expert[r // block_rows]].
+    """y[r] = x[r] @ w[block_expert[r // block_rows]]  (· row_scale[r]).
 
     x: [R, k] with R % block_rows == 0, rows grouped so every row-block
     maps to ONE expert; w: [E, k, n]; block_expert: [R // block_rows]
-    int32. Returns [R, n] in x.dtype (f32 MXU accumulation inside).
-    Differentiable in x and w (not in block_expert — routing indices).
-    ``block_cols`` None = VMEM-budgeted auto (the measured-fastest
-    full-width tiles where they fit). ``interpret`` runs the Pallas
-    interpreter (CPU test path)."""
-    return _gmm(x, w, block_expert, block_rows, block_cols, bool(interpret))
+    int32 — entries may be ``-1`` (sentinel: the block's output rows are
+    written as zeros and no FLOPs are spent; used by the ep-sharded
+    dispatch whose statically-sized all-to-all buffers are partially
+    occupied). ``row_scale``: optional [R] f32 applied to the output
+    rows inside the kernel (the fused MoE combine epilogue).
+    Returns [R, n] in x.dtype (f32 MXU accumulation inside).
+    Differentiable in x, w and row_scale (not in block_expert — routing
+    indices). ``block_cols`` None = VMEM-budgeted auto (the
+    measured-fastest full-width tiles where they fit). ``interpret``
+    runs the Pallas interpreter (CPU test path)."""
+    if row_scale is None:
+        return _gmm(x, w, block_expert, block_rows, block_cols,
+                    bool(interpret))
+    return _gmm_scaled(x, w, row_scale, block_expert, block_rows,
+                       block_cols, bool(interpret))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _gmm(x, w, block_expert, block_rows, block_cols, interpret):
-    return _gmm_call(x, w, block_expert, block_rows, block_cols, interpret)
+    return _gmm_call(x, w, None, block_expert, block_rows, block_cols,
+                     interpret)
 
 
-def _gmm_call(x, w, block_expert, block_rows, block_cols, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _gmm_scaled(x, w, row_scale, block_expert, block_rows, block_cols,
+                interpret):
+    return _gmm_call(x, w, row_scale, block_expert, block_rows, block_cols,
+                     interpret)
+
+
+def _gmm_fwd_kernel(be_ref, x_ref, w_ref, o_ref):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    e = be_ref[i]
+
+    @pl.when(e >= 0)
+    def _compute():
+        o_ref[...] = jax.lax.dot_general(
+            x_ref[...], w_ref[0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(o_ref.dtype)
+
+    @pl.when(e < 0)
+    def _sentinel():
+        # sentinel blocks still own output rows (static shapes): write
+        # zeros so downstream gathers/transposes never see uninitialized
+        # memory, but spend no MXU work
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+
+def _gmm_fwd_scaled_kernel(be_ref, x_ref, w_ref, s_ref, o_ref):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    e = be_ref[i]
+
+    @pl.when(e >= 0)
+    def _compute():
+        acc = jax.lax.dot_general(
+            x_ref[...], w_ref[0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # the combine epilogue: gate-weight each output row while the
+        # tile is still in VMEM — the [T,k,d] weighted-reduction pass
+        # this replaces is pure HBM traffic
+        o_ref[...] = (acc * s_ref[...]).astype(o_ref.dtype)
+
+    @pl.when(e < 0)
+    def _sentinel():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+
+def _gmm_call(x, w, row_scale, block_expert, block_rows, block_cols,
+              interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -107,80 +186,152 @@ def _gmm_call(x, w, block_expert, block_rows, block_cols, interpret):
     )
     nb = R // block_rows
 
+    in_specs = [
+        pl.BlockSpec((block_rows, k), lambda i, j, be: (i, 0),
+                     memory_space=pltpu.VMEM),
+        # sentinel blocks (-1) clamp to expert 0's tile — a dead DMA the
+        # skipped dot never reads
+        pl.BlockSpec((1, k, bn),
+                     lambda i, j, be: (jnp.maximum(be[i], 0), 0, j),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [block_expert, x, w]
+    kernel = _gmm_fwd_kernel
+    if row_scale is not None:
+        in_specs.append(
+            pl.BlockSpec((block_rows, 1), lambda i, j, be: (i, 0),
+                         memory_space=pltpu.VMEM)
+        )
+        operands.append(row_scale.astype(jnp.float32).reshape(R, 1))
+        kernel = _gmm_fwd_scaled_kernel
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(nb, n // bn),
-        in_specs=[
-            pl.BlockSpec((block_rows, k), lambda i, j, be: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, k, bn), lambda i, j, be: (be[i], 0, j),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((block_rows, bn), lambda i, j, be: (i, j),
                                memory_space=pltpu.VMEM),
     )
     return pl.pallas_call(
-        _gmm_fwd_kernel,
+        kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((R, n), x.dtype),
         interpret=interpret,
-    )(block_expert, x, w)
+    )(*operands)
 
 
-def _dw_kernel(be_ref, x_ref, dy_ref, dw_ref):
+def _dw_kernel(nb_ref, bl_ref, x_ref, dy_ref, dw_ref):
     from jax.experimental import pallas as pl
 
-    i = pl.program_id(1)  # row-block index — INNERMOST (accumulation dim)
-    e = be_ref[i]
-    prev = be_ref[jnp.maximum(i - 1, 0)]
-    first = jnp.logical_or(i == 0, e != prev)
+    e = pl.program_id(0)
+    l = pl.program_id(2)  # block-walk step — INNERMOST (accumulation dim)
 
-    @pl.when(first)
+    @pl.when(l == 0)
+    def _zero():
+        # every (expert, col-tile) zeroes at walk start — an expert with
+        # ZERO blocks gets an exact-zero dw tile, never uninitialized
+        # kernel output memory
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+
+    @pl.when(l < nb_ref[e])
+    def _accum():
+        dw_ref[...] += jax.lax.dot_general(
+            x_ref[...], dy_ref[...],
+            (((0,), (0,)), ((), ())),  # [bR,k]ᵀ·[bR,bn] -> [k,bn]
+            preferred_element_type=jnp.float32,
+        )[None]
+
+
+def _dw_scaled_kernel(nb_ref, bl_ref, x_ref, dy_ref, s_ref, dw_ref):
+    from jax.experimental import pallas as pl
+
+    e = pl.program_id(0)
+    l = pl.program_id(2)
+
+    @pl.when(l == 0)
     def _zero():
         dw_ref[...] = jnp.zeros_like(dw_ref)
 
-    dw_ref[...] += jax.lax.dot_general(
-        x_ref[...], dy_ref[...],
-        (((0,), (0,)), ((), ())),  # [bR,k]ᵀ·[bR,bn] -> [k,bn]
-        preferred_element_type=jnp.float32,
-    )[None]
+    @pl.when(l < nb_ref[e])
+    def _accum():
+        # dw_e = Σ (s⊙x)ᵀ·dy — the scale rides the x rows so the scaled
+        # forward's weight cotangent needs no [R,d] pre-scaled copy of x
+        dw_ref[...] += jax.lax.dot_general(
+            x_ref[...] * s_ref[...], dy_ref[...],
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )[None]
 
 
-def _gmm_dw(x, dy, w_shape, block_expert, block_rows, block_cols, interpret):
-    """dw[e] = Σ_{blocks i of e} x_i^T @ dy_i — grid (col-tile, row-block)
-    with row-blocks innermost so same-expert revisits are consecutive."""
+def _expert_block_lists(block_expert, n_experts: int, nb: int):
+    """Per-expert block lists from a block→expert map: blist[e, l] = the
+    l-th row-block of expert e (walk entries past an expert's count
+    repeat its LAST valid block so the input window never changes on
+    skipped steps — no re-DMA), nblocks[e] = its real count. Sentinel
+    (-1) blocks belong to no expert."""
+    be = block_expert.astype(jnp.int32)
+    bucket = jnp.where(be >= 0, be, n_experts)  # sentinels into a spare bucket
+    order = jnp.argsort(bucket, stable=True).astype(jnp.int32)
+    cnt = jnp.bincount(bucket, length=n_experts + 1)[:n_experts].astype(jnp.int32)
+    starts = jnp.cumsum(cnt) - cnt  # [E]
+    walk = jnp.minimum(jnp.arange(nb, dtype=jnp.int32)[None, :],
+                       jnp.maximum(cnt[:, None] - 1, 0))
+    idx = jnp.clip(starts[:, None] + walk, 0, nb - 1)
+    return cnt, order[idx].reshape(-1)  # nblocks [E], blist [E*nb]
+
+
+def _gmm_dw(x, dy, w_shape, block_expert, block_rows, block_cols, interpret,
+            row_scale=None):
+    """dw[e] = Σ_{blocks of e} x_blᵀ @ dy_bl — grid (expert, col-tile,
+    block-walk) over scalar-prefetched per-expert block lists. The
+    output tile's index map is (e, 0, j): grid-only, so the f32
+    accumulator stays in VMEM for the whole inner walk instead of
+    round-tripping per step behind a data-dependent window."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     R, k = x.shape
     E, k2, n = w_shape
     # dw accumulates in an f32 [k, bn] output tile held across the inner
-    # row-block walk — budget on 4 bytes, not the bf16 fwd tile
+    # block walk — budget on 4 bytes, not the bf16 fwd tile
     bn = _auto_cols(n, k, 4) if block_cols is None else _pick_cols(n, block_cols)
     nb = R // block_rows
+    nblocks, blist = _expert_block_lists(block_expert, E, nb)
 
+    def x_map(e, j, l, nbr, blr):
+        return (blr[e * nb + l], 0)
+
+    def dy_map(e, j, l, nbr, blr):
+        return (blr[e * nb + l], j)
+
+    in_specs = [
+        pl.BlockSpec((block_rows, k), x_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_rows, bn), dy_map, memory_space=pltpu.VMEM),
+    ]
+    operands = [nblocks, blist, x, dy]
+    kernel = _dw_kernel
+    if row_scale is not None:
+        in_specs.append(
+            pl.BlockSpec((block_rows, 1), x_map, memory_space=pltpu.VMEM)
+        )
+        operands.append(row_scale.astype(jnp.float32).reshape(R, 1))
+        kernel = _dw_scaled_kernel
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(n // bn, nb),
-        in_specs=[
-            pl.BlockSpec((block_rows, k), lambda j, i, be: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_rows, bn), lambda j, i, be: (i, j),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, k, bn), lambda j, i, be: (be[i], 0, j),
+        num_scalar_prefetch=2,
+        grid=(E, n // bn, nb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, k, bn), lambda e, j, l, nbr, blr: (e, 0, j),
                                memory_space=pltpu.VMEM),
     )
     return pl.pallas_call(
-        _dw_kernel,
+        kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((E, k, n), jnp.float32),
         interpret=interpret,
-    )(block_expert, x, dy)
+    )(*operands)
 
 
 def _gmm_fwd_rule(x, w, block_expert, block_rows, block_cols, interpret):
-    y = _gmm_call(x, w, block_expert, block_rows, block_cols, interpret)
+    y = _gmm_call(x, w, None, block_expert, block_rows, block_cols, interpret)
     return y, (x, w, block_expert)
 
 
@@ -191,8 +342,8 @@ def _gmm_bwd_rule(block_rows, block_cols, interpret, res, dy):
     # HBM traffic — ~0.3 ms at moe-small shapes, negligible next to the
     # padded-FLOP term this kernel retires).
     dx = _gmm_call(
-        dy, jnp.swapaxes(w, 1, 2), block_expert, block_rows, block_cols,
-        interpret,
+        dy, jnp.swapaxes(w, 1, 2), None, block_expert, block_rows,
+        block_cols, interpret,
     )
     dw = _gmm_dw(
         x, dy, w.shape, block_expert, block_rows, block_cols, interpret
@@ -201,3 +352,32 @@ def _gmm_bwd_rule(block_rows, block_cols, interpret, res, dy):
 
 
 _gmm.defvjp(_gmm_fwd_rule, _gmm_bwd_rule)
+
+
+def _gmm_scaled_fwd_rule(x, w, row_scale, block_expert, block_rows,
+                         block_cols, interpret):
+    y = _gmm_call(x, w, row_scale, block_expert, block_rows, block_cols,
+                  interpret)
+    return y, (x, w, row_scale, block_expert)
+
+
+def _gmm_scaled_bwd_rule(block_rows, block_cols, interpret, res, dy):
+    x, w, row_scale, block_expert = res
+    # One UNSCALED transposed product serves two cotangents:
+    #   t = dy @ w_eᵀ  ⇒  dx = s ⊙ t   and   ds[r] = x[r]·t[r]
+    # (x·(dy@wᵀ) = (x@w)·dy — the scale's cotangent without recomputing
+    # the forward or saving an unscaled copy of y).
+    t = _gmm_call(
+        dy, jnp.swapaxes(w, 1, 2), None, block_expert, block_rows,
+        block_cols, interpret,
+    ).astype(jnp.float32)
+    dx = row_scale.astype(jnp.float32)[:, None] * t
+    ds = jnp.sum(x.astype(jnp.float32) * t, axis=-1)
+    dw = _gmm_dw(
+        x, dy, w.shape, block_expert, block_rows, block_cols, interpret,
+        row_scale=row_scale,
+    ).astype(w.dtype)
+    return dx.astype(x.dtype), dw, ds.astype(row_scale.dtype), None
+
+
+_gmm_scaled.defvjp(_gmm_scaled_fwd_rule, _gmm_scaled_bwd_rule)

@@ -272,12 +272,149 @@ def _moe_single_gmm(x, gate_logits, expert_params, k_top: int = 1,
     run = partial(gmm, block_rows=B, interpret=interpret)
     zg = run(x_pad, expert_params["w_gate"].astype(x.dtype), block_expert)
     zu = run(x_pad, expert_params["w_up"].astype(x.dtype), block_expert)
-    h = run(jax.nn.silu(zg) * zu,
-            expert_params["w_down"].astype(x.dtype), block_expert)
-
+    # fused combine epilogue (r6): each padded slot's gate weight rides
+    # the down-projection kernel as a row scale, so the combine below is
+    # a pure gather+sum — the separate f32 [T,k,d] weighted-reduction
+    # einsum (and its HBM pass) is gone. Garbage slots scale by 0.
     dst = pad_start[flat_e] + ranks  # [T*k] — every choice's padded slot
-    gathered = h[dst.reshape(tokens, k_top)]  # [T, k, d]
-    out = jnp.einsum("tk,tkd->td", top_p, gathered.astype(jnp.float32))
+    s_pad = jnp.zeros((nb * B,), jnp.float32).at[dst].set(top_p.reshape(-1))
+    h = run(jax.nn.silu(zg) * zu,
+            expert_params["w_down"].astype(x.dtype), block_expert,
+            row_scale=s_pad)
+
+    gathered = h[dst.reshape(tokens, k_top)]  # [T, k, d] — pre-weighted
+    out = jnp.sum(gathered.astype(jnp.float32), axis=1)
+    stats = {
+        "expert_load": counts.astype(jnp.float32) / tk,
+        "mean_gate": jnp.mean(gate_probs, axis=0),
+        "drop_frac": jnp.float32(0.0),
+    }
+    return out.astype(x.dtype), stats
+
+
+def _moe_local_gmm(x, gate_logits, expert_params, axis_name: str,
+                   k_top: int = 1, block_rows: int = 256):
+    """Padding-free EP-SHARDED MoE over the Pallas grouped-matmul kernel
+    (r6 — the tentpole that brings the gmm path to the flagship ep
+    layouts; before this, dispatch_impl="gmm" silently degraded to
+    capacity queues under an ep axis).
+
+    The obstruction the capacity path existed to solve: ``all_to_all``
+    needs static shapes, but per-(source-shard, expert) token counts are
+    data-dependent. Resolution:
+
+    1. COUNT EXCHANGE — each shard routes its T·k token-choices, counts
+       per global expert, and all_to_alls the [S, E/S] count matrix, so
+       every shard knows exactly how many rows it will receive from each
+       source for each of its local experts before touching the payload.
+    2. BLOCK-QUANTUM BUFFERS — the payload a2a moves one statically
+       sized segment per (source, dest) pair: seg_blocks = ceil(T·k/B) +
+       E_local row-blocks (the lossless bound — all of a source's
+       choices could route to one destination, plus worst-case
+       per-expert round-up to the kernel's B-row quantum). Within a
+       segment, each expert's rows sit at block-aligned offsets computed
+       from the counts, so the RECEIVER can rebuild an exact
+       block→expert steering map with pure index arithmetic — no
+       capacity queues, no drops, ever.
+    3. SENTINEL-SKIPPED COMPUTE — buffer occupancy is data-dependent but
+       the kernel grid is static; unoccupied blocks get block_expert=-1
+       and the kernel writes zeros without spending MXU work, so expert
+       FLOPs scale with ROUTED tokens (+ ≤B-row round-up per
+       (source, expert)), not with the worst-case buffer.
+    4. FUSED COMBINE — gate weights ride the payload a2a as a [S_cap]
+       f32 sidecar and are applied inside the down-projection kernel's
+       epilogue (gmm row_scale), so the return-path combine is a pure
+       gather+sum at the source.
+
+    The trade receipted in docs/design.md: wire bytes are S× the active
+    rows (worst-case-sized segments traverse the a2a even when lightly
+    occupied) vs cf× for capacity queues — identical at the flagship
+    ep=2/cf=2 point, and the ~2× PADDING FLOPS (the r4 decomposition's
+    top structural term) are retired outright. Gradients: garbage rows
+    carry zero cotangents by construction (their outputs are never
+    gathered and their gate-weight sidecar is hard 0), and the dw kernel
+    zero-initializes every expert tile, so zero-token experts get exact
+    zero gradients (pinned by the ep-gmm tests)."""
+    n_shards = axis_size(axis_name)
+    tokens, d = x.shape
+    n_experts = gate_logits.shape[-1]
+    e_local = n_experts // n_shards
+    B = block_rows
+    tk = tokens * k_top
+
+    gate_probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    top_p, top_i = jax.lax.top_k(gate_probs, k_top)  # [T, k]
+    if k_top > 1:
+        top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+
+    flat_e = top_i.reshape(-1).astype(jnp.int32)  # [T*k], t-major
+    order = jnp.argsort(flat_e, stable=True)
+    counts = jnp.bincount(flat_e, length=n_experts).astype(jnp.int32)
+    offsets = jnp.cumsum(counts) - counts  # unpadded sorted offsets [E]
+    rank_sorted = jnp.arange(tk, dtype=jnp.int32) - offsets[flat_e[order]]
+    ranks = jnp.zeros((tk,), jnp.int32).at[order].set(rank_sorted)
+
+    # --- send layout: [dest segment | expert region | rank] -------------
+    seg_blocks = -(-tk // B) + e_local  # static lossless bound (blocks)
+    s_cap = seg_blocks * B              # rows per (src, dest) segment
+    pad_rows = (-(-counts // B)) * B    # [E] B-aligned region per expert
+    pad_r = pad_rows.reshape(n_shards, e_local)
+    bounds_rows = jnp.cumsum(pad_r, axis=1)          # [S, E_l]
+    off_in_seg = (bounds_rows - pad_r).reshape(-1)   # [E] flat == expert id
+
+    send_slot = (
+        (flat_e // e_local) * s_cap + off_in_seg[flat_e] + ranks
+    )  # [T*k] — each choice's row in the send buffer (and, after the
+    # return all_to_all, in the received-output buffer: the exchange is
+    # symmetric, so the send layout IS the combine layout)
+
+    # fill the send buffer by row GATHER (the cheap direction on TPU —
+    # same rationale as _moe_single_gmm's x_pad)
+    r = jnp.arange(n_shards * s_cap, dtype=jnp.int32)
+    seg, u = r // s_cap, r % s_cap
+    le_r = jnp.sum(u[:, None] >= bounds_rows[seg], axis=1).astype(jnp.int32)
+    in_region = le_r < e_local
+    e_r = seg * e_local + jnp.minimum(le_r, e_local - 1)
+    rank_r = u - off_in_seg[e_r]
+    valid = in_region & (rank_r < counts[e_r])
+    src_choice = order[jnp.clip(offsets[e_r] + rank_r, 0, tk - 1)]
+    x_send = x[jnp.where(valid, src_choice // k_top, 0)]  # [S*S_cap, d]
+    s_send = jnp.where(
+        valid, top_p.reshape(-1)[jnp.clip(src_choice, 0, tk - 1)], 0.0
+    )  # gate-weight sidecar; hard 0 on garbage rows kills their outputs
+    # AND their backward (ds flows only through the where)
+
+    # --- exchanges ------------------------------------------------------
+    a2a = partial(jax.lax.all_to_all, axis_name=axis_name, split_axis=0,
+                  concat_axis=0, tiled=False)
+    counts_rcv = a2a(counts.reshape(n_shards, e_local))      # [S(src), E_l]
+    x_rcv = a2a(x_send.reshape(n_shards, s_cap, d))          # [S(src), S_cap, d]
+    s_rcv = a2a(s_send.reshape(n_shards, s_cap))             # [S(src), S_cap]
+
+    # --- dest-side block→expert map from the exchanged counts -----------
+    pad_blocks_rcv = -(-counts_rcv // B)                     # [S, E_l]
+    bounds_blocks = jnp.cumsum(pad_blocks_rcv, axis=1)       # [S, E_l]
+    b = jnp.arange(n_shards * seg_blocks, dtype=jnp.int32)
+    seg_b, ub = b // seg_blocks, b % seg_blocks
+    le_b = jnp.sum(ub[:, None] >= bounds_blocks[seg_b], axis=1).astype(jnp.int32)
+    block_expert = jnp.where(le_b < e_local, le_b, -1).astype(jnp.int32)
+
+    from tf_operator_tpu.ops.grouped_matmul import gmm
+
+    interpret = jax.default_backend() != "tpu"
+    run = partial(gmm, block_rows=B, interpret=interpret)
+    x_flat = x_rcv.reshape(n_shards * s_cap, d)
+    zg = run(x_flat, expert_params["w_gate"].astype(x.dtype), block_expert)
+    zu = run(x_flat, expert_params["w_up"].astype(x.dtype), block_expert)
+    h = run(jax.nn.silu(zg) * zu,
+            expert_params["w_down"].astype(x.dtype), block_expert,
+            row_scale=s_rcv.reshape(-1))
+
+    # --- return results to source shards, combine -----------------------
+    h_ret = a2a(h.reshape(n_shards, s_cap, -1)).reshape(n_shards * s_cap, -1)
+    gathered = h_ret[send_slot.reshape(tokens, k_top)]  # [T, k, d] pre-weighted
+    out = jnp.sum(gathered.astype(jnp.float32), axis=1)
+
     stats = {
         "expert_load": counts.astype(jnp.float32) / tk,
         "mean_gate": jnp.mean(gate_probs, axis=0),
@@ -366,18 +503,36 @@ def _moe_single(x, gate_logits, expert_params, expert_fn, capacity: int, dropped
 
 def _moe_local(x, gate_logits, expert_params, expert_fn, axis_name: str, capacity: int,
                dropped: str, k_top: int = 1, stat_axes: tuple = (),
-               dispatch_impl: str = "sort"):
+               dispatch_impl: str = "sort", block_rows: int = 256):
     """Per-device body. x: [tokens_local, d]; gate_logits: [tokens_local, E];
     expert_params: this device's experts (leading dim E_local).
     ``stat_axes``: every mesh axis the token dim shards over (data axes +
     ep) — router stats pmean over all of them to give the global view.
-    Both dispatch impls build the same [E, C, d] inbox layout, so the
-    all_to_all exchange is impl-agnostic."""
+    The sort/einsum impls build the same [E, C, d] inbox layout, so the
+    capacity all_to_all exchange is impl-agnostic; "gmm" (r6) replaces
+    the capacity queues with block-quantum buffers (_moe_local_gmm)."""
     n_shards = axis_size(axis_name)
     tokens, d = x.shape
     n_experts = gate_logits.shape[-1]
     experts_per_shard = n_experts // n_shards
 
+    if dispatch_impl == "gmm":
+        if not isinstance(expert_params, dict) or set(expert_params) != {
+            "w_gate", "w_up", "w_down"
+        }:
+            raise ValueError(
+                "dispatch_impl='gmm' computes a SwiGLU expert from "
+                "{w_gate, w_up, w_down} stacked params and ignores "
+                f"expert_fn; got param keys {sorted(expert_params)} — use "
+                "dispatch_impl='sort' for custom expert bodies"
+            )
+        out, stats = _moe_local_gmm(
+            x, gate_logits, expert_params, axis_name, k_top, block_rows
+        )
+        for ax in stat_axes or (axis_name,):
+            stats = jax.tree_util.tree_map(
+                lambda s: jax.lax.pmean(s, ax), stats)
+        return out, stats
     if dispatch_impl == "sort":
         slot, w, keep_any, inbox, stats = _route_sparse(
             x, gate_logits, capacity, k_top, dropped)
@@ -465,15 +620,21 @@ def moe_apply(
 
     ``dispatch_impl``: "sort" (default, r3 — argsort/scatter/gather
     dispatch, O(T·d)) or "einsum" (the one-hot-matmul formulation,
-    O(T²·d) — kept as the parity oracle), or "ragged" (r5 — grouped
-    ragged_dot over actual per-expert counts via ``ragged_expert_fn``:
-    no capacity, no padding FLOPs, no drops; single-device/no-ep path
-    only — the ep all_to_all needs static per-expert shapes, so the
-    sharded path falls back to "sort" with a visible note in the stats
-    contract). Same queue semantics for sort/einsum, same drop patterns,
+    O(T²·d) — kept as the parity oracle), or "gmm" (r5/r6 — the Pallas
+    grouped-matmul kernel, ops/grouped_matmul.py: no capacity queues,
+    no drops, padding only to the kernel's row-block quantum; r6 runs it
+    under ep sharding too via count-exchange + block-quantum all_to_all
+    buffers, _moe_local_gmm — the flagship layouts no longer degrade to
+    capacity queues), or "ragged" (r5 — grouped ragged_dot over actual
+    per-expert counts via ``ragged_expert_fn``; single-device/no-ep path
+    only: its XLA lowering has no steering map to skip unoccupied
+    blocks, so the sharded path falls back to "sort" with a runtime
+    warning). Same queue semantics for sort/einsum, same drop patterns,
     same stats (pinned by the impl-parity tests); the end-to-end win is
     recorded in BASELINE.md."""
-    from jax import shard_map
+    from tf_operator_tpu.parallel.collectives import (  # noqa: F401
+        shard_map_compat as shard_map,
+    )
 
     if dispatch_impl not in ("sort", "einsum", "ragged", "gmm"):
         raise ValueError(f"unknown dispatch_impl {dispatch_impl!r}")
@@ -488,19 +649,33 @@ def moe_apply(
             dispatch_impl, ragged_expert_fn,
         )
         return (out, stats) if return_stats else out
-    if dispatch_impl in ("ragged", "gmm"):
-        # static all_to_all shapes require capacity queues; the sharded
-        # path keeps the sort dispatch. Logged, not just documented: the
-        # caller opted into the zero-drop path and is getting capacity
-        # drops instead — that change must be visible at runtime.
+    if dispatch_impl == "ragged":
+        # ragged_dot has no block steering to skip unoccupied regions of
+        # a statically-sized a2a buffer, so under ep it would pay the
+        # worst-case FLOPs — the sharded path keeps the sort dispatch.
+        # Logged, not just documented: the caller opted into the
+        # zero-drop path and is getting capacity drops instead — that
+        # change must be visible at runtime. (The gmm impl no longer
+        # falls back: r6 runs it ep-sharded via _moe_local_gmm.)
         import logging
 
         logging.getLogger("tpujob.moe").warning(
-            "dispatch_impl=%r needs static per-expert shapes under ep "
-            "sharding; falling back to 'sort' (capacity queues, drops "
-            "possible)", dispatch_impl,
+            "dispatch_impl='ragged' needs static per-expert shapes under "
+            "ep sharding; falling back to 'sort' (capacity queues, drops "
+            "possible) — use dispatch_impl='gmm' for the padding-free "
+            "ep path",
         )
         dispatch_impl = "sort"
+    if dispatch_impl == "gmm" and (
+        not isinstance(expert_params, dict)
+        or set(expert_params) != {"w_gate", "w_up", "w_down"}
+    ):
+        raise ValueError(
+            "dispatch_impl='gmm' computes a SwiGLU expert from "
+            "{w_gate, w_up, w_down} stacked params and ignores expert_fn; "
+            f"got param keys {sorted(expert_params)} — use "
+            "dispatch_impl='sort' for custom expert bodies"
+        )
     ep = mesh.shape[axis_name]
     data_axes = tuple(a for a in batch_axes if a in mesh.axis_names)
     n_data = math.prod(mesh.shape[a] for a in data_axes) if data_axes else 1
@@ -516,14 +691,16 @@ def moe_apply(
     token_spec = P((*data_axes, axis_name))
     param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), expert_params)
     stat_specs = {"expert_load": P(), "mean_gate": P(), "drop_frac": P()}
+    import os
+
     fn = shard_map(
         partial(_moe_local, expert_fn=expert_fn, axis_name=axis_name, capacity=capacity,
                 dropped=dropped, k_top=k_top, stat_axes=(*data_axes, axis_name),
-                dispatch_impl=dispatch_impl),
+                dispatch_impl=dispatch_impl,
+                block_rows=int(os.environ.get("TPUJOB_GMM_BLOCK_ROWS", "256"))),
         mesh=mesh,
         in_specs=(token_spec, token_spec, param_specs),
         out_specs=(token_spec, stat_specs),
-        check_vma=False,
     )
     out, stats = fn(x, gate_logits, expert_params)
     return (out, stats) if return_stats else out

@@ -397,7 +397,9 @@ def pipeline_apply(
     nothing crosses dp), while stage params shard over ``axis_name`` (+ tp
     when param_specs say so) and replicate over the data axes.
     """
-    from jax import shard_map
+    from tf_operator_tpu.parallel.collectives import (  # noqa: F401
+        shard_map_compat as shard_map,
+    )
 
     batch = x.shape[0]
     if n_chunks > 1:
@@ -433,7 +435,6 @@ def pipeline_apply(
             mesh=mesh,
             in_specs=(param_specs, x_spec),
             out_specs=(x_spec, aux_spec),
-            check_vma=False,
         )(stage_params, x_micro)
     else:
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
@@ -485,7 +486,9 @@ def _apply_1f1b(stage_params, x_micro, fn, mesh, axis_name, x_spec, param_specs,
     reshape on the way back), specs shift to P(None, axis_name, …), and
     the local tick bodies see chunk-major [v, ...] params. v = 1 keeps
     the [S, ...] layout where the local [1, ...] block IS chunk-major."""
-    from jax import shard_map
+    from tf_operator_tpu.parallel.collectives import (  # noqa: F401
+        shard_map_compat as shard_map,
+    )
 
     fn2 = _with_aux(fn, aux_size)
     k = max(aux_size, 1)
@@ -523,7 +526,6 @@ def _apply_1f1b(stage_params, x_micro, fn, mesh, axis_name, x_spec, param_specs,
             body, mesh=mesh,
             in_specs=(pspecs, x_spec),
             out_specs=(x_spec, aux_spec, saved_spec),
-            check_vma=False,
         )(params, xm)
         return (y, aux_rows), (params, x_saved)
 
@@ -580,7 +582,6 @@ def _apply_1f1b(stage_params, x_micro, fn, mesh, axis_name, x_spec, param_specs,
             body, mesh=mesh,
             in_specs=(pspecs, saved_spec, x_spec, aux_spec),
             out_specs=(pspecs, x_spec),
-            check_vma=False,
         )(params, x_saved, gy, gaux_rows)
         return dparams, dx
 

@@ -120,7 +120,9 @@ def ulysses_attention(
     then h/cp query vs n_kv/cp kv heads — the flash kernel and the
     grouped dense default both do; an MHA-only attn_fn is safe only for
     equal-head models)."""
-    from jax import shard_map
+    from tf_operator_tpu.parallel.collectives import (  # noqa: F401
+        shard_map_compat as shard_map,
+    )
 
     cp = mesh.shape[axis_name]
     b, t, h, d = q.shape
@@ -154,6 +156,5 @@ def ulysses_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
     )
     return fn(q, k, v)

@@ -340,21 +340,38 @@ class CheckpointManager:
             # rejects PyTreeRestore args (and vice versa) — a fresh
             # instance resolves the handler from the restore args.
             mgr = self._ocp.CheckpointManager(self.directory)
+            # explicit restore_args: without them PyTreeRestore lays
+            # arrays out with the sharding recorded at save time, not the
+            # template's (evaluator mesh != trainer mesh is the normal
+            # case)
+            restore_args = self._ocp.checkpoint_utils.construct_restore_args(
+                abstract
+            )
             try:
-                restored = mgr.restore(
-                    int(step),
-                    args=self._ocp.args.PyTreeRestore(
-                        item=abstract,
-                        # explicit restore_args: without them PyTreeRestore
-                        # lays arrays out with the sharding recorded at save
-                        # time, not the template's (evaluator mesh != trainer
-                        # mesh is the normal case)
-                        restore_args=self._ocp.checkpoint_utils.construct_restore_args(
-                            abstract
+                try:
+                    restored = mgr.restore(
+                        int(step),
+                        args=self._ocp.args.PyTreeRestore(
+                            item=abstract,
+                            restore_args=restore_args,
+                            partial_restore=True,
                         ),
-                        partial_restore=True,
-                    ),
-                )
+                    )
+                except TypeError:
+                    # orbax < 0.9: PyTreeRestore has no partial_restore
+                    # kwarg; the (deprecated-but-kept) transformations API
+                    # spells the same contract — item defines the subset,
+                    # transforms={} says "no renames, drop the rest"
+                    # (r6: previously this raised and evaluators silently
+                    # scored nothing on such containers)
+                    restored = mgr.restore(
+                        int(step),
+                        args=self._ocp.args.PyTreeRestore(
+                            item=abstract,
+                            restore_args=restore_args,
+                            transforms={},
+                        ),
+                    )
             finally:
                 mgr.close()
             return {k: restored[k] for k in templates}

@@ -48,4 +48,16 @@ def enable(cache_dir: str | None = None) -> str | None:
     # every compile on the submit path.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # jax initializes its cache object lazily at the first compile and
+    # then never re-reads the config dir — if ANYTHING compiled before
+    # enable() (an orbax restore, a warmup jit), the cache would stay
+    # pinned to that moment's (usually disabled) state and this call
+    # would silently do nothing (r6: observed as checkpoint-restore →
+    # compile-cache test-order pollution, present since the seed).
+    try:
+        from jax._src import compilation_cache as _jcc
+
+        _jcc.reset_cache()
+    except (ImportError, AttributeError):  # private API; best-effort
+        pass
     return path

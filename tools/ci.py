@@ -53,7 +53,113 @@ def run_stage(stage: dict, subs: dict, sink=None) -> int:
     return rc
 
 
+def shard_pytest(argv) -> int:
+    """Run the unit tiers in parallel pytest shards (r6: the suite grew
+    past 500 tests / ~36 min serial; the e2e/chaos tiers are marked and
+    staged separately, and everything that spawns an operator binds
+    ephemeral ports, so file-level parallelism is safe).
+
+    With pytest-xdist installed this simply execs ``pytest -n N``; the
+    CI container has no xdist, so the fallback partitions test FILES
+    over N concurrent pytest subprocesses (greedy by file size — a crude
+    but monotone duration proxy), each with its own junit artifact. Exit
+    is nonzero if any shard fails; "no tests collected" (pytest exit 5 —
+    a shard whose files were entirely deselected by -m) counts as pass.
+    The pass count is the sum over shards — identical to the serial run
+    by construction (same selection expression, disjoint file sets).
+
+    Usage: python -m tools.ci shard-pytest [--shards N]
+               [--junit-prefix P] -- <pytest args...>
+    """
+    p = argparse.ArgumentParser(prog="tpujob-ci shard-pytest")
+    p.add_argument("--shards", type=int, default=0,
+                   help="0 = auto (cpu_count//4, clamped to [2, 6])")
+    p.add_argument("--junit-prefix", default=None,
+                   help="write <prefix>-shard<i>.xml per shard")
+    p.add_argument("rest", nargs=argparse.REMAINDER,
+                   help="pytest args after --")
+    args = p.parse_args(argv)
+    rest = [a for a in args.rest if a != "--"]
+    n = args.shards or max(2, min(6, (os.cpu_count() or 4) // 4))
+
+    try:
+        import xdist  # noqa: F401
+
+        cmd = [sys.executable, "-m", "pytest", "-n", str(n), *rest]
+        if args.junit_prefix:
+            cmd.append(f"--junitxml={args.junit_prefix}-xdist.xml")
+        print(f"shard-pytest: xdist available, exec {' '.join(cmd)}",
+              flush=True)
+        return subprocess.run(cmd, cwd=REPO_ROOT).returncode
+    except ImportError:
+        pass
+
+    import glob
+    import re as _re
+    import threading
+
+    files = sorted(glob.glob(os.path.join(REPO_ROOT, "tests", "test_*.py")))
+    if not files:
+        print("shard-pytest: no test files found", file=sys.stderr)
+        return 2
+    # greedy longest-processing-time partition on file size
+    buckets = [[] for _ in range(n)]
+    sizes = [0] * n
+    for f in sorted(files, key=lambda f: -os.path.getsize(f)):
+        i = sizes.index(min(sizes))
+        buckets[i].append(os.path.relpath(f, REPO_ROOT))
+        sizes[i] += os.path.getsize(f)
+    buckets = [b for b in buckets if b]
+
+    results = [None] * len(buckets)
+
+    def run_shard(i):
+        cmd = [sys.executable, "-m", "pytest", *buckets[i], *rest]
+        if args.junit_prefix:
+            cmd.append(f"--junitxml={args.junit_prefix}-shard{i}.xml")
+        proc = subprocess.run(
+            cmd, cwd=REPO_ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        results[i] = (proc.returncode, proc.stdout)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=run_shard, args=(i,))
+               for i in range(len(buckets))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    passed = failed = 0
+    bad = False
+    for i, (rc, out) in enumerate(results):
+        tail = out.strip().splitlines()[-1] if out.strip() else ""
+        print(f"--- shard {i} ({len(buckets[i])} files): exit {rc}: {tail}",
+              flush=True)
+        if rc not in (0, 5):
+            bad = True
+            # full log only for failing shards — the passing ones would
+            # bury the failure under thousands of dots
+            print(out, flush=True)
+        for key, pat in (("passed", r"(\d+) passed"),
+                         ("failed", r"(\d+) failed")):
+            m = _re.search(pat, out)
+            if m:
+                if key == "passed":
+                    passed += int(m.group(1))
+                else:
+                    failed += int(m.group(1))
+    print(f"shard-pytest: {len(buckets)} shards, {passed} passed, "
+          f"{failed} failed in {time.perf_counter() - t0:.1f}s", flush=True)
+    return 1 if bad else 0
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "shard-pytest":
+        return shard_pytest(argv[1:])
     p = argparse.ArgumentParser(prog="tpujob-ci")
     p.add_argument("--pipeline", default=os.path.join(REPO_ROOT, "ci", "pipeline.yaml"))
     p.add_argument("--artifacts", default="/tmp/tpujob-ci-artifacts")

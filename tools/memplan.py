@@ -216,6 +216,27 @@ def plan(preset_name: str, mesh_axes: dict, batch: int, seq: int,
     # working set: q + attn-out + 2 residual-stream temporaries (d each),
     # k + v (kv each), gate/up/act/down intermediates (4f/tp)
     working = local_tokens * (6 * d + 2 * kv + 4 * f // tp) * dtype_bytes
+    if (cfg.n_experts and mesh_axes.get("ep", 1) > 1
+            and getattr(cfg, "moe_dispatch", "sort") == "gmm" and not pipelined):
+        # ep-gmm dispatch (r6): the padding-free exchange trades capacity
+        # queues for statically-sized BLOCK-QUANTUM all_to_all buffers —
+        # one segment per (source, dest) pair of seg_rows =
+        # ceil(T_moe·k/B)·B + (E/ep)·B rows (lossless bound: any source
+        # may route everything to one destination, plus worst-case
+        # per-expert round-up to the kernel's B-row block). Live set per
+        # MoE layer: the [ep·seg_rows, d] payload on each side of BOTH
+        # exchanges (x_send/x_rcv, h/h_ret) and the two [ep·seg_rows, f]
+        # SwiGLU intermediates between the grouped matmuls. The f32 gate
+        # sidecars are noise. T_moe = this chip's tokens / ep (tokens
+        # shard over (data axes × ep) inside moe_apply).
+        ep = mesh_axes["ep"]
+        bq = int(os.environ.get("TPUJOB_GMM_BLOCK_ROWS", "256"))
+        t_moe = max(1, local_tokens // ep)
+        k_top = int(getattr(cfg, "moe_top_k", 1))
+        e_local = max(1, cfg.n_experts // ep)
+        seg_rows = -(-t_moe * k_top // bq) * bq + e_local * bq
+        buf_rows = ep * seg_rows
+        working += buf_rows * (4 * d + 2 * f) * dtype_bytes
     if cfg.fused_xent:
         head = local_tokens * d * dtype_bytes * 2  # hidden + recompute block
     else:

@@ -640,7 +640,12 @@ def moe_roofline(tokens: int = 32768, d: int = 768, f: int = 3072,
 
     def body_experts_gmm(args):
         # the grouped matmul alone on ACTIVE rows (uniform groups): the
-        # "experts-vmap at cf" rows vs this one isolates the padding term
+        # "experts-vmap at cf" rows vs this one isolates the padding
+        # term. r6: the down projection runs with the fused combine
+        # epilogue (row_scale) exactly as the shipped layer does, and
+        # the dw walk behind this row is the regridded
+        # (expert, col-tile, block-walk) kernel — this row is where its
+        # retired per-step accumulator round trip shows up.
         from tf_operator_tpu.ops.grouped_matmul import gmm as gmm_op
 
         xs, w = args["x"], args["w"]
@@ -653,7 +658,8 @@ def moe_roofline(tokens: int = 32768, d: int = 768, f: int = 3072,
         ).astype(jnp.int32)
         zg = gmm_op(xs, w["w_gate"], be)
         zu = gmm_op(xs, w["w_up"], be)
-        out = gmm_op(jax.nn.silu(zg) * zu, w["w_down"], be)
+        out = gmm_op(jax.nn.silu(zg) * zu, w["w_down"], be,
+                     row_scale=args["rs"])
         return jnp.sum(out.astype(jnp.float32) ** 2)
 
     # Active-FLOP reference: 6·(3·d·f)·T_active fwd+bwd matmul FLOPs
@@ -698,7 +704,9 @@ def moe_roofline(tokens: int = 32768, d: int = 768, f: int = 3072,
         ("dense", body_dense, {"x": x, "w": dense_w}),
         ("experts-loop", body_experts_loop, {"x": inbox, "w": wp}),
         ("experts-vmap", body_experts_vmap, {"x": inbox, "w": wp}),
-        ("experts-gmm", body_experts_gmm, {"x": x_active, "w": wp}),
+        ("experts-gmm", body_experts_gmm,
+         {"x": x_active, "w": wp,
+          "rs": jnp.ones((tokens * k_top,), jnp.float32)}),
         ("routing", body_routing, {"x": x, "w": router}),
         ("full", body_full, {"x": x, "wr": router, "w": wp}),
         ("full-gmm", body_full_gmm, {"x": x, "wr": router, "w": wp}),
