@@ -95,6 +95,32 @@ def test_reregistration_adopts_and_refreshes_spec_and_phase():
     assert time.time() - h.status.heartbeat_time < 5
 
 
+def test_stillborn_host_is_lost_after_registration_ttl():
+    """A host that registered but crashed before its first heartbeat
+    (status.heartbeat_time never set) must not stay Ready forever: the
+    registration time anchors the liveness TTL until a heartbeat lands,
+    so the stillborn host ages into lost_hosts like any silent one."""
+    from tf_operator_tpu.api.types import ObjectMeta
+    from tf_operator_tpu.runtime.objects import Host, HostSpec
+    from tf_operator_tpu.runtime.scheduler import GangScheduler
+
+    store = Store()
+    h = Host(
+        metadata=ObjectMeta(name="h9", namespace="default"),
+        spec=HostSpec(address="10.0.0.9", total_chips=8),
+    )
+    h.status.phase = HostPhase.READY
+    assert not h.status.heartbeat_time  # registered, never heartbeated
+    store.create(h)
+    s = GangScheduler(store, heartbeat_ttl=0.05)
+    # within the registration grace window it is schedulable...
+    assert [x.metadata.name for x in s.ready_hosts()] == ["h9"]
+    time.sleep(0.1)
+    # ...but once the TTL passes with no heartbeat it is lost, not Ready
+    assert s.ready_hosts() == []
+    assert [x.metadata.name for x in s.lost_hosts()] == ["h9"]
+
+
 def test_draining_agent_reports_draining_property():
     store = Store()
     agent = HostAgent(store, "h5", total_chips=1)

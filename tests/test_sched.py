@@ -1,0 +1,500 @@
+"""Fleet-scheduler tests (r7 tentpole): admission quota boundaries,
+preempt-by-priority victim selection + warm-resume, backfill without
+starvation, topology packing beating the old most-free-first spread, and
+the place_gang list-cost regression contract."""
+
+import time
+
+import pytest
+
+from tf_operator_tpu.api.types import (
+    ConditionType,
+    ObjectMeta,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    SchedulingSpec,
+    TopologySpec,
+    TPUJob,
+    TPUJobSpec,
+)
+from tf_operator_tpu.controller.reconciler import ANNOTATION_PREEMPT
+from tf_operator_tpu.controller.status import get_condition, has_condition
+from tf_operator_tpu.runtime.objects import (
+    Host,
+    HostPhase,
+    HostSpec,
+    Process,
+    ProcessPhase,
+    ProcessSpec,
+)
+from tf_operator_tpu.runtime.scheduler import GangScheduler, SchedulingError
+from tf_operator_tpu.runtime.store import Store
+from tf_operator_tpu.sched.fleet import ADMIT, FAIL, PREEMPT, WAIT, FleetScheduler
+from tf_operator_tpu.sched.objects import PriorityClass, Queue, QueueSpec, job_demand
+
+from tests.test_reconciler import Harness, make_job, make_process
+
+
+def host(name, chips=8, domain="", slice_type=""):
+    h = Host(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=HostSpec(
+            address=f"10.0.0.{len(name)}",
+            slice_type=slice_type,
+            total_chips=chips,
+            topology_domain=domain,
+        ),
+    )
+    h.status.phase = HostPhase.READY
+    h.status.heartbeat_time = time.time()
+    return h
+
+
+def used_chips(store, node, chips, name=None):
+    """Pin ``chips`` on ``node`` with a live foreign process."""
+    store.create(
+        Process(
+            metadata=ObjectMeta(name=name or f"used-{node}", namespace="default"),
+            spec=ProcessSpec(job_name="other", chips=chips, node_name=node),
+        )
+    )
+
+
+def sjob(name, ns="t1", queue="main", priority="", chips=8, workers=1,
+         num_hosts=1, ctime=None):
+    job = TPUJob(
+        metadata=ObjectMeta(name=name, namespace=ns, uid=f"uid-{name}"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=workers,
+                    template=ProcessTemplate(entrypoint="m:f",
+                                             chips_per_process=chips),
+                )
+            },
+            topology=TopologySpec(num_hosts=num_hosts),
+            scheduling=SchedulingSpec(queue=queue, priority_class=priority),
+        ),
+    )
+    job.metadata.creation_timestamp = ctime if ctime is not None else time.time()
+    return job
+
+
+def fleet_env(hosts=(), quota_chips=0, max_jobs=0, ns="t1"):
+    store = Store()
+    for h in hosts:
+        store.create(h)
+    store.create(
+        Queue(metadata=ObjectMeta(name="main", namespace=ns),
+              spec=QueueSpec(quota_chips=quota_chips, max_running_jobs=max_jobs))
+    )
+    store.create(PriorityClass(
+        metadata=ObjectMeta(name="high", namespace="default"), value=100))
+    store.create(PriorityClass(
+        metadata=ObjectMeta(name="low", namespace="default"), value=0))
+    return store, FleetScheduler(store, GangScheduler(store))
+
+
+# ---- admission quota boundaries -------------------------------------------
+
+
+class TestQuota:
+    def test_no_queue_or_missing_queue_admits(self):
+        _, fleet = fleet_env()
+        assert fleet.admit(sjob("a", queue="")).action == ADMIT
+        assert fleet.admit(sjob("b", queue="no-such-queue")).action == ADMIT
+
+    def test_chip_quota_boundary_inclusive(self):
+        """16-chip quota holds exactly two 8-chip jobs; the third waits
+        and re-enters at the head once quota frees."""
+        _, fleet = fleet_env(quota_chips=16)
+        j1, j2, j3 = sjob("a"), sjob("b"), sjob("c")
+        assert fleet.admit(j1).action == ADMIT
+        fleet.commit(j1)
+        assert fleet.admit(j2).action == ADMIT  # 8+8 == 16: boundary admits
+        fleet.commit(j2)
+        d = fleet.admit(j3)
+        assert d.action == WAIT and "quota exhausted" in d.reason
+        assert fleet.release(j1.key())  # held quota -> caller kicks queue
+        assert fleet.next_queued() == [j3.key()]
+        assert fleet.admit(j3).action == ADMIT
+
+    def test_demand_over_quota_is_permanently_unsatisfiable(self):
+        _, fleet = fleet_env(quota_chips=16)
+        d = fleet.admit(sjob("huge", chips=32))
+        assert d.action == FAIL and "unsatisfiable" in d.reason
+
+    def test_max_running_jobs_boundary(self):
+        _, fleet = fleet_env(max_jobs=1)
+        j1, j2 = sjob("a"), sjob("b")
+        assert fleet.admit(j1).action == ADMIT
+        fleet.commit(j1)
+        assert fleet.admit(j2).action == WAIT
+        fleet.release(j1.key())
+        assert fleet.admit(j2).action == ADMIT
+
+    def test_placement_failure_never_leaks_quota(self):
+        """ADMIT without commit (placement failed) must leave usage
+        untouched — quota commits only after the gang actually placed."""
+        _, fleet = fleet_env(quota_chips=8)
+        j = sjob("a")
+        assert fleet.admit(j).action == ADMIT  # no commit
+        assert fleet.admit(sjob("b")).action == ADMIT  # quota still free
+
+
+# ---- preempt-by-priority ---------------------------------------------------
+
+
+class TestPreemption:
+    def test_picks_lowest_priority_newest_victim(self):
+        _, fleet = fleet_env(quota_chips=16)
+        low_old = sjob("low-old", priority="low", ctime=100.0)
+        low_new = sjob("low-new", priority="low", ctime=200.0)
+        for j in (low_old, low_new):
+            fleet.admit(j)
+            fleet.commit(j)
+        d = fleet.admit(sjob("high", priority="high", ctime=300.0))
+        assert d.action == PREEMPT
+        assert d.victims == [low_new.key()]  # newest low, not the old one
+
+    def test_victim_quota_releases_only_after_drain(self):
+        """Two-phase handoff: a draining victim keeps holding its quota
+        (admit() parks it, it is not re-victimizable), and only release()
+        — the gang-is-gone observation — hands the headroom to the
+        preemptor. Victim and preemptor never hold the same chips."""
+        _, fleet = fleet_env(quota_chips=8)
+        victim = sjob("victim", priority="low", ctime=100.0)
+        fleet.admit(victim)
+        fleet.commit(victim)
+        high = sjob("high", priority="high", ctime=200.0)
+        d = fleet.admit(high)
+        assert d.action == PREEMPT and d.victims == [victim.key()]
+        fleet.begin_preempt(victim.key())
+        # mid-drain: quota still held, the victim cannot re-create, and
+        # the preemptor cannot double-promise the draining victim's chips
+        assert fleet.usage()[("t1", "main")] == (8, 1)
+        assert fleet.admit(victim).action == WAIT
+        d = fleet.admit(high)
+        assert d.action == WAIT and not d.victims
+        # drain observed complete -> release -> the preemptor is the kick
+        # target and now admits into the freed headroom
+        assert fleet.release(victim.key())
+        assert fleet.next_queued()[0] == high.key()
+        assert fleet.admit(high).action == ADMIT
+
+    def test_equal_priority_waits_instead_of_preempting(self):
+        _, fleet = fleet_env(quota_chips=16)
+        for name in ("a", "b"):
+            j = sjob(name, priority="low")
+            fleet.admit(j)
+            fleet.commit(j)
+        assert fleet.admit(sjob("c", priority="low")).action == WAIT
+
+    def test_queue_orders_by_priority_then_submit_time(self):
+        _, fleet = fleet_env(quota_chips=8)
+        blocker = sjob("blocker", ctime=1.0)
+        fleet.admit(blocker)
+        fleet.commit(blocker)
+        low = sjob("low", priority="low", ctime=10.0)
+        high = sjob("high", priority="high", ctime=20.0)
+        tie_a = sjob("aa", priority="low", ctime=10.0)
+        for j in (low, high, tie_a):
+            assert fleet.admit(j).action in (WAIT, PREEMPT)
+        # priority first, then ctime, then key (deterministic under ties)
+        assert fleet.next_queued() == [high.key(), tie_a.key(), low.key()]
+
+
+# ---- backfill + reservations (no starvation) -------------------------------
+
+
+class TestBackfill:
+    def _fragmented(self):
+        store, fleet = fleet_env(
+            hosts=[host("h1", chips=8), host("h2", chips=8), host("h3", chips=4)]
+        )
+        used_chips(store, "h2", 4)  # h2: 4 free; h1: 8 free; h3: 4 free
+        return store, fleet
+
+    def test_queued_gang_reserves_hosts_against_backfill(self):
+        _, fleet = self._fragmented()
+        big = sjob("big", num_hosts=2, workers=2, chips=8, ctime=100.0)
+        gang = fleet.gang
+        with pytest.raises(SchedulingError):
+            gang.place_gang(big, _procs(big), ranks={"big-0": 0, "big-1": 1})
+        d = fleet.on_unplaceable(big)
+        assert d.action == WAIT
+        # big holds the emptiest 2 hosts (h1, then h2 by name among ties)
+        small = sjob("small", chips=4, ctime=200.0)
+        reserved = fleet.reserved_for_others(small)
+        assert reserved == {"h1": 8, "h2": 8}
+        # the reservation doesn't apply to the reserving job itself
+        assert fleet.reserved_for_others(big) == {}
+
+    def test_backfill_lands_in_hole_reservation_does_not_cover(self):
+        _, fleet = self._fragmented()
+        big = sjob("big", num_hosts=2, workers=2, chips=8, ctime=100.0)
+        fleet.on_unplaceable(big)
+        small = sjob("small", chips=4, ctime=200.0)
+        placement = fleet.gang.place_gang(
+            small, _procs(small), ranks={"small-0": 0},
+            reserved=fleet.reserved_for_others(small),
+        )
+        # h1/h2 are spoken for; the only hole left is h3
+        assert placement["small-0"].metadata.name == "h3"
+
+    def test_backfill_cannot_take_the_reserved_hole(self):
+        """A backfiller whose demand only fits on reserved hosts must NOT
+        place — that's exactly the starvation the reservation prevents."""
+        _, fleet = self._fragmented()
+        big = sjob("big", num_hosts=2, workers=2, chips=8, ctime=100.0)
+        fleet.on_unplaceable(big)
+        grabby = sjob("grabby", chips=8, ctime=200.0)  # only h1 could fit it
+        with pytest.raises(SchedulingError):
+            fleet.gang.place_gang(
+                grabby, _procs(grabby), ranks={"grabby-0": 0},
+                reserved=fleet.reserved_for_others(grabby),
+            )
+
+
+def _procs(job, chips=None):
+    n = job.spec.replica_specs[ReplicaType.WORKER].replicas
+    c = chips if chips is not None else \
+        job.spec.replica_specs[ReplicaType.WORKER].template.chips_per_process
+    return [
+        Process(
+            metadata=ObjectMeta(name=f"{job.metadata.name}-{i}",
+                                namespace=job.metadata.namespace),
+            spec=ProcessSpec(job_name=job.metadata.name, chips=c),
+        )
+        for i in range(n)
+    ]
+
+
+# ---- topology packing ------------------------------------------------------
+
+
+def gjob(name, num_hosts=1, workers=1):
+    return TPUJob(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=workers, template=ProcessTemplate(entrypoint="m:f")
+                )
+            },
+            topology=TopologySpec(num_hosts=num_hosts),
+        ),
+    )
+
+
+class TestPacking:
+    def test_best_fit_places_strictly_more_gangs_than_spread(self):
+        """The synthetic fragmented fleet: free chips {4, 4, 8}. The old
+        most-free-first policy put a 4-chip job on the 8-free host and
+        then could not place the 8-chip gang at all. Best-fit packs the
+        4-chip job into a 4-chip hole, so BOTH gangs place."""
+        store = Store()
+        for name in ("h1", "h2", "h3"):
+            store.create(host(name, chips=8))
+        used_chips(store, "h1", 4)
+        used_chips(store, "h2", 4)  # free: h1=4, h2=4, h3=8
+        s = GangScheduler(store)
+
+        first = gjob("first")
+        p1 = _fixed_procs(first, chips=4)
+        placement = s.place_gang(first, p1, ranks={p1[0].metadata.name: 0})
+        node = placement[p1[0].metadata.name].metadata.name
+        assert node in ("h1", "h2")  # into a hole, NOT the 8-free host
+        used_chips(store, node, 4, name="first-placed")
+
+        second = gjob("second")
+        p2 = _fixed_procs(second, chips=8)
+        placement = s.place_gang(second, p2, ranks={p2[0].metadata.name: 0})
+        assert placement[p2[0].metadata.name].metadata.name == "h3"
+
+    def test_gang_packs_into_one_ici_domain(self):
+        """A 2-host gang must land inside a single topology domain when
+        one holds it whole, not spread across pods; equal candidates tie
+        on name so placement is deterministic."""
+        store = Store()
+        for name, dom in (("pa1", "pod-a"), ("pa2", "pod-a"),
+                          ("pb1", "pod-b"), ("pb2", "pod-b"),
+                          ("pc1", "pod-c")):
+            store.create(host(name, chips=8, domain=dom))
+        s = GangScheduler(store)
+        job = gjob("gang", num_hosts=2, workers=2)
+        procs = _fixed_procs(job, chips=4)
+        ranks = {p.metadata.name: i for i, p in enumerate(procs)}
+        placement = s.place_gang(job, procs, ranks=ranks)
+        nodes = {placement[p.metadata.name].metadata.name for p in procs}
+        assert nodes == {"pa1", "pa2"}  # whole domain, name-tie -> pod-a
+
+    def test_partial_domain_preferred_over_splitting(self):
+        """When no single domain holds the gang whole, the biggest
+        partial domain is used first — fewest ICI domains crossed."""
+        store = Store()
+        for name, dom in (("pa1", "pod-a"), ("pa2", "pod-a"),
+                          ("pb1", "pod-b")):
+            store.create(host(name, chips=8, domain=dom))
+        s = GangScheduler(store)
+        job = gjob("gang", num_hosts=3, workers=3)
+        procs = _fixed_procs(job, chips=4)
+        ranks = {p.metadata.name: i for i, p in enumerate(procs)}
+        placement = s.place_gang(job, procs, ranks=ranks)
+        nodes = sorted(placement[p.metadata.name].metadata.name for p in procs)
+        assert nodes == ["pa1", "pa2", "pb1"]
+
+
+def _fixed_procs(job, chips):
+    n = job.spec.replica_specs[ReplicaType.WORKER].replicas
+    return [
+        Process(
+            metadata=ObjectMeta(name=f"{job.metadata.name}-{i}",
+                                namespace="default"),
+            spec=ProcessSpec(job_name=job.metadata.name, chips=chips),
+        )
+        for i in range(n)
+    ]
+
+
+# ---- list-cost regression --------------------------------------------------
+
+
+def test_place_gang_scan_cost_independent_of_process_population():
+    """place_gang must read host load from the store's node-usage index,
+    not a full Process scan: the objects scanned per placement equals the
+    Host count however many Processes exist."""
+    store = Store()
+    for name in ("h1", "h2", "h3"):
+        store.create(host(name, chips=64))
+    for i in range(200):
+        store.create(
+            Process(
+                metadata=ObjectMeta(name=f"noise-{i}", namespace="default"),
+                spec=ProcessSpec(job_name="noise", chips=0, node_name="h1"),
+            )
+        )
+    s = GangScheduler(store)
+    job = gjob("probe")
+    procs = _fixed_procs(job, chips=4)
+    before = store.list_stats()
+    s.place_gang(job, procs, ranks={procs[0].metadata.name: 0})
+    after = store.list_stats()
+    # 3 Hosts scanned; the 200 Processes were never visited
+    assert after["scanned"] - before["scanned"] == 3
+
+
+# ---- reconciler integration ------------------------------------------------
+
+
+def _sched_spec(job, queue="main"):
+    job.spec.scheduling = SchedulingSpec(queue=queue)
+    return job
+
+
+def test_preempt_annotation_drains_gang_and_warm_resumes():
+    """The victim side of preemption: the preempt annotation makes the
+    job's own sync drain its gang with cause ``preemption`` — counted in
+    preemption_count, NOT restart_count (never charged to backoff)."""
+    job = make_job(workers=1)
+    procs = [
+        make_process(job, ReplicaType.COORDINATOR, 0, ProcessPhase.RUNNING),
+        make_process(job, ReplicaType.WORKER, 0, ProcessPhase.RUNNING),
+    ]
+    h = Harness(job, procs)
+    stored = h.stored_job()
+    stored.metadata.annotations[ANNOTATION_PREEMPT] = "t1/high-job"
+    h.store.update(stored)
+    h.ctl.job_informer.seed([h.stored_job()])
+    h.sync()
+    st = h.stored_job().status
+    assert st.preemption_count == 1
+    assert st.restart_count == 0
+    assert st.last_restart_cause == "preemption"
+    # the annotation drained exactly once — cleared store-side
+    assert ANNOTATION_PREEMPT not in h.stored_job().metadata.annotations
+    # two-phase handoff: mid-drain the victim still holds its quota and
+    # cannot re-create; only the sync that OBSERVES the gang gone
+    # releases it (and from there the job re-admits and warm-restarts)
+    key = h.stored_job().key()
+    assert h.ctl.fleet.draining(key)
+    # drain completes: the gang's processes leave the store, and the
+    # watch observes the deletions (satisfying the expectations gate)
+    for p in h.store.list("Process"):
+        h.store.delete("Process", p.metadata.namespace, p.metadata.name)
+    h.ctl.process_informer._cache.clear()
+    h.ctl.job_informer.seed([h.stored_job()])
+    exp = h.ctl._exp_key(key)
+    h.ctl.expectations.deletion_observed(exp)
+    h.ctl.expectations.deletion_observed(exp)
+    h.sync()
+    assert not h.ctl.fleet.draining(key)
+    assert h.fake.created  # released -> re-admitted -> gang recreated
+
+
+def test_quota_blocked_job_parks_in_queued_condition_and_resumes():
+    """Anti-hot-loop: an over-quota job parks in QUEUED (no processes,
+    no SchedulingError retries); when the quota holder finishes, the
+    release kicks the queued job and it admits with QUEUED cleared."""
+    job1 = _sched_spec(make_job(name="holder", workers=1))
+    procs1 = [
+        make_process(job1, ReplicaType.COORDINATOR, 0, ProcessPhase.RUNNING),
+        make_process(job1, ReplicaType.WORKER, 0, ProcessPhase.RUNNING),
+    ]
+    h = Harness(job1, procs1)
+    # demand = topology total chips = 4; quota fits exactly one job
+    h.store.create(
+        Queue(metadata=ObjectMeta(name="main", namespace="default"),
+              spec=QueueSpec(quota_chips=4))
+    )
+    job2 = _sched_spec(make_job(name="parked", workers=1))
+    stored2 = h.store.create(job2)
+    h.ctl.job_informer.seed([h.stored_job(), stored2])
+
+    h.ctl.sync_job(stored2.key())  # ensure_synced commits holder's live gang
+    parked = h.store.get("TPUJob", "default", "parked")
+    assert has_condition(parked.status, ConditionType.QUEUED)
+    assert not h.fake.created  # parked created NOTHING
+
+    # holder's gang succeeds -> job finishes -> release kicks the queue
+    for p in h.store.list("Process"):
+        if p.spec.job_name == "holder":
+            p.status.phase = ProcessPhase.SUCCEEDED
+            p.status.exit_code = 0
+            h.store.update(p)
+    h.ctl.process_informer.seed(h.store.list("Process"))
+    h.ctl.sync_job(job1.key())
+    assert h.ctl.queue.get(timeout=1) == "default/parked"  # the kick
+
+    h.ctl.job_informer.seed(
+        [h.store.get("TPUJob", "default", "holder"),
+         h.store.get("TPUJob", "default", "parked")]
+    )
+    h.ctl.sync_job(stored2.key())
+    assert {p.metadata.name for p in h.fake.created} == {
+        "parked-coordinator-0", "parked-worker-0"
+    }
+    parked = h.store.get("TPUJob", "default", "parked")
+    assert not has_condition(parked.status, ConditionType.QUEUED)
+
+
+def test_unsatisfiable_quota_fails_job_permanently():
+    job = _sched_spec(make_job(workers=1))
+    h = Harness(job)
+    h.store.create(
+        Queue(metadata=ObjectMeta(name="main", namespace="default"),
+              spec=QueueSpec(quota_chips=2))  # demand 4 > quota 2
+    )
+    h.sync()
+    st = h.stored_job().status
+    cond = get_condition(st, ConditionType.FAILED)
+    assert cond is not None and cond.reason == "TPUJobQuotaUnsatisfiable"
+    assert not h.fake.created
+
+
+def test_job_demand_prices_topology_or_replica_sum():
+    priced = sjob("a", chips=4, workers=3)
+    assert job_demand(priced) == 12
+    topo = make_job(workers=5)  # num_hosts=1 x chips_per_host=4
+    assert job_demand(topo) == 4

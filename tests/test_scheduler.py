@@ -173,12 +173,22 @@ class TestPlacement:
             s.place_gang(job(num_hosts=1, workers=2),
                          [proc("p0", chips=1), proc("p1", chips=1)])
 
-    def test_prefers_freest_host_deterministically(self):
+    def test_best_fit_host_deterministically(self):
         store = Store()
         store.create(host("h1", chips=4))
         store.create(host("h2", chips=16))
         store.create(host("h3", chips=16))
         s = GangScheduler(store)
         placement = s.place_gang(job(num_hosts=1), [proc("p0", chips=2)])
-        # h2/h3 tie on free chips; name breaks the tie deterministically
+        # Best-fit packing: the tightest host that still fits wins, keeping
+        # the 16-chip hosts whole for larger gangs.
+        assert placement["p0"].metadata.name == "h1"
+
+    def test_best_fit_tie_breaks_on_name(self):
+        store = Store()
+        store.create(host("h2", chips=16))
+        store.create(host("h3", chips=16))
+        s = GangScheduler(store)
+        placement = s.place_gang(job(num_hosts=1), [proc("p0", chips=2)])
+        # Equal scores: name breaks the tie, so placement is deterministic.
         assert placement["p0"].metadata.name == "h2"

@@ -43,6 +43,11 @@ KIND_EVENT = "Event"
 KIND_HOST = "Host"
 KIND_LEASE = "Lease"
 KIND_SPAN = "Span"
+# Fleet-scheduler object kinds (sched/): cluster-level priority classes and
+# per-namespace admission queues with chip/job quotas. Like Spans, they ride
+# the generic store/API seam (runtime/serialize.py registers decoders).
+KIND_PRIORITY_CLASS = "PriorityClass"
+KIND_QUEUE = "Queue"
 
 # Default port the coordinator's jax.distributed service listens on
 # (replaces the reference's TF gRPC port 2222, v1alpha1/types.go:30).
@@ -92,6 +97,9 @@ class JobPhase(str, enum.Enum):
 
     NONE = ""
     CREATING = "Creating"
+    # Admitted-pending: the job waits in the fleet scheduler's admission
+    # queue (over quota, or no capacity) instead of hot-looping placement.
+    QUEUED = "Queued"
     RUNNING = "Running"
     CLEANUP = "CleanUp"
     FAILED = "Failed"
@@ -102,6 +110,9 @@ class ConditionType(str, enum.Enum):
     """Job conditions (reference: v1alpha2/types.go:167-196)."""
 
     CREATED = "Created"
+    # Waiting in the fleet scheduler's admission queue (sched/): over the
+    # queue's quota or unplaceable on current capacity. Cleared on admission.
+    QUEUED = "Queued"
     RUNNING = "Running"
     RESTARTING = "Restarting"
     SUCCEEDED = "Succeeded"
@@ -221,12 +232,25 @@ class RunPolicy:
 
 
 @dataclass
+class SchedulingSpec:
+    """Fleet-scheduler knobs (sched/): which admission queue this job joins
+    and which PriorityClass orders it there. Both are names resolved at
+    admission time — a missing Queue means "no quota" and a missing
+    PriorityClass means priority 0, so jobs submitted before the objects
+    exist still run (kube-scheduler's optional schedulerName spirit)."""
+
+    queue: str = ""  # Queue name in the job's namespace; "" ⇒ unqueued
+    priority_class: str = ""  # PriorityClass name; "" ⇒ priority 0
+
+
+@dataclass
 class TPUJobSpec:
     """Desired state (reference: v1alpha2 TFJobSpec, types.go:45-54)."""
 
     replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
     topology: TopologySpec = field(default_factory=TopologySpec)
     run_policy: RunPolicy = field(default_factory=RunPolicy)
+    scheduling: SchedulingSpec = field(default_factory=SchedulingSpec)
     # Free-form workload config passed through to every process's context
     # (hyperparameters etc.) — the data plane reads it, the control plane
     # never interprets it, preserving the reference's strict control/data
@@ -301,6 +325,7 @@ class TPUJobStatus:
             return JobPhase.CLEANUP
         return {
             ConditionType.CREATED: JobPhase.CREATING,
+            ConditionType.QUEUED: JobPhase.QUEUED,
             ConditionType.RUNNING: JobPhase.RUNNING,
             ConditionType.RESTARTING: JobPhase.RUNNING,
             ConditionType.SUCCEEDED: JobPhase.DONE,
@@ -378,6 +403,7 @@ def _tpujob_from_dict(data: Dict[str, Any]) -> TPUJob:
         replica_specs=replica_specs,
         topology=topo,
         run_policy=run,
+        scheduling=SchedulingSpec(**spec_d.get("scheduling", {})),
         workload=spec_d.get("workload", {}),
     )
     status_d = data.get("status", {})

@@ -76,6 +76,17 @@ def validate_spec(spec: TPUJobSpec) -> None:
         if tmpl.chips_per_process < 0:
             raise ValidationError(f"{prefix}.template.chips_per_process must be >= 0")
 
+    sched = spec.scheduling
+    # Queue/PriorityClass references are resolved at admission, so only
+    # their SHAPE is validated here (a missing object is legal: quota and
+    # priority are opt-in); the names feed store keys and the dashboard.
+    if sched.queue:
+        _validate_dns_label(sched.queue, "spec.scheduling.queue")
+    if sched.priority_class:
+        _validate_dns_label(
+            sched.priority_class, "spec.scheduling.priority_class"
+        )
+
     rp = spec.run_policy
     if rp.heartbeat_ttl_seconds is not None and rp.heartbeat_ttl_seconds <= 0:
         raise ValidationError(
@@ -91,6 +102,22 @@ def validate_spec(spec: TPUJobSpec) -> None:
         raise ValidationError("spec.replica_specs[Coordinator].replicas must be 1")
 
     _validate_topology(spec)
+
+
+def validate_queue(queue) -> None:
+    """Queue admission checks (dashboard POST seam, like validate_job)."""
+    _validate_dns_label(queue.metadata.name, "metadata.name")
+    _validate_dns_label(queue.metadata.namespace, "metadata.namespace")
+    if queue.spec.quota_chips < 0:
+        raise ValidationError("spec.quota_chips must be >= 0 (0 = unlimited)")
+    if queue.spec.max_running_jobs < 0:
+        raise ValidationError("spec.max_running_jobs must be >= 0 (0 = unlimited)")
+
+
+def validate_priority_class(pc) -> None:
+    _validate_dns_label(pc.metadata.name, "metadata.name")
+    if not isinstance(pc.value, int) or isinstance(pc.value, bool):
+        raise ValidationError("value must be an integer")
 
 
 def _validate_topology(spec: TPUJobSpec) -> None:

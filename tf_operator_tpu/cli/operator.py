@@ -39,6 +39,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="controller worker threads (reference default 2)")
     p.add_argument("--resync-period", type=float, default=15.0,
                    help="reconciler sync loop period seconds (reference 15s)")
+    p.add_argument("--reconcile-shards", type=int, default=1,
+                   help="partition the reconcile workqueue into N namespace-"
+                        "hashed shards (clamped to --threadiness); >1 keeps "
+                        "one tenant's submit burst from head-of-line "
+                        "blocking other tenants behind a single queue mutex")
     p.add_argument("--port", type=int, default=8080, help="dashboard/API port")
     p.add_argument("--host", default="127.0.0.1", help="dashboard/API bind host")
     p.add_argument("--api-workers", type=int, default=64,
@@ -315,7 +320,7 @@ def main(argv=None) -> int:
     controller.api_url = args.store_server or dashboard.url
 
     def start_controller():
-        controller.run(workers=args.threadiness)
+        controller.run(workers=args.threadiness, shards=args.reconcile_shards)
         if recovery is not None and recovery.recovered:
             # Restart re-adoption: claim recovered children, stamp a
             # controller-restart span/event into every live job's trace,

@@ -61,6 +61,14 @@ def build_parser() -> argparse.ArgumentParser:
     tp.add_argument("name", nargs="?", default=None)
     ep = sub.add_parser("events")
     ep.add_argument("--namespace", default=None)
+    ap = sub.add_parser(
+        "apply",
+        help="create a non-job object (Queue, PriorityClass, Host, ...) "
+             "from a JSON doc with a top-level \"kind\"",
+    )
+    ap.add_argument("file")
+    qp = sub.add_parser("queues", help="list Queues with quota usage")
+    qp.add_argument("--namespace", default=None)
     return p
 
 
@@ -87,12 +95,14 @@ def main(argv=None) -> int:
             jobs = client.list(args.namespace)
             print(
                 f"{'NAMESPACE':<12} {'NAME':<24} {'PHASE':<10} "
-                f"{'RESTARTS':<8} {'PREEMPTED':<9}"
+                f"{'QUEUE':<12} {'PRIORITY':<10} {'RESTARTS':<8} {'PREEMPTED':<9}"
             )
             for j in jobs:
                 print(
                     f"{j.metadata.namespace:<12} {j.metadata.name:<24} "
                     f"{j.status.phase().value or '-':<10} "
+                    f"{j.spec.scheduling.queue or '-':<12} "
+                    f"{j.spec.scheduling.priority_class or '-':<10} "
                     f"{j.status.restart_count:<8} {j.status.preemption_count:<9}"
                 )
         elif args.cmd == "get":
@@ -118,6 +128,44 @@ def main(argv=None) -> int:
         elif args.cmd == "events":
             for e in client.events(args.namespace):
                 print(f"{e['type']:<8} {e['reason']:<28} x{e['count']:<4} {e['message']}")
+        elif args.cmd == "apply":
+            from tf_operator_tpu.runtime.serialize import from_doc
+
+            with open(args.file) as f:
+                doc = json.load(f)
+            kind = doc.get("kind")
+            if not kind:
+                print("error: document needs a top-level \"kind\"", file=sys.stderr)
+                return 1
+            obj = from_doc(kind, doc)
+            client.create_object(obj)
+            print(f"{kind} {obj.metadata.namespace}/{obj.metadata.name} created")
+        elif args.cmd == "queues":
+            from tf_operator_tpu.api.types import KIND_QUEUE
+            from tf_operator_tpu.sched.objects import job_demand
+
+            queues = client.list_objects(KIND_QUEUE, args.namespace)
+            jobs = client.list(args.namespace)
+            used: dict = {}
+            for j in jobs:
+                qname = j.spec.scheduling.queue
+                phase = j.status.phase().value
+                if qname and phase not in ("Done", "Failed", "Queued"):
+                    k = (j.metadata.namespace, qname)
+                    c, n = used.get(k, (0, 0))
+                    used[k] = (c + job_demand(j), n + 1)
+            print(
+                f"{'NAMESPACE':<12} {'NAME':<16} {'QUOTA-CHIPS':<12} "
+                f"{'USED-CHIPS':<11} {'JOBS':<5} {'MAX-JOBS':<8}"
+            )
+            for qobj in queues:
+                k = (qobj.metadata.namespace, qobj.metadata.name)
+                c, n = used.get(k, (0, 0))
+                print(
+                    f"{qobj.metadata.namespace:<12} {qobj.metadata.name:<16} "
+                    f"{qobj.spec.quota_chips or '-':<12} {c:<11} {n:<5} "
+                    f"{qobj.spec.max_running_jobs or '-':<8}"
+                )
     except TPUJobApiError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 1

@@ -32,6 +32,11 @@ REASON_NODE_LOST = "NodeLost"
 # Preemption drain: a host under a preemption notice forced a graceful
 # (checkpoint-resumed, backoff-exempt) gang restart.
 REASON_JOB_PREEMPTED = "TPUJobPreempted"
+# Fleet scheduler: the job is parked in the admission queue (over quota,
+# behind a higher-precedence job, or waiting for fleet capacity).
+REASON_JOB_QUEUED = "TPUJobQueued"
+# Fleet scheduler: this job requested preemption of lower-priority victims.
+REASON_JOB_PREEMPTING = "TPUJobPreempting"
 # Control-plane crash-recovery: a restarted operator recovered this job
 # from the durable store and re-adopted its children (record_recovery).
 REASON_CONTROLLER_RESTARTED = "ControllerRestarted"

@@ -14,7 +14,13 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
-from tf_operator_tpu.api.types import KIND_HOST, KIND_PROCESS, KIND_TPUJOB
+from tf_operator_tpu.api.types import (
+    KIND_HOST,
+    KIND_PROCESS,
+    KIND_QUEUE,
+    KIND_TPUJOB,
+)
+from tf_operator_tpu.sched.objects import job_demand
 
 
 class ControllerMetrics:
@@ -30,6 +36,10 @@ class ControllerMetrics:
         "tpujob_controller_restarts_total": (
             "Controller restarts that recovered state from the durable "
             "store (WAL + snapshot) and re-adopted live jobs."
+        ),
+        "tpujob_preemptions_requested_total": (
+            "Preempt-by-priority victim drains requested by the fleet "
+            "scheduler."
         ),
     }
 
@@ -58,6 +68,10 @@ class ControllerMetrics:
         "tpujob_restart_downtime_seconds": (
             "Gang restart decided -> gang RUNNING again (MTTR), by "
             "restart cause."
+        ),
+        "tpujob_queue_wait_seconds": (
+            "Fleet-scheduler admission wait (queued span: parked in "
+            "QUEUED -> admitted), by queue and priority class."
         ),
     }
 
@@ -285,6 +299,44 @@ class ControllerMetrics:
             )
             out.append("# TYPE tpujob_hosts_draining gauge")
             out.append(f"tpujob_hosts_draining {draining}")
+
+        queues = self.store.list(KIND_QUEUE)
+        if queues:
+            # Per-queue quota gauges, recomputed from the store at scrape
+            # time (not from the fleet scheduler's in-memory usage) so the
+            # numbers survive a controller restart and double as the
+            # quota-overshoot oracle the sched bench polls.
+            used: Dict[tuple, int] = {}
+            for j in self.store.list(KIND_TPUJOB):
+                qname = j.spec.scheduling.queue
+                # Only chip-holding phases count against the queue: a job
+                # holds its quota from gang-create (Creating) until its
+                # terminal classification releases it, so Done/Failed jobs
+                # awaiting GC and parked Queued jobs must not inflate used.
+                if not qname or _job_phase(j) not in ("Creating", "Running", "CleanUp"):
+                    continue
+                k = (j.metadata.namespace, qname)
+                used[k] = used.get(k, 0) + job_demand(j)
+            for help_text, name in (
+                ("Queue chip quota (0 = unlimited).", "tpujob_queue_quota_chips"),
+                ("Chips held by admitted jobs in the queue.", "tpujob_queue_used_chips"),
+                ("Quota headroom (quota - used; unlimited renders -1).", "tpujob_queue_free_chips"),
+            ):
+                out.append(f"# HELP {name} {help_text}")
+                out.append(f"# TYPE {name} gauge")
+                for q in queues:
+                    k = (q.metadata.namespace, q.metadata.name)
+                    quota = q.spec.quota_chips
+                    u = used.get(k, 0)
+                    value = {
+                        "tpujob_queue_quota_chips": quota,
+                        "tpujob_queue_used_chips": u,
+                        "tpujob_queue_free_chips": (quota - u) if quota else -1,
+                    }[name]
+                    out.append(
+                        f'{name}{{namespace="{_escape_label_value(k[0])}",'
+                        f'queue="{_escape_label_value(k[1])}"}} {value}'
+                    )
         return out
 
 

@@ -34,6 +34,7 @@ import logging
 import socket
 import threading
 import time
+import zlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from tf_operator_tpu.api import set_defaults, validate_job
@@ -63,6 +64,7 @@ from tf_operator_tpu.controller.expectations import ControllerExpectations
 from tf_operator_tpu.controller.informer import Informer
 from tf_operator_tpu.controller.metrics import ControllerMetrics
 from tf_operator_tpu.controller.status import (
+    clear_condition,
     has_condition,
     initialize_replica_statuses,
     is_finished,
@@ -70,7 +72,7 @@ from tf_operator_tpu.controller.status import (
     set_condition,
     update_replica_status,
 )
-from tf_operator_tpu.controller.workqueue import RateLimitingQueue
+from tf_operator_tpu.controller.workqueue import RateLimitingQueue, ShardedQueueView
 from tf_operator_tpu.obs.spans import (
     COMPONENT_SCHEDULER,
     SpanRecorder,
@@ -106,6 +108,7 @@ from tf_operator_tpu.runtime.store import (
     NotFoundError,
     Store,
 )
+from tf_operator_tpu.sched import fleet as fleetsched
 from tf_operator_tpu.train.checkpoint import latest_checkpoint_step
 from tf_operator_tpu.utils.exit_codes import ExitClass, classify_exit_code, is_retryable
 
@@ -114,6 +117,11 @@ log = logging.getLogger(__name__)
 # Annotation where the controller persists the job's allocated rendezvous
 # port (so reconciles are stable across controller restarts).
 ANNOTATION_PORT = "tpujob.dev/rendezvous-port"
+# Fleet-scheduler preemption request: stamped on a victim job (value = the
+# preemptor's key); the victim's own sync drains it through the graceful
+# preemption lifecycle (cause ``preemption``, warm-resumed, backoff-exempt)
+# and clears the annotation store-side.
+ANNOTATION_PREEMPT = "tpujob.dev/preempt"
 
 # Gang-restart causes (status.last_restart_cause + the by-cause metric).
 # Preemption restarts are graceful — checkpoint-resumed and NOT counted
@@ -180,6 +188,12 @@ class TPUJobController:
         # promised the same free chips.
         self.scheduler = GangScheduler(store)
         self._sched_lock = threading.Lock()
+        # Fleet scheduler (sched/): multi-tenant quota admission, priority
+        # preemption, and head-of-line reservations in FRONT of gang
+        # placement. It has no lock of its own — every call happens under
+        # _sched_lock, the same hold that serializes placement+create, so
+        # "usage never exceeds quota" is an invariant, not a race window.
+        self.fleet = fleetsched.FleetScheduler(store, self.scheduler)
         # Lifecycle tracing (obs/): the reconciler records the controller-
         # and scheduler-side spans of every job's timeline and derives the
         # TTFS / time-to-scheduled / restart-downtime histograms from the
@@ -192,6 +206,12 @@ class TPUJobController:
         self._ttfs_observed: set = set()  # uids whose TTFS hit the histogram
         self._open_restart: Dict[str, Dict[str, Any]] = {}  # uid -> span info
         self._open_schedwait: Dict[str, Dict[str, Any]] = {}
+        self._open_queued: Dict[str, Dict[str, Any]] = {}  # uid -> span info
+        # Workqueue shards (run(shards=N) expands): keys hash by NAMESPACE,
+        # so one tenant's burst cannot head-of-line-block another tenant's
+        # keys behind a single queue mutex, while all of one job's events
+        # stay on one shard (the single-flight-per-key guarantee holds).
+        self._shards: List[RateLimitingQueue] = [self.queue]
 
         self.job_informer = Informer(store, KIND_TPUJOB)
         self.process_informer = Informer(store, KIND_PROCESS)
@@ -214,14 +234,14 @@ class TPUJobController:
     # ---- informer callbacks (controller_pod.go:285-412) -----------------
 
     def _on_job_add(self, job) -> None:
-        self.queue.add(job.key())
+        self._enqueue(job.key())
 
     def _on_job_update(self, old, new) -> None:
         del old
-        self.queue.add(new.key())
+        self._enqueue(new.key())
 
     def _on_job_delete(self, job) -> None:
-        self.queue.add(job.key())
+        self._enqueue(job.key())
 
     def _job_key_for_process(self, process: Process) -> Optional[str]:
         name = process.spec.job_name or process.metadata.labels.get(LABEL_JOB_NAME)
@@ -233,28 +253,60 @@ class TPUJobController:
         key = self._job_key_for_process(process)
         if key:
             self.expectations.creation_observed(self._exp_key(key))
-            self.queue.add(key)
+            self._enqueue(key)
 
     def _on_process_update(self, old, new) -> None:
         del old
         key = self._job_key_for_process(new)
         if key:
-            self.queue.add(key)
+            self._enqueue(key)
 
     def _on_process_delete(self, process: Process) -> None:
         key = self._job_key_for_process(process)
         if key:
             self.expectations.deletion_observed(self._exp_key(key))
-            self.queue.add(key)
+            self._enqueue(key)
 
     @staticmethod
     def _exp_key(job_key: str) -> str:
         return f"{job_key}/processes"
 
+    # ---- workqueue sharding ---------------------------------------------
+
+    def _route(self, key: str) -> RateLimitingQueue:
+        """Shard for a job key: hash by namespace (crc32, not the salted
+        builtin hash) so a tenant's keys always land together."""
+        shards = self._shards
+        if len(shards) == 1:
+            return shards[0]
+        ns = key.split("/", 1)[0]
+        return shards[zlib.crc32(ns.encode("utf-8")) % len(shards)]
+
+    def _enqueue(self, key: str) -> None:
+        self._route(key).add(key)
+
     # ---- lifecycle ------------------------------------------------------
 
-    def run(self, workers: int = 1, wait_synced_timeout: float = 10.0) -> None:
-        """Start informers and worker threads (controller.go:245-277)."""
+    def run(
+        self,
+        workers: int = 1,
+        wait_synced_timeout: float = 10.0,
+        shards: int = 1,
+    ) -> None:
+        """Start informers and worker threads (controller.go:245-277).
+
+        ``shards`` > 1 partitions the workqueue by namespace hash: each
+        worker serves shard ``i % shards``, so multi-tenant bursts stop
+        contending on one queue mutex. Shard 0 stays ``self.queue`` —
+        single-shard (the default) is byte-for-byte the old behavior."""
+        shards = max(1, min(shards, max(1, workers)))
+        if shards > 1:
+            self._shards = [self.queue] + [
+                RateLimitingQueue() for _ in range(shards - 1)
+            ]
+            # The workqueue-depth gauge keeps meaning "keys waiting
+            # anywhere" after the split.
+            self.metrics.queue = ShardedQueueView(self._shards)
         self.job_informer.run()
         self.process_informer.run()
         deadline = time.time() + wait_synced_timeout
@@ -263,7 +315,10 @@ class TPUJobController:
                 raise TimeoutError("informer caches failed to sync")
             time.sleep(0.01)
         for i in range(workers):
-            t = threading.Thread(target=self._worker_loop, name=f"sync-worker-{i}", daemon=True)
+            t = threading.Thread(
+                target=self._worker_loop, args=(i,),
+                name=f"sync-worker-{i}", daemon=True,
+            )
             t.start()
             self._workers.append(t)
         self._resync_thread = threading.Thread(
@@ -273,7 +328,8 @@ class TPUJobController:
 
     def stop(self) -> None:
         self._stop.set()
-        self.queue.shutdown()
+        for q in self._shards:
+            q.shutdown()
         self.job_informer.stop()
         self.process_informer.stop()
         for t in self._workers:
@@ -354,7 +410,7 @@ class TPUJobController:
                 f"controller restarted; recovered store at rv "
                 f"{info.resource_version}, re-adopted {adopted} children",
             )
-            self.queue.add(job.key())
+            self._enqueue(job.key())
         return n
 
     def _rearm_open_spans(self, job: TPUJob) -> None:
@@ -384,6 +440,13 @@ class TPUJobController:
                 self._open_schedwait[uid] = {
                     "ns": s.metadata.namespace, "name": s.metadata.name,
                 }
+            elif s.op == "queued" and uid not in self._open_queued:
+                self._open_queued[uid] = {
+                    "ns": s.metadata.namespace, "name": s.metadata.name,
+                    "start": s.start_time,
+                    "queue": s.attrs.get("queue", "default"),
+                    "priority": s.attrs.get("priority", "none"),
+                }
 
     def _resync_loop(self) -> None:
         """Periodic resync (ReconcilerSyncLoopPeriod, controller.go:63-78).
@@ -406,17 +469,19 @@ class TPUJobController:
                 rs.active for rs in job.status.replica_statuses.values()
             ):
                 continue
-            self.queue.add(job.key())
+            self._enqueue(job.key())
             n += 1
         return n
 
-    def _worker_loop(self) -> None:
-        while self.process_next_item():
+    def _worker_loop(self, i: int = 0) -> None:
+        queue = self._shards[i % len(self._shards)]
+        while self.process_next_item(queue):
             pass
 
-    def process_next_item(self) -> bool:
+    def process_next_item(self, queue: Optional[RateLimitingQueue] = None) -> bool:
         """One workqueue pop + sync (controller.go:289-321)."""
-        key = self.queue.get()
+        queue = self.queue if queue is None else queue
+        key = queue.get()
         if key is None:
             return False
         t0 = time.perf_counter()
@@ -426,11 +491,11 @@ class TPUJobController:
         except Exception:
             error = True
             log.exception("sync failed for %s; requeueing", key)
-            self.queue.add_rate_limited(key)
+            queue.add_rate_limited(key)
         else:
-            self.queue.forget(key)
+            queue.forget(key)
         finally:
-            self.queue.done(key)
+            queue.done(key)
             self.metrics.observe_sync(time.perf_counter() - t0, error)
         return True
 
@@ -447,6 +512,7 @@ class TPUJobController:
             self._delete_children(namespace, name, cleanup=CleanupPolicy.ALL)
             self._delete_spans(namespace, name)
             self.expectations.delete_expectations(self._exp_key(key))
+            self._release_job(key)
             return
 
         job = cached.deepcopy()
@@ -747,6 +813,53 @@ class TPUJobController:
             job.status.completion_time = time.time()
             self._finish(job)
             return
+
+        # -- fleet preemption request (preempt-by-priority) ---------------
+        # A higher-priority job's admission stamped the preempt annotation
+        # on this one: drain the gang through the SAME graceful lifecycle
+        # as a host preemption notice (checkpoint warm-resume, cause
+        # ``preemption``, exempt from backoff), release its quota to the
+        # preemptor, and let the next create re-admit it — it will queue
+        # behind the job that evicted it. Gated on the STORE-side clear
+        # succeeding, so a sync from a stale informer snapshot can never
+        # drain the gang twice for one request.
+        if job.metadata.annotations.get(ANNOTATION_PREEMPT):
+            preemptor = job.metadata.annotations.pop(ANNOTATION_PREEMPT)
+
+            def _drop_preempt(fresh):
+                if ANNOTATION_PREEMPT not in fresh.metadata.annotations:
+                    return False
+                fresh.metadata.annotations.pop(ANNOTATION_PREEMPT, None)
+
+            cleared = self.store.update_with_retry(
+                KIND_TPUJOB, job.metadata.namespace, job.metadata.name,
+                _drop_preempt,
+            )
+            if cleared is not None:
+                # Two-phase handoff: the victim KEEPS its quota while the
+                # gang drains (the chips are still physically occupied);
+                # _create_processes releases it once the gang is observed
+                # gone, so victim and preemptor never hold the same
+                # headroom at once — not even for one store snapshot.
+                with self._sched_lock:
+                    self.fleet.begin_preempt(key)
+                live = [
+                    p
+                    for r in gang
+                    if (p := observed.get((r[0].value, r[1]))) is not None
+                    and not p.is_finished()
+                ]
+                if live:
+                    self.recorder.warning(
+                        job, ev.REASON_JOB_PREEMPTED,
+                        f"preempted by higher-priority job {preemptor}; gang "
+                        "restarting (checkpoint-resumed, not counted against "
+                        "backoff)",
+                    )
+                    self._restart_gang(
+                        job, gang, observed, exp_key, cause=CAUSE_PREEMPTION
+                    )
+                    return
 
         # -- failure handling --------------------------------------------
         # Hosts under a preemption notice: live members there take the
@@ -1109,14 +1222,32 @@ class TPUJobController:
         # Gang-atomic host placement (multi-host mode): bind every process
         # to a Ready host BEFORE any create — a partially-placed gang must
         # never exist (SURVEY.md §7 hard part b). The scheduler lock spans
-        # placement through creation so concurrent workers cannot promise
-        # the same free chips to two jobs (uncontended-lock cost in
-        # single-host mode is negligible).
+        # admission through creation so concurrent workers cannot promise
+        # the same free chips — or the same quota headroom — to two jobs
+        # (uncontended-lock cost in single-host mode is negligible).
+        # Preemption handoff, second half: the victim's quota releases
+        # only once its drained gang is observably gone from the store —
+        # the release kicks the preemptor's admission, so the preemptor's
+        # gang is created strictly after the victim's chips freed.
+        if self.fleet.draining(job.key()):
+            still_live = any(
+                (p := (observed or {}).get((r[0].value, r[1]))) is not None
+                and not p.is_finished()
+                for r in gang
+            )
+            if not still_live:
+                self._release_job(job.key())
+
         placement: Dict[str, Any] = {}
+        blocked: Optional[fleetsched.Decision] = None
+        sched_reason = ""
         with self._sched_lock:
             managed = self.scheduler.managed()
             t_place = time.time()
-            if managed:
+            decision = self.fleet.admit(job)
+            if decision.action != fleetsched.ADMIT:
+                blocked = decision
+            elif managed:
                 # Rank-keyed placement: a member's host slot is its gang
                 # rank mod num_hosts, and slots already holding LIVE bound
                 # members stay pinned to those hosts — a partial recreate
@@ -1135,138 +1266,259 @@ class TPUJobController:
                     placement = self.scheduler.place_gang(
                         job, procs, ranks=ranks, bound_slots=bound_slots,
                         ttl=self._job_heartbeat_ttl(job),
+                        reserved=self.fleet.reserved_for_others(job),
                     )
                 except SchedulingError as exc:
                     self.recorder.warning(
                         job, ev.REASON_FAILED_SCHEDULING, str(exc)
                     )
-                    # Trace: open ONE scheduling-wait span on the first
-                    # failed placement; it stays open (visible in the
-                    # timeline as "the job is waiting for capacity")
-                    # until a later placement succeeds.
-                    uid = job.metadata.uid
-                    if uid not in self._open_schedwait:
-                        name = self._span_name(job, "scheduling-wait")
-                        self.tracer.record(
-                            job.metadata.namespace, job.metadata.name, uid,
-                            "scheduling-wait", t_place, 0.0,
-                            attrs={"reason": str(exc)[:200]},
-                            name=name, component=COMPONENT_SCHEDULER,
-                        )
-                        self._open_schedwait[uid] = {
-                            "ns": job.metadata.namespace, "name": name,
-                        }
-                    raise  # rate-limited requeue retries the gang later
-                for p in procs:
-                    p.spec.node_name = placement[p.metadata.name].metadata.name
-                # Trace: the placement decision itself (scheduler span).
-                self.tracer.record(
-                    job.metadata.namespace, job.metadata.name,
-                    job.metadata.uid, "placement", t_place, time.time(),
-                    attrs={
-                        "hosts": ",".join(sorted(
-                            {h.metadata.name for h in placement.values()}
-                        )),
-                        "processes": str(len(procs)),
-                        "track": "placement",
-                    },
-                    component=COMPONENT_SCHEDULER,
-                )
-            self._mark_scheduled(job, time.time())
-
-            # Chief host: prefer the existing rendezvous Endpoint (the chief
-            # may already be running and we are only recreating lost
-            # members); then the chief's bound host; then the resolver. An
-            # endpoint owned by a DEAD incarnation (delete → same-name
-            # recreate race) is garbage, not truth: collect it instead.
-            chief_host: Optional[str] = None
-            try:
-                ep = self.store.get(
-                    KIND_ENDPOINT, job.metadata.namespace,
-                    f"{job.metadata.name}-rendezvous",
-                )
-                if ep.metadata.owner_uid not in (None, job.metadata.uid):
-                    try:
-                        self.store.delete(
-                            KIND_ENDPOINT, ep.metadata.namespace, ep.metadata.name
-                        )
-                    except NotFoundError:
-                        pass
-                    raise NotFoundError(ep.metadata.key())
-                chief_host = ep.address.host
-            except NotFoundError:
-                if chief_name in placement:
-                    chief_host = placement[chief_name].spec.address
+                    # No atomic placement: park in the admission queue
+                    # (QUEUED condition) instead of raising into the
+                    # workqueue rate limiter — the old hot loop of
+                    # SchedulingError retries. The fleet scheduler may
+                    # answer with victims to drain (preempt-by-priority)
+                    # or a host reservation that keeps backfillers from
+                    # starving this gang; either way a release or the
+                    # periodic resync retries the placement.
+                    blocked = self.fleet.on_unplaceable(job)
+                    sched_reason = str(exc)
                 else:
                     for p in procs:
-                        if p.metadata.name == chief_name:
-                            chief_host = self.host_resolver(p)
-                            break
-            if chief_host is None and managed:
-                # Partial recreate with no Endpoint and a chief that already
-                # exists elsewhere: resolve through the chief's node binding
-                # — defaulting to loopback here would point the recreated
-                # members' coordinator address at themselves.
-                try:
-                    cp = self.store.get(
-                        KIND_PROCESS, job.metadata.namespace, chief_name
+                        p.spec.node_name = placement[p.metadata.name].metadata.name
+                    # Trace: the placement decision itself (scheduler span).
+                    self.tracer.record(
+                        job.metadata.namespace, job.metadata.name,
+                        job.metadata.uid, "placement", t_place, time.time(),
+                        attrs={
+                            "hosts": ",".join(sorted(
+                                {h.metadata.name for h in placement.values()}
+                            )),
+                            "processes": str(len(procs)),
+                            "track": "placement",
+                        },
+                        component=COMPONENT_SCHEDULER,
                     )
-                    if cp.spec.node_name:
-                        chief_host = self.store.get(
-                            KIND_HOST, "default", cp.spec.node_name
-                        ).spec.address
+            if blocked is None:
+                # Quota commits only AFTER placement succeeded, so a
+                # placement failure never leaks quota.
+                self.fleet.commit(job)
+                now = time.time()
+                self._mark_admitted(job, now)
+                self._mark_scheduled(job, now)
+                self._bind_and_create(
+                    job, procs, placement, managed, port, chief_name,
+                    exp_key, resume_step,
+                )
+        if blocked is not None:
+            # Handled OUTSIDE the lock: _finish and _queue_job re-enter
+            # paths (_release_job) that take the same non-reentrant lock.
+            if blocked.action == fleetsched.FAIL:
+                self._fail_job(job, "TPUJobQuotaUnsatisfiable", blocked.reason)
+                self._finish(job)
+                return
+            if blocked.victims:
+                self._request_preemptions(job, blocked.victims)
+            self._queue_job(job, sched_reason or blocked.reason)
+
+    def _bind_and_create(
+        self,
+        job: TPUJob,
+        procs: List[Process],
+        placement: Dict[str, Any],
+        managed: bool,
+        port: int,
+        chief_name: str,
+        exp_key: str,
+        resume_step: int,
+    ) -> None:
+        """Resolve the chief address, stamp rendezvous env, and create the
+        gang. Called with _sched_lock held — creation must complete before
+        another worker reads chip usage, or two gangs get the same chips."""
+        # Chief host: prefer the existing rendezvous Endpoint (the chief
+        # may already be running and we are only recreating lost
+        # members); then the chief's bound host; then the resolver. An
+        # endpoint owned by a DEAD incarnation (delete → same-name
+        # recreate race) is garbage, not truth: collect it instead.
+        chief_host: Optional[str] = None
+        try:
+            ep = self.store.get(
+                KIND_ENDPOINT, job.metadata.namespace,
+                f"{job.metadata.name}-rendezvous",
+            )
+            if ep.metadata.owner_uid not in (None, job.metadata.uid):
+                try:
+                    self.store.delete(
+                        KIND_ENDPOINT, ep.metadata.namespace, ep.metadata.name
+                    )
                 except NotFoundError:
                     pass
-            if chief_host is None:
-                chief_host = "127.0.0.1"
-            for p in procs:
-                p.spec.env[ENV_COORDINATOR_ADDRESS] = f"{chief_host}:{port}"
-                if self.api_url:
-                    p.spec.env.setdefault(ENV_API_SERVER, self.api_url)
-
-            self.expectations.expect_creations(exp_key, len(procs))
-            created = 0
-            t_create = time.time()
+                raise NotFoundError(ep.metadata.key())
+            chief_host = ep.address.host
+        except NotFoundError:
+            if chief_name in placement:
+                chief_host = placement[chief_name].spec.address
+            else:
+                for p in procs:
+                    if p.metadata.name == chief_name:
+                        chief_host = self.host_resolver(p)
+                        break
+        if chief_host is None and managed:
+            # Partial recreate with no Endpoint and a chief that already
+            # exists elsewhere: resolve through the chief's node binding
+            # — defaulting to loopback here would point the recreated
+            # members' coordinator address at themselves.
             try:
-                for proc in procs:
-                    try:
-                        if proc.spec.node_name:
-                            # Bound: create the object only — the host's
-                            # agent launches it (controller/kubelet split).
-                            self.store.create(proc)
-                        else:
-                            self.process_control.create_process(proc)
-                    except AlreadyExistsError:
-                        self.expectations.creation_failed(exp_key)
-                    else:
-                        created += 1
-                        self.metrics.inc("tpujob_processes_created_total")
-                        self.recorder.normal(
-                            job, ev.REASON_SUCCESSFUL_CREATE,
-                            f"created process {proc.metadata.name}"
-                            + (f" on {proc.spec.node_name}" if proc.spec.node_name else ""),
-                        )
-                    if proc.metadata.name == chief_name:
-                        self._ensure_endpoint(job, chief_name, chief_host, port)
-            except Exception as exc:
-                # Roll back unobserved expectations so the job isn't stuck
-                # waiting for creations that will never happen.
-                for _ in range(len(procs) - created):
-                    self.expectations.creation_failed(exp_key)
-                self.recorder.warning(job, ev.REASON_FAILED_CREATE, str(exc))
-                raise
-            if created:
-                # Trace: one gang-create span per create batch (restarts
-                # produce one each; the warm-restart step is an attr).
-                self.tracer.record(
-                    job.metadata.namespace, job.metadata.name,
-                    job.metadata.uid, "gang-create", t_create, time.time(),
-                    attrs={
-                        "processes": str(created),
-                        "resume_step": str(resume_step),
-                        "track": "gang-create",
-                    },
+                cp = self.store.get(
+                    KIND_PROCESS, job.metadata.namespace, chief_name
                 )
+                if cp.spec.node_name:
+                    chief_host = self.store.get(
+                        KIND_HOST, "default", cp.spec.node_name
+                    ).spec.address
+            except NotFoundError:
+                pass
+        if chief_host is None:
+            chief_host = "127.0.0.1"
+        for p in procs:
+            p.spec.env[ENV_COORDINATOR_ADDRESS] = f"{chief_host}:{port}"
+            if self.api_url:
+                p.spec.env.setdefault(ENV_API_SERVER, self.api_url)
+
+        self.expectations.expect_creations(exp_key, len(procs))
+        created = 0
+        t_create = time.time()
+        try:
+            for proc in procs:
+                try:
+                    if proc.spec.node_name:
+                        # Bound: create the object only — the host's
+                        # agent launches it (controller/kubelet split).
+                        self.store.create(proc)
+                    else:
+                        self.process_control.create_process(proc)
+                except AlreadyExistsError:
+                    self.expectations.creation_failed(exp_key)
+                else:
+                    created += 1
+                    self.metrics.inc("tpujob_processes_created_total")
+                    self.recorder.normal(
+                        job, ev.REASON_SUCCESSFUL_CREATE,
+                        f"created process {proc.metadata.name}"
+                        + (f" on {proc.spec.node_name}" if proc.spec.node_name else ""),
+                    )
+                if proc.metadata.name == chief_name:
+                    self._ensure_endpoint(job, chief_name, chief_host, port)
+        except Exception as exc:
+            # Roll back unobserved expectations so the job isn't stuck
+            # waiting for creations that will never happen.
+            for _ in range(len(procs) - created):
+                self.expectations.creation_failed(exp_key)
+            self.recorder.warning(job, ev.REASON_FAILED_CREATE, str(exc))
+            raise
+        if created:
+            # Trace: one gang-create span per create batch (restarts
+            # produce one each; the warm-restart step is an attr).
+            self.tracer.record(
+                job.metadata.namespace, job.metadata.name,
+                job.metadata.uid, "gang-create", t_create, time.time(),
+                attrs={
+                    "processes": str(created),
+                    "resume_step": str(resume_step),
+                    "track": "gang-create",
+                },
+            )
+
+    # ---- fleet-scheduler actions ----------------------------------------
+
+    def _queue_job(self, job: TPUJob, reason: str) -> None:
+        """Park the job in the QUEUED condition and open the ``queued``
+        trace span (admission-queue entry → admitted). Repeats update the
+        condition message in place — no event/span churn while waiting."""
+        first = not has_condition(job.status, ConditionType.QUEUED)
+        message = reason or "waiting in fleet-scheduler admission queue"
+        set_condition(
+            job.status,
+            new_condition(ConditionType.QUEUED, ev.REASON_JOB_QUEUED, message),
+        )
+        if first:
+            self.recorder.normal(job, ev.REASON_JOB_QUEUED, message)
+            uid = job.metadata.uid
+            if uid not in self._open_queued:
+                sched = job.spec.scheduling
+                queue = sched.queue or "default"
+                priority = sched.priority_class or "none"
+                # One span per queue visit: a preempted job that re-queues
+                # gets a fresh span (the counters moved), not a dedupe hit.
+                n = job.status.restart_count + job.status.preemption_count
+                name = self._span_name(job, f"queued-{n}")
+                start = time.time()
+                if self.tracer.record(
+                    job.metadata.namespace, job.metadata.name, uid,
+                    "queued", start, 0.0,
+                    attrs={
+                        "reason": message[:200], "queue": queue,
+                        "priority": priority, "track": "queued",
+                    },
+                    name=name, component=COMPONENT_SCHEDULER,
+                ) is not None:
+                    self._open_queued[uid] = {
+                        "ns": job.metadata.namespace, "name": name,
+                        "start": start, "queue": queue, "priority": priority,
+                    }
+        self._write_status(job)
+
+    def _mark_admitted(self, job: TPUJob, now: float) -> None:
+        """The fleet scheduler admitted the job: close the open ``queued``
+        span — its width is the admission-queue wait, observed into the
+        per-queue/per-priority histogram — and drop the QUEUED condition."""
+        uid = job.metadata.uid
+        info = self._open_queued.pop(uid, None)
+        if info is not None:
+            self.tracer.close(info["ns"], info["name"], now)
+            self.metrics.observe_hist(
+                "tpujob_queue_wait_seconds",
+                max(0.0, now - info["start"]),
+                labels={"queue": info["queue"], "priority": info["priority"]},
+            )
+        clear_condition(job.status, ConditionType.QUEUED)
+
+    def _request_preemptions(self, job: TPUJob, victims: List[str]) -> None:
+        """Stamp the preempt annotation on each victim; the victim's own
+        sync drains its gang gracefully (cause ``preemption``) and releases
+        its quota. Idempotent: a victim already under a notice — or already
+        finished — is skipped."""
+        stamped = []
+        for vkey in victims:
+            ns, _, name = vkey.partition("/")
+
+            def _stamp(fresh):
+                if is_finished(fresh.status):
+                    return False
+                if fresh.metadata.annotations.get(ANNOTATION_PREEMPT):
+                    return False  # already being drained
+                fresh.metadata.annotations[ANNOTATION_PREEMPT] = job.key()
+
+            if self.store.update_with_retry(KIND_TPUJOB, ns, name, _stamp) is not None:
+                stamped.append(vkey)
+                self.metrics.inc("tpujob_preemptions_requested_total")
+                self._enqueue(vkey)
+        if stamped:
+            self.recorder.normal(
+                job, ev.REASON_JOB_PREEMPTING,
+                f"requested preemption of {len(stamped)} lower-priority "
+                f"job(s): {', '.join(sorted(stamped))}",
+            )
+
+    def _release_job(self, key: str) -> None:
+        """Release a finished/deleted/preempted job's quota and re-kick the
+        admission-queue heads. ONE lock hold for both steps — _sched_lock
+        is non-reentrant, so release() and next_queued() must not be split
+        across nested acquisitions."""
+        with self._sched_lock:
+            released = self.fleet.release(key)
+            keys = self.fleet.next_queued() if released else []
+        for k in keys:
+            self._enqueue(k)
 
     def _ensure_endpoint(self, job: TPUJob, target: str, host: str, port: int) -> None:
         name = f"{job.metadata.name}-rendezvous"
@@ -1457,12 +1709,17 @@ class TPUJobController:
             wait = self._open_schedwait.pop(uid, None)
             if wait is not None:
                 self.tracer.close(wait["ns"], wait["name"], end)
+            queued = self._open_queued.pop(uid, None)
+            if queued is not None:
+                self.tracer.close(queued["ns"], queued["name"], end)
             self._observe_first_step(job)
             self._sched_observed.discard(uid)
             self._ttfs_observed.discard(uid)
         self._delete_children(
             job.metadata.namespace, job.metadata.name, job.spec.run_policy.cleanup_policy
         )
+        # Quota back to the pool; queued heads get re-kicked.
+        self._release_job(job.key())
 
     def _write_status(self, job: TPUJob) -> None:
         """Persist job.status (status-subresource analogue,
@@ -1565,7 +1822,15 @@ def _restart_cause(gang_failed: List[Process]) -> str:
 
 
 def _annotations_except_port(annotations: Dict[str, str]) -> Dict[str, str]:
-    return {k: v for k, v in annotations.items() if k != ANNOTATION_PORT}
+    # The preempt annotation is managed store-side exactly like the port
+    # (_request_preemptions stamps it, the victim's drain clears it);
+    # merging it back from a stale snapshot would re-preempt the victim
+    # on every status write.
+    return {
+        k: v
+        for k, v in annotations.items()
+        if k not in (ANNOTATION_PORT, ANNOTATION_PREEMPT)
+    }
 
 
 def _status_equal_ignoring_heartbeat(a, b) -> bool:

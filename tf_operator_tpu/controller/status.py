@@ -26,8 +26,11 @@ from tf_operator_tpu.api.types import (
 from tf_operator_tpu.runtime.objects import Process, ProcessPhase
 
 _EXCLUSIVE = {
-    ConditionType.RUNNING: {ConditionType.RESTARTING},
+    # Queued is a "currently" condition too: a job admitted to run is no
+    # longer waiting in the fleet-scheduler queue, and vice versa.
+    ConditionType.RUNNING: {ConditionType.RESTARTING, ConditionType.QUEUED},
     ConditionType.RESTARTING: {ConditionType.RUNNING},
+    ConditionType.QUEUED: {ConditionType.RUNNING},
 }
 
 
@@ -79,6 +82,15 @@ def set_condition(status: TPUJobStatus, cond: Condition) -> None:
         )
         status.conditions = [c for c in status.conditions if c.type is not cond.type]
     status.conditions.append(cond)
+
+
+def clear_condition(status: TPUJobStatus, ctype: ConditionType) -> bool:
+    """Drop all conditions of ``ctype`` (filterOutCondition analogue);
+    phase() falls back to the latest remaining True condition. Returns
+    True when something was removed."""
+    before = len(status.conditions)
+    status.conditions = [c for c in status.conditions if c.type is not ctype]
+    return len(status.conditions) != before
 
 
 def initialize_replica_statuses(status: TPUJobStatus, rtypes) -> None:

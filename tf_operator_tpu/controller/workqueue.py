@@ -60,6 +60,22 @@ class TokenBucket:
             return -self._tokens / self.qps
 
 
+class ShardedQueueView:
+    """Read-only depth aggregation over partitioned worker queues (the
+    reconciler's namespace shards). Metrics only ever call ``depth()``,
+    so the workqueue-depth gauge keeps meaning "keys waiting anywhere"
+    after the queue splits into shards."""
+
+    def __init__(self, shards) -> None:
+        self._shards = list(shards)
+
+    def depth(self) -> int:
+        return sum(q.depth() for q in self._shards)
+
+    def __len__(self) -> int:
+        return self.depth()
+
+
 class RateLimitingQueue:
     """Deduplicating queue with delayed adds and combined rate limiting.
 

@@ -84,6 +84,22 @@ class TPUJobClient:
     def delete(self, namespace: str, name: str) -> None:
         self._request("DELETE", f"/api/tpujob/{namespace}/{name}")
 
+    # -- generic objects (the /api/v1 machine seam) ------------------------
+
+    def create_object(self, obj) -> Dict[str, Any]:
+        """Create any serializable object (Queue, PriorityClass, Host, ...)
+        through the generic kind API; the server runs per-kind validation."""
+        from tf_operator_tpu.runtime.serialize import to_doc
+
+        return self._request("POST", f"/api/v1/{obj.kind}", to_doc(obj))
+
+    def list_objects(self, kind: str, namespace: Optional[str] = None) -> List[Any]:
+        from tf_operator_tpu.runtime.serialize import from_doc
+
+        q = f"?namespace={namespace}" if namespace else ""
+        items = self._request("GET", f"/api/v1/{kind}{q}")["items"]
+        return [from_doc(kind, d) for d in items]
+
     def trace(self, namespace: str, name: str) -> Dict[str, Any]:
         """The job's lifecycle trace as Chrome trace-event JSON
         (Perfetto-loadable: traceEvents + derived timings in otherData)."""

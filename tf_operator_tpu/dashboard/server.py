@@ -50,12 +50,15 @@ from urllib.parse import parse_qs, unquote, urlparse
 from tf_operator_tpu.api.types import (
     KIND_ENDPOINT,
     KIND_EVENT,
+    KIND_PRIORITY_CLASS,
     KIND_PROCESS,
+    KIND_QUEUE,
     KIND_TPUJOB,
     LABEL_JOB_NAME,
     TPUJob,
 )
 from tf_operator_tpu.api import set_defaults, validate_job, ValidationError
+from tf_operator_tpu.api.validation import validate_priority_class, validate_queue
 from tf_operator_tpu.api.types import _to_jsonable
 from tf_operator_tpu.runtime.process_backend import LocalProcessControl
 from tf_operator_tpu.runtime.serialize import KNOWN_KINDS, from_doc, to_doc
@@ -431,6 +434,10 @@ class _Handler(BaseHTTPRequestHandler):
                     # same defaulting + admission as the /api/tpujob route.
                     set_defaults(obj)
                     validate_job(obj)
+                elif kind == KIND_QUEUE:
+                    validate_queue(obj)
+                elif kind == KIND_PRIORITY_CLASS:
+                    validate_priority_class(obj)
             except (ValueError, ValidationError, KeyError, TypeError) as exc:
                 return self._error(400, f"invalid {kind}: {exc}")
             try:

@@ -143,6 +143,10 @@ class HostSpec:
     slice_type: str = ""  # e.g. "v5p-32"; "" accepts any job
     total_chips: int = 0
     max_processes: int = 0  # 0 = unlimited
+    # ICI-domain label (e.g. the pod/superpod this host's chips share an
+    # interconnect with): gangs pack onto the fewest domains. "" means the
+    # host is its own domain (single-host rack, DCN-only fleet).
+    topology_domain: str = ""
 
 
 @dataclass

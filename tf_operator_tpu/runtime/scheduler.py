@@ -39,6 +39,22 @@ def _family(slice_type: str) -> str:
     return slice_type.split("-")[0] if slice_type else ""
 
 
+def _liveness_anchor(h: Host) -> float:
+    """Last proof the host's agent was alive: the heartbeat, else the
+    registration (creation) time. A host that registered but NEVER
+    heartbeated must still age out — anchored only on heartbeat_time
+    (which stays 0.0) it would be Ready forever and never declared lost
+    (the stillborn-agent bug: a provisioner-written Host whose agent
+    died before its first beat)."""
+    return h.status.heartbeat_time or h.metadata.creation_timestamp
+
+
+def _domain(host: Host) -> str:
+    """ICI-domain key: hosts sharing it share an interconnect; a host
+    without one is its own domain."""
+    return host.spec.topology_domain or host.metadata.name
+
+
 @dataclass
 class _HostState:
     host: Host
@@ -70,7 +86,8 @@ class GangScheduler:
         for h in self.store.list(KIND_HOST):
             if h.status.phase is not HostPhase.READY:
                 continue
-            if h.status.heartbeat_time and (now - h.status.heartbeat_time > ttl):
+            anchor = _liveness_anchor(h)
+            if anchor and (now - anchor > ttl):
                 continue
             out.append(h)
         return out
@@ -78,13 +95,14 @@ class GangScheduler:
     def lost_hosts(
         self, now: Optional[float] = None, ttl: Optional[float] = None
     ) -> List[Host]:
-        """Hosts whose agent stopped heartbeating (NodeLost)."""
+        """Hosts whose agent stopped heartbeating — or never started
+        (stillborn registration ages out against its creation time)."""
         now = time.time() if now is None else now
         ttl = self.heartbeat_ttl if ttl is None else ttl
         return [
             h
             for h in self.store.list(KIND_HOST)
-            if h.status.heartbeat_time and now - h.status.heartbeat_time > ttl
+            if (anchor := _liveness_anchor(h)) and now - anchor > ttl
         ]
 
     def draining_hosts(
@@ -101,7 +119,7 @@ class GangScheduler:
             for h in self.store.list(KIND_HOST)
             if h.status.phase is HostPhase.DRAINING
             and not (
-                h.status.heartbeat_time and now - h.status.heartbeat_time > ttl
+                (anchor := _liveness_anchor(h)) and now - anchor > ttl
             )
         ]
 
@@ -112,14 +130,21 @@ class GangScheduler:
         ttl: Optional[float] = None,
     ) -> List[_HostState]:
         fam = _family(job_slice)
-        # Chips already promised to live processes, by node.
-        used: Dict[str, int] = {}
-        count: Dict[str, int] = {}
-        for p in self.store.list(KIND_PROCESS):
-            node = p.spec.node_name
-            if node and not p.is_finished():
-                used[node] = used.get(node, 0) + max(p.spec.chips, 0)
-                count[node] = count.get(node, 0) + 1
+        # Chips already promised to live processes, by node. The store's
+        # incrementally-maintained node-usage index makes this O(hosts);
+        # a store without one (RemoteStore) falls back to the scan.
+        usage_fn = getattr(self.store, "node_usage", None)
+        if usage_fn is not None:
+            usage = usage_fn()
+            used = {n: u[0] for n, u in usage.items()}
+            count = {n: u[1] for n, u in usage.items()}
+        else:
+            used, count = {}, {}
+            for p in self.store.list(KIND_PROCESS):
+                node = p.spec.node_name
+                if node and not p.is_finished():
+                    used[node] = used.get(node, 0) + max(p.spec.chips, 0)
+                    count[node] = count.get(node, 0) + 1
         states = []
         for h in self.ready_hosts(now, ttl):
             if fam and h.spec.slice_type and _family(h.spec.slice_type) != fam:
@@ -128,9 +153,19 @@ class GangScheduler:
             if h.spec.max_processes and count.get(h.metadata.name, 0) >= h.spec.max_processes:
                 continue
             states.append(_HostState(h, free, count.get(h.metadata.name, 0)))
-        # Stable order: most free chips first, then name (deterministic).
-        states.sort(key=lambda s: (-s.free_chips, s.host.metadata.name))
+        # Stable base order; packing (place_gang) decides preference.
+        states.sort(key=lambda s: s.host.metadata.name)
         return states
+
+    def host_states(
+        self,
+        job_slice: str = "",
+        now: Optional[float] = None,
+        ttl: Optional[float] = None,
+    ) -> List[_HostState]:
+        """Public capacity snapshot (fleet-scheduler reservations use it
+        to pick which hosts to hold for a queued gang)."""
+        return self._states(job_slice, now, ttl)
 
     # -- placement --------------------------------------------------------
 
@@ -142,6 +177,7 @@ class GangScheduler:
         ranks: Optional[Dict[str, int]] = None,
         bound_slots: Optional[Dict[int, str]] = None,
         ttl: Optional[float] = None,
+        reserved: Optional[Dict[str, int]] = None,
     ) -> Dict[str, Host]:
         """Atomically choose a Host for every process in ``procs``.
 
@@ -153,17 +189,30 @@ class GangScheduler:
         position it had. ``ranks`` maps process name → gang rank (members
         missing from it — evaluators — pack anywhere with capacity);
         ``bound_slots`` maps slot → host name for LIVE members of the gang,
-        pinning those slots to their existing hosts. Raises SchedulingError
-        when the gang cannot be fully placed — the caller must create
-        nothing in that case.
+        pinning those slots to their existing hosts. ``reserved`` maps
+        host name → chips held for higher-precedence queued gangs (the
+        fleet scheduler's anti-starvation reservations): those chips are
+        invisible to this placement, except on hosts already pinned by
+        live members. Raises SchedulingError when the gang cannot be
+        fully placed — the caller must create nothing in that case.
+
+        Packing policy (replaces the original most-free-first spread):
+        open slots go to the fewest ICI domains — domains already holding
+        pinned members first, then the tightest single domain that fits
+        the whole remainder (best-fit at domain granularity), then
+        greedily by descending fit count; within a domain hosts are
+        best-fit (least free chips that still fit). Every tie breaks on
+        name, so placement is deterministic under equal scores. Best-fit
+        leaves the emptiest hosts intact for large gangs; small jobs land
+        in fragmentation holes instead of carving up fresh hosts.
         """
         want_hosts = max(1, job.spec.topology.num_hosts)
         states = self._states(job.spec.topology.slice_type, now, ttl)
         by_name = {s.host.metadata.name: s for s in states}
 
-        # Slot → host assignment. Slots pinned by live members keep their
-        # host (it must still be schedulable); remaining slots take the
-        # most-free Ready hosts not already holding a slot.
+        # Slots pinned by live members keep their host (it must still be
+        # schedulable) — reservations never apply to them, the members
+        # are already physically there.
         slot_host: Dict[int, _HostState] = {}
         for slot, host_name in (bound_slots or {}).items():
             s = by_name.get(host_name)
@@ -174,16 +223,51 @@ class GangScheduler:
                 )
             slot_host[slot % want_hosts] = s
         taken = {s.host.metadata.name for s in slot_host.values()}
-        spare = [s for s in states if s.host.metadata.name not in taken]
-        for slot in range(want_hosts):
-            if slot not in slot_host:
-                if not spare:
-                    raise SchedulingError(
-                        f"need {want_hosts} ready host(s) for slice "
-                        f"{job.spec.topology.slice_type or '(any)'}, have "
-                        f"{len(states)}"
-                    )
-                slot_host[slot] = spare.pop(0)
+        if reserved:
+            for s in states:
+                name = s.host.metadata.name
+                if name not in taken:
+                    s.free_chips -= reserved.get(name, 0)
+
+        # Per-slot chip demand: ranked members map to slot = rank %
+        # want_hosts; a candidate host must fit the heaviest open slot.
+        slot_need = [0] * want_hosts
+        for proc in procs:
+            rank = (ranks or {}).get(proc.metadata.name)
+            if rank is not None:
+                slot_need[rank % want_hosts] += max(proc.spec.chips, 0)
+
+        open_slots = [s for s in range(want_hosts) if s not in slot_host]
+        if open_slots:
+            candidates = [s for s in states if s.host.metadata.name not in taken]
+            if len(candidates) < len(open_slots):
+                raise SchedulingError(
+                    f"need {want_hosts} ready host(s) with capacity for "
+                    f"slice {job.spec.topology.slice_type or '(any)'}, have "
+                    f"{len(states)}"
+                )
+            chosen = _pack_hosts(
+                candidates,
+                k=len(open_slots),
+                need=max(slot_need[s] for s in open_slots),
+                pinned_domains={_domain(st.host) for st in slot_host.values()},
+            )
+            if chosen is not None:
+                for slot, state in zip(open_slots, chosen):
+                    slot_host[slot] = state
+            else:
+                # No host set fits every open slot's full demand. Fall back
+                # to the legacy spread — most-free-first, heaviest slot
+                # paired with the freest host — so the per-member capacity
+                # check below reports the precise shortfall ("lacks
+                # capacity") and heterogeneous slot demands still place.
+                by_free = sorted(
+                    candidates,
+                    key=lambda s: (-s.free_chips, s.host.metadata.name),
+                )[: len(open_slots)]
+                heaviest = sorted(open_slots, key=lambda s: (-slot_need[s], s))
+                for slot, state in zip(heaviest, by_free):
+                    slot_host[slot] = state
 
         placement: Dict[str, Host] = {}
         free = {s.host.metadata.name: s.free_chips for s in states}
@@ -221,3 +305,46 @@ class GangScheduler:
             counts[state.host.metadata.name] += 1
             placement[proc.metadata.name] = state.host
         return placement
+
+
+def _pack_hosts(
+    candidates: List[_HostState],
+    k: int,
+    need: int,
+    pinned_domains: set,
+) -> Optional[List[_HostState]]:
+    """Choose ``k`` hosts (each with ``need`` free chips) packed onto the
+    fewest ICI domains. Domain order: pinned first, then whole domains
+    (>= k fitting hosts) tightest-total-free first, then partial domains
+    by descending fit count; hosts within a domain are best-fit. All ties
+    break on name. None when fewer than ``k`` hosts fit."""
+    fit = [s for s in candidates if s.free_chips >= need]
+    if len(fit) < k:
+        return None
+    by_domain: Dict[str, List[_HostState]] = {}
+    for s in fit:
+        by_domain.setdefault(_domain(s.host), []).append(s)
+    for hosts in by_domain.values():
+        hosts.sort(key=lambda s: (s.free_chips, s.host.metadata.name))
+
+    def domain_rank(item):
+        name, hosts = item
+        whole = len(hosts) >= k
+        total_free = sum(s.free_chips for s in hosts)
+        return (
+            0 if name in pinned_domains else 1,
+            0 if whole else 1,
+            # Whole domains best-fit (tightest holds the gang); partial
+            # domains largest-first (fewest domains span the remainder).
+            total_free if whole else -len(hosts),
+            total_free,
+            name,
+        )
+
+    chosen: List[_HostState] = []
+    for _, hosts in sorted(by_domain.items(), key=domain_rank):
+        for s in hosts:
+            if len(chosen) == k:
+                return chosen
+            chosen.append(s)
+    return chosen if len(chosen) == k else None

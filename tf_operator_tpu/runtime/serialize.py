@@ -17,7 +17,9 @@ from tf_operator_tpu.api.types import (
     KIND_EVENT,
     KIND_HOST,
     KIND_LEASE,
+    KIND_PRIORITY_CLASS,
     KIND_PROCESS,
+    KIND_QUEUE,
     KIND_SPAN,
     KIND_TPUJOB,
     ObjectMeta,
@@ -25,6 +27,7 @@ from tf_operator_tpu.api.types import (
     _to_jsonable,
 )
 from tf_operator_tpu.obs.spans import Span
+from tf_operator_tpu.sched.objects import PriorityClass, Queue, QueueSpec
 from tf_operator_tpu.runtime.objects import (
     Endpoint,
     EndpointAddress,
@@ -95,6 +98,15 @@ def _span_from_doc(doc: Dict[str, Any]) -> Span:
     return Span(metadata=_meta(doc), **d)
 
 
+def _priority_class_from_doc(doc: Dict[str, Any]) -> PriorityClass:
+    d = {k: v for k, v in doc.items() if k not in ("metadata", "kind")}
+    return PriorityClass(metadata=_meta(doc), **d)
+
+
+def _queue_from_doc(doc: Dict[str, Any]) -> Queue:
+    return Queue(metadata=_meta(doc), spec=QueueSpec(**doc.get("spec", {})))
+
+
 _DECODERS = {
     KIND_PROCESS: _process_from_doc,
     KIND_HOST: _host_from_doc,
@@ -102,6 +114,8 @@ _DECODERS = {
     KIND_EVENT: _event_from_doc,
     KIND_LEASE: _lease_from_doc,
     KIND_SPAN: _span_from_doc,
+    KIND_PRIORITY_CLASS: _priority_class_from_doc,
+    KIND_QUEUE: _queue_from_doc,
     KIND_TPUJOB: lambda doc: TPUJob.from_dict(doc),
 }
 

@@ -163,6 +163,12 @@ class Store:
         self._by_kind_ns: Dict[Tuple[str, str], Dict[Tuple[str, str, str], Any]] = {}
         # (kind, label_key, label_value) -> {key: obj}
         self._by_label: Dict[Tuple[str, str, str], Dict[Tuple[str, str, str], Any]] = {}
+        # node name -> [live chips, live process count]: the placement
+        # capacity index. Maintained incrementally on every Process
+        # mutation so GangScheduler._states is O(hosts), not O(all live
+        # processes in the fleet). Duck-typed on kind/spec/status shape —
+        # runtime sits below api in the layering, same as INDEXED_LABELS.
+        self._node_usage: Dict[str, List[int]] = {}
         # list-cost telemetry: candidates visited vs objects returned.
         self._list_calls = 0
         self._list_scanned = 0
@@ -207,13 +213,48 @@ class Store:
             if lk in labels
         ]
 
+    @staticmethod
+    def _usage_entry(obj: Any) -> Optional[Tuple[str, int]]:
+        """(node, chips) for a Process that currently occupies capacity on
+        a host: bound (spec.node_name set) and not terminal."""
+        if obj.kind != "Process":
+            return None
+        node = obj.spec.node_name
+        if not node or obj.status.phase.value in ("Succeeded", "Failed"):
+            return None
+        return node, max(obj.spec.chips, 0)
+
+    def _usage_add(self, obj: Any) -> None:
+        e = self._usage_entry(obj)
+        if e is not None:
+            u = self._node_usage.setdefault(e[0], [0, 0])
+            u[0] += e[1]
+            u[1] += 1
+
+    def _usage_remove(self, obj: Any) -> None:
+        e = self._usage_entry(obj)
+        if e is not None:
+            u = self._node_usage.get(e[0])
+            if u is not None:
+                u[0] -= e[1]
+                u[1] -= 1
+                if u[1] <= 0 and u[0] <= 0:
+                    del self._node_usage[e[0]]
+
+    def node_usage(self) -> Dict[str, Tuple[int, int]]:
+        """Snapshot of node -> (live chips, live process count). O(nodes)."""
+        with self._lock:
+            return {n: (u[0], u[1]) for n, u in self._node_usage.items()}
+
     def _index_add(self, k: Tuple[str, str, str], obj: Any) -> None:
         self._by_kind.setdefault(k[0], {})[k] = obj
         self._by_kind_ns.setdefault((k[0], k[1]), {})[k] = obj
         for b in self._label_buckets(obj):
             self._by_label.setdefault(b, {})[k] = obj
+        self._usage_add(obj)
 
     def _index_remove(self, k: Tuple[str, str, str], obj: Any) -> None:
+        self._usage_remove(obj)
         for table, tk in (
             (self._by_kind, k[0]),
             (self._by_kind_ns, (k[0], k[1])),
@@ -232,7 +273,10 @@ class Store:
 
     def _index_replace(self, k: Tuple[str, str, str], old: Any, new: Any) -> None:
         # kind/ns buckets just swap the value; label buckets may move
-        # (an update can change labels).
+        # (an update can change labels); node usage may flip (a Process
+        # binding to a host or reaching a terminal phase).
+        self._usage_remove(old)
+        self._usage_add(new)
         self._by_kind[k[0]][k] = new
         self._by_kind_ns[(k[0], k[1])][k] = new
         old_b, new_b = self._label_buckets(old), self._label_buckets(new)

@@ -1,0 +1,14 @@
+"""Fleet scheduler: multi-tenant priority/quota admission, preemption and
+topology packing in front of the gang scheduler (see docs/design.md
+§"Fleet scheduling")."""
+
+from tf_operator_tpu.sched.objects import (  # noqa: F401
+    PriorityClass,
+    Queue,
+    QueueSpec,
+    job_demand,
+)
+from tf_operator_tpu.sched.fleet import (  # noqa: F401
+    Decision,
+    FleetScheduler,
+)

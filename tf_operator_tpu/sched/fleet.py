@@ -1,0 +1,449 @@
+"""FleetScheduler: multi-tenant admission in front of the GangScheduler.
+
+The kube-batch/Volcano-shaped layer the reference design doc explicitly
+left to kube-arbitrator (training.go:450-511 only writes a
+PodDisruptionBudget and hopes). Responsibilities:
+
+- **Admission**: a job must clear its Queue's chip/job quota before any
+  placement happens. Over-quota jobs park in the QUEUED condition
+  (ordered by (priority desc, submit time asc)) instead of hot-looping
+  SchedulingError retries through the workqueue's rate limiter.
+- **Preempt-by-priority**: a higher-priority job over quota (or without
+  fleet capacity) picks the lowest-priority, newest admitted victims;
+  the reconciler drains them through the PR 1 preemption lifecycle
+  (cause ``preemption``: checkpoint warm-resume, never charged to
+  backoff) rather than killing them.
+- **Backfill without starvation**: the head-of-line gang that cannot
+  place yet holds a host/chip reservation; smaller jobs may run only on
+  capacity the reservation doesn't cover, so they fill fragmentation
+  holes but can never delay the reserved gang.
+
+Deliberately NOT implemented (see docs/design.md): fair-share / DRF
+across queues, cross-queue quota borrowing, and preemption of
+equal-priority jobs.
+
+Concurrency: the scheduler is a plain mutable object with NO lock of its
+own — every method is called under the controller's ``_sched_lock``,
+which already serializes admission+placement+commit across sync workers
+(that atomicity is what makes "usage never exceeds quota" a real
+invariant rather than a race window).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from tf_operator_tpu.api.types import (
+    KIND_PRIORITY_CLASS,
+    KIND_PROCESS,
+    KIND_QUEUE,
+    KIND_TPUJOB,
+    LABEL_JOB_NAME,
+    ConditionType,
+    JobPhase,
+    TPUJob,
+)
+from tf_operator_tpu.runtime.store import NotFoundError
+from tf_operator_tpu.sched.objects import Queue, job_demand
+
+# Decision actions.
+ADMIT = "admit"  # proceed to placement
+WAIT = "wait"  # park in QUEUED; a release/resync will retry
+FAIL = "fail"  # permanently unsatisfiable (demand > quota)
+PREEMPT = "preempt"  # drain victims, then park until their chips free up
+
+# PriorityClass objects are cluster-scoped in spirit; they live in this
+# namespace and are resolved by name from any tenant namespace.
+PRIORITY_CLASS_NAMESPACE = "default"
+
+
+@dataclass
+class Decision:
+    action: str
+    reason: str = ""
+    victims: List[str] = field(default_factory=list)  # TPUJob keys to drain
+
+
+@dataclass
+class _JobInfo:
+    key: str
+    namespace: str
+    queue: str
+    priority: int
+    demand: int
+    ctime: float
+
+    def precedence(self) -> Tuple[int, float, str]:
+        # Lower sorts first: priority desc, submit asc, name as tiebreak —
+        # the admission-queue order (deterministic under equal scores).
+        return (-self.priority, self.ctime, self.key)
+
+
+class FleetScheduler:
+    def __init__(self, store: Any, gang: Any) -> None:
+        self.store = store
+        self.gang = gang  # GangScheduler: capacity oracle for reservations
+        self._admitted: Dict[str, _JobInfo] = {}
+        self._queued: Dict[str, _JobInfo] = {}
+        # (namespace, queue) -> [chips, jobs] held by admitted jobs.
+        # Maintained incrementally so admit() never rescans the store.
+        self._usage: Dict[Tuple[str, str], List[int]] = {}
+        # Head-of-line capacity reservations: job key -> {host: chips}
+        # held for a queued gang so backfillers can't starve it.
+        self._reservations: Dict[str, Dict[str, int]] = {}
+        # Preemption victims mid-drain: still admitted (their gang is
+        # winding down, the chips are NOT free yet) but barred from
+        # re-creating. release() is deferred until the reconciler
+        # observes the drained gang gone — so victim and preemptor can
+        # never hold the same quota headroom at once, even transiently.
+        self._draining: set = set()
+        self._synced = False
+
+    # ---- store lookups --------------------------------------------------
+
+    def priority_of(self, job: TPUJob) -> int:
+        name = job.spec.scheduling.priority_class
+        if not name:
+            return 0
+        try:
+            pc = self.store.get(KIND_PRIORITY_CLASS, PRIORITY_CLASS_NAMESPACE, name)
+        except NotFoundError:
+            return 0  # missing class degrades to baseline, never blocks
+        return int(pc.value)
+
+    def queue_for(self, job: TPUJob) -> Optional[Queue]:
+        name = job.spec.scheduling.queue
+        if not name:
+            return None
+        try:
+            return self.store.get(KIND_QUEUE, job.metadata.namespace, name)
+        except NotFoundError:
+            return None  # unquota'd until the Queue object appears
+
+    def _info(self, job: TPUJob) -> _JobInfo:
+        return _JobInfo(
+            key=job.key(),
+            namespace=job.metadata.namespace,
+            queue=job.spec.scheduling.queue,
+            priority=self.priority_of(job),
+            demand=job_demand(job),
+            ctime=job.metadata.creation_timestamp or time.time(),
+        )
+
+    # ---- crash/restart resync -------------------------------------------
+
+    def ensure_synced(self) -> None:
+        """Rebuild admission state from the store on first use (covers
+        controller restart): a job with live children is admitted and
+        holds quota; a job parked in the QUEUED condition re-enters the
+        queue with its original precedence (ctime is durable)."""
+        if self._synced:
+            return
+        self._synced = True
+        for job in self.store.list(KIND_TPUJOB):
+            if _terminal(job):
+                continue
+            info = self._info(job)
+            procs = self.store.list(
+                KIND_PROCESS,
+                namespace=job.metadata.namespace,
+                label_selector={LABEL_JOB_NAME: job.metadata.name},
+            )
+            if any(not p.is_finished() for p in procs):
+                self._commit(info)
+            elif job.status.phase() is JobPhase.QUEUED:
+                self._queued[info.key] = info
+
+    # ---- bookkeeping ----------------------------------------------------
+
+    def _commit(self, info: _JobInfo) -> None:
+        if info.key in self._admitted:
+            return
+        self._queued.pop(info.key, None)
+        self._reservations.pop(info.key, None)
+        self._admitted[info.key] = info
+        u = self._usage.setdefault((info.namespace, info.queue), [0, 0])
+        u[0] += info.demand
+        u[1] += 1
+
+    def commit(self, job: TPUJob) -> None:
+        """The gang placed and its processes are being created: its demand
+        now counts against the queue quota. Idempotent."""
+        self._commit(self._info(job))
+
+    def begin_preempt(self, key: str) -> None:
+        """First half of the preemption handoff: mark an admitted victim
+        as draining. It keeps holding its quota (the gang still occupies
+        chips) but admit() parks it instead of re-creating; the second
+        half is release(), called once the gang is observably gone."""
+        self.ensure_synced()  # the victim may predate any admit() call
+        if key in self._admitted:
+            self._draining.add(key)
+
+    def draining(self, key: str) -> bool:
+        return key in self._draining
+
+    def release(self, key: str) -> bool:
+        """Forget a job (finished / deleted / preempted). Returns True when
+        it held quota — callers then kick the queue head."""
+        self._draining.discard(key)
+        self._queued.pop(key, None)
+        self._reservations.pop(key, None)
+        info = self._admitted.pop(key, None)
+        if info is None:
+            return False
+        u = self._usage.get((info.namespace, info.queue))
+        if u is not None:
+            u[0] = max(0, u[0] - info.demand)
+            u[1] = max(0, u[1] - 1)
+        return True
+
+    def next_queued(self, limit: int = 64) -> List[str]:
+        """Top-of-queue job keys by precedence — the re-enqueue targets
+        after quota or capacity was released."""
+        order = sorted(self._queued.values(), key=lambda i: i.precedence())
+        return [i.key for i in order[:limit]]
+
+    def usage(self) -> Dict[Tuple[str, str], Tuple[int, int]]:
+        """Snapshot of (namespace, queue) -> (chips, jobs) held by admitted
+        jobs (metrics/CLI surface)."""
+        return {k: (v[0], v[1]) for k, v in self._usage.items()}
+
+    # ---- admission ------------------------------------------------------
+
+    def admit(self, job: TPUJob) -> Decision:
+        """Quota/priority gate, called before any placement. ADMIT means
+        "may try to place now"; commit() only happens after placement
+        succeeds, so a placement failure never leaks quota."""
+        self.ensure_synced()
+        key = job.key()
+        if key in self._draining:
+            # Preemption victim whose gang is still winding down: it must
+            # not re-create (that would undo the eviction) and its quota
+            # is not free yet. The post-drain release re-queues it.
+            return Decision(
+                WAIT, reason="preempted; re-queues once the drained gang exits"
+            )
+        if key in self._admitted:
+            return Decision(ADMIT)
+        info = self._info(job)
+        q = self.queue_for(job)
+        if q is None:
+            return Decision(ADMIT)
+        quota = max(q.spec.quota_chips, 0)
+        max_jobs = max(q.spec.max_running_jobs, 0)
+        if quota and info.demand > quota:
+            # No amount of waiting or preemption can ever satisfy this.
+            return Decision(
+                FAIL,
+                reason=(
+                    f"demands {info.demand} chip(s) but queue {info.queue!r} "
+                    f"quota is {quota} chip(s): unsatisfiable"
+                ),
+            )
+        used, running = self._usage.get((info.namespace, info.queue), (0, 0))
+        if (quota and used + info.demand > quota) or (
+            max_jobs and running + 1 > max_jobs
+        ):
+            victims = self._quota_victims(info, quota, max_jobs)
+            self._queued[key] = info
+            if victims:
+                return Decision(
+                    PREEMPT,
+                    reason=(
+                        f"over queue {info.queue!r} quota; preempting "
+                        f"{len(victims)} lower-priority job(s)"
+                    ),
+                    victims=victims,
+                )
+            return Decision(
+                WAIT,
+                reason=(
+                    f"queue {info.queue!r} quota exhausted "
+                    f"({used}/{quota or 'unlimited'} chips, "
+                    f"{running} running job(s))"
+                ),
+            )
+        blocker = self._head_blocker(info, quota, used)
+        if blocker is not None:
+            self._queued[key] = info
+            return Decision(
+                WAIT,
+                reason=(
+                    f"behind higher-precedence queued job {blocker} "
+                    "(admitting now would delay its quota headroom)"
+                ),
+            )
+        return Decision(ADMIT)
+
+    def _quota_victims(
+        self, info: _JobInfo, quota: int, max_jobs: int
+    ) -> List[str]:
+        """Lowest-priority-NEWEST admitted jobs in the same queue whose
+        eviction brings the queue under quota for ``info``. Empty when no
+        strictly-lower-priority set suffices (equal priority never
+        preempts — the job just waits)."""
+        cands = [
+            a
+            for a in self._admitted.values()
+            if a.namespace == info.namespace
+            and a.queue == info.queue
+            and a.priority < info.priority
+            # A victim already draining is spoken for: its chips free up
+            # when its drain completes, so evicting it "again" would
+            # double-promise the same headroom (and churn events).
+            and a.key not in self._draining
+        ]
+        cands.sort(key=lambda a: (a.priority, -a.ctime, a.key))
+        used, running = self._usage.get((info.namespace, info.queue), (0, 0))
+
+        def fits() -> bool:
+            return (not quota or used + info.demand <= quota) and (
+                not max_jobs or running + 1 <= max_jobs
+            )
+
+        victims: List[str] = []
+        for a in cands:
+            if fits():
+                break
+            victims.append(a.key)
+            used -= a.demand
+            running -= 1
+        return victims if victims and fits() else []
+
+    def _head_blocker(
+        self, info: _JobInfo, quota: int, used: int
+    ) -> Optional[str]:
+        """First queued same-queue job with higher precedence that
+        admitting ``info`` would delay. Backfill rule: ``info`` may jump
+        the line only when the quota holds BOTH it and every job ahead of
+        it — the blocker's headroom stays intact."""
+        if not quota:
+            return None  # no chip quota => admission can't delay anyone
+        for w in sorted(self._queued.values(), key=lambda i: i.precedence()):
+            if (
+                w.key == info.key
+                or w.namespace != info.namespace
+                or w.queue != info.queue
+            ):
+                continue
+            if w.precedence() < info.precedence():
+                if used + info.demand + w.demand > quota:
+                    return w.key
+        return None
+
+    # ---- capacity: reservations + fleet-wide preemption -----------------
+
+    def on_unplaceable(self, job: TPUJob) -> Decision:
+        """The gang cleared quota but had no atomic placement. Either
+        preempt lower-priority placed jobs (their per-host chips become
+        this job's reservation) or reserve the best candidate hosts and
+        wait. Both park the job; a release or resync retries it."""
+        self.ensure_synced()
+        key = job.key()
+        info = self._queued.get(key) or self._info(job)
+        self._queued[key] = info
+        victims = self._capacity_victims(info)
+        if victims:
+            reservation: Dict[str, int] = {}
+            for _, hosts in victims:
+                for host, chips in hosts.items():
+                    reservation[host] = reservation.get(host, 0) + chips
+            self._reservations[key] = reservation
+            return Decision(
+                PREEMPT,
+                reason=(
+                    f"no capacity; preempting {len(victims)} lower-priority "
+                    "job(s) fleet-wide"
+                ),
+                victims=[vkey for vkey, _ in victims],
+            )
+        if key not in self._reservations:
+            res = self._head_reservation(job, info)
+            if res:
+                self._reservations[key] = res
+        return Decision(WAIT, reason="waiting for fleet capacity")
+
+    def reserved_for_others(self, job: TPUJob) -> Dict[str, int]:
+        """Chips on each host held for queued jobs with precedence over
+        ``job`` — the placement subtracts them from free capacity, so a
+        backfilling job fits only into holes the reserved gangs don't
+        need (no starvation of the head of line)."""
+        self.ensure_synced()
+        if not self._reservations:
+            return {}
+        mine = job.key()
+        prec = (
+            self._queued[mine].precedence()
+            if mine in self._queued
+            else self._info(job).precedence()
+        )
+        merged: Dict[str, int] = {}
+        for key, res in self._reservations.items():
+            w = self._queued.get(key)
+            if key == mine or w is None or not (w.precedence() < prec):
+                continue
+            for host, chips in res.items():
+                merged[host] = merged.get(host, 0) + chips
+        return merged
+
+    def _victim_hosts(self, info: _JobInfo) -> Dict[str, int]:
+        """Per-host live chips of an admitted job (label-indexed list)."""
+        ns, _, name = info.key.partition("/")
+        hosts: Dict[str, int] = {}
+        for p in self.store.list(
+            KIND_PROCESS, namespace=ns, label_selector={LABEL_JOB_NAME: name}
+        ):
+            if p.spec.node_name and not p.is_finished():
+                hosts[p.spec.node_name] = hosts.get(p.spec.node_name, 0) + max(
+                    p.spec.chips, 0
+                )
+        return hosts
+
+    def _capacity_victims(
+        self, info: _JobInfo
+    ) -> List[Tuple[str, Dict[str, int]]]:
+        """Fleet-wide preempt-by-priority: lowest-priority-newest admitted
+        jobs with live placements, accumulated until the chips they free
+        cover the gang's demand. Approximate on purpose: placement
+        re-verifies per-host fit after the drain, and the next pass picks
+        more victims if fragmentation still blocks."""
+        if info.priority <= 0 and not any(
+            a.priority < info.priority for a in self._admitted.values()
+        ):
+            return []
+        cands = [a for a in self._admitted.values() if a.priority < info.priority]
+        cands.sort(key=lambda a: (a.priority, -a.ctime, a.key))
+        victims: List[Tuple[str, Dict[str, int]]] = []
+        freed = 0
+        need = max(info.demand, 1)
+        for a in cands:
+            if freed >= need:
+                break
+            hosts = self._victim_hosts(a)
+            if not hosts:
+                continue
+            victims.append((a.key, hosts))
+            freed += sum(hosts.values())
+        return victims if victims and freed >= need else []
+
+    def _head_reservation(self, job: TPUJob, info: _JobInfo) -> Dict[str, int]:
+        """Hold the emptiest hosts this gang will need so smaller jobs
+        backfill AROUND them — without this, a stream of small admits
+        could consume every hole and starve the large gang forever."""
+        want = max(1, job.spec.topology.num_hosts)
+        if not info.demand:
+            return {}
+        per_host = -(-info.demand // want)  # ceil
+        states = self.gang.host_states(job.spec.topology.slice_type)
+        states.sort(key=lambda s: (-s.free_chips, s.host.metadata.name))
+        return {s.host.metadata.name: per_host for s in states[:want]}
+
+
+def _terminal(job: TPUJob) -> bool:
+    for c in job.status.conditions:
+        if c.status and c.type in (ConditionType.SUCCEEDED, ConditionType.FAILED):
+            return True
+    return False

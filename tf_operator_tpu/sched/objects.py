@@ -1,0 +1,80 @@
+"""Fleet-scheduler store objects: PriorityClass and Queue.
+
+Reference parity: the reference operator punted multi-job scheduling to
+kube-arbitrator behind a PodDisruptionBudget (pkg/trainer/
+training.go:450-511) — there is no in-tree priority or quota object.
+These two kinds are the kube-batch/Volcano-shaped replacement: a
+cluster-level priority band and a per-namespace admission queue with a
+chip/job quota. Both ride the generic store/API seam exactly like Spans
+(runtime/serialize.py registers decoders; the dashboard serves CRUD at
+/api/v1/{kind}).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tf_operator_tpu.api.types import (
+    KIND_PRIORITY_CLASS,
+    KIND_QUEUE,
+    ObjectMeta,
+    ReplicaType,
+    TPUJob,
+)
+
+
+@dataclass
+class PriorityClass:
+    """Cluster-level priority band (k8s PriorityClass analogue).
+
+    Stored in the "default" namespace by convention and resolved by NAME
+    from any job's ``spec.scheduling.priority_class``. Higher ``value``
+    schedules first and may preempt lower values; a job naming a missing
+    class gets priority 0 (scheduling stays optional)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+    description: str = ""
+    kind: str = KIND_PRIORITY_CLASS
+
+    def key(self) -> str:
+        return self.metadata.key()
+
+
+@dataclass
+class QueueSpec:
+    """Admission quota. 0 means unlimited on that dimension."""
+
+    quota_chips: int = 0  # max chips admitted jobs in this queue may hold
+    max_running_jobs: int = 0  # max concurrently admitted jobs
+
+
+@dataclass
+class Queue:
+    """Per-namespace admission queue (kube-batch Queue analogue): jobs in
+    the queue's namespace that name it in ``spec.scheduling.queue`` share
+    its quota. A job naming a missing queue is unquota'd — quota is an
+    opt-in contract, not a trap for unconfigured namespaces."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: QueueSpec = field(default_factory=QueueSpec)
+    kind: str = KIND_QUEUE
+
+    def key(self) -> str:
+        return self.metadata.key()
+
+
+def job_demand(job: TPUJob) -> int:
+    """Chips the job occupies while admitted: the topology's slice size,
+    falling back to the sum of per-process chip requests when the topology
+    doesn't price itself (``chips_per_host`` unset). Evaluators are not
+    gang members and don't count (they pack opportunistically)."""
+    chips = job.spec.topology.total_chips()
+    if chips > 0:
+        return chips
+    total = 0
+    for rtype, rs in job.spec.replica_specs.items():
+        if rtype is ReplicaType.EVALUATOR:
+            continue
+        total += (rs.replicas or 1) * max(rs.template.chips_per_process, 0)
+    return total
