@@ -99,6 +99,7 @@ def test_retention_and_latest(tmp_path, sharded_state, backend):
     for _ in range(3):
         state, _ = trainer.step(state, tokens)
         mgr.save(int(state.step), state)
+    mgr.wait_until_finished()  # retention runs in the async drain
     steps = mgr.all_steps()
     assert len(steps) == 2, steps  # keep=2 pruned the oldest
     assert mgr.latest_step() == steps[-1] == int(state.step)
@@ -184,7 +185,7 @@ def test_npy_orphan_tmp_dirs_swept(tmp_path):
 
     root = tmp_path / "orphans"
     mgr = CheckpointManager(root, backend="npy")
-    mgr.save(1, {"x": jnp.ones((2,))})
+    mgr.save(1, {"x": jnp.ones((2,))}, wait=True)
     orphan = root / ".tmp_step_9_12345"
     orphan.mkdir()
     (orphan / "leaf_0.npy").write_bytes(b"partial")
@@ -455,3 +456,102 @@ def test_npy_step_without_manifest_is_not_a_resume_point(tmp_path):
     (d / "step_3" / "manifest.json").write_text("{}")
     (d / "step_5").mkdir()  # no manifest: torn npy save
     assert latest_checkpoint_step(str(d)) == 3
+
+
+# ---- chunked async npy pipeline (r8) ------------------------------------
+
+
+def test_async_npy_crash_never_yields_torn_resume_point(tmp_path):
+    """Commit ordering: a crash at ANY phase of the async drain (mid-leaf,
+    before the manifest, between the manifest and the rename) must never
+    make the step discoverable by the controller's resume oracle
+    (latest_checkpoint_step), and the failure must surface at the next
+    fence — then a retry of the same step succeeds."""
+    from tf_operator_tpu.train.checkpoint import latest_checkpoint_step
+
+    for phase in ("leaf", "manifest", "commit"):
+        root = tmp_path / f"crash-{phase}"
+        mgr = CheckpointManager(root, backend="npy")
+        assert mgr.save(1, {"x": jnp.ones((4,))}, wait=True)
+
+        def boom(p, step, _phase=phase):
+            if p == _phase and step == 2:
+                raise RuntimeError(f"injected crash at {p}")
+
+        mgr._fault_hook = boom
+        assert mgr.save(2, {"x": jnp.full((4,), 2.0)})
+        with pytest.raises(RuntimeError, match="never committed"):
+            mgr.wait_until_finished()
+        # The torn step is invisible to the warm-restart contract: the
+        # controller would stamp TPUJOB_RESUME_STEP=1, never 2.
+        assert latest_checkpoint_step(str(root)) == 1
+        assert mgr.all_steps() == [1]
+        # Retry (same incarnation) rebuilds its tmp from scratch and lands.
+        mgr._fault_hook = None
+        assert mgr.save(2, {"x": jnp.full((4,), 2.0)}, wait=True)
+        assert latest_checkpoint_step(str(root)) == 2
+        np.testing.assert_array_equal(
+            np.asarray(mgr.restore({"x": jnp.zeros((4,))})["x"]),
+            np.full((4,), 2.0),
+        )
+
+
+def test_async_npy_save_returns_before_commit(tmp_path):
+    """Overlap receipt: save() hands back control while the drain is still
+    running; until the commit rename, nothing on disk is discoverable (a
+    crash in this window is a clean orphan, not a resume point)."""
+    import threading
+
+    from tf_operator_tpu.train.checkpoint import latest_checkpoint_step
+
+    root = tmp_path / "overlap"
+    mgr = CheckpointManager(root, backend="npy")
+    gate = threading.Event()
+    mgr._fault_hook = (
+        lambda phase, step: gate.wait(timeout=30) if phase == "commit" else None
+    )
+    assert mgr.save(1, {"x": jnp.ones((1024,))})
+    # save() already returned; the drain is parked just before the rename
+    assert latest_checkpoint_step(str(root)) == 0
+    assert mgr.last_save_stall_s < 30.0  # the caller never waited on the gate
+    gate.set()
+    mgr.wait_until_finished()
+    assert latest_checkpoint_step(str(root)) == 1
+
+
+def test_duplicate_step_save_never_fences_inflight_write(tmp_path):
+    """The head-of-line fix: a duplicate-step save must answer from the
+    step list WITHOUT fencing the previous in-flight write (here the
+    in-flight drain is the SAME step, parked at the commit gate — a
+    fencing implementation would block 30s)."""
+    import threading
+    import time as _time
+
+    root = tmp_path / "hol"
+    mgr = CheckpointManager(root, backend="npy")
+    gate = threading.Event()
+    mgr._fault_hook = (
+        lambda phase, step: gate.wait(timeout=30) if phase == "commit" else None
+    )
+    assert mgr.save(3, {"x": jnp.ones((8,))})
+    t0 = _time.perf_counter()
+    assert mgr.save(3, {"x": jnp.ones((8,))}) is False
+    assert _time.perf_counter() - t0 < 5.0, "duplicate save fenced the drain"
+    gate.set()
+    mgr.wait_until_finished()
+    assert mgr.all_steps() == [3]
+
+
+def test_workload_checkpointer_records_save_stall(tmp_path):
+    """Every ACCEPTED periodic save contributes one stall sample (the
+    bench artifact's p50/p99 source); skipped duplicates contribute none."""
+    from tf_operator_tpu.train.checkpoint import WorkloadCheckpointer
+
+    ckpt = WorkloadCheckpointer(
+        {"checkpoint_dir": str(tmp_path / "stall"), "checkpoint_every": 1}
+    )
+    ckpt.advance({"x": jnp.ones((2,))}, loss=1.0)
+    ckpt.advance({"x": jnp.ones((2,))}, loss=1.0)
+    assert len(ckpt.save_stalls) == 2
+    assert all(s >= 0.0 for s in ckpt.save_stalls)
+    ckpt.manager.close()

@@ -1,0 +1,170 @@
+"""Peer-to-peer warm-restore tests (docs/design.md §4.9).
+
+Covers the host shard depot, the workload-side DepotClient, and the
+restore-source decision order — including the two failure modes the
+protocol must survive: a peer dying mid-transfer (fall back, never a
+torn resume point) and uncommitted state (invisible, never served).
+"""
+
+import urllib.error
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tf_operator_tpu.rendezvous.statechannel import (
+    DepotClient,
+    ShardDepot,
+    choose_restore_source,
+)
+from tf_operator_tpu.train.checkpoint import (
+    CheckpointManager,
+    latest_checkpoint_step,
+)
+
+
+@pytest.fixture()
+def depot():
+    d = ShardDepot(keep=2)
+    yield d
+    d.stop()
+
+
+def test_depot_push_steps_fetch_roundtrip(tmp_path, depot):
+    src = tmp_path / "src"
+    mgr = CheckpointManager(src, backend="npy")
+    mgr.save(1, {"x": jnp.arange(8, dtype=jnp.float32)}, wait=True)
+
+    client = DepotClient()
+    assert client.push_step(depot.url, "ns", "job", 1, str(src / "step_1"))
+    assert client.steps(depot.url, "ns", "job") == [1]
+    assert client.best_peer([depot.url], "ns", "job") == (depot.url, 1)
+
+    dest = tmp_path / "dest"
+    dest.mkdir()
+    final = client.fetch_step(depot.url, "ns", "job", 1, str(dest))
+    assert final is not None
+    # The materialized step satisfies the controller's resume oracle.
+    assert latest_checkpoint_step(str(dest)) == 1
+
+
+def test_peer_restore_bit_identical_to_disk(tmp_path, depot):
+    """The acceptance bar: state restored via a peer depot is
+    bit-identical (values AND dtypes) to state restored from the
+    original disk checkpoint at the same step."""
+    tree = {
+        "w": jnp.asarray(np.random.default_rng(0).standard_normal((16, 4)),
+                         dtype=jnp.float32),
+        "b16": jnp.arange(4, dtype=jnp.bfloat16),
+        "step": jnp.asarray(7, dtype=jnp.int32),  # 0-d leaf
+    }
+    src = tmp_path / "src"
+    mgr = CheckpointManager(src, backend="npy")
+    mgr.save(7, tree, wait=True)
+
+    client = DepotClient()
+    assert client.push_step(depot.url, "ns", "lm", 7, str(src / "step_7"))
+    dest = tmp_path / "dest"
+    dest.mkdir()
+    assert client.fetch_step(depot.url, "ns", "lm", 7, str(dest)) is not None
+
+    template = {
+        "w": jnp.zeros((16, 4), jnp.float32),
+        "b16": jnp.zeros((4,), jnp.bfloat16),
+        "step": jnp.zeros((), jnp.int32),
+    }
+    from_disk = CheckpointManager(src, backend="npy").restore(dict(template))
+    from_peer = CheckpointManager(dest, backend="npy").restore(dict(template))
+    for key in template:
+        a, b = np.asarray(from_disk[key]), np.asarray(from_peer[key])
+        assert a.dtype == b.dtype, key
+        assert a.shape == b.shape, key
+        assert np.array_equal(a, b), key
+
+
+def test_depot_staged_but_uncommitted_invisible(depot):
+    """stage() without commit() must never be servable — mirrors the
+    on-disk rule that a tmp dir without the rename is not a checkpoint."""
+    depot.stage("ns", "job", 5, "leaf_0.npy", b"partial bytes")
+    assert depot.steps("ns", "job") == []
+    assert depot.files("ns", "job", 5) is None
+    client = DepotClient()
+    assert client.best_peer([depot.url], "ns", "job") == (None, 0)
+    assert not depot.commit("ns", "job", 6)  # nothing staged for 6
+
+
+def test_depot_retention_prunes_old_steps(depot):
+    for step in (1, 2, 3):
+        depot.stage("ns", "job", step, "a", b"x")
+        assert depot.commit("ns", "job", step)
+    assert depot.steps("ns", "job") == [2, 3]  # keep=2
+
+
+def test_fetch_refuses_step_without_commit_marker(tmp_path, depot):
+    """A depot listing with no commit marker is a torn push — the
+    restorer must refuse it rather than materialize a fake step."""
+    depot.stage("ns", "job", 4, "leaf_0.npy", b"data")
+    depot.commit("ns", "job", 4)  # committed at the depot, but no manifest
+    dest = tmp_path / "dest"
+    dest.mkdir()
+    client = DepotClient()
+    assert client.fetch_step(depot.url, "ns", "job", 4, str(dest)) is None
+    assert latest_checkpoint_step(str(dest)) == 0
+    assert list(dest.iterdir()) == []  # no tmp debris either
+
+
+def test_peer_dies_mid_transfer_falls_back_clean(tmp_path, depot):
+    """Acceptance: a serving peer dying mid-transfer degrades to None
+    (caller falls back to disk) and leaves NO resumable torn step."""
+    src = tmp_path / "src"
+    mgr = CheckpointManager(src, backend="npy")
+    mgr.save(2, {"x": jnp.ones((64,)), "y": jnp.zeros((32,))}, wait=True)
+    client = DepotClient()
+    assert client.push_step(depot.url, "ns", "job", 2, str(src / "step_2"))
+
+    class DyingClient(DepotClient):
+        """Transport that dies after the listing + first shard GET."""
+
+        def __init__(self):
+            super().__init__()
+            self.calls = 0
+
+        def _get(self, base, path, q):
+            if path == "/depot/v1/shard":
+                self.calls += 1
+                if self.calls > 1:
+                    raise urllib.error.URLError("peer died mid-transfer")
+            return super()._get(base, path, q)
+
+    dest = tmp_path / "dest"
+    dest.mkdir()
+    assert DyingClient().fetch_step(depot.url, "ns", "job", 2, str(dest)) is None
+    assert latest_checkpoint_step(str(dest)) == 0
+    assert list(dest.iterdir()) == []
+
+
+def test_choose_restore_source_decision_order(tmp_path, depot):
+    src = tmp_path / "src"
+    mgr = CheckpointManager(src, backend="npy")
+    mgr.save(3, {"x": jnp.ones((2,))}, wait=True)
+    client = DepotClient()
+    assert client.push_step(depot.url, "ns", "job", 3, str(src / "step_3"))
+
+    # peer ahead of disk -> peer
+    assert choose_restore_source([depot.url], "ns", "job", 1) == (
+        "peer", depot.url, 3)
+    # tie goes to the PEER: skipping the slow-store read IS the payoff
+    assert choose_restore_source([depot.url], "ns", "job", 3) == (
+        "peer", depot.url, 3)
+    # peer strictly behind disk -> disk (monotonic resume)
+    assert choose_restore_source([depot.url], "ns", "job", 5) == (
+        "disk", None, 5)
+    # no peers / dead peer -> disk
+    assert choose_restore_source([], "ns", "job", 3) == ("disk", None, 3)
+    assert choose_restore_source(
+        ["http://127.0.0.1:1/"], "ns", "job", 3,
+        client=DepotClient(timeout=0.5),
+    ) == ("disk", None, 3)
+    # nothing anywhere -> disk with step 0 (fresh init)
+    assert choose_restore_source([], "ns", "other", 0) == ("disk", None, 0)

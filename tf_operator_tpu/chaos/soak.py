@@ -38,11 +38,28 @@ the recovery invariants the whole subsystem exists to guarantee:
    kills and recovers the whole control plane mid-run; the job must
    still satisfy every invariant above, and the outage must be VISIBLE
    as a ``controller-restart`` span in the job's trace.
+9. **Peer warm restore** (``--p2p``) — agents run host-lifetime shard
+   depots (rendezvous/statechannel.py); at least one post-fault
+   incarnation must restore from a PEER (its restore span carries
+   ``source=peer``), proving the depot survived the gang teardown and
+   the controller's ``TPUJOB_RESTORE_PEERS`` hint reached the workload.
+   Recovery downtime is additionally measured as EFFECTIVE downtime —
+   restart-span start to the matching restore span's end — because the
+   restart span closes at gang RUNNING, before the workload's restore
+   (and its modeled slow-store read, ``--disk-restore-delay``) runs.
 
-Runnable standalone (the CI ``chaos-soak`` / ``crash-soak`` stages)::
+``--compare-restore`` runs the SAME seed twice — disk-only baseline,
+then p2p — and asserts the p2p effective-downtime p50 cuts the disk
+baseline by more than 2x (the acceptance receipt; JSON artifact under
+``--workdir``).
+
+Runnable standalone (the CI ``chaos-soak`` / ``crash-soak`` /
+``ckpt-soak`` stages)::
 
     python -m tf_operator_tpu.chaos.soak --seed 7 --steps 8
     python -m tf_operator_tpu.chaos.soak --seed 11 --steps 8 --operator-crash
+    python -m tf_operator_tpu.chaos.soak --seed 13 --steps 6 --p2p \\
+        --disk-restore-delay 8 --compare-restore
 
 Exits nonzero when any invariant is violated.
 """
@@ -226,6 +243,16 @@ class SoakResult:
     # (the restart must be VISIBLE as a controller-restart span).
     operator_restarts: int = 0
     trace_ops: List[str] = field(default_factory=list)
+    # Peer warm-restore bookkeeping (invariant 9): whether the rig ran
+    # with shard depots, the source of every restore span in the trace
+    # (chronological), and the EFFECTIVE recovery downtime per restart —
+    # restart-span start to the matching restore span's end. The plain
+    # restart window closes at gang RUNNING, BEFORE the workload's
+    # restore (and any slow-store read) runs; effective downtime is what
+    # an operator actually waits for training to resume.
+    p2p: bool = False
+    restore_sources: List[str] = field(default_factory=list)
+    effective_downtimes_s: List[Optional[float]] = field(default_factory=list)
 
     def check(self) -> List[str]:
         """Invariant failures, empty when the soak passed."""
@@ -299,6 +326,25 @@ class SoakResult:
                     "operator crashed+recovered but the job trace has no "
                     f"controller-restart span (ops: {sorted(set(self.trace_ops))})"
                 )
+        # Invariant 9: with shard depots armed, at least one post-fault
+        # incarnation restored from a PEER — the depot outlived the gang
+        # teardown and the TPUJOB_RESTORE_PEERS hint closed the loop. The
+        # effective downtimes (restart start -> restore end, the number
+        # that includes the workload's restore) also honor the bound —
+        # the TIGHTENED check the plain RUNNING-closed window can't see.
+        if self.p2p:
+            if "peer" not in self.restore_sources:
+                errs.append(
+                    "p2p soak: no restart restored from a peer (restore "
+                    f"sources: {self.restore_sources})"
+                )
+            for d in self.effective_downtimes_s:
+                if d is not None and d > self.downtime_bound_s:
+                    errs.append(
+                        f"effective recovery downtime {d:.1f}s (restart -> "
+                        f"restore committed) exceeds bound "
+                        f"{self.downtime_bound_s:.0f}s"
+                    )
         return errs
 
 
@@ -407,6 +453,7 @@ def _soak_job(
     heartbeat_ttl: Optional[float],
     data_plane: str = "light",
     step_sleep_s: float = 1.0,
+    disk_restore_delay_s: float = 0.0,
 ) -> TPUJob:
     """``data_plane='light'`` (default) runs workloads/soak.py — real
     checkpoint subsystem, no cross-process collectives, so the soak works
@@ -435,6 +482,14 @@ def _soak_job(
             "step_sleep_s": step_sleep_s,
             "checkpoint_dir": ckpt_dir,
             "checkpoint_every": checkpoint_every,
+            # The chunked async npy pipeline is the one under test — it
+            # is also the backend whose commit hook feeds the shard
+            # depots (docs/design.md §4.9), which invariant 9 needs.
+            "checkpoint_backend": "npy",
+            # Models the flagship slow-store read: a resumed chief whose
+            # restore source is DISK sleeps this long; the peer path
+            # skips it (workloads/soak.py).
+            "disk_restore_delay_s": disk_restore_delay_s,
         }
     job = TPUJob(
         metadata=ObjectMeta(name=name),
@@ -474,11 +529,20 @@ def run_soak(
     step_sleep_s: float = 1.0,
     downtime_bound_s: float = 60.0,
     operator_crash: bool = False,
+    p2p_restore: bool = False,
+    disk_restore_delay_s: float = 0.0,
 ) -> SoakResult:
     """Run one seeded soak; returns the observations (see SoakResult.check).
 
     ``hosts`` > ``num_hosts`` leaves spare capacity so a preempted gang has
     somewhere to move — a drained host is not schedulable.
+
+    ``p2p_restore`` arms the peer warm-restore path: every agent runs a
+    host-lifetime shard depot, the controller stamps
+    ``TPUJOB_RESTORE_PEERS``, and invariant 9 requires at least one
+    post-fault incarnation to restore from a peer.
+    ``disk_restore_delay_s`` is the modeled slow-store read a DISK
+    restore pays (and a peer restore skips) in the light data plane.
 
     ``operator_crash`` (or a schedule containing OPERATOR_CRASH) switches
     the rig to the crash-recovery topology: the operator is a
@@ -526,6 +590,9 @@ def run_soak(
             backend=LocalProcessControl(
                 injector.wrap(), log_dir=os.path.join(tmp, "logs")
             ),
+            # p2p mode: host-lifetime shard depots — they outlive every
+            # gang teardown, which is what invariant 9 exercises.
+            depot=p2p_restore,
         )
         for i in range(hosts)
     ]
@@ -533,12 +600,22 @@ def run_soak(
     if crash_mode:
         ctl = None
         fake = None
+        dashboard = None
     else:
         # The controller's own process control must stay idle in managed
         # mode (every gang member is host-bound); a fake makes a leak loud.
         fake = FakeProcessControl()
         ctl = TPUJobController(store, fake, resync_period=0.5)
         ctl.scheduler.heartbeat_ttl = heartbeat_ttl
+        # Workload-side spans (restore-source, save-stall) travel through
+        # the operator API (ENV_API_SERVER); without one they drop
+        # silently and invariant 9 is blind. Crash mode gets this from
+        # RestartableOperator; managed mode needs its own.
+        from tf_operator_tpu.dashboard import DashboardServer
+
+        dashboard = DashboardServer(store, host="127.0.0.1", port=0)
+        dashboard.start()
+        ctl.api_url = dashboard.url
 
     gang_names = [f"{job_name}-worker-{i}" for i in range(workers)]
     watcher = _InvariantWatcher(store, job_name, gang_names)
@@ -552,7 +629,8 @@ def run_soak(
         store.create(
             _soak_job(job_name, workers, num_hosts, ckpt_dir, steps,
                       checkpoint_every, backoff_limit, heartbeat_ttl,
-                      data_plane=data_plane, step_sleep_s=step_sleep_s)
+                      data_plane=data_plane, step_sleep_s=step_sleep_s,
+                      disk_restore_delay_s=disk_restore_delay_s)
         )
         injector.arm()
         deadline = time.monotonic() + timeout
@@ -579,6 +657,34 @@ def run_soak(
         trace = job_trace(store, "default", job_name)
         result.restart_windows = derive_timings(trace).get("restarts", [])
         result.trace_ops = [s.op for s in trace]
+        # Restore-source spans + effective downtime (invariant 9): each
+        # restart window is matched to the earliest CLOSED restore span
+        # starting at/after the window opened — effective = restore end -
+        # restart start. A window with no matching restore (the gang came
+        # back but never reported one) falls back to the RUNNING-closed
+        # width so the bound still sees it.
+        restore_spans = sorted(
+            (s for s in trace if s.op == "restore" and s.end_time),
+            key=lambda s: s.start_time,
+        )
+        result.restore_sources = [
+            s.attrs.get("source", "disk") for s in restore_spans
+        ]
+        windows = sorted(result.restart_windows, key=lambda w: w["start"])
+        starts = [w["start"] for w in windows]
+        for i, w in enumerate(windows):
+            nxt = starts[i + 1] if i + 1 < len(starts) else float("inf")
+            match = next(
+                (s for s in restore_spans
+                 if w["start"] <= s.start_time < nxt),
+                None,
+            )
+            if match is not None:
+                result.effective_downtimes_s.append(
+                    max(0.0, match.end_time - w["start"])
+                )
+            else:
+                result.effective_downtimes_s.append(w.get("downtime_s"))
     finally:
         injector.stop()
         watcher.stop()
@@ -586,6 +692,8 @@ def run_soak(
             ctl.stop()
         for a in agents:
             a.stop()
+        if dashboard is not None:
+            dashboard.stop()
         if operator is not None:
             operator.crash()  # agents stopped; tear the API down last
         if fake is not None:
@@ -594,6 +702,7 @@ def run_soak(
     result.partial_gang_violations = list(watcher.violations)
     result.applied = list(injector.applied)
     result.downtime_bound_s = downtime_bound_s
+    result.p2p = p2p_restore
     result.gang_incarnations = {
         name: len(uids) for name, uids in watcher.created_uids.items()
     }
@@ -641,37 +750,126 @@ def main(argv=None) -> int:
                         "agents ride RemoteStore retries; adds the "
                         "zero-duplicate-creates and restart-in-trace "
                         "invariants")
+    p.add_argument("--p2p", action="store_true",
+                   help="peer warm-restore mode: agents run host-lifetime "
+                        "shard depots; invariant 9 requires >=1 restart to "
+                        "restore from a peer, and recovery downtime is "
+                        "measured through the restore span (effective)")
+    p.add_argument("--disk-restore-delay", type=float, default=0.0,
+                   help="modeled slow-store read (seconds) a DISK restore "
+                        "pays in the light data plane; the peer path "
+                        "skips it")
+    p.add_argument("--compare-restore", action="store_true",
+                   help="run the same seed twice (disk-only baseline, then "
+                        "p2p) and assert the p2p effective-downtime p50 "
+                        "cuts the disk baseline by >2x; writes "
+                        "restore-compare.json under --workdir")
     args = p.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s [%(levelname)s] %(message)s",
         stream=sys.stderr,
     )
-    result = run_soak(
-        seed=args.seed, steps=args.steps, hosts=args.hosts,
-        num_hosts=args.num_hosts, workers=args.workers,
-        checkpoint_every=args.checkpoint_every,
-        backoff_limit=args.backoff_limit, timeout=args.timeout,
-        workdir=args.workdir, data_plane=args.data_plane,
-        step_sleep_s=args.step_sleep, downtime_bound_s=args.downtime_bound,
-        operator_crash=args.operator_crash,
+
+    def one(p2p: bool, workdir: Optional[str]) -> SoakResult:
+        return run_soak(
+            seed=args.seed, steps=args.steps, hosts=args.hosts,
+            num_hosts=args.num_hosts, workers=args.workers,
+            checkpoint_every=args.checkpoint_every,
+            backoff_limit=args.backoff_limit, timeout=args.timeout,
+            workdir=workdir, data_plane=args.data_plane,
+            step_sleep_s=args.step_sleep,
+            downtime_bound_s=args.downtime_bound,
+            operator_crash=args.operator_crash,
+            p2p_restore=p2p, disk_restore_delay_s=args.disk_restore_delay,
+        )
+
+    def report(result: SoakResult, tag: str = "") -> List[str]:
+        downtimes = [
+            round(w["downtime_s"], 2) if w.get("downtime_s") is not None
+            else None
+            for w in result.restart_windows
+        ]
+        effective = [
+            round(d, 2) if d is not None else None
+            for d in result.effective_downtimes_s
+        ]
+        print(
+            f"soak{tag} seed={args.seed}: succeeded={result.succeeded} "
+            f"restarts={result.restart_count} "
+            f"preemptions={result.preemption_count} "
+            f"last_cause={result.last_restart_cause!r} "
+            f"resume_steps={result.resume_steps} applied={result.applied} "
+            f"trace_downtimes_s={downtimes} "
+            f"effective_downtimes_s={effective} "
+            f"restore_sources={result.restore_sources} "
+            f"operator_restarts={result.operator_restarts} "
+            f"gang_incarnations={result.gang_incarnations}"
+        )
+        errors = result.check()
+        for e in errors:
+            print(f"INVARIANT VIOLATED{tag}: {e}", file=sys.stderr)
+        return errors
+
+    if not args.compare_restore:
+        result = one(args.p2p, args.workdir)
+        return 1 if report(result) else 0
+
+    # Compare mode: same seed, same schedule, disk-only then p2p. The
+    # disk baseline pays the modeled slow-store read on every restore;
+    # the acceptance receipt is the p2p p50 cutting it by >2x.
+    import json as _json
+
+    root = args.workdir or tempfile.mkdtemp(prefix="tpujob-ckpt-soak-")
+    disk = one(False, os.path.join(root, "disk"))
+    errors = report(disk, tag="[disk]")
+    p2p = one(True, os.path.join(root, "p2p"))
+    errors += report(p2p, tag="[p2p]")
+
+    def p50(xs: List[Optional[float]]) -> Optional[float]:
+        vals = sorted(x for x in xs if x is not None)
+        return vals[len(vals) // 2] if vals else None
+
+    disk_p50, p2p_p50 = p50(disk.effective_downtimes_s), p50(
+        p2p.effective_downtimes_s
     )
-    downtimes = [
-        round(w["downtime_s"], 2) if w.get("downtime_s") is not None else None
-        for w in result.restart_windows
-    ]
+    if disk_p50 is None or p2p_p50 is None:
+        errors.append(
+            f"compare: missing effective downtimes (disk={disk_p50} "
+            f"p2p={p2p_p50})"
+        )
+    elif not p2p_p50 * 2 < disk_p50:
+        errors.append(
+            f"compare: p2p effective-downtime p50 {p2p_p50:.2f}s does not "
+            f"cut the disk baseline {disk_p50:.2f}s by >2x"
+        )
+    artifact = {
+        "seed": args.seed,
+        "disk_restore_delay_s": args.disk_restore_delay,
+        "disk": {
+            "effective_downtimes_s": disk.effective_downtimes_s,
+            "restore_sources": disk.restore_sources,
+            "p50_s": disk_p50,
+        },
+        "p2p": {
+            "effective_downtimes_s": p2p.effective_downtimes_s,
+            "restore_sources": p2p.restore_sources,
+            "p50_s": p2p_p50,
+        },
+        "cut_factor": (
+            disk_p50 / p2p_p50 if disk_p50 and p2p_p50 else None
+        ),
+        "pass": not errors,
+    }
+    path = os.path.join(root, "restore-compare.json")
+    with open(path, "w") as f:
+        _json.dump(artifact, f, indent=2)
     print(
-        f"soak seed={args.seed}: succeeded={result.succeeded} "
-        f"restarts={result.restart_count} preemptions={result.preemption_count} "
-        f"last_cause={result.last_restart_cause!r} "
-        f"resume_steps={result.resume_steps} applied={result.applied} "
-        f"trace_downtimes_s={downtimes} "
-        f"operator_restarts={result.operator_restarts} "
-        f"gang_incarnations={result.gang_incarnations}"
+        f"restore-compare: disk_p50={disk_p50} p2p_p50={p2p_p50} "
+        f"cut={artifact['cut_factor']} -> {path}"
     )
-    errors = result.check()
     for e in errors:
-        print(f"INVARIANT VIOLATED: {e}", file=sys.stderr)
+        print(f"COMPARE FAILED: {e}", file=sys.stderr)
     return 1 if errors else 0
 
 

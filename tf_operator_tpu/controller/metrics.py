@@ -57,6 +57,13 @@ class ControllerMetrics:
     LIFECYCLE_BUCKETS = (
         0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
     )
+    # Sub-step-time latencies (the async save stall target is < 1
+    # step-time, i.e. milliseconds on real steps): LIFECYCLE_BUCKETS'
+    # 50 ms floor would collapse the whole distribution into bucket 0.
+    FINE_BUCKETS = (
+        0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+        0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    )
     HIST_HELP = {
         "tpujob_time_to_scheduled_seconds": (
             "Submit -> gang placement decided (the scheduled span's end)."
@@ -73,6 +80,20 @@ class ControllerMetrics:
             "Fleet-scheduler admission wait (queued span: parked in "
             "QUEUED -> admitted), by queue and priority class."
         ),
+        "tpujob_checkpoint_save_stall_seconds": (
+            "Step-loop stall per accepted async checkpoint save (the "
+            "staging copy; device->host fetch and disk write overlap "
+            "training behind it)."
+        ),
+        "tpujob_restore_seconds": (
+            "Warm-restore wall time by source (peer = pulled from a "
+            "surviving host's shard depot; disk = orbax/npy read)."
+        ),
+    }
+    # Histogram families measuring sub-step-time latencies use the fine
+    # bucket ladder; everything else stays on the lifecycle ladder.
+    HIST_BUCKETS = {
+        "tpujob_checkpoint_save_stall_seconds": FINE_BUCKETS,
     }
 
     # Reconcile-latency histogram bounds (seconds). Healthy syncs on the
@@ -148,17 +169,22 @@ class ControllerMetrics:
         """Observe one value into a lifecycle-latency histogram family
         (HIST_HELP). Label sets create their series on first use."""
         key = (name, tuple(sorted((labels or {}).items())))
+        bounds = self._buckets_for(name)
         with self._lock:
             h = self._hists.get(key)
             if h is None:
-                h = [[0] * (len(self.LIFECYCLE_BUCKETS) + 1), 0.0, 0]
+                h = [[0] * (len(bounds) + 1), 0.0, 0]
                 self._hists[key] = h
             i = 0
-            while i < len(self.LIFECYCLE_BUCKETS) and seconds > self.LIFECYCLE_BUCKETS[i]:
+            while i < len(bounds) and seconds > bounds[i]:
                 i += 1
             h[0][i] += 1
             h[1] += seconds
             h[2] += 1
+
+    @classmethod
+    def _buckets_for(cls, name: str) -> tuple:
+        return cls.HIST_BUCKETS.get(name, cls.LIFECYCLE_BUCKETS)
 
     def sync_latency_quantiles(self, qs=(0.5, 0.99)) -> Dict[float, float]:
         """Empirical sync-latency quantiles from the raw samples (the
@@ -207,13 +233,14 @@ class ControllerMetrics:
         for name in sorted({k[0] for k in hists}):
             out.append(f"# HELP {name} {self.HIST_HELP.get(name, name)}")
             out.append(f"# TYPE {name} histogram")
+            bounds = self._buckets_for(name)
             for (n, lbls), (bkts, h_sum, h_count) in sorted(hists.items()):
                 if n != name:
                     continue
                 base = _render_labels(lbls)
                 sep = "," if base else ""
                 cum = 0
-                for le, cnt in zip(self.LIFECYCLE_BUCKETS, bkts):
+                for le, cnt in zip(bounds, bkts):
                     cum += cnt
                     out.append(
                         f'{name}_bucket{{{base}{sep}le="{le:g}"}} {cum}'

@@ -88,6 +88,7 @@ from tf_operator_tpu.rendezvous.env import (
     ENV_MESH_AXES,
     ENV_NUM_PROCESSES,
     ENV_PROCESS_ID,
+    ENV_RESTORE_PEERS,
     ENV_RESUME_STEP,
     ENV_TRACE_ID,
     ENV_WORKLOAD,
@@ -95,6 +96,7 @@ from tf_operator_tpu.rendezvous.env import (
 from tf_operator_tpu.runtime.objects import (
     Endpoint,
     EndpointAddress,
+    HostPhase,
     Process,
     ProcessPhase,
     ProcessSpec,
@@ -204,6 +206,7 @@ class TPUJobController:
         self.tracer = SpanRecorder(store)
         self._sched_observed: set = set()  # uids with a scheduled span
         self._ttfs_observed: set = set()  # uids whose TTFS hit the histogram
+        self._ckpt_observed: set = set()  # uids whose ckpt spans hit histograms
         self._open_restart: Dict[str, Dict[str, Any]] = {}  # uid -> span info
         self._open_schedwait: Dict[str, Dict[str, Any]] = {}
         self._open_queued: Dict[str, Dict[str, Any]] = {}  # uid -> span info
@@ -1098,6 +1101,51 @@ class TPUJobController:
             max(0.0, span.start_time - job.metadata.creation_timestamp),
         )
 
+    def _observe_ckpt_spans(self, job: TPUJob) -> None:
+        """Fold workload-reported checkpoint spans into histograms (once
+        per job, at terminal): ``checkpoint-save-stall`` spans — the step
+        loop's staging stall per accepted async save — become
+        ``tpujob_checkpoint_save_stall_seconds``; ``restore`` spans become
+        ``tpujob_restore_seconds{source=peer|disk}``."""
+        uid = job.metadata.uid
+        if uid in self._ckpt_observed:
+            return
+        self._ckpt_observed.add(uid)
+        try:
+            spans = job_trace(self.store, job.metadata.namespace, job.metadata.name)
+        except Exception:  # noqa: BLE001 — telemetry read is best-effort
+            return
+        for span in spans:
+            dur = span.duration()
+            if dur is None:  # still open — not a measurement
+                continue
+            if span.op == "checkpoint-save-stall":
+                self.metrics.observe_hist(
+                    "tpujob_checkpoint_save_stall_seconds", dur
+                )
+            elif span.op == "restore":
+                source = span.attrs.get("source", "disk")
+                self.metrics.observe_hist(
+                    "tpujob_restore_seconds", dur,
+                    labels={"source": "peer" if source == "peer" else "disk"},
+                )
+
+    def _depot_peers(self) -> List[str]:
+        """Depot URLs of hosts that can serve peer warm restores: every
+        Ready or Draining host announcing ``spec.depot_url``. Draining
+        hosts are deliberately included — a preempted gang's replacement
+        pulls from exactly those hosts while they drain."""
+        try:
+            hosts = self.store.list(KIND_HOST)
+        except Exception:  # noqa: BLE001 — advisory hint; never block create
+            return []
+        urls = {
+            h.spec.depot_url
+            for h in hosts
+            if h.spec.depot_url and h.status.phase != HostPhase.NOT_READY
+        }
+        return sorted(urls)
+
     # ---- actions --------------------------------------------------------
 
     def _delete_child(self, process: Process) -> None:
@@ -1143,6 +1191,7 @@ class TPUJobController:
         # no checkpointing). A cheap filesystem scan — no orbax import.
         ckpt_dir = job.spec.workload.get("checkpoint_dir")
         resume_step = latest_checkpoint_step(str(ckpt_dir)) if ckpt_dir else 0
+        restore_peers = self._depot_peers() if ckpt_dir else []
 
         # Build every Process object first so the chief's host can be
         # resolved once and injected into ALL members' coordinator address —
@@ -1196,6 +1245,13 @@ class TPUJobController:
                 # authoritative resume stays latest_step() on disk.
                 env[ENV_CHECKPOINT_DIR] = str(ckpt_dir)
                 env[ENV_RESUME_STEP] = str(resume_step)
+                if restore_peers:
+                    # Peer warm-restore hint: depot URLs of live hosts a
+                    # recreated gang may pull committed shards from before
+                    # touching disk (rendezvous/statechannel.py). Advisory —
+                    # the workload's decision order still falls back to
+                    # disk when no peer holds a step >= the disk step.
+                    env[ENV_RESTORE_PEERS] = json.dumps(restore_peers)
             chips = rs.template.chips_per_process or job.spec.topology.chips_per_host
             procs.append(
                 Process(
@@ -1713,8 +1769,10 @@ class TPUJobController:
             if queued is not None:
                 self.tracer.close(queued["ns"], queued["name"], end)
             self._observe_first_step(job)
+            self._observe_ckpt_spans(job)
             self._sched_observed.discard(uid)
             self._ttfs_observed.discard(uid)
+            self._ckpt_observed.discard(uid)
         self._delete_children(
             job.metadata.namespace, job.metadata.name, job.spec.run_policy.cleanup_policy
         )

@@ -13,7 +13,7 @@ import json
 import os
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict
+from typing import Any, Dict, List
 
 from tf_operator_tpu.rendezvous.env import (
     ENV_API_SERVER,
@@ -26,10 +26,12 @@ from tf_operator_tpu.rendezvous.env import (
     ENV_MESH_AXES,
     ENV_NAMESPACE,
     ENV_NUM_PROCESSES,
+    ENV_PEER_DEPOT,
     ENV_PORT,
     ENV_PROCESS_ID,
     ENV_REPLICA_INDEX,
     ENV_REPLICA_TYPE,
+    ENV_RESTORE_PEERS,
     ENV_RESUME_STEP,
     ENV_TRACE_ID,
     ENV_WORKLOAD,
@@ -62,6 +64,12 @@ class JobContext:
     # resume_step batches. 0 on a cold first incarnation.
     resume_step: int = 0
     checkpoint_dir: str = ""
+    # Peer warm-restore contract (rendezvous/statechannel.py): this host's
+    # shard-depot URL (push committed checkpoint shards here) and the live
+    # hosts' depot URLs a restarted member may pull warm state from before
+    # touching disk. Both empty when the deployment runs without depots.
+    peer_depot: str = ""
+    restore_peers: List[str] = field(default_factory=list)
     # Trace context (obs/): the job's trace id (its uid), injected by the
     # controller so workload-recorded spans (first-step, checkpoint
     # save/restore) join the controller/scheduler/agent timeline.
@@ -86,6 +94,8 @@ class JobContext:
             entrypoint=e.get(ENV_ENTRYPOINT, ""),
             resume_step=int(e.get(ENV_RESUME_STEP, "0") or 0),
             checkpoint_dir=e.get(ENV_CHECKPOINT_DIR, ""),
+            peer_depot=e.get(ENV_PEER_DEPOT, ""),
+            restore_peers=json.loads(e.get(ENV_RESTORE_PEERS, "[]") or "[]"),
             trace_id=e.get(ENV_TRACE_ID, ""),
         )
 
@@ -174,6 +184,31 @@ class JobContext:
             "first-step", now, now,
             attrs={"step": str(step), "track": "first-step"},
             name=first_step_span_name(self.job_name, self.trace_id),
+        )
+
+    def record_save_stall(self, step: int, start: float, end: float) -> bool:
+        """Record the step-loop stall one accepted checkpoint save caused
+        (the async pipeline's overlap receipt: span width = staging copy,
+        NOT the device→host fetch or disk write, which run behind it).
+        The reconciler folds these into the
+        ``tpujob_checkpoint_save_stall_seconds`` histogram at terminal."""
+        return self.record_span(
+            "checkpoint-save-stall", start, end,
+            attrs={"step": str(step), "track": "checkpoint"},
+        )
+
+    def record_restore(
+        self, source: str, step: int, start: float, end: float
+    ) -> bool:
+        """Record one warm restore with its source ("peer" when state was
+        pulled from a surviving host's shard depot, "disk" otherwise) —
+        folded into ``tpujob_restore_seconds{source}`` at terminal, and
+        the span the chaos soak reads effective recovery downtime from."""
+        return self.record_span(
+            "restore", start, end,
+            attrs={
+                "source": source, "step": str(step), "track": "checkpoint",
+            },
         )
 
     # -- result reporting --------------------------------------------------

@@ -68,6 +68,22 @@ ENV_API_SERVER = "TPUJOB_API_SERVER"
 ENV_CHECKPOINT_DIR = "TPUJOB_CHECKPOINT_DIR"
 ENV_RESUME_STEP = "TPUJOB_RESUME_STEP"
 
+# Peer warm-restore contract (rendezvous/statechannel.py), stamped next to
+# the warm-restart env above:
+#
+# - ``TPUJOB_PEER_DEPOT``    — this HOST's shard-depot URL (injected by the
+#                              host agent's backend, not the controller): the
+#                              loopback endpoint a workload pushes committed
+#                              checkpoint shards to, so they survive gang
+#                              teardown.
+# - ``TPUJOB_RESTORE_PEERS`` — JSON list of live hosts' depot URLs (stamped
+#                              by the controller on every created gang
+#                              member): the candidate warm-restore sources a
+#                              restarted member pulls state from before
+#                              falling back to disk.
+ENV_PEER_DEPOT = "TPUJOB_PEER_DEPOT"
+ENV_RESTORE_PEERS = "TPUJOB_RESTORE_PEERS"
+
 # Trace context (obs/): the job's trace id — its uid — injected by the
 # controller into every created gang member (alongside the warm-restart
 # env above) so spans recorded by the agent/backend and by the workload

@@ -1,0 +1,347 @@
+"""Peer state-transfer channel: host-level shard depot + warm-restore client.
+
+The reference operator restarts a failed gang and lets the workload reload
+its checkpoint from shared storage — at flagship scale that disk round-trip
+IS the MTTR floor (BASELINE r4/r5: ~400 s for 11.6 GB of state). This
+module is the restore-side half of the async-checkpoint work: a restarted
+gang member pulls the committed host-side shard bytes directly from a
+surviving peer instead of touching disk at all.
+
+Why host-level and not gang-level: a gang restart in this operator deletes
+and recreates EVERY member — no gang process survives to serve its shards.
+The :class:`ShardDepot` therefore lives next to the :class:`HostAgent`
+(runtime/agent.py), which outlives gang teardowns; the workload pushes each
+COMMITTED checkpoint step to its local depot over loopback
+(``TPUJOB_PEER_DEPOT``), and a recreated member is handed the depot
+endpoints of live hosts by the controller (``TPUJOB_RESTORE_PEERS``, next
+to the existing warm-restart env).
+
+Wire protocol (stdlib HTTP, no new deps):
+
+- ``GET  /depot/v1/steps?ns=&job=``                → ``{"steps": [int]}``
+  (committed steps only — an in-flight push is invisible)
+- ``GET  /depot/v1/files?ns=&job=&step=``          → ``{"files": {rel: sha256}}``
+- ``GET  /depot/v1/shard?ns=&job=&step=&file=``    → raw bytes
+  (+ ``X-Shard-SHA256`` trailer-by-header for end-to-end verification)
+- ``PUT  /depot/v1/shard?ns=&job=&step=&file=``    → stage one file
+- ``POST /depot/v1/commit?ns=&job=&step=``         → staged → committed
+
+Commit ordering mirrors the on-disk contract (train/checkpoint.py): a
+step is served only after its commit POST, and a fetched step materializes
+on the restorer's disk with the commit-marker file (``manifest.json`` /
+orbax markers) written LAST — so a fetch torn by a dying peer can never
+become a resume point; the caller falls back to the next peer, then disk.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger("tpujob.statechannel")
+
+# Files that mark a step directory COMMITTED (train/checkpoint.py): written
+# last on fetch so a torn download is never discoverable as a resume point.
+COMMIT_MARKER_FILES = ("manifest.json", "_CHECKPOINT_METADATA", "commit_success.txt")
+
+_MAX_SHARD_BYTES = 1 << 31  # sanity bound on a single served file
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+class ShardDepot:
+    """In-memory, host-lifetime store of committed checkpoint shards.
+
+    One per host agent. Holds the last ``keep`` committed steps per
+    (namespace, job) in host RAM — the state a surviving host can hand a
+    restarted gang without any disk round-trip. Not durable by design:
+    durability is the disk checkpoint's job; the depot is purely the warm
+    path, and losing it degrades a restore to disk, never to data loss.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, keep: int = 2) -> None:
+        self.keep = int(keep)
+        self._lock = threading.Lock()
+        # (ns, job) -> {step: {relpath: bytes}} — committed, servable.
+        self._committed: Dict[Tuple[str, str], Dict[int, Dict[str, bytes]]] = {}
+        # (ns, job, step) -> {relpath: bytes} — staged by PUTs, invisible
+        # until the commit POST promotes it.
+        self._staging: Dict[Tuple[str, str, int], Dict[str, bytes]] = {}
+        depot = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: D102 — silence stdlib
+                log.debug("depot %s " + fmt, self.client_address[0], *args)
+
+            def _q(self):
+                parsed = urllib.parse.urlparse(self.path)
+                return parsed.path, dict(urllib.parse.parse_qsl(parsed.query))
+
+            def _reply(self, code: int, body: bytes = b"", headers=()):
+                self.send_response(code)
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def _json(self, obj) -> None:
+                self._reply(200, json.dumps(obj).encode(),
+                            [("Content-Type", "application/json")])
+
+            def do_GET(self):
+                path, q = self._q()
+                ns, jobname = q.get("ns", "default"), q.get("job", "")
+                if path == "/depot/v1/steps":
+                    self._json({"steps": depot.steps(ns, jobname)})
+                elif path == "/depot/v1/files":
+                    files = depot.files(ns, jobname, int(q.get("step", "0")))
+                    if files is None:
+                        self._reply(404)
+                    else:
+                        self._json({"files": files})
+                elif path == "/depot/v1/shard":
+                    data = depot.shard(
+                        ns, jobname, int(q.get("step", "0")), q.get("file", "")
+                    )
+                    if data is None:
+                        self._reply(404)
+                    else:
+                        self._reply(200, data, [
+                            ("Content-Type", "application/octet-stream"),
+                            ("X-Shard-SHA256", _sha256(data)),
+                        ])
+                else:
+                    self._reply(404)
+
+            def do_PUT(self):
+                path, q = self._q()
+                if path != "/depot/v1/shard":
+                    self._reply(404)
+                    return
+                n = int(self.headers.get("Content-Length", "0"))
+                if n < 0 or n > _MAX_SHARD_BYTES:
+                    self._reply(413)
+                    return
+                data = self.rfile.read(n)
+                depot.stage(
+                    q.get("ns", "default"), q.get("job", ""),
+                    int(q.get("step", "0")), q.get("file", ""), data,
+                )
+                self._reply(200)
+
+            def do_POST(self):
+                path, q = self._q()
+                if path != "/depot/v1/commit":
+                    self._reply(404)
+                    return
+                ok = depot.commit(
+                    q.get("ns", "default"), q.get("job", ""),
+                    int(q.get("step", "0")),
+                )
+                self._reply(200 if ok else 409)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"shard-depot-{self.port}",
+        )
+        self._thread.start()
+
+    # -- depot-side operations (also callable in-process) ------------------
+
+    def stage(self, ns: str, job: str, step: int, relpath: str, data: bytes) -> None:
+        with self._lock:
+            self._staging.setdefault((ns, job, int(step)), {})[relpath] = data
+
+    def commit(self, ns: str, job: str, step: int) -> bool:
+        """Promote a staged step to committed/servable; prune beyond keep."""
+        step = int(step)
+        with self._lock:
+            files = self._staging.pop((ns, job, step), None)
+            if not files:
+                return False
+            per_job = self._committed.setdefault((ns, job), {})
+            per_job[step] = files
+            for old in sorted(per_job)[: max(0, len(per_job) - self.keep)]:
+                del per_job[old]
+        return True
+
+    def steps(self, ns: str, job: str) -> List[int]:
+        with self._lock:
+            return sorted(self._committed.get((ns, job), {}))
+
+    def files(self, ns: str, job: str, step: int) -> Optional[Dict[str, str]]:
+        with self._lock:
+            fs = self._committed.get((ns, job), {}).get(int(step))
+            if fs is None:
+                return None
+            return {rel: _sha256(data) for rel, data in fs.items()}
+
+    def shard(self, ns: str, job: str, step: int, relpath: str) -> Optional[bytes]:
+        with self._lock:
+            return self._committed.get((ns, job), {}).get(int(step), {}).get(relpath)
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+
+
+class DepotClient:
+    """Workload-side client: push committed steps up, pull warm state down.
+
+    Every method is best-effort and returns None/False/[] on any transport
+    or integrity failure — a peer dying mid-transfer must degrade to the
+    next restore source, never crash the restoring workload."""
+
+    def __init__(self, timeout: float = 10.0) -> None:
+        self.timeout = timeout
+
+    # -- transport helpers -------------------------------------------------
+
+    def _get(self, base: str, path: str, q: Dict[str, str]):
+        url = f"{base}{path}?{urllib.parse.urlencode(q)}"
+        return urllib.request.urlopen(url, timeout=self.timeout)  # noqa: S310
+
+    def _json(self, base: str, path: str, q: Dict[str, str]):
+        with self._get(base, path, q) as resp:
+            return json.loads(resp.read().decode())
+
+    # -- push (serving side feed) -----------------------------------------
+
+    def push_step(self, depot_url: str, ns: str, job: str, step: int,
+                  step_dir: str) -> bool:
+        """Upload one COMMITTED on-disk step directory to a depot, then
+        commit it there. Caller must only push after the local disk commit
+        (the on_commit seam in CheckpointManager guarantees that)."""
+        try:
+            for root, _dirs, names in os.walk(step_dir):
+                for name in names:
+                    full = os.path.join(root, name)
+                    rel = os.path.relpath(full, step_dir)
+                    with open(full, "rb") as f:
+                        data = f.read()
+                    q = {"ns": ns, "job": job, "step": str(step), "file": rel}
+                    url = f"{depot_url}/depot/v1/shard?{urllib.parse.urlencode(q)}"
+                    req = urllib.request.Request(url, data=data, method="PUT")
+                    with urllib.request.urlopen(req, timeout=self.timeout):  # noqa: S310
+                        pass
+            q = {"ns": ns, "job": job, "step": str(step)}
+            url = f"{depot_url}/depot/v1/commit?{urllib.parse.urlencode(q)}"
+            req = urllib.request.Request(url, data=b"", method="POST")
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:  # noqa: S310
+                return resp.status == 200
+        except (OSError, urllib.error.URLError, ValueError) as exc:
+            log.warning("depot push of step %d to %s failed: %s", step, depot_url, exc)
+            return False
+
+    # -- pull (restore side) ----------------------------------------------
+
+    def steps(self, depot_url: str, ns: str, job: str) -> List[int]:
+        try:
+            return [int(s) for s in
+                    self._json(depot_url, "/depot/v1/steps", {"ns": ns, "job": job})["steps"]]
+        except (OSError, urllib.error.URLError, ValueError, KeyError):
+            return []
+
+    def best_peer(self, peers: List[str], ns: str, job: str) -> Tuple[Optional[str], int]:
+        """(depot_url, step) of the highest committed step across peers;
+        (None, 0) when no peer holds anything. Dead peers are skipped."""
+        best_url, best_step = None, 0
+        for url in peers:
+            steps = self.steps(url, ns, job)
+            if steps and steps[-1] > best_step:
+                best_url, best_step = url, steps[-1]
+        return best_url, best_step
+
+    def fetch_step(self, depot_url: str, ns: str, job: str, step: int,
+                   dest_root: str) -> Optional[str]:
+        """Materialize a peer's committed step as a COMMITTED step
+        directory under ``dest_root`` (the restorer's checkpoint dir), so
+        the ordinary disk-restore path loads it bit-identically.
+
+        Integrity + commit ordering: every file is verified against the
+        peer's sha256 before landing, data files are written to a temp dir
+        first, commit-marker files (COMMIT_MARKER_FILES) are written LAST,
+        and the temp dir is atomically renamed into place — a peer dying
+        mid-transfer leaves an unfinished temp dir, never a resume point.
+        Returns the final step path, or None on any failure (caller falls
+        back to the next peer, then disk)."""
+        import shutil
+
+        step = int(step)
+        final = os.path.join(dest_root, f"step_{step}")
+        if os.path.exists(os.path.join(final, "manifest.json")):
+            return final  # disk already holds this committed step
+        q = {"ns": ns, "job": job, "step": str(step)}
+        tmp = os.path.join(dest_root, f".peerfetch_step_{step}_{os.getpid()}")
+        try:
+            listing = self._json(depot_url, "/depot/v1/files", q)["files"]
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp)
+            markers = [r for r in listing if os.path.basename(r) in COMMIT_MARKER_FILES]
+            data_files = [r for r in listing if r not in markers]
+            if not markers:
+                log.warning("peer %s step %d has no commit marker; refusing",
+                            depot_url, step)
+                shutil.rmtree(tmp, ignore_errors=True)
+                return None
+            for rel in data_files + markers:  # markers strictly last
+                with self._get(depot_url, "/depot/v1/shard", {**q, "file": rel}) as resp:
+                    data = resp.read()
+                    want = resp.headers.get("X-Shard-SHA256", "")
+                if want and _sha256(data) != want:
+                    raise ValueError(f"sha256 mismatch on {rel}")
+                full = os.path.join(tmp, rel)
+                os.makedirs(os.path.dirname(full), exist_ok=True)
+                with open(full, "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+            os.makedirs(dest_root, exist_ok=True)
+            try:
+                os.rename(tmp, final)
+            except OSError:
+                shutil.rmtree(tmp, ignore_errors=True)  # lost a race; theirs won
+            return final
+        except (OSError, urllib.error.URLError, ValueError, KeyError) as exc:
+            log.warning("peer fetch of step %d from %s failed: %s — falling back",
+                        step, depot_url, exc)
+            shutil.rmtree(tmp, ignore_errors=True)
+            return None
+
+
+def choose_restore_source(
+    peers: List[str], ns: str, job: str, disk_step: int,
+    client: Optional[DepotClient] = None,
+) -> Tuple[str, Optional[str], int]:
+    """The restore-source decision order (docs/design.md §4.9):
+
+    1. **peer** — some live depot holds a committed step >= the newest
+       complete step on disk (and > 0): pull from that peer; no disk read.
+    2. **disk** — otherwise (no peers, peers behind disk, peers dead).
+
+    Returns ``(source, depot_url, step)`` where source is "peer" or
+    "disk"; for disk the url is None and step is ``disk_step``. A peer
+    strictly BEHIND disk is never chosen — restoring older state than the
+    controller-declared resume step would violate monotonic resume."""
+    client = client or DepotClient()
+    url, peer_step = client.best_peer(peers, ns, job)
+    if url is not None and peer_step > 0 and peer_step >= disk_step:
+        return "peer", url, peer_step
+    return "disk", None, disk_step

@@ -55,7 +55,18 @@ class HostAgent:
         backend: Optional[LocalProcessControl] = None,
         heartbeat_interval: float = DEFAULT_HEARTBEAT_INTERVAL,
         log_dir: Optional[str] = None,
+        depot: bool = False,
+        depot_keep: int = 2,
     ) -> None:
+        """``depot=True`` starts a host-lifetime shard depot
+        (rendezvous/statechannel.py): workloads on this host push each
+        COMMITTED checkpoint step to it over loopback
+        (``TPUJOB_PEER_DEPOT``, injected via the backend's host-local
+        env), and because the depot outlives gang teardowns — unlike any
+        gang member — a restarted gang can pull warm state from it
+        through the controller-stamped ``TPUJOB_RESTORE_PEERS`` instead
+        of re-reading disk. The depot URL is announced on the Host record
+        (``spec.depot_url``) so the controller can stamp it."""
         self.store = store
         self.name = name
         self.spec = HostSpec(
@@ -65,6 +76,14 @@ class HostAgent:
             max_processes=max_processes,
         )
         self.backend = backend or LocalProcessControl(store, log_dir=log_dir)
+        self.depot = None
+        if depot:
+            from tf_operator_tpu.rendezvous.env import ENV_PEER_DEPOT
+            from tf_operator_tpu.rendezvous.statechannel import ShardDepot
+
+            self.depot = ShardDepot(host=address, keep=depot_keep)
+            self.spec.depot_url = self.depot.url
+            self.backend.extra_env[ENV_PEER_DEPOT] = self.depot.url
         self.heartbeat_interval = heartbeat_interval
         self._stop = threading.Event()
         self._threads: list = []
@@ -110,6 +129,11 @@ class HostAgent:
         except Exception as exc:
             log.warning("agent %s: could not mark NotReady (%s)", self.name, exc)
         self.backend.shutdown()
+        if self.depot is not None:
+            # Last: a draining host keeps SERVING shards until the very
+            # end — the preempted gang's replacement may be pulling from
+            # this depot right now.
+            self.depot.stop()
         for t in self._threads:
             t.join(timeout=5)
 

@@ -147,6 +147,10 @@ class HostSpec:
     # interconnect with): gangs pack onto the fewest domains. "" means the
     # host is its own domain (single-host rack, DCN-only fleet).
     topology_domain: str = ""
+    # Shard-depot endpoint (rendezvous/statechannel.py): where this host
+    # serves committed checkpoint shards for peer warm restore. "" means
+    # the host runs no depot — restores on it fall back to disk.
+    depot_url: str = ""
 
 
 @dataclass

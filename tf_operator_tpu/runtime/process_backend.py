@@ -111,11 +111,19 @@ class LocalProcessControl(ProcessControl):
         command_builder: Callable[[Process], List[str]] = default_command_builder,
         inherit_env: bool = True,
         log_dir: Optional[str] = None,
+        extra_env: Optional[Dict[str, str]] = None,
     ) -> None:
         self._store = store
         self._command_builder = command_builder
         self._inherit_env = inherit_env
         self._log_dir = log_dir
+        # Host-local env injected into every launched child, between the
+        # identity env and the controller-provided spec env (controller
+        # still wins on conflicts). The host agent uses this for values
+        # only the host knows — e.g. its shard-depot URL
+        # (TPUJOB_PEER_DEPOT), which the controller cannot stamp because
+        # it is per-host, not per-job.
+        self.extra_env: Dict[str, str] = dict(extra_env or {})
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
         self._lock = threading.Lock()
@@ -322,9 +330,11 @@ class LocalProcessControl(ProcessControl):
         key = process.key()
         uid = process.metadata.uid
         env = dict(os.environ) if self._inherit_env else {}
-        # Identity first, then controller-provided env (controller wins on
-        # conflicts — it may override e.g. the entrypoint for a debug run).
+        # Identity first, then host-local extras, then controller-provided
+        # env (controller wins on conflicts — it may override e.g. the
+        # entrypoint for a debug run).
         env.update(identity_env(process.spec, process.metadata.namespace))
+        env.update(self.extra_env)
         env.update(process.spec.env)
         log_path = process.metadata.annotations.get(self.LOG_ANNOTATION)
         spawn_t = time.time()

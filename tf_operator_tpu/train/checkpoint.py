@@ -23,11 +23,23 @@ Two backends behind one API:
 - **npy** (dependency-free fallback): one ``.npy`` per leaf plus a JSON
   tree manifest, written to a temp dir and atomically renamed. Requires
   fully-addressable arrays (single-host); restore ``device_put``s onto the
-  template's shardings.
+  template's shardings. With ``async_save`` (r8) the npy backend runs the
+  chunked staging pipeline: ``save()`` only pays a device-side staging
+  copy of the state (donation-safe — the step loop may immediately reuse
+  the donated buffers), then a background drain moves staged leaves
+  device→host and to disk in fixed-byte quanta, releasing each staging
+  buffer as its leaf lands. The ONLY hard fence is the commit-marker
+  write (``manifest.json`` written fsync'd-last into the temp dir, then
+  one atomic rename) — the same contract ``latest_checkpoint_step()``
+  already requires, so a crash anywhere in the pipeline leaves a
+  ``.tmp_step_*`` orphan, never a resumable torn step.
 
 Both are step-indexed directories with keep-N retention and
 ``latest_step()`` discovery, so "resume" is simply
-``trainer.restore_or_init(key, manager)``.
+``trainer.restore_or_init(key, manager)``. ``on_commit`` fires once per
+step that actually COMMITTED — the seam the peer shard depot
+(rendezvous/statechannel.py) feeds from, so peers only ever serve state a
+crash could also have restored from disk.
 """
 
 from __future__ import annotations
@@ -37,7 +49,9 @@ import logging
 import os
 import re
 import shutil
-from typing import Any, Dict, List, Optional
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
 
 log = logging.getLogger("tpujob.checkpoint")
 
@@ -135,23 +149,49 @@ class CheckpointManager:
         backend: str = "auto",
         readonly: bool = False,
         async_save: bool = True,
+        chunk_bytes: int = 64 << 20,
+        on_commit: Optional[Callable[[int, str], None]] = None,
     ) -> None:
         """``readonly=True`` is for consumers of someone else's checkpoint
         directory (evaluators): saves are refused and the npy orphan sweep
         is skipped — a live writer may legitimately own a .tmp dir.
 
-        ``async_save`` (orbax only): device→host transfer happens inside
-        ``save()`` (so donated step buffers stay safe), but the disk write
-        runs in a background thread — the step loop overlaps it instead of
-        stalling for the full serialization. Each ``save()`` fences the
-        PREVIOUS in-flight write first, and ``save(..., wait=True)`` /
+        ``async_save``: device→host transfer overlaps subsequent training
+        steps instead of stalling the step loop for the full fetch. With
+        orbax, ``save()`` pays the device→host transfer (donated step
+        buffers stay safe) and the disk write runs in orbax's background
+        thread. With npy, ``save()`` pays only a device-side STAGING copy
+        (bounded by HBM bandwidth, not PCIe) and a background drain moves
+        staged leaves device→host→disk in ``chunk_bytes`` quanta,
+        releasing each staging buffer as its leaf lands. In both cases at
+        most one write is in flight; ``save(..., wait=True)`` /
         ``wait_until_finished()`` / ``close()`` fence completion — the
         final save of a job must be fenced or the process can exit with a
-        torn checkpoint (WorkloadCheckpointer.final does)."""
+        torn checkpoint (WorkloadCheckpointer.final does).
+
+        ``on_commit(step, step_dir)`` fires after a step COMMITS on disk
+        (npy backend; after the atomic rename) — the peer shard depot's
+        feed. Exceptions in the hook are logged, never raised: publishing
+        to peers is best-effort, the disk commit already happened.
+
+        ``last_save_stall_s`` after each accepted save is the wall time
+        the CALLER was blocked — the step-loop stall the async pipeline
+        exists to shrink."""
         self.directory = os.path.abspath(str(directory))
         self.keep = int(keep)
         self.readonly = bool(readonly)
         self.async_save = bool(async_save)
+        self.chunk_bytes = max(1 << 20, int(chunk_bytes))
+        self.on_commit = on_commit
+        self.last_save_stall_s = 0.0
+        # npy async pipeline state: at most one drain thread in flight.
+        self._drain: Optional[threading.Thread] = None
+        self._drain_step: Optional[int] = None
+        self._drain_error: Optional[BaseException] = None
+        # Test seam: called as _fault_hook(phase, step) with phase in
+        # {"leaf", "manifest", "commit"} from inside the drain — lets
+        # tests crash the pipeline between any two phases.
+        self._fault_hook: Optional[Callable[[str, int], None]] = None
         os.makedirs(self.directory, exist_ok=True)
         if backend == "auto":
             try:
@@ -169,8 +209,17 @@ class CheckpointManager:
             # (enforced in _npy_save), so nothing live can own these —
             # except when we are a readonly reader of a live writer's dir.
             for name in os.listdir(self.directory):
-                if name.startswith(".tmp_step_"):
-                    shutil.rmtree(os.path.join(self.directory, name), ignore_errors=True)
+                if not name.startswith(".tmp_step_"):
+                    continue
+                # Tmp names end in the writer's pid: skip OUR pid — a
+                # second manager in this process may have an async drain
+                # live in that dir right now (crashed writers restart
+                # with a new pid, so their orphans still sweep; a pid
+                # collision merely defers cleanup to that step's next
+                # save, which re-creates its tmp from scratch).
+                if name.endswith(f"_{os.getpid()}"):
+                    continue
+                shutil.rmtree(os.path.join(self.directory, name), ignore_errors=True)
         if backend == "orbax":
             import orbax.checkpoint as ocp
 
@@ -189,11 +238,18 @@ class CheckpointManager:
     def all_steps(self) -> List[int]:
         if self._ocp_mgr is not None:
             return sorted(self._ocp_mgr.all_steps())
-        steps = []
+        steps = set()
         for name in os.listdir(self.directory):
             m = _STEP_DIR.match(name)
             if m and os.path.exists(os.path.join(self.directory, name, "manifest.json")):
-                steps.append(int(m.group(1)))
+                steps.add(int(m.group(1)))
+        # Read-your-own-writes, matching the orbax step cache: an ACCEPTED
+        # async save counts as existing — it will commit, or its failure
+        # surfaces (and the step vanishes from this list) at the next
+        # fence. Restore paths fence before reading, so they only ever
+        # load committed bytes.
+        if self._drain_step is not None and self._drain_error is None:
+            steps.add(self._drain_step)
         return sorted(steps)
 
     def latest_step(self) -> Optional[int]:
@@ -224,23 +280,140 @@ class CheckpointManager:
             raise RuntimeError("CheckpointManager is readonly; refusing to save")
         step = int(step)
         tree = _to_tree(state)
-        if self._ocp_mgr is not None:
-            # completion-fence the previous in-flight save (no-op when sync
-            # or idle) BEFORE the step check so a just-finalized step lists
-            self._ocp_mgr.wait_until_finished()
-            if step in self._ocp_mgr.all_steps():
-                return False
-            saved = self._ocp_mgr.save(step, args=self._ocp.args.StandardSave(tree))
-            if wait or not self.async_save:
+        t0 = time.perf_counter()
+        try:
+            if self._ocp_mgr is not None:
+                # Step check FIRST, against the cached step list: a
+                # duplicate-step save (controllers re-drive saves
+                # idempotently) must return without paying a completion
+                # fence on the PREVIOUS in-flight write. The cache can
+                # only miss a step that is itself mid-write — the fence
+                # below, required anyway before starting a new write (at
+                # most one in flight), makes the re-check authoritative.
+                if step in self._ocp_mgr.all_steps():
+                    return False
                 self._ocp_mgr.wait_until_finished()
-            return bool(saved)
-        return self._npy_save(step, tree)
+                if step in self._ocp_mgr.all_steps():
+                    return False  # the write just fenced WAS this step
+                saved = self._ocp_mgr.save(step, args=self._ocp.args.StandardSave(tree))
+                if wait or not self.async_save:
+                    self._ocp_mgr.wait_until_finished()
+                return bool(saved)
+            if self.async_save:
+                accepted = self._npy_save_async(step, tree)
+                if wait and accepted:
+                    self.wait_until_finished()
+                return accepted
+            return self._npy_save(step, tree)
+        finally:
+            self.last_save_stall_s = time.perf_counter() - t0
 
     def wait_until_finished(self) -> None:
-        """Block until any in-flight async save is committed (orbax);
-        no-op for the synchronous npy backend."""
+        """Block until any in-flight async save is committed. Re-raises a
+        background drain failure ONCE (then clears it): a save that died
+        mid-pipeline never committed, and the caller deciding to exit or
+        retry must hear about it at the next fence, not from a log line."""
         if self._ocp_mgr is not None:
             self._ocp_mgr.wait_until_finished()
+            return
+        drain = self._drain
+        if drain is not None:
+            drain.join()
+            self._drain = None
+            self._drain_step = None
+        err, self._drain_error = self._drain_error, None
+        if err is not None:
+            raise RuntimeError(
+                f"async checkpoint drain failed (step never committed): {err}"
+            ) from err
+
+    # -- chunked async pipeline (npy backend) -----------------------------
+
+    def _npy_save_async(self, step: int, tree: Any) -> bool:
+        """Stage-and-drain save: the caller pays only the device-side
+        staging copy; the device→host fetch and disk write overlap the
+        caller's subsequent steps. Same step-check-then-fence order as
+        the orbax path (a duplicate-step save never fences)."""
+        if step in self.all_steps():
+            return False
+        self.wait_until_finished()  # at most one drain in flight
+        if step in self.all_steps():
+            return False  # the drain just fenced committed this step
+        staged = _stage_tree(tree)  # donation-safe; THIS is the stall
+        self._drain_step = step
+        self._drain = threading.Thread(
+            target=self._npy_drain, args=(step, staged), daemon=True,
+            name=f"ckpt-drain-{step}",
+        )
+        self._drain.start()
+        return True
+
+    def _npy_drain(self, step: int, staged: Any) -> None:
+        """Background half of the async save. Durability per phase:
+        nothing before the final rename is discoverable (tmp dir name is
+        dot-prefixed and latest_checkpoint_step requires the manifest), so
+        a crash at ANY point here is an orphan sweep, not a torn resume
+        point. Each staged device buffer is released as soon as its leaf
+        reaches the host — peak staging memory decays during the drain."""
+        import jax
+        import numpy as np
+
+        try:
+            final = os.path.join(self.directory, f"step_{step}")
+            tmp = os.path.join(self.directory, f".tmp_step_{step}_{os.getpid()}")
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp)
+            leaves_with_path = jax.tree_util.tree_flatten_with_path(staged)[0]
+            manifest: Dict[str, Any] = {"step": step, "leaves": []}
+            for i, (path, leaf) in enumerate(leaves_with_path):
+                if self._fault_hook is not None:
+                    self._fault_hook("leaf", step)
+                arr = np.asarray(leaf)  # device -> host, one leaf at a time
+                _write_npy_chunked(
+                    os.path.join(tmp, f"leaf_{i}.npy"), arr, self.chunk_bytes
+                )
+                if hasattr(leaf, "delete"):
+                    try:
+                        leaf.delete()  # release the staging copy early
+                    except Exception:  # noqa: BLE001 — freeing is advisory
+                        pass
+                manifest["leaves"].append(
+                    {
+                        "path": jax.tree_util.keystr(path),
+                        "index": i,
+                        "shape": list(arr.shape),
+                        "dtype": str(arr.dtype),
+                    }
+                )
+            if self._fault_hook is not None:
+                self._fault_hook("manifest", step)
+            # Commit marker, fsync'd: the manifest is what makes the step
+            # discoverable — it must be durable BEFORE the rename
+            # publishes the directory.
+            with open(os.path.join(tmp, "manifest.json"), "w") as f:
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            if self._fault_hook is not None:
+                self._fault_hook("commit", step)
+            try:
+                os.rename(tmp, final)  # THE commit
+            except OSError:
+                shutil.rmtree(tmp, ignore_errors=True)
+                return  # lost a same-step race; theirs is complete
+            self._npy_prune()
+            self._fire_on_commit(step, final)
+        except BaseException as exc:  # noqa: BLE001 — surfaced at next fence
+            self._drain_error = exc
+            log.warning("async checkpoint drain for step %d failed: %s", step, exc)
+
+    def _fire_on_commit(self, step: int, step_dir: str) -> None:
+        if self.on_commit is None:
+            return
+        try:
+            self.on_commit(step, step_dir)
+        except Exception:  # noqa: BLE001 — peer publish is best-effort
+            log.exception("on_commit hook failed for step %d", step)
 
     def _npy_save(self, step: int, tree: Any) -> bool:
         import jax
@@ -282,6 +455,7 @@ class CheckpointManager:
             shutil.rmtree(tmp, ignore_errors=True)
             return False
         self._npy_prune()
+        self._fire_on_commit(step, final)
         return True
 
     def _npy_prune(self) -> None:
@@ -411,6 +585,11 @@ class CheckpointManager:
         arrays = []
         for (path, tmpl_leaf), rec in zip(paths, records):
             arr = np.load(os.path.join(d, f"leaf_{rec['index']}.npy"))
+            if "dtype" in rec and arr.dtype != np.dtype(rec["dtype"]):
+                # Extension dtypes (bfloat16, fp8) round-trip through .npy
+                # as raw void bytes ('V2'); the manifest carries the real
+                # dtype — a same-itemsize view restores it losslessly.
+                arr = arr.view(np.dtype(rec["dtype"]))
             if "shape" in rec:
                 # Path equality alone misses same-structure config drift
                 # (d_model or dtype changed between save and restore) —
@@ -437,6 +616,8 @@ class CheckpointManager:
         if self._ocp_mgr is not None:
             self._ocp_mgr.wait_until_finished()
             self._ocp_mgr.close()
+            return
+        self.wait_until_finished()  # fence the npy drain before exit
 
 
 class WorkloadCheckpointer:
@@ -444,31 +625,115 @@ class WorkloadCheckpointer:
 
     Config keys (from the TPUJob workload dict): ``checkpoint_dir``,
     ``checkpoint_every`` (steps between saves, 0 = final only),
-    ``checkpoint_keep``. Tracks the step count on the HOST (mirroring
-    ``state.step``) so the hot loop never forces a device sync on
-    non-saving steps, and saves are keyed without fetching the step
-    scalar. Disabled (all methods no-ops) when ``checkpoint_dir`` is
-    unset.
+    ``checkpoint_keep``, ``checkpoint_async`` (default on),
+    ``checkpoint_backend`` (auto|npy|orbax). Tracks the
+    step count on the HOST (mirroring ``state.step``) so the hot loop
+    never forces a device sync on non-saving steps, and saves are keyed
+    without fetching the step scalar. Disabled (all methods no-ops) when
+    ``checkpoint_dir`` is unset.
+
+    With a :class:`~tf_operator_tpu.rendezvous.context.JobContext` passed
+    as ``ctx``, the checkpointer also speaks the peer warm-restore
+    protocol (rendezvous/statechannel.py): every committed step is pushed
+    to this host's shard depot (``TPUJOB_PEER_DEPOT``), restore consults
+    the controller-provided peer depots (``TPUJOB_RESTORE_PEERS``) before
+    disk, and save-stall / restore-source spans land in the job trace.
     """
 
-    def __init__(self, workload: Dict[str, Any]) -> None:
+    def __init__(self, workload: Dict[str, Any], ctx=None) -> None:
+        self.ctx = ctx
         self.manager: Optional[CheckpointManager] = None
         if workload.get("checkpoint_dir"):
             self.manager = CheckpointManager(
                 workload["checkpoint_dir"],
                 keep=int(workload.get("checkpoint_keep", 3)),
+                backend=str(workload.get("checkpoint_backend", "auto")),
+                async_save=bool(workload.get("checkpoint_async", True)),
+                on_commit=self._push_to_depot,
             )
         self.every = int(workload.get("checkpoint_every", 0))
         self._step = 0
         self.start_step = 0
+        # Per-accepted-save caller stall (seconds) — the overlap receipt.
+        self.save_stalls: List[float] = []
+        # "peer" | "disk" after a warm restore; "" cold / not restored.
+        self.restore_source = ""
+
+    # -- peer warm-restore protocol (rendezvous/statechannel.py) ----------
+
+    def _push_to_depot(self, step: int, step_dir: str) -> None:
+        """on_commit hook: publish a COMMITTED step to this host's shard
+        depot so it survives the gang teardown a restart implies. Runs on
+        the drain thread; best-effort by contract."""
+        if self.ctx is None or not getattr(self.ctx, "peer_depot", ""):
+            return
+        from tf_operator_tpu.rendezvous.statechannel import DepotClient
+
+        DepotClient().push_step(
+            self.ctx.peer_depot, self.ctx.namespace, self.ctx.job_name,
+            step, step_dir,
+        )
+
+    def prefetch_from_peers(self) -> str:
+        """Restore-source decision (docs/design.md §4.9): if a live peer
+        depot holds a committed step at least as new as the store's,
+        materialize it as a committed step dir under the checkpoint
+        directory — the ordinary disk-restore path then loads it
+        bit-identically. A tie deliberately goes to the PEER: at flagship
+        scale ``checkpoint_dir`` is slow bulk storage, and skipping its
+        read even for an already-known step is the protocol's entire
+        payoff (when the step is already materialized locally the fetch
+        is a no-op). Any peer failure (dead mid-transfer, integrity
+        mismatch) falls back to the next source. Returns the source the
+        subsequent restore will read from."""
+        if self.manager is None or self.ctx is None:
+            return "disk"
+        peers = list(getattr(self.ctx, "restore_peers", []) or [])
+        if not peers:
+            return "disk"
+        from tf_operator_tpu.rendezvous.statechannel import (
+            DepotClient,
+            choose_restore_source,
+        )
+
+        disk_step = self.manager.latest_step() or 0
+        client = DepotClient()
+        source, url, step = choose_restore_source(
+            peers, self.ctx.namespace, self.ctx.job_name, disk_step,
+            client=client,
+        )
+        if source != "peer":
+            return "disk"
+        fetched = client.fetch_step(
+            url, self.ctx.namespace, self.ctx.job_name, step,
+            self.manager.directory,
+        )
+        if fetched is None:
+            log.warning(
+                "peer restore of step %d from %s failed; falling back to "
+                "disk (step %d)", step, url, disk_step,
+            )
+            return "disk"
+        log.info("warm restore: pulled step %d from peer %s", step, url)
+        return "peer"
 
     def restore_or_init(self, trainer, key):
-        """Resume from the latest checkpoint or fresh-init; primes the
-        host-side step mirror."""
+        """Resume from the best warm source (peer depot, then latest disk
+        checkpoint) or fresh-init; primes the host-side step mirror and
+        records the restore-source span."""
+        t0 = time.time()
+        self.restore_source = self.prefetch_from_peers()
         state = trainer.restore_or_init(key, self.manager)
         self._step = self.start_step = int(state.step)
         if self.start_step:
-            log.info("resumed from checkpoint at step %d", self.start_step)
+            log.info(
+                "resumed from checkpoint at step %d (source=%s)",
+                self.start_step, self.restore_source,
+            )
+            if self.ctx is not None:
+                self.ctx.record_restore(
+                    self.restore_source, self.start_step, t0, time.time()
+                )
         return state
 
     def resume_step(self) -> int:
@@ -515,7 +780,21 @@ class WorkloadCheckpointer:
                     f"non-finite loss {float(loss)} at step {self._step}; "
                     "refusing to checkpoint a diverged state"
                 )
-            self.manager.save(self._step, state)
+            if self.manager.save(self._step, state):
+                self._note_save_stall(self._step)
+
+    def _note_save_stall(self, step: int) -> None:
+        """Record how long the step loop was actually blocked by the save
+        just accepted — with the async pipeline this is the staging copy,
+        not the device→host fetch or the disk write. Span lands in the
+        job trace (the overlap-window evidence `tpujob trace` shows)."""
+        import time as _time
+
+        stall = self.manager.last_save_stall_s
+        self.save_stalls.append(stall)
+        if self.ctx is not None:
+            now = _time.time()
+            self.ctx.record_save_stall(step, now - stall, now)
 
     def final(self, state) -> None:
         """Final save — call AFTER any throughput timing is read, so the
@@ -523,7 +802,8 @@ class WorkloadCheckpointer:
         the process may exit right after, and an unfenced async write
         would tear the checkpoint."""
         if self.manager is not None:
-            self.manager.save(self._step, state, wait=True)
+            if self.manager.save(self._step, state, wait=True):
+                self._note_save_stall(self._step)
 
     def run_loop(self, trainer, key, batch, steps: int, on_step=None,
                  device_loop: int = 1):
@@ -655,6 +935,60 @@ class WorkloadCheckpointer:
             raise AssertionError(f"non-finite loss {loss}")
         self.final(state)
         return state, loss, timed, step_s
+
+
+def _stage_tree(tree: Any) -> Any:
+    """Donation-safe staging snapshot of a state pytree.
+
+    The trainer's step is jitted with ``donate_argnums`` over params /
+    opt_state / extra — the moment the NEXT step runs, the buffers a save
+    captured may be reused. Staging makes a device-side copy of every
+    array leaf (an HBM→HBM copy, bounded by device memory bandwidth — the
+    deliberate, small stall) and blocks until the copies materialize; the
+    background drain then owns the copies outright and the step loop may
+    donate the originals immediately."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def one(leaf):
+        if isinstance(leaf, jax.Array):
+            return jnp.copy(leaf)
+        return np.array(leaf, copy=True)
+
+    staged = jax.tree_util.tree_map(one, tree)
+    jax.block_until_ready(staged)
+    return staged
+
+
+def _write_npy_chunked(path: str, arr, chunk_bytes: int) -> None:
+    """np.save-compatible .npy writer that streams the array body in
+    fixed-byte quanta instead of one write syscall — the disk half of the
+    chunked pipeline (a multi-GB leaf never pins one giant dirty buffer,
+    and the drain yields to the OS between quanta)."""
+    import numpy as np
+
+    arr = np.asarray(arr)
+    if not arr.flags["C_CONTIGUOUS"]:
+        # NOT ascontiguousarray unconditionally: it promotes 0-d arrays
+        # to shape (1,), which would corrupt the header (scalars like
+        # TrainState.step must round-trip 0-d).
+        arr = np.ascontiguousarray(arr)
+    with open(path, "wb") as f:
+        np.lib.format.write_array_header_1_0(
+            f, np.lib.format.header_data_from_array_1_0(arr)
+        )
+        if arr.ndim == 0:
+            f.write(arr.tobytes())  # a scalar is one (tiny) quantum
+            return
+        try:
+            mv = memoryview(arr).cast("B")
+        except (TypeError, ValueError):
+            # Extension dtypes (bfloat16, fp8) have no buffer protocol;
+            # a uint8 view of the contiguous body streams the same bytes.
+            mv = memoryview(arr.view(np.uint8)).cast("B")
+        for off in range(0, len(mv), chunk_bytes):
+            f.write(mv[off : off + chunk_bytes])
 
 
 def _abstractify(tree: Any) -> Any:

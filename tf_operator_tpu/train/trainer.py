@@ -476,6 +476,13 @@ class Trainer:
         return loss_sum * inv, new_extra, grads
 
     def _build_step(self):
+        # Donation contract for checkpointing: params/opt_state/extra are
+        # donated, so the moment the next step dispatches, buffers any
+        # in-flight save captured may be reused by XLA. The async save
+        # pipeline (checkpoint._stage_tree) therefore snapshots the state
+        # with a blocking device-side copy BEFORE returning control to the
+        # step loop — that copy is the save stall; everything after it
+        # (device->host fetch, chunked writes, commit) overlaps training.
         return jax.jit(self._step_body, donate_argnums=(0, 1, 3))
 
     # ---- multi-step (device loop) ---------------------------------------

@@ -76,7 +76,10 @@ def main(ctx: JobContext) -> None:
     )
     from tf_operator_tpu.train.checkpoint import WorkloadCheckpointer
 
-    ckpt = WorkloadCheckpointer(wl)
+    # ctx wires the warm-restore seam in: peer prefetch before disk
+    # (TPUJOB_RESTORE_PEERS), committed-step pushes to this host's depot
+    # (TPUJOB_PEER_DEPOT), and save-stall / restore spans on the timeline.
+    ckpt = WorkloadCheckpointer(wl, ctx=ctx)
     if ckpt.is_complete(steps):
         log.info("already complete (budget %d); nothing to do", steps)
         return

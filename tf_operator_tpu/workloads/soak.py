@@ -10,15 +10,24 @@ workload instead, selectable via ``chaos.soak --data-plane lm``):
 - every gang member paces ``steps`` wall-clock steps of ``step_sleep_s``
   (long enough for faults to land mid-run);
 - the chief (worker 0 / coordinator) drives the real checkpoint
-  subsystem — ``train.checkpoint.CheckpointManager`` saves every
-  ``checkpoint_every`` steps into ``checkpoint_dir`` and a resumed
-  incarnation continues from ``latest_step()`` instead of step 0,
-  logging the same "resumed from checkpoint at step N" line the
-  restart-recovery e2e pins.
+  subsystem — ``train.checkpoint.CheckpointManager`` via
+  ``WorkloadCheckpointer`` saves every ``checkpoint_every`` steps into
+  ``checkpoint_dir``, pushes each COMMITTED step to the host shard depot
+  (``TPUJOB_PEER_DEPOT``), and a resumed incarnation pulls warm state
+  from a surviving peer's depot (``TPUJOB_RESTORE_PEERS``) before
+  falling back to disk — logging the same "resumed from checkpoint at
+  step N" line the restart-recovery e2e pins, plus the restore-source
+  span the p2p soak invariant reads.
+
+``disk_restore_delay_s`` models the flagship-scale disk fetch (the
+multi-minute object-store read a real multi-TB restore pays): a resumed
+chief sleeps that long when — and only when — its restore source is
+disk. The peer path skips it, which is exactly the downtime the p2p
+protocol exists to cut; the soak's compare mode measures that cut.
 
 The warm-restart env contract is asserted here, not just logged: the
 controller's declared ``TPUJOB_RESUME_STEP`` must never exceed what is
-actually on disk (it may lag it — a checkpoint can land between creation
+actually restorable (it may lag — a checkpoint can land between creation
 and restore, and the controller fences nothing on it)."""
 
 from __future__ import annotations
@@ -50,33 +59,43 @@ def main(ctx: JobContext) -> None:
 
     import numpy as np
 
-    from tf_operator_tpu.train.checkpoint import CheckpointManager
+    from tf_operator_tpu.train.checkpoint import WorkloadCheckpointer
 
-    mgr = CheckpointManager(
-        wl["checkpoint_dir"], keep=int(wl.get("checkpoint_keep", 3))
-    )
-    every = int(wl.get("checkpoint_every", 2))
+    ckpt = WorkloadCheckpointer(wl, ctx=ctx)
+    mgr = ckpt.manager
+    every = ckpt.every
+
+    # Warm restore: peer depots first (materializes the committed step
+    # locally), then disk — the same decision order run_loop follows.
+    t0 = time.time()
+    source = ckpt.prefetch_from_peers()
     start = mgr.latest_step() or 0
+    state = {"step": np.asarray(start)}
     if start:
-        log.info("resumed from checkpoint at step %d", start)
+        if source == "disk":
+            # Model the flagship disk fetch: a real multi-TB restore pays
+            # minutes of object-store reads the peer path skips entirely.
+            time.sleep(float(wl.get("disk_restore_delay_s", 0.0)))
+        state = mgr.restore(state)
+        ckpt.restore_source = source
+        log.info(
+            "resumed from checkpoint at step %d (source=%s)", start, source
+        )
+        ctx.record_restore(source, start, t0, time.time())
     if ctx.resume_step > start:
         raise AssertionError(
             f"controller declared resume step {ctx.resume_step} but disk "
             f"has only {start} — the warm-restart env over-promised"
         )
-    state = {"step": np.asarray(start)}
     for s in range(start + 1, steps + 1):
         time.sleep(sleep_s)
         state = {"step": np.asarray(s)}
         if s == start + 1:
             ctx.mark_first_step(s)
         if every and s % every == 0:
-            t_save = time.time()
-            mgr.save(s, state)
-            ctx.record_span(
-                "checkpoint-save", t_save, time.time(),
-                attrs={"step": str(s), "track": "checkpoint"},
-            )
+            if mgr.save(s, state):
+                now = time.time()
+                ctx.record_save_stall(s, now - mgr.last_save_stall_s, now)
     mgr.save(steps, state, wait=True)  # final save (no-op if step exists)
     mgr.close()
     log.info("soak workload done: steps=%d (resumed from %d)", steps, start)

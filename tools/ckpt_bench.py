@@ -10,6 +10,12 @@ with-checkpointing step time should be ~equal to the clean loop
 blocking behavior for contrast.
 
     python -m tools.ckpt_bench [--steps 30] [--every 5] [--sync]
+        [--backend auto|npy|orbax]
+
+r8: also reports the per-save caller stall (p50/p99 over the accepted
+saves, from WorkloadCheckpointer.save_stalls) and its ratio to the
+step time — the tentpole's "save stall < 1 step-time" receipt.
+``--backend npy`` exercises the chunked async npy drain specifically.
 
 Prints one JSON line per mode plus the overhead summary.
 """
@@ -27,7 +33,14 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)
 
 
-def run_mode(mode: str, steps: int, every: int, tmpdir: str) -> float:
+def _pctile(xs, q: float) -> float:
+    """Nearest-rank percentile over a small sample (no numpy needed)."""
+    s = sorted(xs)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def run_mode(mode: str, steps: int, every: int, tmpdir: str,
+             backend: str = "auto") -> float:
     import jax
 
     from tf_operator_tpu.models.transformer import (
@@ -62,21 +75,34 @@ def run_mode(mode: str, steps: int, every: int, tmpdir: str) -> float:
     )
     wl = {} if mode == "none" else {
         "checkpoint_dir": tmpdir, "checkpoint_every": every,
+        "checkpoint_backend": backend,
     }
     ckpt = WorkloadCheckpointer(wl)
     if mode == "sync":
         # swap the manager for a blocking one (the r2 default); close the
         # async manager first or its background machinery leaks alongside
         ckpt.manager.close()
-        ckpt.manager = CheckpointManager(tmpdir, async_save=False)
+        ckpt.manager = CheckpointManager(
+            tmpdir, backend=backend, async_save=False
+        )
     _, loss, timed, step_s = ckpt.run_loop(
         trainer, jax.random.PRNGKey(0), tokens, steps
     )
-    print(json.dumps({
+    out = {
         "metric": f"ckpt_{mode}_step_s", "value": round(step_s, 5),
         "timed_steps": timed, "loss": round(float(loss), 4),
         "checkpoint_every": every if mode != "none" else 0,
-    }), flush=True)
+    }
+    if ckpt.save_stalls:
+        # The tentpole receipt: how long the step loop was actually
+        # blocked per accepted save, vs the step time it hides behind.
+        out["save_stall_p50_s"] = round(_pctile(ckpt.save_stalls, 0.5), 5)
+        out["save_stall_p99_s"] = round(_pctile(ckpt.save_stalls, 0.99), 5)
+        if step_s:
+            out["stall_over_step"] = round(
+                _pctile(ckpt.save_stalls, 0.5) / step_s, 3
+            )
+    print(json.dumps(out), flush=True)
     return step_s
 
 
@@ -86,6 +112,9 @@ def main(argv=None) -> int:
     p.add_argument("--every", type=int, default=5)
     p.add_argument("--sync", action="store_true",
                    help="also measure the blocking (async_save=False) mode")
+    p.add_argument("--backend", choices=("auto", "npy", "orbax"),
+                   default="auto",
+                   help="checkpoint backend (npy = chunked async drain)")
     args = p.parse_args(argv)
 
     from tf_operator_tpu.train.compile_cache import enable as enable_compile_cache
@@ -93,14 +122,17 @@ def main(argv=None) -> int:
     enable_compile_cache()
     base = tempfile.mkdtemp(prefix="ckpt-bench-")
     try:
-        clean = run_mode("none", args.steps, args.every, os.path.join(base, "a"))
-        asyn = run_mode("async", args.steps, args.every, os.path.join(base, "b"))
+        clean = run_mode("none", args.steps, args.every,
+                         os.path.join(base, "a"), args.backend)
+        asyn = run_mode("async", args.steps, args.every,
+                        os.path.join(base, "b"), args.backend)
         out = {
             "metric": "async_ckpt_overhead_pct",
             "value": round(100 * (asyn / clean - 1), 2),
         }
         if args.sync:
-            syn = run_mode("sync", args.steps, args.every, os.path.join(base, "c"))
+            syn = run_mode("sync", args.steps, args.every,
+                           os.path.join(base, "c"), args.backend)
             out["sync_overhead_pct"] = round(100 * (syn / clean - 1), 2)
         print(json.dumps(out))
     finally:

@@ -195,6 +195,18 @@ def _scrape_sync_latency(server: str) -> dict:
     out["ttfs_jobs"] = tn
     out["ttfs_p50_ms"] = round(_histogram_quantile(tb, tn, 0.5) * 1e3, 1)
     out["ttfs_p99_ms"] = round(_histogram_quantile(tb, tn, 0.99) * 1e3, 1)
+    # Async-checkpoint overlap receipt (r8): per-accepted-save step-loop
+    # stall, folded from workload save-stall spans at job terminal. Zero
+    # samples (bench workloads without checkpointing) is normal — omit.
+    sb, sn = _parse_histogram(text, "tpujob_checkpoint_save_stall_seconds")
+    if sn:
+        out["save_stalls"] = sn
+        out["save_stall_p50_ms"] = round(
+            _histogram_quantile(sb, sn, 0.5) * 1e3, 2
+        )
+        out["save_stall_p99_ms"] = round(
+            _histogram_quantile(sb, sn, 0.99) * 1e3, 2
+        )
     return out
 
 
