@@ -542,6 +542,128 @@ def test_duplicate_step_save_never_fences_inflight_write(tmp_path):
     assert mgr.all_steps() == [3]
 
 
+def test_waited_duplicate_save_fences_inflight_write(tmp_path):
+    """wait=True must fence even when the save is rejected as a duplicate
+    — the duplicate may BE the in-flight drain (final() re-saving the
+    last periodic step), and returning unfenced would let process exit
+    (daemon drain thread) tear the final checkpoint."""
+    import threading
+
+    from tf_operator_tpu.train.checkpoint import latest_checkpoint_step
+
+    root = tmp_path / "dupfence"
+    mgr = CheckpointManager(root, backend="npy")
+    gate = threading.Event()
+    mgr._fault_hook = (
+        lambda phase, step: gate.wait(timeout=30) if phase == "commit" else None
+    )
+    assert mgr.save(5, {"x": jnp.ones((8,))})  # async, parked pre-rename
+    threading.Timer(0.2, gate.set).start()
+    assert mgr.save(5, {"x": jnp.ones((8,))}, wait=True) is False
+    # The waited call returned only after the drain committed.
+    assert latest_checkpoint_step(str(root)) == 5
+
+
+def test_final_fences_duplicate_of_inflight_save(tmp_path):
+    """The review scenario: steps % checkpoint_every == 0, so final()'s
+    save is a duplicate of the accepted in-flight async save — it must
+    still fence before returning (run_loop callers never close())."""
+    import threading
+
+    from tf_operator_tpu.train.checkpoint import (
+        WorkloadCheckpointer,
+        latest_checkpoint_step,
+    )
+
+    root = tmp_path / "finalfence"
+    ckpt = WorkloadCheckpointer(
+        {"checkpoint_dir": str(root), "checkpoint_every": 1}
+    )
+    gate = threading.Event()
+    ckpt.manager._fault_hook = (
+        lambda phase, step: gate.wait(timeout=30) if phase == "commit" else None
+    )
+    state = {"x": jnp.ones((2,))}
+    ckpt.advance(state, loss=1.0)  # periodic save of step 1 accepted, parked
+    assert latest_checkpoint_step(str(root)) == 0  # still in flight
+    threading.Timer(0.2, gate.set).start()
+    ckpt.final(state)  # duplicate of the in-flight step — must fence
+    assert latest_checkpoint_step(str(root)) == 1
+
+
+def test_failed_drain_cleans_its_tmp_dir(tmp_path):
+    """A drain that dies must remove its partial .tmp_step_* dir NOW (the
+    constructor sweep skips our own pid, so without this each failure
+    pins a partial dir — and disk bytes — for the process lifetime)."""
+    import os
+
+    root = tmp_path / "drainfail"
+    mgr = CheckpointManager(root, backend="npy")
+
+    def boom(phase, step):
+        if phase == "manifest":
+            raise RuntimeError("disk full")
+
+    mgr._fault_hook = boom
+    assert mgr.save(1, {"x": jnp.ones((16,))})
+    with pytest.raises(RuntimeError, match="never committed"):
+        mgr.wait_until_finished()
+    assert not [n for n in os.listdir(root) if n.startswith(".tmp_step_")]
+
+
+def test_prefetch_falls_back_to_next_peer_then_disk(tmp_path, monkeypatch):
+    """The promised fallback order: best peer dying mid-transfer must try
+    the NEXT live peer holding the step before degrading to disk."""
+    from types import SimpleNamespace
+
+    from tf_operator_tpu.rendezvous import statechannel
+    from tf_operator_tpu.rendezvous.statechannel import DepotClient, ShardDepot
+    from tf_operator_tpu.train.checkpoint import (
+        WorkloadCheckpointer,
+        latest_checkpoint_step,
+    )
+
+    depot_a, depot_b = ShardDepot(), ShardDepot()
+    try:
+        src = tmp_path / "src"
+        mgr = CheckpointManager(src, backend="npy")
+        mgr.save(4, {"x": jnp.arange(4, dtype=jnp.float32)}, wait=True)
+        client = DepotClient()
+        assert client.push_step(depot_a.url, "ns", "job", 4, str(src / "step_4"))
+        assert client.push_step(depot_b.url, "ns", "job", 4, str(src / "step_4"))
+
+        real_fetch = statechannel.DepotClient.fetch_step
+
+        def dying_first_peer(self, url, ns, job, step, dest_root):
+            if url == depot_a.url:
+                return None  # peer died mid-transfer
+            return real_fetch(self, url, ns, job, step, dest_root)
+
+        monkeypatch.setattr(
+            statechannel.DepotClient, "fetch_step", dying_first_peer
+        )
+        dest = tmp_path / "dest"
+        ctx = SimpleNamespace(
+            namespace="ns", job_name="job", peer_depot="",
+            restore_peers=[depot_a.url, depot_b.url],
+        )
+        ckpt = WorkloadCheckpointer({"checkpoint_dir": str(dest)}, ctx=ctx)
+        assert ckpt.prefetch_from_peers() == "peer"
+        assert latest_checkpoint_step(str(dest)) == 4
+        # Every peer dead -> disk.
+        monkeypatch.setattr(
+            statechannel.DepotClient, "fetch_step",
+            lambda self, *a, **k: None,
+        )
+        ckpt2 = WorkloadCheckpointer(
+            {"checkpoint_dir": str(tmp_path / "dest2")}, ctx=ctx
+        )
+        assert ckpt2.prefetch_from_peers() == "disk"
+    finally:
+        depot_a.stop()
+        depot_b.stop()
+
+
 def test_workload_checkpointer_records_save_stall(tmp_path):
     """Every ACCEPTED periodic save contributes one stall sample (the
     bench artifact's p50/p99 source); skipped duplicates contribute none."""

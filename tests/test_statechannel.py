@@ -144,6 +144,63 @@ def test_peer_dies_mid_transfer_falls_back_clean(tmp_path, depot):
     assert list(dest.iterdir()) == []
 
 
+def test_commit_prunes_orphaned_staging(depot):
+    """A push that died mid-PUT must not pin its bytes in the
+    host-lifetime agent forever: a newer step committing for the same
+    (ns, job) proves the workload moved on and prunes the orphan."""
+    depot.stage("ns", "job", 3, "leaf_0.npy", b"orphaned partial push")
+    depot.stage("ns", "job", 5, "leaf_0.npy", b"live")
+    assert depot.commit("ns", "job", 5)
+    assert not depot.commit("ns", "job", 3)  # orphan pruned, nothing staged
+    assert depot._staged_bytes == 0
+    assert depot._staging == {}
+    # other jobs' staging is untouched
+    depot.stage("ns", "other", 1, "a", b"x")
+    depot.stage("ns", "job", 6, "a", b"y")
+    assert depot.commit("ns", "job", 6)
+    assert depot.commit("ns", "other", 1)
+
+
+def test_staging_byte_cap_evicts_oldest_push():
+    """Total staged-but-uncommitted bytes are capped; the longest-
+    untouched push is evicted first and its commit degrades to 409
+    (disk fallback), never unbounded agent RAM."""
+    d = ShardDepot(keep=2, max_staged_bytes=100)
+    try:
+        d.stage("ns", "a", 1, "f", b"x" * 60)
+        d.stage("ns", "b", 1, "f", b"y" * 60)  # over cap: evicts job a's push
+        assert not d.commit("ns", "a", 1)  # evicted
+        assert d.commit("ns", "b", 1)
+        assert d._staged_bytes == 0
+        # a single push bigger than the cap is itself dropped
+        d.stage("ns", "c", 1, "f", b"z" * 200)
+        assert not d.commit("ns", "c", 1)
+        assert d._staged_bytes == 0
+    finally:
+        d.stop()
+
+
+def test_fetch_rejects_path_traversal_relpaths(tmp_path, depot):
+    """A compromised/buggy peer listing a relpath that escapes the fetch
+    temp dir ('../../evil', absolute paths) must fail the WHOLE fetch —
+    nothing written anywhere, caller falls back to the next source."""
+    depot.stage("ns", "job", 2, "../../evil.npy", b"attack")
+    depot.stage("ns", "job", 2, "manifest.json", b"{}")
+    assert depot.commit("ns", "job", 2)
+    dest = tmp_path / "dest"
+    dest.mkdir()
+    client = DepotClient()
+    assert client.fetch_step(depot.url, "ns", "job", 2, str(dest)) is None
+    assert list(dest.iterdir()) == []  # no step, no tmp debris
+    assert not (tmp_path / "evil.npy").exists()  # and no escape
+
+    depot.stage("ns", "job2", 2, "/tmp/abs.npy", b"attack")
+    depot.stage("ns", "job2", 2, "manifest.json", b"{}")
+    assert depot.commit("ns", "job2", 2)
+    assert client.fetch_step(depot.url, "ns", "job2", 2, str(dest)) is None
+    assert list(dest.iterdir()) == []
+
+
 def test_choose_restore_source_decision_order(tmp_path, depot):
     src = tmp_path / "src"
     mgr = CheckpointManager(src, backend="npy")

@@ -59,6 +59,24 @@ def _sha256(data: bytes) -> str:
     return hashlib.sha256(data).hexdigest()
 
 
+_LOOPBACK_HOSTS = ("127.0.0.1", "localhost", "::1")
+
+
+def _safe_join(root: str, rel: str) -> str:
+    """Join a PEER-SUPPLIED relative path under ``root``, refusing any
+    form that would escape it (absolute paths, ``..`` components, or
+    anything whose normalized join lands outside root). The depot wire
+    protocol is unauthenticated, so a compromised or buggy peer's listing
+    must never be able to direct writes outside the fetch temp dir."""
+    if not rel or os.path.isabs(rel) or "\\" in rel:
+        raise ValueError(f"unsafe relpath from peer: {rel!r}")
+    root_abs = os.path.abspath(root)
+    full = os.path.abspath(os.path.join(root_abs, rel))
+    if os.path.commonpath([root_abs, full]) != root_abs:
+        raise ValueError(f"unsafe relpath from peer: {rel!r}")
+    return full
+
+
 class ShardDepot:
     """In-memory, host-lifetime store of committed checkpoint shards.
 
@@ -67,16 +85,35 @@ class ShardDepot:
     restarted gang without any disk round-trip. Not durable by design:
     durability is the disk checkpoint's job; the depot is purely the warm
     path, and losing it degrades a restore to disk, never to data loss.
+
+    Staged-but-uncommitted bytes are bounded: a workload dying mid-push
+    (the exact crash this system exists for) must not pin a checkpoint's
+    worth of RAM in the host-lifetime agent forever. Orphaned staging is
+    pruned when a newer step commits for the same (ns, job), and total
+    staged bytes are capped at ``max_staged_bytes`` (oldest-touched push
+    evicted first; an evicted push's commit returns 409 and the workload
+    degrades to the disk path — never to data loss).
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, keep: int = 2) -> None:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        keep: int = 2,
+        max_staged_bytes: int = 8 << 30,
+    ) -> None:
         self.keep = int(keep)
+        self.max_staged_bytes = int(max_staged_bytes)
         self._lock = threading.Lock()
         # (ns, job) -> {step: {relpath: bytes}} — committed, servable.
         self._committed: Dict[Tuple[str, str], Dict[int, Dict[str, bytes]]] = {}
         # (ns, job, step) -> {relpath: bytes} — staged by PUTs, invisible
         # until the commit POST promotes it.
         self._staging: Dict[Tuple[str, str, int], Dict[str, bytes]] = {}
+        self._staged_bytes = 0
+        # key -> last-touch sequence number: the staging-cap eviction order.
+        self._stage_seq = 0
+        self._stage_touch: Dict[Tuple[str, str, int], int] = {}
         depot = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -152,6 +189,17 @@ class ShardDepot:
                 )
                 self._reply(200 if ok else 409)
 
+        if host not in _LOOPBACK_HOSTS:
+            # The depot protocol carries no authentication: a non-loopback
+            # bind serves (and accepts) checkpoint bytes to anything that
+            # can reach the port. Deployments doing this must restrict it
+            # at the network layer (the k8s manifests scope it to the
+            # pod network).
+            log.warning(
+                "shard depot binding non-loopback %s: the depot HTTP "
+                "protocol is unauthenticated — restrict access at the "
+                "network layer", host,
+            )
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._server.daemon_threads = True
         self.port = self._server.server_address[1]
@@ -166,15 +214,60 @@ class ShardDepot:
 
     def stage(self, ns: str, job: str, step: int, relpath: str, data: bytes) -> None:
         with self._lock:
-            self._staging.setdefault((ns, job, int(step)), {})[relpath] = data
+            key = (ns, job, int(step))
+            files = self._staging.setdefault(key, {})
+            prev = files.get(relpath)
+            if prev is not None:
+                self._staged_bytes -= len(prev)
+            files[relpath] = data
+            self._staged_bytes += len(data)
+            self._stage_seq += 1
+            self._stage_touch[key] = self._stage_seq
+            # Enforce the staging cap: evict the longest-untouched push
+            # first (an abandoned one by construction — a live push keeps
+            # touching its key); the push being appended to is evicted
+            # only if it alone exceeds the cap.
+            while self._staged_bytes > self.max_staged_bytes and self._staging:
+                victim = min(
+                    (k for k in self._staging if k != key),
+                    key=self._stage_touch.__getitem__,
+                    default=key,
+                )
+                log.warning(
+                    "staged bytes over cap (%d > %d): evicting staged push %s",
+                    self._staged_bytes, self.max_staged_bytes, victim,
+                )
+                self._drop_staging_locked(victim)
+                if victim == key:
+                    break
+
+    def _drop_staging_locked(self, key: Tuple[str, str, int]) -> None:
+        files = self._staging.pop(key, None)
+        self._stage_touch.pop(key, None)
+        if files:
+            self._staged_bytes -= sum(len(d) for d in files.values())
 
     def commit(self, ns: str, job: str, step: int) -> bool:
-        """Promote a staged step to committed/servable; prune beyond keep."""
+        """Promote a staged step to committed/servable; prune beyond keep.
+
+        Also prunes any staging left at or below the committed step for
+        the same (ns, job): those are orphans of pushes that died mid-PUT
+        — a newer step committing proves the workload moved on, and
+        without the prune each orphan pins its bytes in the host-lifetime
+        agent's RAM forever."""
         step = int(step)
         with self._lock:
             files = self._staging.pop((ns, job, step), None)
             if not files:
                 return False
+            self._stage_touch.pop((ns, job, step), None)
+            self._staged_bytes -= sum(len(d) for d in files.values())
+            for key in [
+                k for k in self._staging
+                if k[0] == ns and k[1] == job and k[2] <= step
+            ]:
+                log.warning("pruning orphaned staged push %s (superseded)", key)
+                self._drop_staging_locked(key)
             per_job = self._committed.setdefault((ns, job), {})
             per_job[step] = files
             for old in sorted(per_job)[: max(0, len(per_job) - self.keep)]:
@@ -294,6 +387,11 @@ class DepotClient:
             listing = self._json(depot_url, "/depot/v1/files", q)["files"]
             shutil.rmtree(tmp, ignore_errors=True)
             os.makedirs(tmp)
+            # Validate EVERY peer-supplied relpath before any byte lands:
+            # a listing entry like '../../x' must fail the whole fetch
+            # (fall back to the next source), not write outside tmp.
+            for rel in listing:
+                _safe_join(tmp, rel)
             markers = [r for r in listing if os.path.basename(r) in COMMIT_MARKER_FILES]
             data_files = [r for r in listing if r not in markers]
             if not markers:
@@ -307,7 +405,7 @@ class DepotClient:
                     want = resp.headers.get("X-Shard-SHA256", "")
                 if want and _sha256(data) != want:
                     raise ValueError(f"sha256 mismatch on {rel}")
-                full = os.path.join(tmp, rel)
+                full = _safe_join(tmp, rel)
                 os.makedirs(os.path.dirname(full), exist_ok=True)
                 with open(full, "wb") as f:
                     f.write(data)

@@ -275,7 +275,10 @@ class CheckpointManager:
         are safely on the host; the disk write completes in background.
         A fence on the previous save runs first (at most one write in
         flight), and ``wait=True`` fences this one too — required for the
-        last save before process exit."""
+        last save before process exit. ``wait=True`` fences even when the
+        save is rejected as a duplicate: the duplicate may BE the
+        in-flight async write (final() re-saving the last periodic step),
+        and returning unfenced there would let process exit tear it."""
         if self.readonly:
             raise RuntimeError("CheckpointManager is readonly; refusing to save")
         step = int(step)
@@ -291,6 +294,11 @@ class CheckpointManager:
                 # below, required anyway before starting a new write (at
                 # most one in flight), makes the re-check authoritative.
                 if step in self._ocp_mgr.all_steps():
+                    if wait:
+                        # The duplicate may be the in-flight write itself
+                        # (the step cache counts accepted saves): a waited
+                        # call must not return with it still unfenced.
+                        self._ocp_mgr.wait_until_finished()
                     return False
                 self._ocp_mgr.wait_until_finished()
                 if step in self._ocp_mgr.all_steps():
@@ -301,7 +309,10 @@ class CheckpointManager:
                 return bool(saved)
             if self.async_save:
                 accepted = self._npy_save_async(step, tree)
-                if wait and accepted:
+                if wait:
+                    # Fence even a rejected duplicate (all_steps counts the
+                    # accepted in-flight drain): this is the seam final()
+                    # relies on — and the fence surfaces any _drain_error.
                     self.wait_until_finished()
                 return accepted
             return self._npy_save(step, tree)
@@ -358,9 +369,9 @@ class CheckpointManager:
         import jax
         import numpy as np
 
+        tmp = os.path.join(self.directory, f".tmp_step_{step}_{os.getpid()}")
         try:
             final = os.path.join(self.directory, f"step_{step}")
-            tmp = os.path.join(self.directory, f".tmp_step_{step}_{os.getpid()}")
             shutil.rmtree(tmp, ignore_errors=True)
             os.makedirs(tmp)
             leaves_with_path = jax.tree_util.tree_flatten_with_path(staged)[0]
@@ -404,6 +415,12 @@ class CheckpointManager:
             self._npy_prune()
             self._fire_on_commit(step, final)
         except BaseException as exc:  # noqa: BLE001 — surfaced at next fence
+            # Remove the partial tmp dir NOW: the constructor sweep skips
+            # our own pid, and without this each distinct-step drain
+            # failure would pin a partially-written dir for the process
+            # lifetime — worsening exactly the disk pressure that likely
+            # caused the failure. (No-op when the rename already landed.)
+            shutil.rmtree(tmp, ignore_errors=True)
             self._drain_error = exc
             log.warning("async checkpoint drain for step %d failed: %s", step, exc)
 
@@ -684,8 +701,11 @@ class WorkloadCheckpointer:
         read even for an already-known step is the protocol's entire
         payoff (when the step is already materialized locally the fetch
         is a no-op). Any peer failure (dead mid-transfer, integrity
-        mismatch) falls back to the next source. Returns the source the
-        subsequent restore will read from."""
+        mismatch) excludes that peer and re-runs the source decision over
+        the survivors — the NEXT live peer holding an eligible step is
+        tried before disk, the fallback order the statechannel module
+        promises. Returns the source the subsequent restore will read
+        from."""
         if self.manager is None or self.ctx is None:
             return "disk"
         peers = list(getattr(self.ctx, "restore_peers", []) or [])
@@ -698,24 +718,28 @@ class WorkloadCheckpointer:
 
         disk_step = self.manager.latest_step() or 0
         client = DepotClient()
-        source, url, step = choose_restore_source(
-            peers, self.ctx.namespace, self.ctx.job_name, disk_step,
-            client=client,
-        )
-        if source != "peer":
-            return "disk"
-        fetched = client.fetch_step(
-            url, self.ctx.namespace, self.ctx.job_name, step,
-            self.manager.directory,
-        )
-        if fetched is None:
-            log.warning(
-                "peer restore of step %d from %s failed; falling back to "
-                "disk (step %d)", step, url, disk_step,
+        remaining = list(peers)
+        while remaining:
+            source, url, step = choose_restore_source(
+                remaining, self.ctx.namespace, self.ctx.job_name, disk_step,
+                client=client,
             )
-            return "disk"
-        log.info("warm restore: pulled step %d from peer %s", step, url)
-        return "peer"
+            if source != "peer":
+                return "disk"
+            fetched = client.fetch_step(
+                url, self.ctx.namespace, self.ctx.job_name, step,
+                self.manager.directory,
+            )
+            if fetched is not None:
+                log.info("warm restore: pulled step %d from peer %s", step, url)
+                return "peer"
+            remaining = [u for u in remaining if u != url]
+            log.warning(
+                "peer restore of step %d from %s failed; %d peer(s) left "
+                "before disk fallback (step %d)",
+                step, url, len(remaining), disk_step,
+            )
+        return "disk"
 
     def restore_or_init(self, trainer, key):
         """Resume from the best warm source (peer depot, then latest disk
