@@ -8,7 +8,7 @@ import tf_operator_tpu.train.compile_cache as cc
 
 def test_enable_creates_and_configures_dir(tmp_path, monkeypatch):
     target = str(tmp_path / "xla-cache")
-    got = cc.enable(target)
+    got = cc.enable(target, force=True)
     assert got == target and os.path.isdir(target)
     import jax
 
@@ -18,25 +18,25 @@ def test_enable_creates_and_configures_dir(tmp_path, monkeypatch):
 def test_env_dir_override(tmp_path, monkeypatch):
     target = str(tmp_path / "from-env")
     monkeypatch.setenv(cc.ENV_DIR, target)
-    assert cc.enable() == target
+    assert cc.enable(force=True) == target
 
 
 def test_disable_env(monkeypatch, tmp_path):
     monkeypatch.setenv(cc.ENV_DISABLE, "1")
-    assert cc.enable(str(tmp_path / "x")) is None
+    assert cc.enable(str(tmp_path / "x"), force=True) is None
     assert not (tmp_path / "x").exists()
 
 
 def test_unwritable_dir_degrades_to_none(monkeypatch, tmp_path):
     blocker = tmp_path / "file"
     blocker.write_text("not a dir")
-    assert cc.enable(str(blocker / "sub")) is None
+    assert cc.enable(str(blocker / "sub"), force=True) is None
 
 
 def test_cache_populates_on_compile(tmp_path):
     """A jitted computation lands executables in the cache directory."""
     target = str(tmp_path / "xla-cache")
-    assert cc.enable(target) == target
+    assert cc.enable(target, force=True) == target
     import jax
     import jax.numpy as jnp
 
@@ -46,3 +46,71 @@ def test_cache_populates_on_compile(tmp_path):
     jax.jit(lambda v: (v * 3 + 1).sum())(x).block_until_ready()
     entries = os.listdir(target)
     assert entries, "compilation cache is empty after a jit compile"
+
+
+# -- crash-safe cache I/O (r10) ----------------------------------------
+#
+# enable() wraps jax's LRUCache with atomic writes + sha256 sidecars:
+# a worker SIGKILLed mid-write (the operator's preempt path) must not be
+# able to leave a truncated executable that aborts every later warm
+# restart in native deserialization code.
+
+
+def _lru(tmp_path):
+    cc.enable(str(tmp_path / "xc"), force=True)  # installs hardened put/get
+    from jax._src.lru_cache import LRUCache
+
+    return LRUCache(str(tmp_path / "lru"), max_size=-1)
+
+
+def test_put_writes_payload_digest_and_atime(tmp_path):
+    cache = _lru(tmp_path)
+    cache.put("k1", b"executable-bytes")
+    names = sorted(os.listdir(tmp_path / "lru"))
+    assert names == ["k1-atime", "k1-cache", "k1-cache-sha256"]
+    assert cache.get("k1") == b"executable-bytes"
+
+
+def test_torn_write_is_a_miss_and_self_heals(tmp_path):
+    """A truncated payload under the final name (pre-fix poison, or a
+    legacy jax write killed mid-flight) must read as a miss and be
+    deleted — never handed to XLA."""
+    cache = _lru(tmp_path)
+    cache.put("k2", b"full-payload")
+    (tmp_path / "lru" / "k2-cache").write_bytes(b"full-pay")  # torn
+    assert cache.get("k2") is None
+    assert not (tmp_path / "lru" / "k2-cache").exists()
+    # the key is writable again afterwards (put skips existing entries)
+    cache.put("k2", b"recompiled")
+    assert cache.get("k2") == b"recompiled"
+
+
+def test_legacy_entry_without_digest_is_purged(tmp_path):
+    """Entries from before the hardening have no sidecar; they are
+    unverifiable, so get() drops them once and recompilation repopulates
+    with a digest."""
+    cache = _lru(tmp_path)
+    (tmp_path / "lru" / "k3-cache").write_bytes(b"who knows")
+    assert cache.get("k3") is None
+    assert not (tmp_path / "lru" / "k3-cache").exists()
+
+
+def test_harden_is_idempotent(tmp_path):
+    from jax._src.lru_cache import LRUCache
+
+    cc.enable(str(tmp_path / "a"), force=True)
+    put1, get1 = LRUCache.put, LRUCache.get
+    cc.enable(str(tmp_path / "b"), force=True)
+    assert LRUCache.put is put1 and LRUCache.get is get1
+
+
+def test_cpu_only_platform_skips_cache(monkeypatch, tmp_path):
+    """jaxlib CPU executable deserialization is not cross-process-safe
+    (r10: a warm-restarted trainer loading another process's cached
+    executable died in native code) — enable() must refuse on a
+    cpu-pinned process unless explicitly forced."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.delenv(cc.ENV_FORCE, raising=False)
+    assert cc.enable(str(tmp_path / "x")) is None
+    monkeypatch.setenv(cc.ENV_FORCE, "1")
+    assert cc.enable(str(tmp_path / "x")) is not None

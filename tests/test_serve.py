@@ -1,0 +1,297 @@
+"""Serve subsystem tests (r10): paged-KV engine correctness (completion,
+leak-freedom, determinism, admission validation), the serve spec/CLI
+surface, serving-class scheduling priority, and memplan's KV-pool
+accounting. The decode-vs-full attention numerics oracle lives in
+tests/test_flash_decode.py; the kernel itself in test_flash_attention."""
+
+import pytest
+
+import tools.memplan as memplan
+from tf_operator_tpu.api.defaults import set_defaults
+from tf_operator_tpu.api.types import (
+    JOB_CLASS_SERVING,
+    JOB_CLASS_TRAINING,
+    ObjectMeta,
+    ReplicaType,
+)
+from tf_operator_tpu.api.validation import ValidationError, validate_job
+from tf_operator_tpu.cli.tpujob import _parse_override, build_parser
+from tf_operator_tpu.runtime.scheduler import GangScheduler
+from tf_operator_tpu.runtime.store import Store
+from tf_operator_tpu.sched.fleet import SERVING_DEFAULT_PRIORITY, FleetScheduler
+from tf_operator_tpu.sched.objects import PriorityClass
+from tf_operator_tpu.serve.kvcache import (
+    PagePool,
+    PoolExhausted,
+    SequencePages,
+    pages_needed,
+)
+from tf_operator_tpu.serve.spec import build_serve_job
+
+# ---- kv cache bookkeeping (pure python, no jax) ---------------------------
+
+
+def test_pages_needed_rounds_up():
+    assert pages_needed(1, 8) == 1
+    assert pages_needed(8, 8) == 1
+    assert pages_needed(9, 8) == 2
+    assert pages_needed(0, 8) == 1  # a live sequence always owns a page
+
+
+def test_pool_alloc_free_roundtrip():
+    pool = PagePool(4)
+    start = pool.free_count
+    pages = pool.alloc(3)
+    assert len(set(pages)) == 3 and pool.free_count == start - 3
+    pool.free(pages)
+    assert pool.free_count == start
+
+
+def test_pool_exhaustion_is_atomic():
+    """A failed alloc must not leak a partial grab."""
+    pool = PagePool(2)
+    start = pool.free_count
+    with pytest.raises(PoolExhausted):
+        pool.alloc(start + 1)
+    assert pool.free_count == start
+
+
+def test_sequence_pages_grow_and_release():
+    pool = PagePool(8)
+    start = pool.free_count
+    sp = SequencePages(page_size=4)
+    sp.ensure(5, pool)  # 2 pages
+    assert sp.capacity >= 5
+    held = len(sp.pages)
+    sp.ensure(3, pool)  # no shrink, no new alloc
+    assert len(sp.pages) == held
+    sp.release(pool)
+    assert pool.free_count == start and not sp.pages
+
+
+# ---- engine: completion, leaks, determinism -------------------------------
+
+
+def _fake_clock(dt=0.001):
+    """Deterministic clock: admission order can't depend on host speed."""
+    t = [0.0]
+
+    def clock():
+        t[0] += dt
+        return t[0]
+
+    return clock
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    import jax
+
+    from tf_operator_tpu.models.transformer import init_transformer, preset
+    from tf_operator_tpu.serve.engine import ServeConfig, ServeEngine
+
+    cfg = preset("tiny")
+    params = init_transformer(jax.random.PRNGKey(0), cfg)
+    scfg = ServeConfig(page_size=8, pool_pages=48, max_slots=3,
+                       prefill_chunk=8)
+    return ServeEngine(cfg, params, scfg)
+
+
+def _requests(n=7, seed=3):
+    from tf_operator_tpu.workloads.serve import synthesize_requests
+
+    return synthesize_requests(
+        {"requests": n, "seed": seed, "prompt_len": 6, "max_new_tokens": 6,
+         "arrival_rate": 0.0},
+        vocab=256,
+    )
+
+
+@pytest.mark.serve
+def test_engine_completes_all_requests_without_leaks(tiny_engine):
+    res = tiny_engine.run(_requests(), clock=_fake_clock())
+    assert res.completed == len(res.requests)
+    assert res.free_pages_start == res.free_pages_end  # zero page leaks
+    assert res.generated_tokens == sum(len(r.tokens) for r in res.requests)
+    for r in res.requests:
+        assert 1 <= len(r.tokens) <= r.max_new
+        assert 0 <= r.arrival <= r.admitted <= r.first_token <= r.finished
+
+
+@pytest.mark.serve
+def test_engine_static_mode_also_completes(tiny_engine):
+    res = tiny_engine.run(_requests(), mode="static", clock=_fake_clock())
+    assert res.completed == len(res.requests)
+    assert res.free_pages_start == res.free_pages_end
+    # drain-the-batch takes strictly more steps than requests' max budget:
+    # late arrivals wait out whole generations
+    cont = tiny_engine.run(_requests(), clock=_fake_clock())
+    assert res.steps > cont.steps
+
+
+@pytest.mark.serve
+def test_engine_is_deterministic(tiny_engine):
+    a = tiny_engine.run(_requests(), clock=_fake_clock())
+    b = tiny_engine.run(_requests(), clock=_fake_clock())
+    assert [r.tokens for r in a.requests] == [r.tokens for r in b.requests]
+
+
+@pytest.mark.serve
+def test_engine_rejects_impossible_requests(tiny_engine):
+    from tf_operator_tpu.serve.engine import Request
+
+    with pytest.raises(ValueError, match="empty prompt"):
+        tiny_engine.run([Request(rid=0, prompt=[], max_new=1)])
+    with pytest.raises(ValueError, match="exceeds max_seq"):
+        tiny_engine.run([Request(rid=0, prompt=[1] * 100, max_new=100)])
+    # fits max_seq but not the page pool: flagged before serving starts
+    # (fresh engine with a 2-page pool; jit builds lazily, so this is cheap)
+    from tf_operator_tpu.serve.engine import ServeConfig, ServeEngine
+
+    small = ServeEngine(
+        tiny_engine.cfg, tiny_engine.params,
+        ServeConfig(page_size=8, pool_pages=2, max_slots=1, prefill_chunk=8),
+    )
+    with pytest.raises(ValueError, match="never be admitted"):
+        small.run([Request(rid=0, prompt=[1] * 30, max_new=8)])
+
+
+# ---- spec validation / defaulting -----------------------------------------
+
+
+def test_serve_spec_validates_clean():
+    validate_job(build_serve_job("s1"))
+
+
+@pytest.mark.parametrize("key,bad,msg", [
+    ("kv_page_size", 0, "kv_page_size"),
+    ("kv_page_size", "eight", "kv_page_size"),
+    ("kv_pool_pages", 0, "kv_pool_pages"),
+    ("max_slots", 0, "max_slots"),
+])
+def test_bad_kv_geometry_rejected_at_submit(key, bad, msg):
+    job = build_serve_job("s1", workload={key: bad})
+    with pytest.raises(ValidationError, match=msg):
+        validate_job(job)
+
+
+def test_unknown_job_class_rejected():
+    job = build_serve_job("s1")
+    job.spec.scheduling.job_class = "batchy"
+    with pytest.raises(ValidationError, match="job_class"):
+        validate_job(job)
+
+
+def test_serve_entrypoint_defaults_job_class():
+    job = build_serve_job("s1")
+    job.spec.scheduling.job_class = ""  # submitter said nothing
+    set_defaults(job)
+    assert job.spec.scheduling.job_class == JOB_CLASS_SERVING
+    # an explicit class is never overridden
+    job2 = build_serve_job("s2")
+    job2.spec.scheduling.job_class = JOB_CLASS_TRAINING
+    set_defaults(job2)
+    assert job2.spec.scheduling.job_class == JOB_CLASS_TRAINING
+
+
+# ---- fleet priority -------------------------------------------------------
+
+
+def _fleet():
+    store = Store()
+    store.create(PriorityClass(
+        metadata=ObjectMeta(name="low", namespace="default"), value=1))
+    return FleetScheduler(store, GangScheduler(store))
+
+
+def test_serving_class_outranks_classless_training():
+    fleet = _fleet()
+    serve = build_serve_job("s1")
+    train = build_serve_job("t1")
+    train.spec.scheduling.job_class = JOB_CLASS_TRAINING
+    assert fleet.priority_of(serve) == SERVING_DEFAULT_PRIORITY
+    assert fleet.priority_of(train) == 0
+    assert fleet.priority_of(serve) > fleet.priority_of(train)
+
+
+def test_explicit_priority_class_beats_serving_default():
+    fleet = _fleet()
+    serve = build_serve_job("s1", priority="low")
+    assert fleet.priority_of(serve) == 1  # named class wins, even downward
+
+
+# ---- CLI ------------------------------------------------------------------
+
+
+def test_parse_override_coerces_types():
+    assert _parse_override("kv_page_size=8") == ("kv_page_size", 8)
+    assert _parse_override("arrival_rate=2.5") == ("arrival_rate", 2.5)
+    assert _parse_override("reserve_full=false") == ("reserve_full", False)
+    assert _parse_override("mode=static") == ("mode", "static")
+    with pytest.raises(ValueError):
+        _parse_override("no-equals-sign")
+
+
+def test_submit_workload_serve_builds_valid_job():
+    args = build_parser().parse_args([
+        "submit", "--workload", "serve", "--name", "edge",
+        "--queue", "main", "--set", "kv_page_size=8",
+        "--set", "requests=12",
+    ])
+    from tf_operator_tpu.cli.tpujob import _build_workload_job
+
+    job = _build_workload_job(args)
+    assert job.metadata.name == "edge"
+    assert job.spec.scheduling.queue == "main"
+    assert job.spec.scheduling.job_class == JOB_CLASS_SERVING
+    assert job.spec.workload["kv_page_size"] == 8
+    assert job.spec.workload["requests"] == 12
+    worker = job.spec.replica_specs[ReplicaType.WORKER]
+    assert worker.template.entrypoint.startswith(
+        "tf_operator_tpu.workloads.serve"
+    )
+    validate_job(job)
+
+
+# ---- memplan accounting ---------------------------------------------------
+
+
+def test_memplan_serve_accounts_kv_pool():
+    out = memplan.serve_plan("tiny", {"kv_page_size": 8, "kv_pool_pages": 32})
+    assert out["mode"] == "serve"
+    assert out["kv_pool_gb"] > 0
+    assert out["total_gb"] >= out["params_gb"] + out["kv_pool_gb"]
+    assert "warning" not in out
+
+
+def test_memplan_refuses_unadmittable_pool():
+    # tiny max_seq=128 @ page 8 needs 16 pages; a 4-page pool can never
+    # admit a max-length sequence — memplan must refuse, not warn-and-pass
+    import argparse
+
+    out = memplan.serve_plan("tiny", {"kv_page_size": 8, "kv_pool_pages": 4})
+    assert "warning" in out
+    rc = memplan._finish_serve(out, argparse.Namespace(hbm_gb=None))
+    assert rc == 1
+
+
+def test_memplan_refuses_over_budget():
+    import argparse
+
+    out = memplan.serve_plan(
+        "gpt-small", {"kv_page_size": 16, "kv_pool_pages": 4096}
+    )
+    rc = memplan._finish_serve(out, argparse.Namespace(hbm_gb=0.001))
+    assert rc == 1
+
+
+def test_memplan_detects_serve_workload_doc():
+    assert memplan._is_serve_workload(
+        {"spec": {"workload": {"kv_pool_pages": 64}}}
+    )
+    assert memplan._is_serve_workload(
+        {"spec": {"scheduling": {"job_class": "serving"}}}
+    )
+    assert not memplan._is_serve_workload(
+        {"spec": {"workload": {"preset": "tiny"}}}
+    )

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from tf_operator_tpu.api.types import (
     DEFAULT_COORDINATOR_PORT,
+    JOB_CLASS_SERVING,
     ReplicaType,
     RestartPolicy,
     TPUJob,
@@ -38,3 +39,12 @@ def set_spec_defaults(spec: TPUJobSpec) -> None:
                 rs.restart_policy = RestartPolicy.ON_FAILURE
             else:
                 rs.restart_policy = RestartPolicy.EXIT_CODE
+    # A job running the serve workload IS a serving job (r10): default the
+    # class so the fleet scheduler's latency-sensitive priority applies
+    # without the submitter having to know the scheduling vocabulary. An
+    # explicit job_class (any value, incl. "training") is left alone.
+    if not spec.scheduling.job_class and any(
+        rs.template.entrypoint.startswith("tf_operator_tpu.workloads.serve")
+        for rs in spec.replica_specs.values()
+    ):
+        spec.scheduling.job_class = JOB_CLASS_SERVING

@@ -49,6 +49,12 @@ KIND_SPAN = "Span"
 KIND_PRIORITY_CLASS = "PriorityClass"
 KIND_QUEUE = "Queue"
 
+# Serving job classes (SchedulingSpec.job_class, r10): "serving" marks a
+# latency-sensitive decode workload — the fleet scheduler gives it a high
+# default priority so it preempts training without PriorityClass setup.
+JOB_CLASS_TRAINING = "training"
+JOB_CLASS_SERVING = "serving"
+
 # Default port the coordinator's jax.distributed service listens on
 # (replaces the reference's TF gRPC port 2222, v1alpha1/types.go:30).
 DEFAULT_COORDINATOR_PORT = 8476
@@ -237,10 +243,19 @@ class SchedulingSpec:
     and which PriorityClass orders it there. Both are names resolved at
     admission time — a missing Queue means "no quota" and a missing
     PriorityClass means priority 0, so jobs submitted before the objects
-    exist still run (kube-scheduler's optional schedulerName spirit)."""
+    exist still run (kube-scheduler's optional schedulerName spirit).
+
+    ``job_class`` (r10) declares WHAT the job is, not where it queues:
+    "serving" jobs are latency-sensitive decode loops that default to a
+    high effective priority (sched/fleet.py SERVING_DEFAULT_PRIORITY)
+    so they preempt training for capacity without any PriorityClass
+    setup — the victim drains and warm-resumes through the ordinary
+    preemption lifecycle, and backfills when the serve job finishes. An
+    explicit priority_class always wins over the class default."""
 
     queue: str = ""  # Queue name in the job's namespace; "" ⇒ unqueued
     priority_class: str = ""  # PriorityClass name; "" ⇒ priority 0
+    job_class: str = ""  # "" | JOB_CLASS_TRAINING | JOB_CLASS_SERVING
 
 
 @dataclass

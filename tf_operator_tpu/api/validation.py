@@ -12,7 +12,13 @@ from __future__ import annotations
 import math
 import re
 
-from tf_operator_tpu.api.types import ReplicaType, TPUJob, TPUJobSpec
+from tf_operator_tpu.api.types import (
+    JOB_CLASS_SERVING,
+    JOB_CLASS_TRAINING,
+    ReplicaType,
+    TPUJob,
+    TPUJobSpec,
+)
 
 # DNS-1123-label shape, like k8s object names: also forecloses path
 # traversal in log paths and HTML injection in the dashboard.
@@ -86,6 +92,43 @@ def validate_spec(spec: TPUJobSpec) -> None:
         _validate_dns_label(
             sched.priority_class, "spec.scheduling.priority_class"
         )
+    job_class = getattr(sched, "job_class", "")
+    if job_class not in ("", JOB_CLASS_TRAINING, JOB_CLASS_SERVING):
+        raise ValidationError(
+            f"spec.scheduling.job_class must be '', "
+            f"'{JOB_CLASS_TRAINING}' or '{JOB_CLASS_SERVING}', "
+            f"got {job_class!r}"
+        )
+
+    # Serve workloads (r10): the KV page geometry is capacity the engine
+    # preallocates at startup — a bad value OOMs or deadlocks the decode
+    # loop at runtime, so reject it at submission where the message can
+    # still name the field.
+    is_serve = job_class == JOB_CLASS_SERVING or any(
+        rs.template.entrypoint.startswith("tf_operator_tpu.workloads.serve")
+        for rs in spec.replica_specs.values()
+    )
+    if is_serve:
+        wl = spec.workload or {}
+        page = wl.get("kv_page_size", 16)
+        pool = wl.get("kv_pool_pages", 64)
+        slots = wl.get("max_slots", 4)
+        if not isinstance(page, int) or page < 1:
+            raise ValidationError(
+                f"spec.workload.kv_page_size must be an int >= 1 tokens "
+                f"(got {page!r}) — the paged KV cache cannot address "
+                f"zero-token pages"
+            )
+        if not isinstance(pool, int) or pool < 1:
+            raise ValidationError(
+                f"spec.workload.kv_pool_pages must be an int >= 1 "
+                f"(got {pool!r}) — a zero-page pool can hold no KV state, "
+                f"so no request could ever be admitted"
+            )
+        if not isinstance(slots, int) or slots < 1:
+            raise ValidationError(
+                f"spec.workload.max_slots must be an int >= 1 (got {slots!r})"
+            )
 
     rp = spec.run_policy
     if rp.heartbeat_ttl_seconds is not None and rp.heartbeat_ttl_seconds <= 0:

@@ -37,7 +37,20 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="cmd", required=True)
 
     s = sub.add_parser("submit", help="create a job from a JSON spec file")
-    s.add_argument("file")
+    s.add_argument("file", nargs="?", default=None,
+                   help="JSON spec file (omit with --workload)")
+    s.add_argument("--workload", choices=["serve"], default=None,
+                   help="build a canned workload job instead of reading a "
+                        "spec file (r10: serve)")
+    s.add_argument("--name", default=None,
+                   help="job name for --workload (default: <workload>)")
+    s.add_argument("--namespace", default="default")
+    s.add_argument("--queue", default="",
+                   help="Queue for --workload jobs")
+    s.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                   dest="overrides",
+                   help="workload config override for --workload "
+                        "(repeatable), e.g. --set kv_page_size=8")
     sub.add_parser("list", help="list jobs").add_argument(
         "--namespace", default=None
     )
@@ -72,6 +85,35 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _parse_override(kv: str):
+    """KEY=VALUE → (key, typed value): ints/floats/bools coerce, else str."""
+    if "=" not in kv:
+        raise ValueError(f"--set expects KEY=VALUE, got {kv!r}")
+    key, _, raw = kv.partition("=")
+    if raw.lower() in ("true", "false"):
+        return key, raw.lower() == "true"
+    for cast in (int, float):
+        try:
+            return key, cast(raw)
+        except ValueError:
+            continue
+    return key, raw
+
+
+def _build_workload_job(args):
+    """submit --workload NAME: build the canned job locally so it still
+    passes through the server's validation/defaulting like any other."""
+    from tf_operator_tpu.serve.spec import build_serve_job
+
+    workload = dict(_parse_override(kv) for kv in args.overrides)
+    return build_serve_job(
+        name=args.name or args.workload,
+        namespace=args.namespace,
+        queue=args.queue,
+        workload=workload,
+    )
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -85,10 +127,17 @@ def main(argv=None) -> int:
     )
     try:
         if args.cmd == "submit":
-            from tf_operator_tpu.api.v1alpha1 import parse_job
+            if args.workload:
+                job = _build_workload_job(args)
+            elif args.file:
+                from tf_operator_tpu.api.v1alpha1 import parse_job
 
-            with open(args.file) as f:
-                job = parse_job(json.load(f))  # accepts both API generations
+                with open(args.file) as f:
+                    job = parse_job(json.load(f))  # accepts both API generations
+            else:
+                print("error: submit needs a spec file or --workload",
+                      file=sys.stderr)
+                return 1
             created = client.create(job)
             print(f"tpujob {created.key()} created (uid {created.metadata.uid})")
         elif args.cmd == "list":
@@ -169,7 +218,7 @@ def main(argv=None) -> int:
     except TPUJobApiError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 1
-    except (FileNotFoundError, json.JSONDecodeError) as exc:
+    except (FileNotFoundError, json.JSONDecodeError, ValueError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 1
     except ValidationError as exc:  # e.g. v1alpha1 PS rejection

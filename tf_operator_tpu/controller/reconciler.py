@@ -207,6 +207,7 @@ class TPUJobController:
         self._sched_observed: set = set()  # uids with a scheduled span
         self._ttfs_observed: set = set()  # uids whose TTFS hit the histogram
         self._ckpt_observed: set = set()  # uids whose ckpt spans hit histograms
+        self._serve_observed: set = set()  # uids whose request spans were folded
         self._open_restart: Dict[str, Dict[str, Any]] = {}  # uid -> span info
         self._open_schedwait: Dict[str, Dict[str, Any]] = {}
         self._open_queued: Dict[str, Dict[str, Any]] = {}  # uid -> span info
@@ -1130,6 +1131,37 @@ class TPUJobController:
                     labels={"source": "peer" if source == "peer" else "disk"},
                 )
 
+    def _observe_serve_spans(self, job: TPUJob) -> None:
+        """Fold serve-job request spans (workloads/serve.py) into metrics
+        once per job, at terminal: each ``first-token`` span's width
+        (arrival -> first generated token) lands in
+        ``tpujob_request_ttft_seconds``, and each ``finished`` span's
+        ``tokens`` attr accumulates into ``tpujob_request_tokens_total``
+        — the serving analogue of the checkpoint-span folding above."""
+        uid = job.metadata.uid
+        if uid in self._serve_observed:
+            return
+        self._serve_observed.add(uid)
+        try:
+            spans = job_trace(self.store, job.metadata.namespace, job.metadata.name)
+        except Exception:  # noqa: BLE001 — telemetry read is best-effort
+            return
+        for span in spans:
+            dur = span.duration()
+            if dur is None:
+                continue
+            if span.op == "first-token":
+                self.metrics.observe_hist(
+                    "tpujob_request_ttft_seconds", max(0.0, dur)
+                )
+            elif span.op == "finished":
+                try:
+                    tokens = float(span.attrs.get("tokens", "0"))
+                except ValueError:
+                    tokens = 0.0
+                if tokens > 0:
+                    self.metrics.inc("tpujob_request_tokens_total", tokens)
+
     def _depot_peers(self) -> List[str]:
         """Depot URLs of hosts that can serve peer warm restores: every
         Ready or Draining host announcing ``spec.depot_url``. Draining
@@ -1770,9 +1802,11 @@ class TPUJobController:
                 self.tracer.close(queued["ns"], queued["name"], end)
             self._observe_first_step(job)
             self._observe_ckpt_spans(job)
+            self._observe_serve_spans(job)
             self._sched_observed.discard(uid)
             self._ttfs_observed.discard(uid)
             self._ckpt_observed.discard(uid)
+            self._serve_observed.discard(uid)
         self._delete_children(
             job.metadata.namespace, job.metadata.name, job.spec.run_policy.cleanup_policy
         )

@@ -110,6 +110,11 @@ async function viewJobs(){
     const reps = Object.entries(j.spec.replica_specs||{})
       .map(([k,v])=>k+':'+v.replicas).join(' ');
     const conds = (j.status.conditions||[]).filter(c=>c.status).map(c=>c.type).join(', ');
+    // Serve jobs report live request counts over the eval_metrics channel.
+    const m = (j.status.eval_metrics||{}).metrics||{};
+    const reqs = (m.requests_total===undefined) ? '' :
+      (m.requests_completed||0)+'/'+(m.requests_total||0)
+      + ((m.requests_active||0) ? ' ('+m.requests_active+' active)' : '');
     const link = el('a', {href:'#/job/'+j.metadata.namespace+'/'+j.metadata.name},
                     j.metadata.name);
     const del = el('button', {class:'danger', onclick: async (ev)=>{
@@ -123,13 +128,14 @@ async function viewJobs(){
       el('td', {class:'phase-'+j.phase}, j.phase||''),
       el('td', null, reps),
       el('td', null, String(j.status.restart_count||0)),
+      el('td', null, reqs),
       el('td', {class:'muted'}, conds),
       el('td', {class:'muted'}, age(j.metadata.creation_timestamp)),
       el('td', null, del)));
   }
   render(el('div', null, el('table', null,
     el('thead', null, el('tr', null, ...['Namespace','Name','Phase','Replicas',
-      'Restarts','Conditions','Age',''].map(h=>el('th',null,h)))), tbody)));
+      'Restarts','Requests','Conditions','Age',''].map(h=>el('th',null,h)))), tbody)));
 }
 
 // ---- job detail ------------------------------------------------------------
@@ -275,6 +281,7 @@ const WORKLOADS = {
   'lm (transformer pretrain)': {entry:'tf_operator_tpu.workloads.lm:main', wl:{preset:'tiny', steps:10, batch_size:8, seq_len:128}},
   'resnet (image classification)': {entry:'tf_operator_tpu.workloads.resnet:main', wl:{steps:10, batch_size:32}},
   'eval (checkpoint scorer)': {entry:'tf_operator_tpu.workloads.eval:main', wl:{preset:'tiny', checkpoint_dir:'/tmp/ckpt'}},
+  'serve (continuous-batching inference)': {entry:'tf_operator_tpu.workloads.serve:main', wl:{preset:'tiny', requests:8, kv_page_size:16, kv_pool_pages:64, max_slots:4}},
   'custom': {entry:'', wl:{}},
 };
 

@@ -325,6 +325,23 @@ def _rope(x, theta: float):
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
 
 
+def rope_at_positions(x, positions, theta: float):
+    """_rope at explicit ABSOLUTE positions. x: [b, t, h, d_head];
+    positions: [b, t] int. ``_rope(x, theta)`` is exactly this with
+    positions = arange(t) — the incremental decode path (serve/engine.py)
+    needs the general form because a decode step's single token sits at
+    position seq_len, not 0, and a prefill chunk starts mid-sequence;
+    rotating at the wrong absolute position is the classic silent KV-cache
+    bug (every token attends as if it were the first)."""
+    half = x.shape[-1] // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [b, t, half]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
 def _attention(q, k, v, cfg: TransformerConfig, mesh):
     """q: [b,t,nh,hd]; k/v: [b,t,nkv,hd].
 

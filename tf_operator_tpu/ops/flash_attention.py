@@ -629,3 +629,210 @@ def flash_attention(
     # One custom-vjp entry serves both public surfaces (the lse output is
     # a residual either way, so dropping it here costs nothing).
     return _flash_lse(q, k, v, causal, block_q, block_k, bool(interpret))[0]
+
+
+# ---------------------------------------------------------------------------
+# paged decode path (serving, r10)
+# ---------------------------------------------------------------------------
+#
+# Single-query-per-sequence attention over a PAGED K/V cache
+# (serve/kvcache.py): K/V live in fixed-size pages of a preallocated pool
+# and each sequence owns an ordered page table. The decode step never
+# materializes a contiguous [t, d] K/V tensor on TPU — the kernel walks
+# the page table as its innermost grid dimension and DMAs one page per
+# step, with page ids resolved through scalar-prefetch (the page table is
+# in SMEM before the grid runs, so the K/V BlockSpec index_map can
+# compute each step's HBM source block from it). The online-softmax
+# carry (m, l, acc) is the forward kernel's, shrunk to the g rows of one
+# GQA group — a decode step has exactly one query position per sequence.
+
+
+def paged_decode_reference(q, k_pages, v_pages, page_table, seq_lens):
+    """Pure-JAX paged decode attention — the correctness oracle and the
+    off-TPU fallback (same contract as the decode kernel).
+
+    q [s, h, d] (one query token per sequence), k_pages/v_pages
+    [n_pages, page_size, h_kv, d], page_table [s, p] int32 (page ids in
+    sequence order; rows padded with any valid id past the live prefix),
+    seq_lens [s] int32 = valid K/V tokens per sequence INCLUDING the
+    current position. Gathers pages to [s, p·page_size, h_kv, d], masks
+    positions >= seq_len with the NEG_INF sentinel, f32 softmax. Rows
+    with seq_len == 0 produce the uniform-softmax artifact (see
+    reference_attention_lse) — callers mask inactive slots out."""
+    s_n, h, d = q.shape
+    n_pages, page_size, h_kv, _ = k_pages.shape
+    p = page_table.shape[1]
+    g = h // h_kv
+    scale = d**-0.5
+    k = k_pages[page_table].reshape(s_n, p * page_size, h_kv, d)
+    v = v_pages[page_table].reshape(s_n, p * page_size, h_kv, d)
+    q5 = q.reshape(s_n, h_kv, g, d).astype(jnp.float32) * scale
+    s = jnp.einsum(
+        "shgd,sthd->shgt", q5, k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )  # [s, h_kv, g, t]
+    kpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+    s = jnp.where(kpos < seq_lens[:, None, None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    pr = jnp.exp(s - m)
+    l = jnp.sum(pr, axis=-1, keepdims=True)
+    out = jnp.einsum(
+        "shgt,sthd->shgd", pr / l, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(s_n, h, d).astype(q.dtype)
+
+
+def _decode_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, page_size, g, scale):
+    """One (sequence, kv-head) pair streams its pages through VMEM. The
+    innermost grid dim walks page-table SLOTS; pages past the sequence's
+    live prefix are skipped with pl.when (the DMA still lands — a valid
+    pool page, contents ignored). In-page positions past seq_len mask to
+    NEG_INF, so a sequence ending mid-page is exact (the page-boundary-
+    crossing case tests/test_flash_decode.py pins)."""
+    from jax.experimental import pallas as pl
+
+    si = pl.program_id(0)
+    pi = pl.program_id(2)
+    npi = pl.num_programs(2)
+    d = q_ref.shape[-1]
+
+    @pl.when(pi == 0)
+    def _init():
+        m_scr[:, :] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:, :] = jnp.zeros_like(l_scr)
+        acc_scr[:, :] = jnp.zeros_like(acc_scr)
+
+    length = sl_ref[si]
+    live = pi * page_size < length
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0].reshape(g, d).astype(jnp.float32) * scale
+        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [page_size, d]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [g, page_size]
+        kpos = pi * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kpos < length, s, NEG_INF)
+        m_prev = m_scr[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[:, :] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[:, :] = l_scr[:, :] * alpha[:, None] + jnp.sum(p, axis=1)[:, None]
+        acc_scr[:, :] = acc_scr[:, :] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(pi == npi - 1)
+    def _finish():
+        # seq_len == 0 leaves l at 0 (no live page ever ran) — guard the
+        # divide so inactive slots emit zeros, not nan.
+        l = l_scr[:, 0]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:, :] / l[:, None]).reshape(g, d).astype(o_ref.dtype)
+
+
+def _decode_call(q, k_pages, v_pages, page_table, seq_lens, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    s_n, h, d = q.shape
+    _, page_size, h_kv, _ = k_pages.shape
+    p = page_table.shape[1]
+    g = h // h_kv
+    q4 = q.reshape(s_n, h_kv, g, d)
+
+    # Scalar-prefetch args (page_table, seq_lens) reach the index_maps as
+    # TRAILING refs after the grid indices — the K/V source block for
+    # grid step (si, hk, pi) is whatever page the table names, which is
+    # the whole paging trick.
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s_n, h_kv, p),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d), lambda si, hk, pi, pt, sl: (si, hk, 0, 0)),
+            pl.BlockSpec(
+                (1, page_size, 1, d),
+                lambda si, hk, pi, pt, sl: (pt[si, pi], 0, hk, 0),
+            ),
+            pl.BlockSpec(
+                (1, page_size, 1, d),
+                lambda si, hk, pi, pt, sl: (pt[si, pi], 0, hk, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, g, d), lambda si, hk, pi, pt, sl: (si, hk, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((g, LSE_LANES), jnp.float32),  # running max m
+            pltpu.VMEM((g, LSE_LANES), jnp.float32),  # running sum l
+            pltpu.VMEM((g, d), jnp.float32),          # output accumulator
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, page_size=page_size, g=g, scale=d**-0.5
+    )
+    o = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s_n, h_kv, g, d), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      q4, k_pages, v_pages)
+    return o.reshape(s_n, h, d)
+
+
+def flash_attention_decode(
+    q,
+    k_pages,
+    v_pages,
+    page_table,
+    seq_lens,
+    interpret: Optional[bool] = None,
+    force_kernel: Optional[bool] = None,
+):
+    """Paged decode attention: one query token per sequence against a
+    paged K/V cache.
+
+    q [s, h, d]; k_pages/v_pages [n_pages, page_size, h_kv, d] (the
+    serve/kvcache.py pool layout); page_table [s, max_pages] int32;
+    seq_lens [s] int32 (valid K/V length per sequence, INCLUDING the
+    just-written current position — decode attends to itself). Returns
+    [s, h, d] in q's dtype. GQA-native: h % h_kv folds into the q tile
+    exactly as in the full kernel.
+
+    Dispatch mirrors flash_attention: the Pallas kernel engages on TPU
+    (or under ``interpret=True`` — the CPU test path) when the page size
+    is sublane-aligned; otherwise the pure-JAX gather reference (same
+    math, same f32 softmax, same NEG_INF masking) — the documented
+    off-TPU path, so the serve engine runs everywhere. ``force_kernel``
+    overrides the heuristic both ways (alignment still binds). Rows with
+    seq_lens == 0 are inactive slots: both paths return garbage-but-
+    finite output there (zeros from the kernel, the uniform artifact
+    from the reference) — callers mask, never read."""
+    if q.ndim != 3 or k_pages.ndim != 4:
+        raise ValueError(
+            f"decode shapes: q [s,h,d] (got {q.shape}), pages "
+            f"[n,page,h_kv,d] (got {k_pages.shape})"
+        )
+    if k_pages.shape != v_pages.shape:
+        raise ValueError(f"k/v pool mismatch: {k_pages.shape} vs {v_pages.shape}")
+    h, h_kv = q.shape[1], k_pages.shape[2]
+    if h % h_kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
+    page_size = k_pages.shape[1]
+    aligned = page_size % 8 == 0
+    use = aligned and (bool(interpret) or jax.default_backend() == "tpu")
+    if force_kernel is not None:
+        use = force_kernel and aligned and (
+            bool(interpret) or jax.default_backend() == "tpu"
+        )
+    if not use:
+        return paged_decode_reference(q, k_pages, v_pages, page_table, seq_lens)
+    return _decode_call(
+        q, k_pages, v_pages, page_table, seq_lens, bool(interpret)
+    )

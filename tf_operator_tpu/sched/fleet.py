@@ -37,6 +37,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from tf_operator_tpu.api.types import (
     KIND_PRIORITY_CLASS,
+    JOB_CLASS_SERVING,
     KIND_PROCESS,
     KIND_QUEUE,
     KIND_TPUJOB,
@@ -57,6 +58,11 @@ PREEMPT = "preempt"  # drain victims, then park until their chips free up
 # PriorityClass objects are cluster-scoped in spirit; they live in this
 # namespace and are resolved by name from any tenant namespace.
 PRIORITY_CLASS_NAMESPACE = "default"
+
+# Effective priority of a job_class="serving" job with no explicit
+# PriorityClass: high enough to preempt any class-less training job
+# (priority 0), low enough that a named class can rank above it.
+SERVING_DEFAULT_PRIORITY = 100
 
 
 @dataclass
@@ -104,13 +110,25 @@ class FleetScheduler:
     # ---- store lookups --------------------------------------------------
 
     def priority_of(self, job: TPUJob) -> int:
+        # job_class (r10): a "serving" job is latency-sensitive by
+        # declaration — it outranks the priority-0 training baseline with
+        # ZERO PriorityClass setup, so serve preempts training out of the
+        # box (the victim drains + warm-resumes and later backfills the
+        # serve-idle capacity). An explicit priority_class still wins —
+        # operators can rank serve tiers or even park a serve job below
+        # training by naming a class.
+        base = (
+            SERVING_DEFAULT_PRIORITY
+            if getattr(job.spec.scheduling, "job_class", "") == JOB_CLASS_SERVING
+            else 0
+        )
         name = job.spec.scheduling.priority_class
         if not name:
-            return 0
+            return base
         try:
             pc = self.store.get(KIND_PRIORITY_CLASS, PRIORITY_CLASS_NAMESPACE, name)
         except NotFoundError:
-            return 0  # missing class degrades to baseline, never blocks
+            return base  # missing class degrades to the class baseline
         return int(pc.value)
 
     def queue_for(self, job: TPUJob) -> Optional[Queue]:

@@ -1,0 +1,455 @@
+"""Continuous-batching decode engine (Orca OSDI '22 iteration-level
+scheduling + vLLM SOSP '23 paged KV) over the models/transformer.py LM.
+
+The unit of scheduling is ONE engine step, not one request: at every
+step boundary the engine admits newly-arrived requests into free batch
+slots, pushes one prefill chunk for each still-prefilling slot, runs one
+batched decode step for every decoding slot, and evicts finished
+sequences immediately (pages back to the free list the same step — the
+next admission reuses them copy-free). There is no drain-the-batch
+barrier anywhere; ``mode="static"`` deliberately reintroduces one (admit
+only into an EMPTY batch, hold every slot until the whole batch
+finishes) as the baseline tools/servebench.py compares against.
+
+Two compiled functions, both fixed-shape:
+
+- the DECODE step: every slot advances one token. Each layer computes
+  single-position q/k/v, rotates at the token's absolute position
+  (rope_at_positions), scatters k/v into the slot's current page row,
+  and attends through the page table (ops.flash_attention_decode —
+  kernel on TPU, gather reference off-TPU). Inactive slots steer their
+  writes to the pool's trash page and mask attention with seq_len 0.
+
+- the PREFILL chunk: ``prefill_chunk`` prompt tokens of ONE sequence.
+  The chunk's C positions are treated as C pseudo-sequences sharing the
+  sequence's page table row with per-position lengths pos+1 — k/v are
+  written first, then the SAME paged decode attention runs, which makes
+  the chunk causal by construction and keeps prefill on the decode
+  path instead of a second attention implementation. The last chunk's
+  final logits yield the request's first generated token (the TTFT
+  boundary).
+
+Greedy argmax sampling, f32 compute throughout: serving determinism is
+what the correctness oracle (tests/test_serve.py) and the seeded bench
+artifact pin against.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from tf_operator_tpu.serve.kvcache import (
+    PagePool,
+    PoolExhausted,
+    SequencePages,
+    pages_needed,
+)
+
+
+@dataclass
+class ServeConfig:
+    """Engine policy knobs (workload keys carry the same names with a
+    ``kv_``/serve prefix — see workloads/serve.py)."""
+
+    page_size: int = 16
+    pool_pages: int = 64
+    max_slots: int = 4
+    prefill_chunk: int = 16
+    # admission policy: reserve the worst case (prompt + max_new) pages
+    # at admission so a running sequence can never hit PoolExhausted
+    # mid-decode; False allocates prompt-only and grows on demand (a
+    # growth failure is a hard error — the knob exists to measure the
+    # reservation's utilization cost, not for production).
+    reserve_full: bool = True
+    # at most this many admissions per step boundary (0 = unlimited):
+    # bounds per-step prefill work so decode latency stays smooth under
+    # an arrival burst.
+    max_admit_per_step: int = 0
+    mode: str = "continuous"  # "continuous" | "static" (drain baseline)
+
+
+@dataclass
+class Request:
+    rid: int
+    prompt: List[int]
+    max_new: int
+    arrival: float = 0.0  # seconds offset from run start
+
+    # filled in by the engine
+    tokens: List[int] = field(default_factory=list)
+    admitted: float = -1.0
+    first_token: float = -1.0
+    finished: float = -1.0
+    token_times: List[float] = field(default_factory=list)
+
+
+@dataclass
+class RunResult:
+    requests: List[Request]
+    steps: int
+    wall_s: float
+    generated_tokens: int
+    free_pages_start: int
+    free_pages_end: int
+
+    @property
+    def completed(self) -> int:
+        return sum(1 for r in self.requests if r.finished >= 0)
+
+    @property
+    def tokens_per_s(self) -> float:
+        return self.generated_tokens / self.wall_s if self.wall_s > 0 else 0.0
+
+    def ttfts(self) -> List[float]:
+        return [r.first_token - r.arrival for r in self.requests
+                if r.first_token >= 0]
+
+    def token_latencies(self) -> List[float]:
+        """Inter-token gaps per request (the per-token latency the bench
+        quotes p50/p99 of; TTFT is excluded — it has its own metric)."""
+        out: List[float] = []
+        for r in self.requests:
+            ts = r.token_times
+            out.extend(b - a for a, b in zip(ts, ts[1:]))
+        return out
+
+
+class _Slot:
+    __slots__ = ("req", "pages", "seq_len", "prefill_pos", "cur_tok", "generated")
+
+    def __init__(self, req: Request, pages: SequencePages):
+        self.req = req
+        self.pages = pages
+        self.seq_len = 0        # K/V positions written
+        self.prefill_pos = 0    # prompt tokens consumed
+        self.cur_tok = -1       # pending input token once decoding
+        self.generated = 0
+
+
+class ServeEngine:
+    def __init__(self, cfg, params, scfg: ServeConfig):
+        import jax
+
+        if cfg.n_experts:
+            raise ValueError("serve engine: MoE presets not supported")
+        if getattr(cfg, "pp_stages", 0):
+            raise ValueError("serve engine: pipeline presets not supported")
+        if scfg.page_size < 1:
+            raise ValueError(f"kv_page_size must be >= 1, got {scfg.page_size}")
+        if scfg.pool_pages < 1:
+            raise ValueError(f"kv_pool_pages must be >= 1, got {scfg.pool_pages}")
+        self.cfg = cfg
+        self.scfg = scfg
+        # f32 master weights: serving determinism + the logits-parity
+        # oracle; pools match.
+        import jax.numpy as jnp
+
+        self.params = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a, jnp.float32), params
+        )
+        self.max_pages_per_seq = pages_needed(cfg.max_seq, scfg.page_size)
+        self._jit_build()
+
+    # -- compiled step functions -----------------------------------------
+
+    def _jit_build(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from tf_operator_tpu.models.transformer import (
+            _rms_norm,
+            rope_at_positions,
+        )
+        from tf_operator_tpu.ops.flash_attention import flash_attention_decode
+
+        cfg = self.cfg
+        ps = self.scfg.page_size
+        trash = self.scfg.pool_pages  # PagePool.trash_page
+        hd = cfg.head_dim
+        L = cfg.n_layers
+
+        def _body(params, kp, vp, x, pos, table, lens, write_pid, write_row):
+            """Shared per-layer body: x [n, d] at absolute positions pos
+            [n]; writes each row's k/v to (write_pid[i], write_row[i])
+            then attends through ``table`` with per-row lengths ``lens``.
+            Returns (kp, vp, final hidden [n, d])."""
+            n = x.shape[0]
+            lp = params["layers"]
+            for l in range(L):
+                h = _rms_norm(x, lp["attn_norm"][l], cfg.norm_eps)
+                q = (h @ lp["wq"][l]).reshape(n, -1, hd)
+                k = (h @ lp["wk"][l]).reshape(n, -1, hd)
+                v = (h @ lp["wv"][l]).reshape(n, -1, hd)
+                q = rope_at_positions(q[:, None], pos[:, None], cfg.rope_theta)[:, 0]
+                k = rope_at_positions(k[:, None], pos[:, None], cfg.rope_theta)[:, 0]
+                kp = kp.at[l, write_pid, write_row].set(k)
+                vp = vp.at[l, write_pid, write_row].set(v)
+                attn = flash_attention_decode(q, kp[l], vp[l], table, lens)
+                x = x + attn.reshape(n, -1) @ lp["wo"][l]
+                h2 = _rms_norm(x, lp["mlp_norm"][l], cfg.norm_eps)
+                x = x + (
+                    jax.nn.silu(h2 @ lp["w_gate"][l]) * (h2 @ lp["w_up"][l])
+                ) @ lp["w_down"][l]
+            return kp, vp, x
+
+        def decode_step(params, kp, vp, table, seq_lens, tokens, active):
+            """One token for every slot. tokens[i] sits at position
+            seq_lens[i]; returns next greedy token per slot."""
+            s = tokens.shape[0]
+            x = params["embed"][tokens]
+            pos = seq_lens
+            pid = table[jnp.arange(s), pos // ps]
+            pid = jnp.where(active, pid, trash)
+            kp, vp, x = _body(
+                params, kp, vp, x, pos, table,
+                jnp.where(active, pos + 1, 0), pid, pos % ps,
+            )
+            logits = _rms_norm(x, params["final_norm"], cfg.norm_eps) @ params["embed"].T
+            return kp, vp, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        def prefill_chunk(params, kp, vp, table_row, start, tokens_c, n_valid):
+            """One chunk of one sequence's prompt: the C positions run as
+            C pseudo-sequences over the shared page-table row (lengths
+            pos+1 ⇒ causal), reusing the paged decode attention."""
+            c = tokens_c.shape[0]
+            idx = jnp.arange(c)
+            pos = start + idx
+            valid = idx < n_valid
+            x = params["embed"][tokens_c]
+            pid = jnp.where(valid, table_row[pos // ps], trash)
+            table_c = jnp.broadcast_to(table_row, (c, table_row.shape[0]))
+            kp, vp, x = _body(
+                params, kp, vp, x, pos, table_c,
+                jnp.where(valid, pos + 1, 0), pid, pos % ps,
+            )
+            last = _rms_norm(x[n_valid - 1], params["final_norm"], cfg.norm_eps)
+            logits = last @ params["embed"].T
+            return kp, vp, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        self._decode = jax.jit(decode_step, donate_argnums=(1, 2))
+        self._prefill = jax.jit(prefill_chunk, donate_argnums=(1, 2))
+
+    def _fresh_pools(self):
+        import jax.numpy as jnp
+
+        cfg, scfg = self.cfg, self.scfg
+        shape = (
+            cfg.n_layers, scfg.pool_pages + 1, scfg.page_size,
+            cfg.n_kv_heads, cfg.head_dim,
+        )
+        return jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32)
+
+    # -- the scheduler loop ----------------------------------------------
+
+    def run(
+        self,
+        requests: List[Request],
+        mode: Optional[str] = None,
+        clock: Callable[[], float] = time.perf_counter,
+        on_event: Optional[Callable[[str, Any], None]] = None,
+    ) -> RunResult:
+        """Serve ``requests`` (arrival offsets in seconds from run start)
+        to completion. ``on_event(kind, payload)`` fires with kinds
+        "admitted"/"first_token"/"finished" (payload: the Request) and
+        "step" (payload: dict with step/active/waiting/completed) — the
+        workload's span + live-count seam."""
+        import jax.numpy as jnp
+
+        mode = mode or self.scfg.mode
+        if mode not in ("continuous", "static"):
+            raise ValueError(f"mode {mode!r}")
+        scfg = self.scfg
+        for r in requests:
+            if not r.prompt:
+                raise ValueError(f"request {r.rid}: empty prompt")
+            if len(r.prompt) + r.max_new > self.cfg.max_seq:
+                raise ValueError(
+                    f"request {r.rid}: prompt {len(r.prompt)} + max_new "
+                    f"{r.max_new} exceeds max_seq {self.cfg.max_seq}"
+                )
+            if pages_needed(len(r.prompt) + r.max_new, scfg.page_size) > scfg.pool_pages:
+                raise ValueError(
+                    f"request {r.rid} alone needs "
+                    f"{pages_needed(len(r.prompt) + r.max_new, scfg.page_size)} "
+                    f"pages but the pool holds {scfg.pool_pages} — it could "
+                    f"never be admitted"
+                )
+        pool = PagePool(scfg.pool_pages)
+        free_start = pool.free_count
+        kp, vp = self._fresh_pools()
+        s_n = scfg.max_slots
+        table = np.full((s_n, self.max_pages_per_seq), pool.trash_page - 1,
+                        np.int32)
+        slots: List[Optional[_Slot]] = [None] * s_n
+
+        pending = deque(sorted(requests, key=lambda r: (r.arrival, r.rid)))
+        waiting: deque = deque()
+        emit = on_event or (lambda kind, payload: None)
+        t0 = clock()
+        step = 0
+        completed = 0
+        generated = 0
+
+        def _admit_ok() -> bool:
+            if mode == "static":
+                # drain-the-batch baseline: the batch forms only when
+                # EMPTY — late arrivals wait out the whole generation.
+                return all(sl is None for sl in slots)
+            return True
+
+        def _try_admit(now: float) -> int:
+            n = 0
+            while waiting and _admit_ok():
+                if scfg.max_admit_per_step and n >= scfg.max_admit_per_step:
+                    break
+                free = [i for i, sl in enumerate(slots) if sl is None]
+                if not free:
+                    break
+                req = waiting[0]
+                want = len(req.prompt) + (req.max_new if scfg.reserve_full else 0)
+                sp = SequencePages(scfg.page_size)
+                try:
+                    sp.ensure(want, pool)
+                except PoolExhausted:
+                    break  # head-of-line blocks: FIFO admission, no bypass
+                waiting.popleft()
+                i = free[0]
+                slots[i] = _Slot(req, sp)
+                table[i, : len(sp.pages)] = sp.pages
+                req.admitted = now
+                emit("admitted", req)
+                n += 1
+                if mode == "static" and n >= s_n:
+                    break
+            return n
+
+        def _finish(i: int, now: float) -> None:
+            """Mark slot i's request complete. Continuous mode releases
+            the slot and its pages IMMEDIATELY (reusable this very step);
+            static mode holds everything until the whole batch drains —
+            the barrier being measured."""
+            nonlocal completed
+            sl = slots[i]
+            sl.req.finished = now
+            completed += 1
+            emit("finished", sl.req)
+            if mode == "continuous":
+                sl.pages.release(pool)
+                table[i, :] = pool.trash_page - 1
+                slots[i] = None
+
+        def _drain_static(now: float) -> None:
+            if mode != "static":
+                return
+            live = [sl for sl in slots if sl is not None]
+            if live and all(sl.generated >= sl.req.max_new for sl in live):
+                for j, sl in enumerate(slots):
+                    if sl is not None:
+                        sl.pages.release(pool)
+                        table[j, :] = pool.trash_page - 1
+                        slots[j] = None
+
+        while completed < len(requests):
+            now = clock() - t0
+            while pending and pending[0].arrival <= now:
+                waiting.append(pending.popleft())
+            _try_admit(now)
+            busy = [sl for sl in slots if sl is not None]
+            if not busy:
+                if pending:
+                    # idle until the next arrival — a serving engine,
+                    # not a busy loop.
+                    time.sleep(
+                        max(0.0, min(0.01, pending[0].arrival - (clock() - t0)))
+                    )
+                continue
+
+            # ---- prefill: one chunk per still-prefilling slot ----------
+            for i, sl in enumerate(slots):
+                if sl is None or sl.prefill_pos >= len(sl.req.prompt):
+                    continue
+                prompt = sl.req.prompt
+                c = self.scfg.prefill_chunk
+                chunk = prompt[sl.prefill_pos : sl.prefill_pos + c]
+                n_valid = len(chunk)
+                buf = np.zeros(c, np.int32)
+                buf[:n_valid] = chunk
+                if not scfg.reserve_full:
+                    sl.pages.ensure(sl.prefill_pos + n_valid, pool)
+                    table[i, : len(sl.pages.pages)] = sl.pages.pages
+                kp, vp, tok = self._prefill(
+                    self.params, kp, vp, jnp.asarray(table[i]),
+                    jnp.int32(sl.prefill_pos), jnp.asarray(buf),
+                    jnp.int32(n_valid),
+                )
+                sl.prefill_pos += n_valid
+                sl.seq_len = sl.prefill_pos
+                if sl.prefill_pos >= len(prompt):
+                    # last chunk's logits ARE the first generated token
+                    t_tok = clock() - t0
+                    first = int(tok)
+                    sl.req.tokens.append(first)
+                    sl.req.token_times.append(t_tok)
+                    sl.req.first_token = t_tok
+                    sl.generated = 1
+                    sl.cur_tok = first
+                    generated += 1
+                    emit("first_token", sl.req)
+                    if sl.generated >= sl.req.max_new:
+                        _finish(i, t_tok)
+
+            # ---- decode: one batched step over decoding slots ----------
+            dec = [
+                (i, sl) for i, sl in enumerate(slots)
+                if sl is not None
+                and sl.prefill_pos >= len(sl.req.prompt)
+                and sl.generated < sl.req.max_new
+            ]
+            if dec:
+                active = np.zeros(s_n, bool)
+                toks = np.zeros(s_n, np.int32)
+                lens = np.zeros(s_n, np.int32)
+                for i, sl in dec:
+                    if not scfg.reserve_full:
+                        sl.pages.ensure(sl.seq_len + 1, pool)
+                        table[i, : len(sl.pages.pages)] = sl.pages.pages
+                    active[i] = True
+                    toks[i] = sl.cur_tok
+                    lens[i] = sl.seq_len
+                kp, vp, nxt = self._decode(
+                    self.params, kp, vp, jnp.asarray(table), jnp.asarray(lens),
+                    jnp.asarray(toks), jnp.asarray(active),
+                )
+                nxt = np.asarray(nxt)
+                t_tok = clock() - t0
+                for i, sl in dec:
+                    sl.seq_len += 1
+                    sl.generated += 1
+                    sl.cur_tok = int(nxt[i])
+                    sl.req.tokens.append(sl.cur_tok)
+                    sl.req.token_times.append(t_tok)
+                    generated += 1
+                    if sl.generated >= sl.req.max_new:
+                        _finish(i, t_tok)
+            _drain_static(clock() - t0)
+            step += 1
+            emit("step", {
+                "step": step,
+                "active": sum(1 for sl in slots if sl is not None),
+                "waiting": len(waiting) + len(pending),
+                "completed": completed,
+                "generated": generated,
+                "free_pages": pool.free_count,
+            })
+
+        wall = clock() - t0
+        return RunResult(
+            requests=list(requests), steps=step, wall_s=wall,
+            generated_tokens=generated, free_pages_start=free_start,
+            free_pages_end=pool.free_count,
+        )

@@ -1,0 +1,131 @@
+"""Paged KV cache: fixed-size pages in a preallocated device pool.
+
+The vLLM (SOSP '23) memory model in jax_graft form: decode K/V state
+lives in PAGES of ``page_size`` token slots, preallocated as one device
+pool per layer side — shape [n_layers, num_pages + 1, page_size,
+n_kv_heads, head_dim]. A sequence owns an ordered page table (host-side
+int32 row); growing by one token touches exactly one page row, and
+completion returns the pages to a free list with NO copying — the next
+sequence overwrites them in place (pages carry no ownership state on
+device; the page table is the only source of truth).
+
+Page index ``num_pages`` (the +1) is the TRASH page: masked writes from
+inactive batch slots and prefill padding are steered there instead of
+predicating the scatter — its contents are never read (no page table
+ever names it inside a live prefix).
+
+The allocator is deliberately host-side and trivial: a LIFO free list.
+LIFO maximizes page reuse locality (a just-freed page is hot in whatever
+cache hierarchy applies) and makes the leak check exact —
+``free_count`` must return to ``num_pages`` when the engine drains,
+which the serve-bench CI stage asserts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+
+class PoolExhausted(Exception):
+    """Raised when an allocation cannot be satisfied — admission control
+    must catch this and hold the request, never the decode step."""
+
+
+def pages_needed(tokens: int, page_size: int) -> int:
+    """Pages required to hold ``tokens`` K/V positions (ceil)."""
+    return max(1, -(-int(tokens) // int(page_size)))
+
+
+def pool_bytes(
+    n_layers: int,
+    num_pages: int,
+    page_size: int,
+    n_kv_heads: int,
+    head_dim: int,
+    dtype_bytes: int = 4,
+) -> int:
+    """Device bytes of the K+V pools (including the trash page) — the
+    number tools/memplan.py budgets for a serve job."""
+    per_side = (
+        n_layers * (num_pages + 1) * page_size * n_kv_heads * head_dim
+    )
+    return 2 * per_side * dtype_bytes
+
+
+@dataclass
+class PagePool:
+    """Free-list page allocator over a pool of ``num_pages`` pages.
+
+    Pure host-side bookkeeping: the device pool itself is allocated by
+    the engine (it owns dtype/layout); this class only decides which
+    page ids are live. ``free_count`` is the leak probe — after every
+    sequence is finished and freed it must equal ``num_pages``."""
+
+    num_pages: int
+    _free: List[int] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if self.num_pages < 1:
+            raise ValueError(f"page pool needs >= 1 page, got {self.num_pages}")
+        # LIFO: pop from the tail, so page 0 is handed out first.
+        self._free = list(range(self.num_pages - 1, -1, -1))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def trash_page(self) -> int:
+        """The masked-write sink: one past the allocatable range."""
+        return self.num_pages
+
+    def alloc(self, n: int) -> List[int]:
+        """Allocate ``n`` pages or raise PoolExhausted (all-or-nothing:
+        a partial grant would leak on the caller's error path)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            raise PoolExhausted(
+                f"need {n} pages, {len(self._free)}/{self.num_pages} free"
+            )
+        got = [self._free.pop() for _ in range(n)]
+        return got
+
+    def free(self, pages: List[int]) -> None:
+        """Return pages to the free list. Copy-free reuse: the device
+        pages are NOT cleared — the next owner overwrites them and its
+        page table masks anything it hasn't written yet."""
+        for p in pages:
+            if not 0 <= p < self.num_pages:
+                raise ValueError(f"free of page {p} outside pool")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+        self._free.extend(pages)
+
+
+@dataclass
+class SequencePages:
+    """One sequence's page table: the ordered page ids backing positions
+    [0, len). Grown on demand by the engine as the sequence crosses page
+    boundaries; freed wholesale at completion."""
+
+    page_size: int
+    pages: List[int] = field(default_factory=list)
+
+    @property
+    def capacity(self) -> int:
+        return len(self.pages) * self.page_size
+
+    def ensure(self, length: int, pool: PagePool) -> None:
+        """Grow to cover ``length`` positions (PoolExhausted propagates —
+        the engine's admission policy reserves worst-case up front by
+        default, so on-demand growth only fires under the optimistic
+        knob)."""
+        need = pages_needed(length, self.page_size) - len(self.pages)
+        if need > 0:
+            self.pages.extend(pool.alloc(need))
+
+    def release(self, pool: PagePool) -> None:
+        pool.free(self.pages)
+        self.pages = []

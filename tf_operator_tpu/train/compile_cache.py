@@ -18,6 +18,7 @@ to call multiple times; honors an explicit ``JAX_COMPILATION_CACHE_DIR``.
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 
@@ -28,12 +29,132 @@ DEFAULT_CACHE_DIR = os.path.join(
 )
 ENV_DIR = "JAX_COMPILATION_CACHE_DIR"
 ENV_DISABLE = "TPUJOB_NO_COMPILE_CACHE"
+ENV_FORCE = "TPUJOB_FORCE_COMPILE_CACHE"
+
+_DIGEST_SUFFIX = "-sha256"
+_hardened = False
 
 
-def enable(cache_dir: str | None = None) -> str | None:
+def _digest_path(cache_path):
+    return cache_path.with_name(cache_path.name + _DIGEST_SUFFIX)
+
+
+def _harden_cache_io() -> None:
+    """Crash-safe the jax file cache (r10, found by the serve preemption
+    probe): jax's ``LRUCache.put`` writes entries with a bare
+    ``write_bytes()`` and never overwrites an existing key. A process
+    killed mid-write — the operator's preempt path SIGKILLs workers, so
+    this is a *routine* event, not a freak one — leaves a truncated blob
+    under the final name; every warm-restarted incarnation that hits that
+    key then deserializes garbage inside XLA and dies with
+    SIGSEGV/SIGABRT, which the restart taxonomy rightly calls permanent.
+    Net effect: one unlucky preemption poisons the cache key and turns
+    every later warm restart of that program into a crash loop.
+
+    Two wraps fix it for good:
+
+    - ``put``: write a sha256 sidecar, then the payload via temp file +
+      atomic ``os.replace`` — a kill at any instant leaves either no
+      entry or a complete one.
+    - ``get``: verify the sidecar before handing bytes to XLA; a
+      mismatching or missing sidecar deletes the entry and reports a
+      miss (recompile), so pre-existing poison self-heals instead of
+      aborting the process.
+
+    Private-API patch, same caveat and best-effort guard as the
+    ``reset_cache()`` call in ``enable()`` below."""
+    global _hardened
+    if _hardened:
+        return
+    try:
+        from jax._src.lru_cache import LRUCache
+    except ImportError:
+        return
+
+    orig_put, orig_get = LRUCache.put, LRUCache.get
+
+    def safe_put(self, key: str, val: bytes) -> None:
+        cache_path = self.path / f"{key}-cache"
+        try:
+            if cache_path.exists():
+                return
+            _digest_path(cache_path).write_bytes(
+                hashlib.sha256(val).hexdigest().encode()
+            )
+            tmp = cache_path.with_name(cache_path.name + f".tmp{os.getpid()}")
+            tmp.write_bytes(val)
+            os.replace(tmp, cache_path)
+            import time as _time
+
+            (self.path / f"{key}-atime").write_bytes(
+                _time.time_ns().to_bytes(8, "little")
+            )
+            # The original put sees the entry already present and returns
+            # without rewriting the payload; calling it keeps the
+            # eviction-lock bookkeeping of eviction-enabled caches intact.
+        except OSError:
+            pass
+        orig_put(self, key, val)
+
+    def safe_get(self, key: str):
+        val = orig_get(self, key)
+        if val is None:
+            return None
+        cache_path = self.path / f"{key}-cache"
+        dpath = _digest_path(cache_path)
+        try:
+            want = dpath.read_bytes().decode()
+        except OSError:
+            want = ""
+        if want == hashlib.sha256(val).hexdigest():
+            return val
+        # Unverifiable (legacy or torn write): purge and recompile.
+        log.warning(
+            "compilation cache entry %s failed integrity check; "
+            "dropping it (will recompile)", key,
+        )
+        for p in (cache_path, dpath, self.path / f"{key}-atime"):
+            try:
+                p.unlink()
+            except OSError:
+                pass
+        return None
+
+    LRUCache.put, LRUCache.get = safe_put, safe_get
+    _hardened = True
+
+
+def _cpu_only_platform() -> bool:
+    """True when JAX is pinned to the CPU backend (JAX_PLATFORMS=cpu).
+    Env-only check on purpose: enable() runs BEFORE
+    jax.distributed.initialize in the harness, and asking jax for its
+    backend would initialize it too early."""
+    plats = (os.environ.get("JAX_PLATFORMS") or "").replace(" ", "").lower()
+    return plats.strip(",") == "cpu"
+
+
+def enable(cache_dir: str | None = None, force: bool = False) -> str | None:
     """Turn on the persistent compilation cache; returns the directory in
-    use, or None when disabled via TPUJOB_NO_COMPILE_CACHE=1."""
+    use, or None when disabled via TPUJOB_NO_COMPILE_CACHE=1 or because
+    the process is pinned to the CPU backend.
+
+    CPU is excluded (r10, root-caused by the serve preemption probe):
+    jaxlib 0.4.x serializes CPU executables with process-local state
+    (custom-call pointers), so an entry deserialized by a DIFFERENT
+    process than the one that compiled it can execute as heap
+    corruption — observed as warm-restarted trainers dying with
+    SIGSEGV/SIGABRT ("corrupted double-linked list") or, worse,
+    silently computing garbage that trips the non-finite-loss
+    checkpoint gate. Bit-identical entries reproduce it: the writing
+    process runs fine, a second identical process reading the entry
+    crashes. The cache is a TPU submit-latency lever; on CPU (tests,
+    local benches) compiles are cheap and correctness wins.
+    ``force=True`` / TPUJOB_FORCE_COMPILE_CACHE=1 override for cache
+    machinery tests."""
     if os.environ.get(ENV_DISABLE, "") == "1":
+        return None
+    if not force and os.environ.get(ENV_FORCE, "") != "1" and _cpu_only_platform():
+        log.debug("persistent compilation cache disabled on cpu-only backend")
         return None
     path = cache_dir or os.environ.get(ENV_DIR) or DEFAULT_CACHE_DIR
     try:
@@ -60,4 +181,5 @@ def enable(cache_dir: str | None = None) -> str | None:
         _jcc.reset_cache()
     except (ImportError, AttributeError):  # private API; best-effort
         pass
+    _harden_cache_io()
     return path

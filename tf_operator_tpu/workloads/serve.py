@@ -1,0 +1,169 @@
+"""Continuous-batching LM serving workload (operator-launchable).
+
+Drives serve/engine.py with a models/transformer.py preset under
+JobContext: synthetic requests arrive on a seeded Poisson schedule, the
+engine serves them with iteration-level continuous batching over the
+paged KV cache, and the job exits 0 when every request has completed.
+
+Per-request spans land in the PR 3 trace next to the per-job spans:
+``request-admitted`` (arrival → admission), ``first-token`` (arrival →
+first generated token: the TTFT the reconciler folds into
+``tpujob_request_ttft_seconds`` at terminal) and ``finished`` (arrival →
+completion, attrs carry the generated-token count feeding
+``tpujob_request_tokens_total``). Span names are deterministic per
+(job, request, op), so restarts re-record idempotently.
+
+Live request-count rides the eval_metrics status channel (the same
+optimistic RMW the Evaluator uses) every ``report_every`` steps — the
+dashboard's serve-job "Requests" column reads it.
+
+workload config keys: preset (+ any TransformerConfig override),
+requests, prompt_len, max_new_tokens, arrival_rate (req/s Poisson; 0 ⇒
+all at t=0), seed, kv_page_size, kv_pool_pages, max_slots,
+prefill_chunk, reserve_full, max_admit_per_step, mode
+("continuous"|"static"), report_every.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from tf_operator_tpu.rendezvous.context import JobContext
+
+log = logging.getLogger("tpujob.serve")
+
+
+def synthesize_requests(wl: dict, vocab: int):
+    """The seeded request stream (shared with tools/servebench.py so the
+    bench and the operator workload replay identical traffic): Poisson
+    arrivals, uniform prompt lengths around prompt_len, uniform random
+    prompt tokens, ragged generation budgets in [1, max_new_tokens]."""
+    import numpy as np
+
+    from tf_operator_tpu.serve.engine import Request
+
+    rng = np.random.RandomState(int(wl.get("seed", 0)))
+    n = int(wl.get("requests", 8))
+    rate = float(wl.get("arrival_rate", 20.0))
+    mean_prompt = max(1, int(wl.get("prompt_len", 8)))
+    max_new = max(1, int(wl.get("max_new_tokens", 16)))
+    t = 0.0
+    reqs = []
+    for i in range(n):
+        if rate > 0:
+            t += float(rng.exponential(1.0 / rate))
+        plen = int(rng.randint(max(1, mean_prompt // 2), mean_prompt * 2 + 1))
+        reqs.append(
+            Request(
+                rid=i,
+                prompt=[int(x) for x in rng.randint(1, vocab, size=plen)],
+                max_new=int(rng.randint(1, max_new + 1)),
+                arrival=t,
+            )
+        )
+    return reqs
+
+
+def _quantile(xs, q):
+    if not xs:
+        return 0.0
+    ys = sorted(xs)
+    idx = min(len(ys) - 1, int(round(q * (len(ys) - 1))))
+    return ys[idx]
+
+
+def main(ctx: JobContext) -> None:
+    ctx.initialize_distributed()
+    if ctx.process_id != 0:
+        # the decode engine is single-process (multi-host serving is
+        # roadmap); extra ranks just hold their gang slot
+        return
+
+    import jax
+
+    from tf_operator_tpu.models.transformer import (
+        init_transformer,
+        preset_from_workload,
+    )
+    from tf_operator_tpu.obs.spans import trace8
+    from tf_operator_tpu.serve.engine import ServeConfig, ServeEngine
+
+    wl = ctx.workload
+    cfg = preset_from_workload(wl)
+    scfg = ServeConfig(
+        page_size=int(wl.get("kv_page_size", 16)),
+        pool_pages=int(wl.get("kv_pool_pages", 64)),
+        max_slots=int(wl.get("max_slots", 4)),
+        prefill_chunk=int(wl.get("prefill_chunk", 16)),
+        reserve_full=bool(wl.get("reserve_full", True)),
+        max_admit_per_step=int(wl.get("max_admit_per_step", 0)),
+        mode=str(wl.get("mode", "continuous")),
+    )
+    params = init_transformer(jax.random.PRNGKey(int(wl.get("seed", 0))), cfg)
+    engine = ServeEngine(cfg, params, scfg)
+    requests = synthesize_requests(wl, cfg.vocab)
+    total = len(requests)
+    report_every = max(1, int(wl.get("report_every", 4)))
+
+    wall0 = time.time()  # engine offsets → epoch times for spans
+
+    def span_name(rid: int, op: str) -> str:
+        return f"{ctx.job_name}-{trace8(ctx.trace_id)}-req{rid}-{op}"
+
+    first_step = []
+
+    def on_event(kind: str, payload) -> None:
+        if kind == "step":
+            if not first_step:
+                first_step.append(payload["step"])
+                ctx.mark_first_step(0)
+            if payload["step"] % report_every == 0:
+                ctx.report_eval_metrics(payload["step"], {
+                    "requests_total": float(total),
+                    "requests_active": float(payload["active"]),
+                    "requests_completed": float(payload["completed"]),
+                    "tokens_generated": float(payload["generated"]),
+                })
+            return
+        req = payload
+        base = {"request": str(req.rid), "track": "serve"}
+        if kind == "admitted":
+            ctx.record_span(
+                "request-admitted", wall0 + req.arrival, wall0 + req.admitted,
+                attrs=base, name=span_name(req.rid, "request-admitted"),
+            )
+        elif kind == "first_token":
+            ctx.record_span(
+                "first-token", wall0 + req.arrival, wall0 + req.first_token,
+                attrs=base, name=span_name(req.rid, "first-token"),
+            )
+        elif kind == "finished":
+            ctx.record_span(
+                "finished", wall0 + req.arrival, wall0 + req.finished,
+                attrs={**base, "tokens": str(len(req.tokens))},
+                name=span_name(req.rid, "finished"),
+            )
+
+    res = engine.run(requests, on_event=on_event)
+
+    leaked = res.free_pages_start - res.free_pages_end
+    if leaked:
+        raise RuntimeError(
+            f"KV page leak: {leaked} pages not returned to the free list"
+        )
+    ctx.report_eval_metrics(res.steps, {
+        "requests_total": float(total),
+        "requests_active": 0.0,
+        "requests_completed": float(res.completed),
+        "tokens_generated": float(res.generated_tokens),
+        "tokens_per_s": float(res.tokens_per_s),
+    })
+    ttfts = res.ttfts()
+    log.info(
+        "serve done: preset=%s mode=%s requests=%d/%d tokens=%d tok/s=%.1f "
+        "ttft_p50=%.3fs ttft_p99=%.3fs steps=%d (0 page leaks)",
+        wl.get("preset", "tiny"), scfg.mode, res.completed, total,
+        res.generated_tokens, res.tokens_per_s,
+        _quantile(ttfts, 0.50), _quantile(ttfts, 0.99), res.steps,
+    )

@@ -261,6 +261,120 @@ def plan(preset_name: str, mesh_axes: dict, batch: int, seq: int,
     }
 
 
+def serve_plan(preset_name: str, workload: dict | None = None,
+               kv_page_size: int = 16, kv_pool_pages: int = 64,
+               max_slots: int = 4, prefill_chunk: int = 16):
+    """Per-chip HBM plan for a SERVE job (r10): f32 params + the paged KV
+    pool + the decode-step working set. No optimizer, no gradients, no
+    remat saves — inference holds none of the training state. The pool is
+    the dominant steady-state term and is preallocated up front by
+    serve/engine.py, so an overflow here is an overflow at step 0, not a
+    load-dependent surprise."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, _REPO_ROOT)
+    import math
+
+    import jax
+
+    from tf_operator_tpu.models.transformer import (
+        init_transformer,
+        preset_from_workload,
+    )
+    from tf_operator_tpu.serve.kvcache import pages_needed, pool_bytes
+
+    wl = dict(workload or {})
+    wl.setdefault("preset", preset_name)
+    kv_page_size = int(wl.get("kv_page_size", kv_page_size))
+    kv_pool_pages = int(wl.get("kv_pool_pages", kv_pool_pages))
+    max_slots = int(wl.get("max_slots", max_slots))
+    prefill_chunk = int(wl.get("prefill_chunk", prefill_chunk))
+    cfg = preset_from_workload(wl)
+
+    # the engine casts params to f32 for deterministic greedy decode
+    shapes = jax.eval_shape(
+        lambda k: init_transformer(k, cfg), jax.random.PRNGKey(0)
+    )
+    params_b = sum(
+        math.prod(leaf.shape) * 4 for leaf in jax.tree_util.tree_leaves(shapes)
+    )
+    kv_b = pool_bytes(
+        cfg.n_layers, kv_pool_pages, kv_page_size,
+        cfg.n_kv_heads, cfg.head_dim, dtype_bytes=4,
+    )
+    # working set per step: the wider of a decode batch (max_slots rows)
+    # and a prefill chunk, through one layer's intermediates plus the
+    # f32 logits row for sampling
+    rows = max(max_slots, prefill_chunk)
+    d, f = cfg.d_model, cfg.d_ff
+    kv_width = cfg.n_kv_heads * cfg.head_dim
+    working_b = rows * (6 * d + 2 * kv_width + 4 * f) * 4
+    working_b += max_slots * cfg.vocab * 4
+
+    total = params_b + kv_b + working_b
+    out = {
+        "preset": wl.get("preset", preset_name),
+        "mode": "serve",
+        "kv_page_size": kv_page_size,
+        "kv_pool_pages": kv_pool_pages,
+        "max_slots": max_slots,
+        "max_pages_per_seq": pages_needed(cfg.max_seq, kv_page_size),
+        "params_gb": params_b / 2**30,
+        "kv_pool_gb": kv_b / 2**30,
+        "working_gb": working_b / 2**30,
+        "total_gb": total / 2**30,
+    }
+    # A single max-length sequence that cannot fit the pool can never be
+    # admitted — that is a config error, not a capacity question.
+    if out["max_pages_per_seq"] > kv_pool_pages:
+        out["warning"] = (
+            f"a max_seq={cfg.max_seq} sequence needs "
+            f"{out['max_pages_per_seq']} pages but the pool has only "
+            f"{kv_pool_pages} — such a request can NEVER be admitted"
+        )
+    return out
+
+
+def _is_serve_workload(doc: dict) -> bool:
+    spec = doc.get("spec", {})
+    wl = spec.get("workload", {})
+    if "kv_pool_pages" in wl or "kv_page_size" in wl:
+        return True
+    if spec.get("scheduling", {}).get("job_class") == "serving":
+        return True
+    for rs in spec.get("replica_specs", {}).values():
+        entry = rs.get("template", {}).get("entrypoint", "")
+        if entry.startswith("tf_operator_tpu.workloads.serve"):
+            return True
+    return False
+
+
+def _finish_serve(out: dict, args) -> int:
+    """Print a serve plan; REFUSE loudly when it exceeds the HBM budget
+    or when the pool cannot hold even one max-length sequence — the
+    engine would preallocate-and-OOM (or never admit) at step 0, so a
+    quiet exit code is not enough."""
+    for k, val in out.items():
+        print(f"  {k:<16} {val if not isinstance(val, float) else f'{val:.2f}'}")
+    if "warning" in out:
+        print(f"REFUSED: {out['warning']}", file=sys.stderr)
+        return 1
+    if args.hbm_gb is not None:
+        fits = out["total_gb"] <= args.hbm_gb
+        print(f"  {'fits':<16} {fits} (budget {args.hbm_gb} GiB/chip)")
+        if not fits:
+            print(
+                f"REFUSED: serve plan needs {out['total_gb']:.2f} GiB/chip "
+                f"(kv pool alone is {out['kv_pool_gb']:.2f} GiB) but the "
+                f"budget is {args.hbm_gb} GiB — shrink kv_pool_pages/"
+                f"kv_page_size or pick a smaller preset; the engine "
+                f"preallocates the whole pool at startup, so this WILL "
+                f"OOM at step 0, not under load",
+                file=sys.stderr,
+            )
+            return 1
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--preset", default=None)
@@ -277,6 +391,12 @@ def main(argv=None) -> int:
                         "stage; read from the job spec in --job mode)")
     p.add_argument("--job", default=None,
                    help="read preset/mesh/batch/seq from a TPUJob JSON spec")
+    p.add_argument("--serve", action="store_true",
+                   help="plan a SERVE job (f32 params + paged KV pool, no "
+                        "optimizer/grads); auto-detected in --job mode")
+    p.add_argument("--kv-page-size", type=int, default=16)
+    p.add_argument("--kv-pool-pages", type=int, default=64)
+    p.add_argument("--max-slots", type=int, default=4)
     p.add_argument("--hbm-gb", type=float, default=None,
                    help="per-chip HBM budget; exit 1 if the plan exceeds it")
     args = p.parse_args(argv)
@@ -285,6 +405,10 @@ def main(argv=None) -> int:
         with open(args.job) as f:
             doc = json.load(f)
         wl = doc["spec"].get("workload", {})
+        if args.serve or _is_serve_workload(doc):
+            return _finish_serve(
+                serve_plan(wl.get("preset", "tiny"), wl), args
+            )
         mesh_axes = doc["spec"].get("topology", {}).get("mesh_axes", {}) or {"dp": 1}
         preset_name = wl.get("preset", "tiny")
         batch = int(wl.get("batch_size", args.batch))
@@ -297,6 +421,16 @@ def main(argv=None) -> int:
     else:
         if not args.preset:
             p.error("--preset or --job required")
+        if args.serve:
+            return _finish_serve(
+                serve_plan(
+                    args.preset,
+                    kv_page_size=args.kv_page_size,
+                    kv_pool_pages=args.kv_pool_pages,
+                    max_slots=args.max_slots,
+                ),
+                args,
+            )
         wl = None
         preset_name, mesh_axes = args.preset, _parse_mesh(args.mesh)
         batch, seq, remat = args.batch, args.seq, args.remat

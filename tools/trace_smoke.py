@@ -12,7 +12,12 @@ the observability subsystem exists to keep:
   (so submit→scheduled and TTFS are derivable);
 - spans from >= 3 distinct components are present (controller +
   agent/backend + trainer at minimum — the cross-component stitching
-  is the whole point).
+  is the whole point);
+- a smoke serve job's trace carries the per-request span schema
+  (``request-admitted`` → ``first-token`` → ``finished``, one finished
+  span per request, each with a ``tokens`` attr — the rows the
+  reconciler folds into ``tpujob_request_ttft_seconds`` /
+  ``tpujob_request_tokens_total`` at terminal).
 
 Usage:
     python -m tools.trace_smoke --server http://127.0.0.1:8080
@@ -25,6 +30,7 @@ import sys
 import time
 
 from tf_operator_tpu.dashboard.client import TPUJobApiError, TPUJobClient
+from tf_operator_tpu.serve.spec import build_serve_job
 from tools.genjob import build_job
 
 REQUIRED_EVENT_KEYS = ("name", "ph", "pid", "tid")
@@ -48,6 +54,65 @@ def validate_chrome_trace(doc: dict) -> list:
         if ph == "X" and "dur" not in ev:
             errs.append(f"event {i} (X) missing dur")
     return errs
+
+
+SERVE_SMOKE_REQUESTS = 4
+
+
+def serve_trace_errors(doc: dict, requests: int) -> list:
+    """Request-span schema violations in a serve job's trace; [] = valid."""
+    errs = validate_chrome_trace(doc)
+    slices = [ev for ev in doc.get("traceEvents", ()) if ev.get("ph") == "X"]
+    by_op: dict = {}
+    for ev in slices:
+        by_op.setdefault(ev.get("name"), []).append(ev)
+    for op in ("request-admitted", "first-token", "finished"):
+        if op not in by_op:
+            errs.append(
+                f"serve trace missing {op!r} spans (ops: {sorted(by_op)})"
+            )
+    finished = by_op.get("finished", [])
+    if len(finished) != requests:
+        errs.append(
+            f"expected {requests} 'finished' spans (one per request), "
+            f"got {len(finished)}"
+        )
+    for ev in finished:
+        args = ev.get("args", {})
+        if "request" not in args:
+            errs.append(f"finished span missing 'request' attr: {args}")
+        tokens = args.get("tokens")
+        if not (isinstance(tokens, str) and tokens.isdigit() and int(tokens) > 0):
+            errs.append(f"finished span 'tokens' attr not a count: {tokens!r}")
+    return errs
+
+
+def run_serve_smoke(client: TPUJobClient, timeout: float) -> list:
+    """Submit one smoke serve job, return request-span schema errors."""
+    name = f"tracesmoke-serve-{int(time.time()) % 100000}"
+    job = build_serve_job(name, workload={
+        "requests": SERVE_SMOKE_REQUESTS, "prompt_len": 6,
+        "max_new_tokens": 6, "arrival_rate": 0.0,
+    })
+    client.create(job)
+    try:
+        done = client.wait_for_job("default", name, timeout=timeout)
+        phase = done.status.phase().value
+        if phase != "Done":
+            return [f"serve smoke job finished {phase}"]
+        doc = client.trace("default", name)
+        errs = serve_trace_errors(doc, SERVE_SMOKE_REQUESTS)
+        if not errs:
+            print(
+                f"serve trace ok: {name} events={len(doc['traceEvents'])} "
+                f"requests={SERVE_SMOKE_REQUESTS}"
+            )
+        return errs
+    finally:
+        try:
+            client.delete("default", name)
+        except TPUJobApiError:
+            pass
 
 
 def run(server: str, jobs: int, workers: int, timeout: float) -> int:
@@ -87,6 +152,8 @@ def run(server: str, jobs: int, workers: int, timeout: float) -> int:
     timings = doc.get("otherData", {})
     if timings.get("time_to_first_step_s") is None:
         errs.append("otherData.time_to_first_step_s not derived")
+
+    errs.extend(run_serve_smoke(client, timeout))
 
     # best-effort cleanup so reruns aren't poisoned
     for name in names:
