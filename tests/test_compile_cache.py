@@ -2,6 +2,7 @@
 SURVEY.md §7 hard part d)."""
 
 import os
+import time
 
 import tf_operator_tpu.train.compile_cache as cc
 
@@ -102,6 +103,75 @@ def test_harden_is_idempotent(tmp_path):
     put1, get1 = LRUCache.put, LRUCache.get
     cc.enable(str(tmp_path / "b"), force=True)
     assert LRUCache.put is put1 and LRUCache.get is get1
+
+
+def test_concurrent_writers_never_publish_torn_pairs(tmp_path):
+    """r11 safe_put race pin: many writers racing one key must commit the
+    sidecar+payload as a unit. Before the fix, two writers staging to the
+    SAME tmp names could interleave replace()s and publish writer A's
+    payload under writer B's digest — a permanently unverifiable entry.
+    A concurrent verifier must only ever observe (a) no entry, or (b) a
+    payload that matches its sidecar AND equals one writer's value."""
+    import hashlib
+    import threading
+
+    root = tmp_path / "cc"
+    root.mkdir()
+    values = [f"payload-from-writer-{i}".encode() * 8 for i in range(8)]
+    digests = {hashlib.sha256(v).hexdigest(): v for v in values}
+    stop = threading.Event()
+    bad: list = []
+
+    def verifier():
+        payload_path = root / "k-cache"
+        digest_path = root / "k-cache-sha256"
+        while not stop.is_set():
+            try:
+                data = payload_path.read_bytes()
+                want = digest_path.read_bytes().decode()
+            except OSError:
+                continue  # not published yet / mid-swap: a miss, fine
+            got = hashlib.sha256(data).hexdigest()
+            if got == want and want not in digests:
+                bad.append(("foreign verified payload", data[:40]))
+
+    def writer(val):
+        for _ in range(50):
+            cc.publish_pair(root, "k", val)
+
+    v = threading.Thread(target=verifier)
+    v.start()
+    writers = [threading.Thread(target=writer, args=(val,)) for val in values]
+    for t in writers:
+        t.start()
+    for t in writers:
+        t.join()
+    stop.set()
+    v.join()
+    assert not bad
+    # Quiesced: exactly one writer's value, verified by its own sidecar.
+    data = (root / "k-cache").read_bytes()
+    want = (root / "k-cache-sha256").read_bytes().decode()
+    assert hashlib.sha256(data).hexdigest() == want
+    assert data in values
+
+
+def test_publish_pair_skips_existing_entry(tmp_path):
+    cc.publish_pair(tmp_path, "k", b"first")
+    cc.publish_pair(tmp_path, "k", b"second")
+    assert (tmp_path / "k-cache").read_bytes() == b"first"
+
+
+def test_publish_pair_breaks_stale_lock(tmp_path, monkeypatch):
+    """A writer SIGKILLed between lock and publish must not wedge the key
+    forever: the O_EXCL lock is age-broken."""
+    lock = tmp_path / "k-cache.lock"
+    lock.write_text("")
+    old = time.time() - 2 * cc._LOCK_STALE_S
+    os.utime(lock, (old, old))
+    cc.publish_pair(tmp_path, "k", b"value")
+    assert (tmp_path / "k-cache").read_bytes() == b"value"
+    assert not lock.exists()
 
 
 def test_cpu_only_platform_skips_cache(monkeypatch, tmp_path):

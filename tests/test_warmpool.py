@@ -1,0 +1,101 @@
+"""Warm worker pool tests (r11): the pre-warmed child handoff must be
+indistinguishable from a cold spawn to the rest of the stack, and every
+protocol failure must degrade to a cold spawn, never a launch failure."""
+
+import os
+import sys
+import time
+
+import pytest
+
+from tf_operator_tpu.runtime.warmpool import _HARNESS_PREFIX, WarmPool
+
+
+def _env(entrypoint="tf_operator_tpu.workloads.noop:main"):
+    return {
+        "PATH": os.environ.get("PATH", ""),
+        "PYTHONPATH": os.pathsep.join(sys.path),
+        "JAX_PLATFORMS": "cpu",
+        "TPUJOB_ENTRYPOINT": entrypoint,
+        "TPUJOB_JOB_NAME": "t",
+        "TPUJOB_WORKLOAD": "{}",
+    }
+
+
+def test_serves_only_harness_commands():
+    pool = WarmPool(0)
+    assert pool.serves(list(_HARNESS_PREFIX) + ["--x"])
+    assert not pool.serves(["/bin/sleep", "1"])
+    assert not pool.serves([sys.executable, "-m", "something.else"])
+    pool.stop()
+
+
+def test_claim_runs_harness_under_assignment(tmp_path):
+    pool = WarmPool(1)
+    try:
+        assert pool.ready(timeout=30)
+        log_path = str(tmp_path / "child.log")
+        child = pool.claim(list(_HARNESS_PREFIX), _env(), log_path,
+                           cwd=str(tmp_path))
+        assert child is not None
+        assert child.wait(timeout=30) == 0
+        assert pool.claimed == 1
+        # the cold spawn's log contract was adopted
+        assert "starting tf_operator_tpu.workloads.noop:main" in open(
+            log_path).read()
+    finally:
+        pool.stop()
+
+
+def test_claim_rejects_non_harness_command():
+    pool = WarmPool(1)
+    try:
+        assert pool.claim(["/bin/true"], {}, None) is None
+        assert pool.claimed == 0
+    finally:
+        pool.stop()
+
+
+def test_empty_pool_claims_none():
+    pool = WarmPool(0)
+    assert pool.claim(list(_HARNESS_PREFIX), _env(), None) is None
+    pool.stop()
+
+
+def test_dead_idle_child_reaped_not_served():
+    pool = WarmPool(1)
+    try:
+        assert pool.ready(timeout=30)
+        pool._idle[0].child.kill()
+        pool._idle[0].child.wait()
+        assert pool.claim(list(_HARNESS_PREFIX), _env(), None) is None
+    finally:
+        pool.stop()
+
+
+def test_aged_slot_recycled_not_served():
+    pool = WarmPool(1, max_age_s=0.0)
+    try:
+        assert pool.ready(timeout=30)
+        assert pool.claim(list(_HARNESS_PREFIX), _env(), None) is None
+        # the recycle kicked an async refill
+        deadline = time.time() + 30
+        while time.time() < deadline and pool.warm_idle() == 0:
+            time.sleep(0.05)
+        # refilled slot is itself instantly stale (max_age 0) — but alive
+        assert pool._idle
+    finally:
+        pool.stop()
+
+
+def test_invalidate_drains_idle_slots():
+    pool = WarmPool(1)
+    try:
+        assert pool.ready(timeout=30)
+        children = [s.child for s in pool._idle]
+        pool.invalidate()
+        assert pool.warm_idle() == 0
+        for c in children:
+            assert c.wait(timeout=10) is not None  # killed, not leaked
+    finally:
+        pool.stop()

@@ -1,0 +1,19 @@
+"""Fleet-wide compile-cache service (the TTFS attack, ROADMAP item 4).
+
+Three cooperating pieces:
+
+- :mod:`service` — the operator-hosted HTTP store of compiled
+  executables (sha256-verified, byte-bounded, key-sanitized), plus
+  compile *intents* for fleet-wide single-flight compilation.
+- :mod:`client` — the best-effort worker/controller client; every
+  failure degrades to the PR 10 local-only path, never to a job failure.
+- :mod:`aot` — AOT-at-admission: compiles a workload's step function
+  while the job is still scheduling/queued and publishes the executable,
+  so the gang's processes find a warm cache the moment they reach
+  ``compile_cache.enable()``.
+"""
+
+from tf_operator_tpu.cachesvc.client import CacheClient
+from tf_operator_tpu.cachesvc.service import CompileCacheService
+
+__all__ = ["CacheClient", "CompileCacheService"]

@@ -1,0 +1,139 @@
+"""Worker/controller-side client for the compile-cache service.
+
+Every method is best-effort and returns None/False on any transport or
+integrity failure — the remote tier is a latency lever, and a dead or
+lying cachesvc must degrade the caller to the PR 10 local-only path
+(recompile), never fail a job. The ``dead`` flag records that a
+transport failure was seen; ``train/compile_cache.py`` surfaces it as a
+span attribute so the degradation is observable in the job trace
+instead of silent.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, Optional
+
+log = logging.getLogger("tpujob.cachesvc")
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+class CacheClient:
+    def __init__(self, url: str, timeout: float = 5.0) -> None:
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+        # Sticky: one observed transport failure marks the service dead
+        # for span-attribute purposes (the caller's degradation receipt).
+        # Later calls still try — the service may come back.
+        self.dead = False
+
+    def _entry_url(self, key: str) -> str:
+        return f"{self.url}/cachesvc/v1/entry?{urllib.parse.urlencode({'key': key})}"
+
+    def alive(self) -> bool:
+        try:
+            with urllib.request.urlopen(  # noqa: S310 — operator-stamped URL
+                f"{self.url}/healthz", timeout=self.timeout
+            ) as resp:
+                return resp.status == 200
+        except (OSError, urllib.error.URLError, ValueError):
+            self.dead = True
+            return False
+
+    def fetch(self, key: str, wait_s: float = 0.0) -> Optional[bytes]:
+        """Fetch one verified entry. ``wait_s`` > 0 honors the service's
+        202/Retry-After while an admission-time compile intent is live —
+        the single-flight wait that turns AOT-at-admission overlap into a
+        hit instead of a duplicated compile. Returns None on miss, digest
+        mismatch, or any transport failure."""
+        deadline = time.monotonic() + max(0.0, wait_s)
+        while True:
+            try:
+                with urllib.request.urlopen(  # noqa: S310
+                    self._entry_url(key), timeout=self.timeout
+                ) as resp:
+                    if resp.status == 200:
+                        data = resp.read()
+                        want = resp.headers.get("X-Entry-SHA256", "")
+                        if want and _sha256(data) != want:
+                            log.warning(
+                                "cachesvc entry %s failed transfer "
+                                "verification; treating as a miss", key,
+                            )
+                            return None
+                        return data
+                    retry_after = float(resp.headers.get("Retry-After", "1") or 1)
+            except urllib.error.HTTPError as exc:
+                if exc.code == 202:
+                    retry_after = float(exc.headers.get("Retry-After", "1") or 1)
+                elif exc.code == 404:
+                    return None
+                else:
+                    self.dead = True
+                    return None
+            except (OSError, urllib.error.URLError, ValueError):
+                self.dead = True
+                return None
+            # 202: a compile intent is live. Wait out the retry hint while
+            # budget remains; otherwise report a miss (the caller compiles
+            # locally — correct, just not deduplicated). The 100 ms cap
+            # bounds how long a published entry sits unnoticed — this poll
+            # latency lands directly on TTFS when AOT-at-admission is
+            # racing the gang to first step.
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            time.sleep(min(retry_after, remaining, 0.1))
+
+    def publish(self, key: str, data: bytes) -> bool:
+        try:
+            req = urllib.request.Request(
+                self._entry_url(key), data=data, method="PUT",
+                headers={"X-Entry-SHA256": _sha256(data)},
+            )
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:  # noqa: S310
+                return resp.status == 200
+        except urllib.error.HTTPError as exc:
+            if exc.code == 409:
+                return True  # first-writer-wins: the entry already exists
+            log.debug("cachesvc rejected publish of %s: HTTP %d", key, exc.code)
+            return False  # e.g. 413 over-cap: a policy reject, not a death
+        except (OSError, urllib.error.URLError, ValueError) as exc:
+            self.dead = True
+            log.debug("cachesvc publish of %s failed: %s", key, exc)
+            return False
+
+    def announce(self, key: str) -> bool:
+        """Register a compile intent (AOT-at-admission calls this the
+        moment the scheduler decides, before compiling)."""
+        try:
+            req = urllib.request.Request(
+                f"{self.url}/cachesvc/v1/intent?"
+                f"{urllib.parse.urlencode({'key': key})}",
+                data=b"", method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:  # noqa: S310
+                return resp.status == 200
+        except (OSError, urllib.error.URLError, ValueError):
+            self.dead = True
+            return False
+
+    def stats(self) -> Optional[Dict[str, int]]:
+        try:
+            import json
+
+            with urllib.request.urlopen(  # noqa: S310
+                f"{self.url}/cachesvc/v1/stats", timeout=self.timeout
+            ) as resp:
+                return json.loads(resp.read().decode())
+        except (OSError, urllib.error.URLError, ValueError):
+            self.dead = True
+            return None

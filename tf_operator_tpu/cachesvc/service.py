@@ -1,0 +1,357 @@
+"""Fleet-wide compile-cache service: the shared remote tier behind
+``train/compile_cache.py``.
+
+TTFS (submit→first-step) is the north-star latency metric and on TPU it
+is dominated by XLA compilation — a per-host persistent cache (PR 10)
+only amortizes it per machine, so the first job on every host of a fleet
+still pays the full compile. This service makes any host's first compile
+of a config the FLEET's last: executables keyed exactly the way jax's
+persistent cache keys them ((HLO fingerprint, compile options, backend)
+— the key string IS jax's cache key) are published here once and fetched
+everywhere else.
+
+Same construction discipline as the PR 8 shard depots
+(rendezvous/statechannel.py), because the threat model is identical —
+an unauthenticated loopback/pod-network HTTP service moving opaque
+binary blobs that will be handed to native code:
+
+- every transfer carries a sha256 (``X-Entry-SHA256``) verified on BOTH
+  ends; a mismatch is a miss, never bytes-to-XLA,
+- keys are validated against a filesystem-safe charset before they touch
+  a path (the relpath-sanitization lesson: an unauthenticated peer's
+  string must never steer a filesystem write),
+- held bytes are bounded with oldest-touched eviction — an evicted entry
+  degrades the fleet to a local recompile, never to failure,
+- puts are staged (temp file) and committed with one ``os.replace``; a
+  service killed mid-put never serves a torn entry.
+
+One extra verb the depots don't need: **compile intents**. AOT-at-
+admission (cachesvc/aot.py) announces "this key is being compiled" when
+the scheduler admits or parks a job; a worker that reaches its cache
+miss while the intent is live gets 202 + Retry-After instead of 404 and
+briefly waits for the admission-time compile instead of duplicating it —
+single-flight compilation, fleet-wide.
+
+Wire protocol (stdlib HTTP, no new deps):
+
+- ``GET  /cachesvc/v1/entry?key=``  → raw bytes + ``X-Entry-SHA256``;
+  404 miss; 202 + ``Retry-After`` while a compile intent is live
+- ``PUT  /cachesvc/v1/entry?key=``  → stage+verify+commit (409 on digest
+  mismatch, 413 over the entry bound)
+- ``POST /cachesvc/v1/intent?key=`` → register an in-flight compile
+  (TTL-bounded; cleared by the entry's PUT)
+- ``GET  /cachesvc/v1/stats``       → JSON counters
+- ``GET  /healthz``                 → liveness
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import re
+import shutil
+import tempfile
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+log = logging.getLogger("tpujob.cachesvc")
+
+# jax persistent-cache keys are "jit_<name>-<hex digest>"; allow that plus
+# the digest-only keys cached_compile() derives. Anything else — path
+# separators, dots that could spell "..", unicode — is rejected before it
+# can steer a filesystem operation.
+_KEY_RE = re.compile(r"^[A-Za-z0-9_=-]{1,200}$")
+
+_MAX_ENTRY_BYTES = 1 << 31  # sanity bound on a single executable
+DEFAULT_MAX_BYTES = 4 << 30  # total held bytes before eviction
+DEFAULT_INTENT_TTL = 120.0  # an AOT compile slower than this lost its slot
+
+_LOOPBACK_HOSTS = ("127.0.0.1", "localhost", "::1")
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def valid_key(key: str) -> bool:
+    return bool(_KEY_RE.match(key or ""))
+
+
+class CompileCacheService:
+    """Disk-backed, byte-bounded compile-executable store over HTTP.
+
+    One per operator (cli/operator.py hosts it next to the dashboard and
+    the controller stamps its URL into every gang member's env as
+    ``TPUJOB_COMPILE_CACHE``). Entries live under ``root`` as
+    ``<key>.bin`` with the digest in the in-memory index — the service is
+    a cache, not a system of record: losing it degrades every host to
+    the PR 10 local-only path, never to failure.
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        root: Optional[str] = None,
+        max_bytes: int = DEFAULT_MAX_BYTES,
+        intent_ttl: float = DEFAULT_INTENT_TTL,
+    ) -> None:
+        self.max_bytes = int(max_bytes)
+        self.intent_ttl = float(intent_ttl)
+        self._own_root = root is None
+        self.root = root or tempfile.mkdtemp(prefix="tpujob-cachesvc-")
+        os.makedirs(self.root, exist_ok=True)
+        self._lock = threading.Lock()
+        # key -> (size, sha256hex); the committed, servable index.
+        self._entries: Dict[str, tuple] = {}
+        self._bytes = 0
+        # key -> last-use sequence number: the eviction order.
+        self._seq = 0
+        self._touch: Dict[str, int] = {}
+        # key -> intent deadline (monotonic): in-flight compiles.
+        self._intents: Dict[str, float] = {}
+        self.stats = {
+            "hits": 0, "misses": 0, "waits": 0, "puts": 0,
+            "put_rejects": 0, "evictions": 0,
+        }
+        svc = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: D102 — silence stdlib
+                log.debug("cachesvc %s " + fmt, self.client_address[0], *args)
+
+            def _q(self):
+                parsed = urllib.parse.urlparse(self.path)
+                return parsed.path, dict(urllib.parse.parse_qsl(parsed.query))
+
+            def _reply(self, code: int, body: bytes = b"", headers=()):
+                self.send_response(code)
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def do_GET(self):
+                path, q = self._q()
+                if path == "/healthz":
+                    self._reply(200, b"ok")
+                    return
+                if path == "/cachesvc/v1/stats":
+                    self._reply(200, json.dumps(svc.snapshot()).encode(),
+                                [("Content-Type", "application/json")])
+                    return
+                if path != "/cachesvc/v1/entry":
+                    self._reply(404)
+                    return
+                key = q.get("key", "")
+                if not valid_key(key):
+                    self._reply(400)
+                    return
+                data = svc.get(key)
+                if data is not None:
+                    self._reply(200, data, [
+                        ("Content-Type", "application/octet-stream"),
+                        ("X-Entry-SHA256", _sha256(data)),
+                    ])
+                elif svc.intent_live(key):
+                    # An admission-time AOT compile of this key is in
+                    # flight: tell the worker to wait briefly instead of
+                    # duplicating the compile.
+                    self._reply(202, b"", [("Retry-After", "1")])
+                else:
+                    self._reply(404)
+
+            def do_PUT(self):
+                path, q = self._q()
+                if path != "/cachesvc/v1/entry":
+                    self._reply(404)
+                    return
+                key = q.get("key", "")
+                n = int(self.headers.get("Content-Length", "0"))
+                if not valid_key(key):
+                    self._reply(400)
+                    return
+                if n < 0 or n > _MAX_ENTRY_BYTES:
+                    self._reply(413)
+                    return
+                data = self.rfile.read(n)
+                want = self.headers.get("X-Entry-SHA256", "")
+                code = svc.put(key, data, want)
+                self._reply(code)
+
+            def do_POST(self):
+                path, q = self._q()
+                if path != "/cachesvc/v1/intent":
+                    self._reply(404)
+                    return
+                key = q.get("key", "")
+                if not valid_key(key):
+                    self._reply(400)
+                    return
+                svc.announce(key)
+                self._reply(200)
+
+        if host not in _LOOPBACK_HOSTS:
+            # Same caveat as the shard depots: the protocol carries no
+            # authentication, and what it serves is EXECUTABLE code.
+            log.warning(
+                "compile-cache service binding non-loopback %s: the "
+                "protocol is unauthenticated — restrict access at the "
+                "network layer", host,
+            )
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"cachesvc-{self.port}",
+        )
+        self._thread.start()
+
+    # -- service-side operations (also callable in-process) ---------------
+
+    def _path(self, key: str) -> str:
+        # valid_key() already forbids separators/dots; belt-and-suspenders
+        # against any future key-charset loosening.
+        full = os.path.abspath(os.path.join(self.root, f"{key}.bin"))
+        if os.path.dirname(full) != os.path.abspath(self.root):
+            raise ValueError(f"unsafe cache key: {key!r}")
+        return full
+
+    def get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.stats["misses"] += 1
+                return None
+            self._seq += 1
+            self._touch[key] = self._seq
+            size, want = entry
+        try:
+            with open(self._path(key), "rb") as f:
+                data = f.read()
+        except OSError:
+            data = b""
+        if _sha256(data) != want:
+            # Disk rot / torn external write: drop the entry — this
+            # service must NEVER serve bytes that don't match its index.
+            log.warning("cachesvc entry %s failed integrity check; dropping", key)
+            self.drop(key)
+            with self._lock:
+                self.stats["misses"] += 1
+            return None
+        with self._lock:
+            self.stats["hits"] += 1
+        return data
+
+    def put(self, key: str, data: bytes, want_digest: str = "") -> int:
+        """Stage+verify+commit one entry; returns an HTTP status code.
+        First writer wins — a key already committed is left untouched
+        (200): executables for one key are interchangeable by keying."""
+        digest = _sha256(data)
+        if want_digest and digest != want_digest:
+            with self._lock:
+                self.stats["put_rejects"] += 1
+            log.warning("cachesvc put of %s rejected: digest mismatch "
+                        "(transfer corruption)", key)
+            return 409
+        if len(data) > self.max_bytes:
+            with self._lock:
+                self.stats["put_rejects"] += 1
+            return 413
+        with self._lock:
+            if key in self._entries:
+                self._intents.pop(key, None)
+                return 200
+            # Make room BEFORE committing: evict oldest-touched until the
+            # new entry fits (never the entry being inserted).
+            while self._bytes + len(data) > self.max_bytes and self._entries:
+                victim = min(self._entries, key=lambda k: self._touch.get(k, 0))
+                self._evict_locked(victim)
+            tmp = self._path(key) + f".tmp{os.getpid()}-{threading.get_ident()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._path(key))
+        except OSError as exc:
+            log.warning("cachesvc put of %s failed: %s", key, exc)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return 500
+        with self._lock:
+            if key not in self._entries:
+                self._entries[key] = (len(data), digest)
+                self._bytes += len(data)
+                self._seq += 1
+                self._touch[key] = self._seq
+                self.stats["puts"] += 1
+            self._intents.pop(key, None)  # the compile landed
+        return 200
+
+    def _evict_locked(self, key: str) -> None:
+        size, _ = self._entries.pop(key)
+        self._touch.pop(key, None)
+        self._bytes -= size
+        self.stats["evictions"] += 1
+        log.info("cachesvc evicting %s (%d bytes) under the byte cap", key, size)
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+
+    def drop(self, key: str) -> None:
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            self._touch.pop(key, None)
+            if entry is not None:
+                self._bytes -= entry[0]
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+
+    def announce(self, key: str) -> None:
+        """Register an in-flight compile intent for ``key`` (TTL-bounded:
+        a compiler that died keeps nobody waiting past the TTL)."""
+        with self._lock:
+            if key not in self._entries:
+                self._intents[key] = time.monotonic() + self.intent_ttl
+
+    def intent_live(self, key: str) -> bool:
+        with self._lock:
+            deadline = self._intents.get(key)
+            if deadline is None:
+                return False
+            if time.monotonic() > deadline:
+                self._intents.pop(key, None)
+                return False
+            self.stats["waits"] += 1
+            return True
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                **self.stats,
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "intents": len(self._intents),
+            }
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+        if self._own_root:
+            shutil.rmtree(self.root, ignore_errors=True)

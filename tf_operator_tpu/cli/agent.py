@@ -51,6 +51,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "or the grace expires. 0 = stop immediately "
                         "(SIGINT always stops immediately)")
     p.add_argument("--backend", choices=("native", "local"), default="native")
+    p.add_argument("--warm-pool", type=int, default=0, metavar="N",
+                   help="keep N pre-initialized harness runtimes per host "
+                        "(runtime/warmpool.py); gang members launch into a "
+                        "warm slot instead of a cold fork. 0 = disabled")
+    p.add_argument("--warm-import-jax", action="store_true",
+                   help="warm slots also pre-initialize the jax runtime/"
+                        "backend (the expensive part on TPU hosts)")
     p.add_argument("--log-dir", default=None,
                    help="capture launched processes' stdout/stderr here")
     p.add_argument("--json-log-format", action="store_true")
@@ -106,6 +113,8 @@ def main(argv=None) -> int:
         max_processes=args.max_processes,
         backend=backend,
         heartbeat_interval=args.heartbeat_interval,
+        warm_pool=args.warm_pool,
+        warm_import_jax=args.warm_import_jax,
     )
     stop = threading.Event()
     drain = threading.Event()

@@ -102,6 +102,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="chip capacity each local agent advertises")
     p.add_argument("--agent-slice-type", default="",
                    help="slice type local agents advertise (e.g. v5e-8)")
+    p.add_argument("--compile-cache", action="store_true",
+                   help="host the fleet compile-cache service (cachesvc/): "
+                        "created gang members get its URL as "
+                        "TPUJOB_COMPILE_CACHE and compile_cache.enable() "
+                        "becomes a two-tier read-through; the reconciler "
+                        "kicks AOT compiles at admission so compilation "
+                        "overlaps the scheduling wait")
+    p.add_argument("--compile-cache-bytes", type=int, default=4 << 30,
+                   help="compile-cache service byte cap (oldest-touched "
+                        "entries are evicted past it)")
+    p.add_argument("--aot-workers", type=int, default=2,
+                   help="admission-time AOT compiler threads (with "
+                        "--compile-cache)")
+    p.add_argument("--warm-pool", type=int, default=0, metavar="N",
+                   help="each local agent keeps N pre-initialized harness "
+                        "runtimes (runtime/warmpool.py); gang members "
+                        "launch into a warm slot instead of a cold fork")
+    p.add_argument("--warm-import-jax", action="store_true",
+                   help="warm slots also pre-initialize the jax runtime")
     p.add_argument("--backend", choices=("native", "local"), default="native",
                    help="process runtime: 'native' = C++ supervisor "
                         "(group kills, normalized exit codes; built on demand), "
@@ -272,6 +291,44 @@ def main(argv=None) -> int:
         store, backend, resync_period=args.resync_period,
         controller_config=controller_config,
     )
+    warm_pool = None
+    if args.warm_pool > 0 and args.local_agents == 0:
+        # Single-host mode: the operator's own backend launches the gang,
+        # so the warm pool attaches here (multi-host: each agent's).
+        from tf_operator_tpu.runtime.warmpool import WarmPool
+
+        warm_pool = WarmPool(args.warm_pool, import_jax=args.warm_import_jax)
+        backend.warm_pool = warm_pool
+        controller.metrics.gauge_providers["tpujob_warmpool_warm_idle"] = (
+            warm_pool.warm_idle
+        )
+        controller.metrics.gauge_help["tpujob_warmpool_warm_idle"] = (
+            "Idle pre-warmed worker slots ready for handoff."
+        )
+        log.info("warm pool: %d pre-initialized runtimes", args.warm_pool)
+    cachesvc = None
+    aot = None
+    if args.compile_cache:
+        from tf_operator_tpu.cachesvc import CompileCacheService
+        from tf_operator_tpu.cachesvc.aot import AOTCompiler
+
+        cachesvc = CompileCacheService(
+            host=args.host, max_bytes=args.compile_cache_bytes
+        )
+        aot = AOTCompiler(
+            cachesvc.url, workers=args.aot_workers,
+            on_done=controller._aot_span,
+        )
+        controller.compile_cache_url = cachesvc.url
+        controller.aot = aot
+        controller.metrics.gauge_providers["tpujob_cachesvc_entries"] = (
+            lambda: cachesvc.snapshot()["entries"]
+        )
+        controller.metrics.gauge_help["tpujob_cachesvc_entries"] = (
+            "Entries resident in the fleet compile-cache service."
+        )
+        log.info("compile-cache service on %s (cap %d bytes, %d AOT workers)",
+                 cachesvc.url, args.compile_cache_bytes, args.aot_workers)
     # In --store-server HA mode the primary API/UI lives on the store
     # server, but each operator still serves its own endpoint: /metrics
     # (workqueue depth, reconcile counters) exists only in the controller
@@ -298,6 +355,8 @@ def main(argv=None) -> int:
                     total_chips=args.agent_chips,
                     slice_type=args.agent_slice_type,
                     backend=type(backend)(store, log_dir=args.log_dir),
+                    warm_pool=args.warm_pool,
+                    warm_import_jax=args.warm_import_jax,
                 )
             )
         for a in agents:
@@ -361,10 +420,16 @@ def main(argv=None) -> int:
 
     stop.wait()
     chaos.stop()
+    if aot is not None:
+        aot.stop()
     controller.stop()
     for a in agents:
         a.stop()
+    if warm_pool is not None:
+        warm_pool.stop()
     backend.shutdown()
+    if cachesvc is not None:
+        cachesvc.stop()
     dashboard.stop()
     return rc["code"]
 

@@ -83,6 +83,7 @@ from tf_operator_tpu.obs.spans import (
 from tf_operator_tpu.rendezvous.env import (
     ENV_API_SERVER,
     ENV_CHECKPOINT_DIR,
+    ENV_COMPILE_CACHE,
     ENV_COORDINATOR_ADDRESS,
     ENV_DCN_MESH_AXES,
     ENV_MESH_AXES,
@@ -179,6 +180,15 @@ class TPUJobController:
         # Admin accelerator/runtime injection (ControllerConfig,
         # api/helpers.py; reference server.go:138-156 + helpers.go:50-104).
         self.controller_config = controller_config
+        # Fleet compile-cache service (cachesvc/, r11): when the daemon
+        # hosts one it sets the URL here; every created gang member gets
+        # it stamped as ENV_COMPILE_CACHE, turning compile_cache.enable()
+        # into a two-tier read-through. ``aot`` (cachesvc/aot.py) is the
+        # admission-time compiler the sync path kicks on admit/park so
+        # compilation overlaps the scheduling wait. Both optional — unset
+        # reproduces the r10 local-only behavior exactly.
+        self.compile_cache_url: Optional[str] = None
+        self.aot = None
 
         self.queue = RateLimitingQueue()
         self.expectations = ControllerExpectations()
@@ -1097,9 +1107,17 @@ class TPUJobController:
         except Exception:  # noqa: BLE001 — telemetry read is best-effort
             return
         self._ttfs_observed.add(uid)
+        ttfs = max(0.0, span.start_time - job.metadata.creation_timestamp)
+        self.metrics.observe_hist("tpujob_time_to_first_step_seconds", ttfs)
+        # r11 split: the workload stamps warm="1" on the first-step span
+        # when it ran from a warm slot or hit a compile cache tier. Two
+        # separate families (not labels) so existing scrapers of the
+        # aggregate family keep working unchanged.
+        warm = (getattr(span, "attrs", None) or {}).get("warm") == "1"
         self.metrics.observe_hist(
-            "tpujob_time_to_first_step_seconds",
-            max(0.0, span.start_time - job.metadata.creation_timestamp),
+            "tpujob_time_to_first_step_warm_seconds" if warm
+            else "tpujob_time_to_first_step_cold_seconds",
+            ttfs,
         )
 
     def _observe_ckpt_spans(self, job: TPUJob) -> None:
@@ -1391,6 +1409,7 @@ class TPUJobController:
                 # placement failure never leaks quota.
                 self.fleet.commit(job)
                 now = time.time()
+                self._kick_aot(job)  # overlap compile with placement+spawn
                 self._mark_admitted(job, now)
                 self._mark_scheduled(job, now)
                 self._bind_and_create(
@@ -1471,6 +1490,10 @@ class TPUJobController:
             p.spec.env[ENV_COORDINATOR_ADDRESS] = f"{chief_host}:{port}"
             if self.api_url:
                 p.spec.env.setdefault(ENV_API_SERVER, self.api_url)
+            if self.compile_cache_url:
+                # Fleet compile-cache tier (cachesvc/): enable() turns this
+                # into a read-through/write-back remote cache.
+                p.spec.env.setdefault(ENV_COMPILE_CACHE, self.compile_cache_url)
 
         self.expectations.expect_creations(exp_key, len(procs))
         created = 0
@@ -1518,11 +1541,49 @@ class TPUJobController:
 
     # ---- fleet-scheduler actions ----------------------------------------
 
+    def _kick_aot(self, job: TPUJob) -> None:
+        """AOT-at-admission (cachesvc/aot.py): the moment the fleet
+        scheduler decides — admit or park — start compiling the workload's
+        declared step function so the compile overlaps the scheduling/
+        placement/spawn wait and the gang finds a warm cache at
+        ``compile_cache.enable()``. O(enqueue) on the sync path; no-op
+        without a hosted cachesvc or a workload AOT declaration."""
+        if self.aot is None:
+            return
+        try:
+            if self.aot.kick(job.metadata.namespace, job.metadata.name,
+                             job.metadata.uid, job.spec.workload):
+                self.metrics.inc("tpujob_aot_compiles_kicked_total")
+        except Exception:  # noqa: BLE001 — a broken AOT pool never fails a sync
+            log.exception("aot kick for %s failed", job.key())
+
+    def _aot_span(self, namespace: str, job_name: str, trace_id: str,
+                  key: str, mode: str, start: float, end: float,
+                  ok: bool) -> None:
+        """on_done callback for the AOT pool: land the aot-compile span in
+        the job timeline (width = the compile cost that was overlapped
+        with scheduling) and count the publish outcome."""
+        self.metrics.inc(
+            "tpujob_aot_compiles_published_total" if ok
+            else "tpujob_aot_compiles_failed_total"
+        )
+        self.tracer.record(
+            namespace, job_name, trace_id, "aot-compile", start, end,
+            attrs={
+                "key": key[:16], "mode": mode,
+                "published": str(ok).lower(), "track": "aot-compile",
+            },
+            component=COMPONENT_SCHEDULER,
+        )
+
     def _queue_job(self, job: TPUJob, reason: str) -> None:
         """Park the job in the QUEUED condition and open the ``queued``
         trace span (admission-queue entry → admitted). Repeats update the
         condition message in place — no event/span churn while waiting."""
         first = not has_condition(job.status, ConditionType.QUEUED)
+        # A parked job is the best AOT candidate: the whole queue wait is
+        # compile-overlap budget (idempotent — kick() dedupes per job/key).
+        self._kick_aot(job)
         message = reason or "waiting in fleet-scheduler admission queue"
         set_condition(
             job.status,

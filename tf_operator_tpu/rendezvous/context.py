@@ -176,13 +176,36 @@ class JobContext:
     def mark_first_step(self, step: int = 0) -> bool:
         """Mark the job's first training step (the TTFS boundary). Every
         rank may call this — the deterministic gang-wide span name means
-        the store keeps exactly the earliest mark."""
+        the store keeps exactly the earliest mark.
+
+        The span also carries the r11 warm/cold classification the
+        reconciler splits TTFS on: warm="1" when this process ran from a
+        pre-warmed slot (ENV_WARM_SLOT) or any compile-cache tier hit,
+        plus the compile-cache counters and the remote tier's health
+        (``cache_degraded`` — a dead cachesvc is a span attribute, never
+        a job failure)."""
         from tf_operator_tpu.obs.spans import first_step_span_name
 
+        attrs = {"step": str(step), "track": "first-step"}
+        try:
+            from tf_operator_tpu.rendezvous.env import ENV_WARM_SLOT
+            from tf_operator_tpu.train import compile_cache
+
+            stats = compile_cache.stats()
+            hits = stats.get("local_hits", 0) + stats.get("remote_hits", 0)
+            warm_slot = os.environ.get(ENV_WARM_SLOT, "") == "1"
+            attrs["warm"] = "1" if (warm_slot or hits > 0) else "0"
+            attrs["warm_slot"] = "1" if warm_slot else "0"
+            attrs["cache_local_hits"] = str(stats.get("local_hits", 0))
+            attrs["cache_remote_hits"] = str(stats.get("remote_hits", 0))
+            attrs["cache_misses"] = str(stats.get("misses", 0))
+            if stats.get("remote_dead"):
+                attrs["cache_degraded"] = "1"
+        except Exception:  # noqa: BLE001 — classification must never block TTFS
+            pass
         now = time.time()
         return self.record_span(
-            "first-step", now, now,
-            attrs={"step": str(step), "track": "first-step"},
+            "first-step", now, now, attrs=attrs,
             name=first_step_span_name(self.job_name, self.trace_id),
         )
 

@@ -84,6 +84,24 @@ ENV_RESUME_STEP = "TPUJOB_RESUME_STEP"
 ENV_PEER_DEPOT = "TPUJOB_PEER_DEPOT"
 ENV_RESTORE_PEERS = "TPUJOB_RESTORE_PEERS"
 
+# Sub-second TTFS contract (r11, cachesvc/ + runtime/warmpool.py):
+#
+# - ``TPUJOB_COMPILE_CACHE`` — the fleet compile-cache service URL
+#                              (stamped by the controller on every created
+#                              gang member): compile_cache.enable() turns
+#                              its hardened cache I/O into a read-through/
+#                              write-back remote tier against it. Unset =
+#                              the PR 10 local-only path.
+# - ``TPUJOB_WARM_SLOT``     — "1" when this process was handed a
+#                              pre-warmed runtime slot by the host agent's
+#                              warm pool instead of a cold spawn (set by
+#                              the warm child on itself, never by the
+#                              controller): workloads surface it on the
+#                              compile-cache span so the bench can split
+#                              TTFS into warm/cold populations.
+ENV_COMPILE_CACHE = "TPUJOB_COMPILE_CACHE"
+ENV_WARM_SLOT = "TPUJOB_WARM_SLOT"
+
 # Trace context (obs/): the job's trace id — its uid — injected by the
 # controller into every created gang member (alongside the warm-restart
 # env above) so spans recorded by the agent/backend and by the workload

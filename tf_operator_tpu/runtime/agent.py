@@ -57,6 +57,8 @@ class HostAgent:
         log_dir: Optional[str] = None,
         depot: bool = False,
         depot_keep: int = 2,
+        warm_pool: int = 0,
+        warm_import_jax: bool = False,
     ) -> None:
         """``depot=True`` starts a host-lifetime shard depot
         (rendezvous/statechannel.py): workloads on this host push each
@@ -84,6 +86,18 @@ class HostAgent:
             self.depot = ShardDepot(host=address, keep=depot_keep)
             self.spec.depot_url = self.depot.url
             self.backend.extra_env[ENV_PEER_DEPOT] = self.depot.url
+        # Warm worker pool (runtime/warmpool.py): N pre-initialized
+        # harness runtimes for this host's topology, handed to gang
+        # members at launch instead of a cold fork. Attached on the
+        # backend's spawn seam; sized 0 = disabled (the r10 cold path).
+        self.warm_pool = None
+        if warm_pool > 0:
+            from tf_operator_tpu.runtime.warmpool import WarmPool
+
+            self.warm_pool = WarmPool(
+                warm_pool, topology=slice_type, import_jax=warm_import_jax
+            )
+            self.backend.warm_pool = self.warm_pool
         self.heartbeat_interval = heartbeat_interval
         self._stop = threading.Event()
         self._threads: list = []
@@ -128,6 +142,8 @@ class HostAgent:
             self._set_phase(HostPhase.NOT_READY, "agent stopped", transient_timeout=5.0)
         except Exception as exc:
             log.warning("agent %s: could not mark NotReady (%s)", self.name, exc)
+        if self.warm_pool is not None:
+            self.warm_pool.stop()
         self.backend.shutdown()
         if self.depot is not None:
             # Last: a draining host keeps SERVING shards until the very
@@ -151,6 +167,10 @@ class HostAgent:
         Ready → Draining → gone lifecycle."""
         self._draining = True
         log.warning("agent %s: preemption notice — draining", self.name)
+        if self.warm_pool is not None:
+            # No new placements are coming; idle pre-warmed runtimes are
+            # just memory the reclaiming infrastructure wants back.
+            self.warm_pool.invalidate()
         self._set_phase(HostPhase.DRAINING, message)
 
     @property

@@ -124,6 +124,10 @@ class LocalProcessControl(ProcessControl):
         # (TPUJOB_PEER_DEPOT), which the controller cannot stamp because
         # it is per-host, not per-job.
         self.extra_env: Dict[str, str] = dict(extra_env or {})
+        # Optional warm worker pool (runtime/warmpool.py), attached by the
+        # host agent. When set, _spawn first tries to hand the launch to a
+        # pre-warmed child; any miss falls through to a cold spawn.
+        self.warm_pool = None
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
         self._lock = threading.Lock()
@@ -271,10 +275,30 @@ class LocalProcessControl(ProcessControl):
 
     # -- internals --------------------------------------------------------
 
+    def _claim_warm(self, process: Process, env: Dict[str, str], log_path: Optional[str]):
+        """Try to serve the launch from the attached warm pool. Returns the
+        warm child's Popen, or None → the caller cold-spawns. Only launches
+        using the default harness command are eligible (a custom
+        command_builder changes the command shape and disqualifies itself
+        via WarmPool.serves)."""
+        pool = self.warm_pool
+        if pool is None:
+            return None
+        try:
+            return pool.claim(
+                self._command_builder(process), env, log_path,
+                cwd=process.spec.workdir,
+            )
+        except Exception:  # noqa: BLE001 — warm handoff must never fail a launch
+            return None
+
     def _spawn(self, process: Process, env: Dict[str, str], log_path: Optional[str]):
         """Launch the child; returns a Popen-like handle (pid / poll / wait /
         terminate / kill). Raises OSError on any launch failure (log-file
         open or exec). The seam NativeProcessControl overrides."""
+        warm = self._claim_warm(process, env, log_path)
+        if warm is not None:
+            return warm
         log_file = open(log_path, "ab") if log_path else None
         try:
             return subprocess.Popen(
@@ -440,6 +464,12 @@ class NativeProcessControl(LocalProcessControl):
         self._sup = NativeSupervisor()
 
     def _spawn(self, process: Process, env: Dict[str, str], log_path: Optional[str]):
+        # Warm handoff applies here too; a claimed child is a plain Popen
+        # supervised Python-side (exit codes in Python's -signum form for
+        # signal deaths — the taxonomy handles both conventions).
+        warm = self._claim_warm(process, env, log_path)
+        if warm is not None:
+            return warm
         return self._sup.spawn(
             self._command_builder(process), env, process.spec.workdir, log_path
         )
@@ -450,7 +480,9 @@ class NativeProcessControl(LocalProcessControl):
         if isinstance(child, NativeChild):
             # Native escalation: TERM → grace → KILL, on the whole group.
             self._sup.terminate(child, self.GRACE_SECONDS)
-        else:  # pragma: no cover - children are always NativeChild here
+        else:
+            # Warm-pool handoffs are plain Popen children even under the
+            # native backend; the Python escalation path covers them.
             super()._terminate(child)
 
 

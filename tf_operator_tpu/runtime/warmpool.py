@@ -1,0 +1,291 @@
+"""Warm worker pools: pre-initialized runtimes for sub-second gang spawn.
+
+The third leg of the r11 TTFS attack (with cachesvc/ and AOT-at-
+admission): even with every executable cached, a cold gang member pays
+interpreter start + framework imports + jax runtime/backend init before
+its first step — hundreds of ms on CPU hosts, seconds on TPU hosts
+(libtpu init + mesh bring-up). The host agent therefore keeps N
+**pre-warmed children** per host: forked processes that have already
+paid those costs and then block on stdin waiting for an assignment.
+When the backend launches a gang member whose command is the default
+harness command, it hands the member a warm slot — writes the identity/
+rendezvous env + args as one JSON line — instead of forking cold. The
+child adopts the env, redirects its logs, and calls the ordinary
+harness main; from the store's and monitor's point of view it is
+indistinguishable from a cold spawn (same Popen supervision, same
+phase/exit-code reporting, same spans).
+
+Topology note: pools are per-host, and a host has one topology — its
+slice. A v5e-8 host's warm runtime IS a v5e-8 runtime, so "N slots per
+topology" reduces to "N slots on each host of that topology"; the
+``topology`` label rides along for spans and logs.
+
+Lifecycle/invalidation (docs/design.md §4.10): a claimed slot is
+replaced asynchronously; a slot older than ``max_age_s`` is recycled at
+claim time (a pre-warmed runtime pinned for hours drifts from the
+host's env/driver state); ``invalidate()`` drains the pool explicitly
+(the agent calls it on drain); pool shutdown kills idle children. A
+warm child that dies while idle is reaped by the next claim. Claiming
+is strictly best-effort — any protocol hiccup falls back to a cold
+spawn, never to a launch failure.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+log = logging.getLogger("tpujob.warmpool")
+
+# The only command shape a warm slot can serve: the in-process harness.
+# Anything else (custom spec.command, debug wrappers) cold-spawns.
+_HARNESS_PREFIX = [sys.executable, "-m", "tf_operator_tpu.rendezvous.harness"]
+
+DEFAULT_MAX_AGE_S = 600.0
+
+
+class _Slot:
+    def __init__(self, child: subprocess.Popen, born: float) -> None:
+        self.child = child
+        self.born = born
+        self.warm = threading.Event()  # set once the child printed WARM
+
+
+class WarmPool:
+    def __init__(
+        self,
+        size: int,
+        topology: str = "",
+        import_jax: bool = False,
+        max_age_s: float = DEFAULT_MAX_AGE_S,
+    ) -> None:
+        self.size = max(0, int(size))
+        self.topology = topology
+        self.import_jax = import_jax
+        self.max_age_s = float(max_age_s)
+        self._lock = threading.Lock()
+        self._idle: List[_Slot] = []
+        self._stopping = False
+        self.claimed = 0  # telemetry: warm handoffs served
+        for _ in range(self.size):
+            self._add_slot()
+
+    # -- pool maintenance --------------------------------------------------
+
+    def _add_slot(self) -> None:
+        cmd = [sys.executable, "-m", "tf_operator_tpu.runtime.warmpool", "--child"]
+        if self.import_jax:
+            cmd.append("--import-jax")
+        try:
+            child = subprocess.Popen(
+                cmd,
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,  # the WARM handshake
+                stderr=None,  # inherited: pre-assignment noise goes to the agent
+                start_new_session=True,
+            )
+        except OSError as exc:
+            log.warning("warm pool could not pre-spawn a child: %s", exc)
+            return
+        slot = _Slot(child, time.time())
+
+        def _handshake():
+            # The child prints WARM once its imports/runtime init are
+            # done; until then the slot exists but is not claimable.
+            line = child.stdout.readline()
+            if line.strip() == b"WARM":
+                slot.warm.set()
+            child.stdout.close()
+
+        threading.Thread(target=_handshake, daemon=True,
+                         name=f"warmpool-handshake-{child.pid}").start()
+        with self._lock:
+            if self._stopping:
+                self._kill(slot)
+                return
+            self._idle.append(slot)
+
+    def _kill(self, slot: _Slot) -> None:
+        try:
+            if slot.child.poll() is None:
+                slot.child.kill()
+            slot.child.wait()
+        except OSError:
+            pass
+        try:
+            slot.child.stdin.close()
+        except OSError:
+            pass
+
+    def _refill_async(self) -> None:
+        threading.Thread(target=self._add_slot, daemon=True,
+                         name="warmpool-refill").start()
+
+    # -- the handoff -------------------------------------------------------
+
+    def serves(self, command: List[str]) -> bool:
+        return command[: len(_HARNESS_PREFIX)] == _HARNESS_PREFIX
+
+    def warm_idle(self) -> int:
+        """Idle slots that are warm and alive right now (the
+        ``tpujob_warmpool_warm_idle`` gauge)."""
+        with self._lock:
+            return sum(
+                1 for s in self._idle
+                if s.warm.is_set() and s.child.poll() is None
+            )
+
+    def ready(self, timeout: float = 10.0) -> bool:
+        """Wait until at least one slot is warm (bench/tests sync point)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if any(s.warm.is_set() and s.child.poll() is None
+                       for s in self._idle):
+                    return True
+            time.sleep(0.02)
+        return False
+
+    def claim(
+        self,
+        command: List[str],
+        env: Dict[str, str],
+        log_path: Optional[str],
+        cwd: Optional[str] = None,
+    ) -> Optional[subprocess.Popen]:
+        """Hand a warm slot the assignment; returns its Popen (now running
+        the harness under the given identity), or None when no slot
+        matches and the caller must cold-spawn. Never raises."""
+        if not self.serves(command):
+            return None
+        while True:
+            with self._lock:
+                if self._stopping or not self._idle:
+                    return None
+                slot = self._idle.pop(0)
+            if slot.child.poll() is not None:
+                continue  # died while idle; reap and try the next
+            if time.time() - slot.born > self.max_age_s:
+                # Age invalidation: a runtime warmed long ago may predate
+                # env/driver changes on this host — recycle it.
+                self._kill(slot)
+                self._refill_async()
+                continue
+            if not slot.warm.wait(timeout=0.5):
+                # Still importing: a cold spawn beats waiting on it. Put
+                # it back for the next launch.
+                with self._lock:
+                    self._idle.append(slot)
+                return None
+            assignment = {
+                "args": command[len(_HARNESS_PREFIX):],
+                "env": env,
+                "log_path": log_path,
+                "cwd": cwd,
+            }
+            try:
+                slot.child.stdin.write(json.dumps(assignment).encode() + b"\n")
+                slot.child.stdin.flush()
+                slot.child.stdin.close()
+            except (OSError, ValueError):
+                self._kill(slot)
+                self._refill_async()
+                continue
+            self.claimed += 1
+            self._refill_async()
+            return slot.child
+
+    def invalidate(self) -> None:
+        """Drain every idle slot (agent drain / env change): claimed
+        children are untouched — they are jobs now."""
+        with self._lock:
+            idle, self._idle = self._idle, []
+        for slot in idle:
+            self._kill(slot)
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopping = True
+            idle, self._idle = self._idle, []
+        for slot in idle:
+            self._kill(slot)
+
+
+# -- the pre-warmed child ---------------------------------------------------
+
+
+def _child_main(import_jax: bool) -> int:
+    # Pay the cold-start costs NOW, while no job is waiting: interpreter
+    # start already happened; import the harness chain (context, store
+    # client, span machinery) plus the modules every workload touches on
+    # its way to the first step — the compile cache (whose package init
+    # pulls the full train/ stack: the single biggest import in the
+    # tree) and the span/store client used by mark_first_step. Without
+    # these the child is only *lukewarm*: it would pay the heavy imports
+    # after the assignment, on the job's critical path.
+    import tf_operator_tpu.rendezvous.harness  # noqa: F401  (the point is the import)
+    import tf_operator_tpu.obs.spans  # noqa: F401
+    import tf_operator_tpu.runtime.remote_store  # noqa: F401
+    import tf_operator_tpu.train.compile_cache  # noqa: F401
+
+    if import_jax:
+        try:
+            import jax
+
+            jax.devices()  # force backend/runtime init, the expensive part
+        except Exception:  # noqa: BLE001 — pre-warm must never kill the slot
+            log.warning("warm child: jax runtime pre-init failed", exc_info=True)
+    sys.stdout.write("WARM\n")
+    sys.stdout.flush()
+    line = sys.stdin.readline()
+    if not line:
+        return 0  # pool shutdown: stdin closed without an assignment
+    try:
+        assignment = json.loads(line)
+    except ValueError:
+        return 2
+    env = assignment.get("env") or {}
+    os.environ.clear()
+    os.environ.update(env)
+    from tf_operator_tpu.rendezvous.env import ENV_WARM_SLOT
+
+    os.environ[ENV_WARM_SLOT] = "1"
+    log_path = assignment.get("log_path")
+    if log_path:
+        # Adopt the cold spawn's log contract: combined stdout+stderr
+        # into the per-process log file the dashboard serves.
+        fd = os.open(log_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    else:
+        fd = 2  # no log dir: fold stdout into the inherited stderr
+    os.dup2(fd, 1)
+    os.dup2(fd, 2)
+    if fd > 2:
+        os.close(fd)
+    cwd = assignment.get("cwd")
+    if cwd:
+        try:
+            os.chdir(cwd)
+        except OSError:
+            return 127
+    from tf_operator_tpu.rendezvous import harness
+
+    return harness.main(assignment.get("args") or None)
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if "--child" in args:
+        return _child_main(import_jax="--import-jax" in args)
+    print("usage: python -m tf_operator_tpu.runtime.warmpool --child "
+          "[--import-jax]", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,60 @@
+"""Modeled-compile workload: the TTFS-bench payload (r11, no JAX import).
+
+Exercises the full compile-cache pipeline with a MODELED compile cost —
+the r8 ``--disk-restore-delay`` precedent for honest mechanism receipts
+in a chipless container: the cache key derivation, two-tier lookup,
+compile intents, sha256-verified transfer, and local landing are all
+real (``compile_cache.cached_compile``); only the XLA compile itself is
+replaced by a sleep of ``compile_ms``. A cache hit (local or remote —
+including one published by AOT-at-admission while this job sat in the
+scheduler) skips the modeled cost exactly as a real hit skips XLA.
+
+workload config keys:
+
+- ``aot``: ``{"key": <key material>, "compile_ms": <int>}`` — the same
+  section the reconciler's AOT kick reads, so admission-time compilation
+  and this workload derive the SAME cache key.
+- ``sleep_s`` / ``exit_code``: as in the noop workload.
+
+The first-step mark lands AFTER the compile resolves — TTFS includes
+the (modeled) compile exactly as it includes real XLA time — and its
+span carries the hit/miss counters and warm-slot flag the reconciler
+splits the cold/warm TTFS histograms on.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from tf_operator_tpu.rendezvous.context import JobContext
+from tf_operator_tpu.train.compile_cache import cached_compile
+
+
+def main(ctx: JobContext) -> None:
+    aot = ctx.workload.get("aot") or {}
+    key_material = str(aot.get("key", f"{ctx.namespace}/{ctx.job_name}"))
+    compile_ms = float(aot.get("compile_ms", 0))
+
+    def compile_fn() -> bytes:
+        # The modeled XLA compile: identical artifact derivation to the
+        # admission-time compiler, so integrity checks are end-to-end.
+        from tf_operator_tpu.cachesvc.aot import modeled_payload
+
+        if compile_ms:
+            time.sleep(compile_ms / 1000.0)
+        return modeled_payload(key_material)
+
+    t0 = time.time()
+    data, source = cached_compile(key_material, compile_fn)
+    ctx.record_span(
+        "compile", t0, time.time(),
+        attrs={"source": source, "bytes": str(len(data)), "track": "compile"},
+    )
+    ctx.mark_first_step(0)
+    sleep_s = float(ctx.workload.get("sleep_s", 0))
+    if sleep_s:
+        time.sleep(sleep_s)
+    code = int(ctx.workload.get("exit_code", 0))
+    if code:
+        sys.exit(code)

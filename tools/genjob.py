@@ -65,6 +65,8 @@ def build_job(
     priority: str = "",
     chips: int = 0,
     sleep_s: float = 0.0,
+    workload_extra: dict = None,
+    env_extra: dict = None,
 ) -> TPUJob:
     env = {}
     if cpu_env:
@@ -74,11 +76,13 @@ def build_job(
             "PALLAS_AXON_POOL_IPS": "",
             "XLA_FLAGS": "",
         }
+    env.update(env_extra or {})
     template = ProcessTemplate(entrypoint=entrypoint, env=env,
                                chips_per_process=chips)
     workload = {"dim": 16, "steps": steps}
     if sleep_s:
         workload["sleep_s"] = sleep_s
+    workload.update(workload_extra or {})
     spec = TPUJobSpec(
         replica_specs={ReplicaType.WORKER: ReplicaSpec(replicas=workers, template=template)},
         workload=workload,
@@ -195,6 +199,22 @@ def _scrape_sync_latency(server: str) -> dict:
     out["ttfs_jobs"] = tn
     out["ttfs_p50_ms"] = round(_histogram_quantile(tb, tn, 0.5) * 1e3, 1)
     out["ttfs_p99_ms"] = round(_histogram_quantile(tb, tn, 0.99) * 1e3, 1)
+    # r11 cold/warm split: the reconciler folds TTFS into a second family
+    # keyed on the first-step span's warm attribute (warm worker slot
+    # and/or compile-cache hit). Both populations reported whenever they
+    # have samples — the classic no-op bench lands everything in cold.
+    for pop in ("cold", "warm"):
+        pb, pn = _parse_histogram(
+            text, f"tpujob_time_to_first_step_{pop}_seconds"
+        )
+        if pn:
+            out[f"ttfs_{pop}_jobs"] = pn
+            out[f"ttfs_{pop}_p50_ms"] = round(
+                _histogram_quantile(pb, pn, 0.5) * 1e3, 1
+            )
+            out[f"ttfs_{pop}_p99_ms"] = round(
+                _histogram_quantile(pb, pn, 0.99) * 1e3, 1
+            )
     # Async-checkpoint overlap receipt (r8): per-accepted-save step-loop
     # stall, folded from workload save-stall spans at job terminal. Zero
     # samples (bench workloads without checkpointing) is normal — omit.
@@ -326,6 +346,218 @@ def run_bench(args) -> int:
         if r["failed"] or r["unfinished"] or r["succeeded"] != r["jobs"]
     ]
     return 1 if bad else 0
+
+
+# ---- --bench-ttfs: the sub-second time-to-first-step oracle (r11) -------
+
+
+def _wait_gauge(server: str, name: str, want: float, timeout: float) -> bool:
+    """Poll /metrics until gauge ``name`` >= want (pool-warm sync point)."""
+    import re
+    import urllib.request
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(server + "/metrics", timeout=5) as r:
+                m = re.search(rf"^{name} ([0-9.e+-]+)$",
+                              r.read().decode(), re.MULTILINE)
+            if m and float(m.group(1)) >= want:
+                return True
+        except OSError:
+            pass
+        time.sleep(0.25)
+    return False
+
+
+def _ttfs_submit_wave(client, jobs, timeout: float, inflight: int) -> dict:
+    """Submit jobs with a bounded in-flight window (repeat-submit shape:
+    a stream of submissions, not one thundering batch — 100 concurrent
+    gangs would measure control-plane queueing, not TTFS) and wait until
+    every job is terminal."""
+    t0 = time.perf_counter()
+    pending = list(jobs)
+    live: list = []
+    done: dict = {}
+    deadline = time.time() + timeout
+    while (pending or live) and time.time() < deadline:
+        while pending and len(live) < inflight:
+            job = pending.pop(0)
+            client.create(job)
+            live.append(job.metadata.name)
+        try:
+            listed = {j.metadata.name: j for j in client.list("default")}
+        except Exception:
+            time.sleep(0.2)
+            continue
+        for name in list(live):
+            j = listed.get(name)
+            if j is not None and j.status.phase().value in ("Done", "Failed"):
+                done[name] = j.status.phase().value
+                live.remove(name)
+        if pending or live:
+            time.sleep(0.1)
+    succeeded = sum(1 for v in done.values() if v == "Done")
+    return {
+        "jobs": len(jobs),
+        "succeeded": succeeded,
+        "failed": len(done) - succeeded,
+        "unfinished": len(jobs) - len(done),
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+
+
+def _ttfs_wave(tag: str, args, machinery: bool, keyer, seed: bool = False) -> dict:
+    """One TTFS wave on a fresh operator: submit ``--bench-ttfs-jobs``
+    single-process modeled-compile jobs (workloads/compiled.py) with a
+    bounded in-flight window, wait terminal, scrape the TTFS split.
+    ``machinery`` toggles the whole r11 stack (cachesvc + AOT-at-
+    admission + warm pool); ``keyer(i)`` names each job's compile key —
+    unique per job = every submission cold-compiles a fresh program,
+    constant = repeat submissions of the same workload."""
+    from tf_operator_tpu.dashboard.client import TPUJobClient
+
+    extra = ()
+    if machinery:
+        extra = (
+            "--compile-cache",
+            "--aot-workers", "4",
+            "--warm-pool", str(args.bench_ttfs_inflight),
+        )
+    operator, server, workdir, log_path = _start_operator(
+        args, f"ttfs-{tag}", extra=extra
+    )
+    try:
+        if machinery:
+            # Measure steady state, not pool bring-up: a production host
+            # agent warms its pool at agent start, long before any job
+            # arrives. Wait for the warm-idle gauge to report full.
+            _wait_gauge(server, "tpujob_warmpool_warm_idle",
+                        args.bench_ttfs_inflight, timeout=60.0)
+        # Hermetic local tier: point cached_compile's directory inside the
+        # wave's workdir so no state leaks across waves or bench runs
+        # (JAX_PLATFORMS=cpu keeps enable() from touching jax itself).
+        cache_dir = os.path.join(workdir, "compile-cache")
+        jobs = [
+            build_job(
+                f"ttfs-{tag}-{i}", 1, 0,
+                "tf_operator_tpu.workloads.compiled:main", "", True,
+                workload_extra={"aot": {
+                    "key": keyer(i),
+                    "compile_ms": args.bench_compile_ms,
+                }},
+                env_extra={"JAX_COMPILATION_CACHE_DIR": cache_dir},
+            )
+            for i in range(args.bench_ttfs_jobs)
+        ]
+        client = TPUJobClient(server)
+        if seed:
+            # Repeat-submit semantics: the measured jobs re-submit a
+            # workload the fleet has already compiled once. Run one seed
+            # job with the same key to terminal, outside the timed wave.
+            seed_job = build_job(
+                f"ttfs-{tag}-seed", 1, 0,
+                "tf_operator_tpu.workloads.compiled:main", "", True,
+                workload_extra={"aot": {
+                    "key": keyer(0),
+                    "compile_ms": args.bench_compile_ms,
+                }},
+                env_extra={"JAX_COMPILATION_CACHE_DIR": cache_dir},
+            )
+            client.create(seed_job)
+            wait_for_terminal(client, [seed_job], args.timeout,
+                              time.perf_counter())
+        report = _ttfs_submit_wave(
+            client, jobs, args.timeout, args.bench_ttfs_inflight
+        )
+        latency = _scrape_sync_latency(server)
+        import urllib.request
+
+        with urllib.request.urlopen(server + "/metrics", timeout=10) as resp:
+            text = resp.read().decode()
+        row = {
+            "wave": tag,
+            "machinery": machinery,
+            **report,
+            **latency,
+            "aot_kicked": _scrape_counter(
+                text, "tpujob_aot_compiles_kicked_total"),
+            "aot_published": _scrape_counter(
+                text, "tpujob_aot_compiles_published_total"),
+        }
+        print(json.dumps(row), flush=True)
+        return row
+    finally:
+        _stop_operator(operator, workdir)
+
+
+def run_ttfs_bench(args) -> int:
+    """Three waves, each on a fresh operator (same-host A/B, the r7
+    precedent for honest regression calls):
+
+    - ``baseline``: machinery OFF, unique compile keys — the pre-change
+      cold population (every job pays spawn + modeled compile serially).
+    - ``cold``: the full r11 stack ON, unique compile keys — first
+      submission of a never-seen program; the speedup mechanisms are
+      AOT-at-admission (compile overlaps scheduling + spawn; the gang
+      member waits out the compile *intent* instead of recompiling) and
+      the warm worker pool (no cold fork/imports).
+    - ``warm``: stack ON, every job shares ONE key — repeat submissions;
+      after the first publish, every job is a pure cache hit.
+
+    Gates (the r11 acceptance): warm p50 under the bound; cold p50 at or
+    under ``--bench-ttfs-cold-factor`` x the same-host baseline p50; and
+    zero cache-integrity failures surfaced as job failures — every job
+    in every wave must end Done (a corrupt/dead-cachesvc path degrades
+    to local compile by design, so any Failed job is a real defect)."""
+    nonce = f"{os.getpid()}-{int(time.time())}"
+    waves = [
+        _ttfs_wave("baseline", args, False, lambda i: f"b-{nonce}-{i}"),
+        _ttfs_wave("cold", args, True, lambda i: f"c-{nonce}-{i}"),
+        _ttfs_wave("warm", args, True, lambda i: f"w-{nonce}", seed=True),
+    ]
+    base, cold, warm = waves
+    warm_p50 = warm.get("ttfs_warm_p50_ms", warm.get("ttfs_p50_ms", 0.0))
+    artifact = {
+        "metric": "ttfs_bench",
+        "unit": "ms",
+        "backend": args.bench_backend,
+        "jobs_per_wave": args.bench_ttfs_jobs,
+        "inflight": args.bench_ttfs_inflight,
+        "modeled_compile_ms": args.bench_compile_ms,
+        "payload": "tf_operator_tpu.workloads.compiled:main",
+        "waves": waves,
+        "pre_cold_p50_ms": base.get("ttfs_p50_ms", 0.0),
+        "cold_p50_ms": cold.get("ttfs_p50_ms", 0.0),
+        "warm_p50_ms": warm_p50,
+        "warm_bound_ms": args.bench_ttfs_warm_bound_ms,
+        "cold_factor_bound": args.bench_ttfs_cold_factor,
+    }
+    line = json.dumps(artifact)
+    print(line)
+    if args.bench_out:
+        os.makedirs(os.path.dirname(args.bench_out) or ".", exist_ok=True)
+        with open(args.bench_out, "w") as f:
+            f.write(line + "\n")
+    ok = True
+    for w in waves:
+        if w["failed"] or w["unfinished"] or w["succeeded"] != w["jobs"]:
+            print(f"FAIL: wave {w['wave']}: not every job Succeeded "
+                  "(cache-integrity or degradation surfaced as a job "
+                  "failure)", file=sys.stderr)
+            ok = False
+    if warm_p50 >= args.bench_ttfs_warm_bound_ms:
+        print(f"FAIL: warm TTFS p50 {warm_p50}ms >= bound "
+              f"{args.bench_ttfs_warm_bound_ms}ms", file=sys.stderr)
+        ok = False
+    bound = args.bench_ttfs_cold_factor * artifact["pre_cold_p50_ms"]
+    if artifact["cold_p50_ms"] > bound:
+        print(f"FAIL: cold TTFS p50 {artifact['cold_p50_ms']}ms > "
+              f"{args.bench_ttfs_cold_factor} x baseline "
+              f"{artifact['pre_cold_p50_ms']}ms = {bound:.1f}ms",
+              file=sys.stderr)
+        ok = False
+    return 0 if ok else 1
 
 
 # ---- --bench-tenants: the multi-tenant fleet-scheduler oracle (r7) ------
@@ -695,8 +927,29 @@ def main(argv=None) -> int:
                         "many jobs/min (0 = correctness-only; pick the "
                         "floor from a same-host baseline run, not from an "
                         "artifact captured on different hardware)")
+    p.add_argument("--bench-ttfs", action="store_true",
+                   help="run the r11 time-to-first-step bench: three waves "
+                        "(baseline / cold-with-machinery / warm repeat-"
+                        "submit), each on a fresh operator; gates warm p50 "
+                        "and the cold-vs-baseline ratio")
+    p.add_argument("--bench-ttfs-jobs", type=int, default=100,
+                   help="jobs per TTFS wave")
+    p.add_argument("--bench-compile-ms", type=int, default=600,
+                   help="modeled XLA compile cost each cache miss pays "
+                        "(workloads/compiled.py)")
+    p.add_argument("--bench-ttfs-warm-bound-ms", type=float, default=1000.0,
+                   help="fail if the warm wave's warm-population TTFS p50 "
+                        "is at or above this (the sub-second headline)")
+    p.add_argument("--bench-ttfs-cold-factor", type=float, default=0.5,
+                   help="fail if the cold wave's TTFS p50 exceeds this "
+                        "fraction of the same-host baseline p50")
+    p.add_argument("--bench-ttfs-inflight", type=int, default=4,
+                   help="bounded submission window (and warm-pool size): "
+                        "repeat-submit is a stream, not one batch")
     args = p.parse_args(argv)
 
+    if args.bench_ttfs:
+        return run_ttfs_bench(args)
     if args.bench:
         if args.bench_tenants > 0:
             return run_sched_bench(args)
