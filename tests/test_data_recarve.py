@@ -1,0 +1,170 @@
+"""Elastic re-carve accounting (r12): the union of post-resize rank
+strides equals the uninterrupted stream — no token duplicated, none
+dropped — across shrink -> grow -> shrink chains and uneven window
+counts. Pins the invariant the elastic soak's bit-identical gate rests
+on (train/data.py elastic_* + workloads/elastic orphan re-deal)."""
+
+import numpy as np
+import pytest
+
+from tf_operator_tpu.train.data import (
+    TokenMemmapDataset,
+    elastic_coverage,
+    elastic_global_order,
+    elastic_rank_positions,
+    write_token_corpus,
+)
+from tf_operator_tpu.workloads.elastic import _deal
+
+
+def test_rank_positions_partition_interval():
+    # rank::n strides over [start, end): disjoint, exhaustive, in order
+    start, end, n = 7, 40, 3
+    strides = [list(elastic_rank_positions(start, end, r, n)) for r in range(n)]
+    union = sorted(p for s in strides for p in s)
+    assert union == list(range(start, end))
+    for a in range(n):
+        for b in range(a + 1, n):
+            assert not set(strides[a]) & set(strides[b])
+
+
+def test_rank_positions_validation():
+    with pytest.raises(ValueError):
+        elastic_rank_positions(0, 10, 0, 0)
+    with pytest.raises(ValueError):
+        elastic_rank_positions(0, 10, 3, 3)
+
+
+def test_global_order_independent_of_world_and_rank():
+    # G is a pure function of (n_windows, seed) — every member of every
+    # incarnation derives the identical sequence
+    a = elastic_global_order(100, seed=5)
+    b = elastic_global_order(100, seed=5)
+    assert np.array_equal(a, b)
+    assert sorted(a.tolist()) == list(range(100))
+    assert not np.array_equal(a, elastic_global_order(100, seed=6))
+
+
+@pytest.mark.parametrize(
+    "total,worlds",
+    [
+        # shrink -> grow -> shrink, even total
+        (120, [4, 3, 4, 2]),
+        # uneven window count: total not divisible by any world size
+        (97, [4, 3, 4]),
+        # degenerate worlds: down to one member and back
+        (53, [3, 1, 3]),
+    ],
+)
+def test_resize_chain_covers_stream_exactly_once(total, worlds):
+    """Walk a resize chain, each epoch consuming a slice of the offset
+    space at its own world size; the union of every rank's stride over
+    every epoch must be the uninterrupted stream."""
+    # cut the offset space into len(worlds) contiguous segments of
+    # deliberately uneven width
+    bounds = [0]
+    for i in range(1, len(worlds)):
+        bounds.append(bounds[-1] + total // len(worlds) + (i % 2))
+    bounds.append(total)
+    segments = [
+        (bounds[i], bounds[i + 1], worlds[i]) for i in range(len(worlds))
+    ]
+    cover = elastic_coverage(segments)
+    positions = [p for p, _rank in cover]
+    assert positions == list(range(total)), "dropped or duplicated offsets"
+    # and per-epoch the ranks really partition their segment
+    for start, end, n in segments:
+        seen = {}
+        for r in range(n):
+            for p in elastic_rank_positions(start, end, r, n):
+                assert p not in seen, f"offset {p} owned by {seen[p]} and {r}"
+                seen[p] = r
+        assert sorted(seen) == list(range(start, end))
+
+
+def test_orphan_redeal_covers_exactly_once():
+    """The workload's re-carve: a member dies mid-epoch, its unconsumed
+    positions (orphans) fall back into the remaining pool and the new
+    world deals remaining[r::n] — union over the whole run is exact,
+    through shrink -> grow -> shrink."""
+    total = 101
+    members = ["m0", "m1", "m2"]
+    deal = _deal(list(range(total)), members)
+    consumed = set()
+    # epoch 0: m2 dies after consuming 7 of its positions; survivors
+    # consume 11 each
+    for m, k in (("m0", 11), ("m1", 11), ("m2", 7)):
+        consumed.update(deal[m][:k])
+    # epoch 1 (shrink to 2): re-deal the remainder; m1 consumes 9, m0 13
+    remaining = [p for p in range(total) if p not in consumed]
+    deal1 = _deal(remaining, ["m0", "m1"])
+    assert sorted(deal1["m0"] + deal1["m1"]) == remaining
+    for m, k in (("m0", 13), ("m1", 9)):
+        consumed.update(deal1[m][:k])
+    # epoch 2 (grow back to 3): the returned member joins the re-deal
+    remaining = [p for p in range(total) if p not in consumed]
+    deal2 = _deal(remaining, members)
+    for m, k in (("m0", 5), ("m1", 5), ("m2", 5)):
+        consumed.update(deal2[m][:k])
+    # epoch 3 (shrink again, m0 dies this time)
+    remaining = [p for p in range(total) if p not in consumed]
+    deal3 = _deal(remaining, ["m1", "m2"])
+    for m in ("m1", "m2"):
+        consumed.update(deal3[m])
+    assert sorted(consumed) == list(range(total)), (
+        "resize chain dropped or double-consumed offsets"
+    )
+
+
+def test_deal_disjoint_and_exhaustive_uneven():
+    remaining = [3, 5, 8, 13, 21, 34, 55]
+    deal = _deal(remaining, ["a", "b", "c"])
+    assert sorted(deal["a"] + deal["b"] + deal["c"]) == remaining
+    assert len(deal["a"]) == 3 and len(deal["b"]) == 2 and len(deal["c"]) == 2
+
+
+def test_dataset_elastic_windows_union_is_uninterrupted_stream(tmp_path):
+    """TokenMemmapDataset.elastic_windows across a shrink: the window ids
+    consumed by all ranks across both segments equal exactly what a
+    single uninterrupted pass at any world size would consume."""
+    seq_len, n_windows = 4, 30
+    corpus = tmp_path / "corpus.bin"
+    write_token_corpus(
+        str(corpus), np.arange(seq_len * n_windows, dtype=np.uint16)
+    )
+    ds = TokenMemmapDataset(
+        str(corpus), batch_size=2, seq_len=seq_len, seed=9,
+        process_shard=False,
+    )
+    # 3 ranks consume offsets [0, 12), then a shrink to 2 ranks consumes
+    # [12, 30)
+    seen = []
+    for r in range(3):
+        seen.extend(ds.elastic_windows(0, 12, r, 3).tolist())
+    for r in range(2):
+        seen.extend(ds.elastic_windows(12, n_windows, r, 2).tolist())
+    order = elastic_global_order(n_windows, seed=9)
+    assert sorted(seen) == list(range(n_windows))
+    assert sorted(seen) == sorted(order.tolist())
+    # position -> window mapping is the canonical order, not rank-local
+    assert set(ds.elastic_windows(0, 12, 0, 3).tolist()) <= set(
+        order[:12].tolist()
+    )
+
+
+def test_dataset_elastic_windows_respects_holdout(tmp_path):
+    seq_len, n_windows, holdout = 4, 20, 5
+    corpus = tmp_path / "corpus.bin"
+    write_token_corpus(
+        str(corpus), np.arange(seq_len * n_windows, dtype=np.uint16)
+    )
+    ds = TokenMemmapDataset(
+        str(corpus), batch_size=2, seq_len=seq_len, seed=1,
+        process_shard=False, holdout=holdout,
+    )
+    train_n = n_windows - holdout
+    seen = []
+    for r in range(2):
+        seen.extend(ds.elastic_windows(0, train_n, r, 2).tolist())
+    # the held-out tail is never consumed by any elastic carve
+    assert sorted(seen) == list(range(train_n))

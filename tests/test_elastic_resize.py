@@ -1,0 +1,295 @@
+"""Elastic gang resize (r12) — reconciler shrink/re-grow decisions, the
+backoff exemption, the world-size tagging on checkpoints and depot
+commits, and the loud mixed-world restore refusal."""
+
+import json
+
+import numpy as np
+import pytest
+
+from tests.test_reconciler import Harness, make_job, make_process
+from tf_operator_tpu.api.types import ReplicaType
+from tf_operator_tpu.controller.reconciler import (
+    CAUSE_RESIZE_GROW,
+    CAUSE_RESIZE_SHRINK,
+    _elastic_mesh_ok,
+)
+from tf_operator_tpu.api.types import ConditionType
+from tf_operator_tpu.controller.status import has_condition
+from tf_operator_tpu.rendezvous.env import ENV_RESIZE_EPOCH
+from tf_operator_tpu.rendezvous.statechannel import (
+    DepotClient,
+    ShardDepot,
+    choose_restore_source,
+)
+from tf_operator_tpu.runtime.objects import ProcessPhase
+from tf_operator_tpu.train.checkpoint import (
+    CheckpointManager,
+    checkpoint_world_size,
+)
+
+
+def elastic_job(workers=3, **kw):
+    kw.setdefault("elastic", True)
+    return make_job(workers=workers, **kw)
+
+
+def seeded(job, failed_worker=None, exit_code=137, phases=None):
+    procs = [make_process(job, ReplicaType.COORDINATOR, 0, ProcessPhase.RUNNING)]
+    n = job.spec.replica_specs[ReplicaType.WORKER].replicas
+    for i in range(n):
+        if i == failed_worker:
+            procs.append(
+                make_process(
+                    job, ReplicaType.WORKER, i, ProcessPhase.FAILED,
+                    exit_code=exit_code,
+                )
+            )
+        else:
+            phase = (phases or {}).get(i, ProcessPhase.RUNNING)
+            procs.append(make_process(job, ReplicaType.WORKER, i, phase))
+    return procs
+
+
+# ---- shrink decision ----------------------------------------------------
+
+
+def test_member_loss_shrinks_instead_of_restarting():
+    job = elastic_job(workers=3)
+    h = Harness(job, seeded(job, failed_worker=2))
+    h.sync()
+    st = h.stored_job().status
+    # a resize, not a restart: the failure budget is untouched
+    assert st.restart_count == 0
+    assert st.resize_count == 1
+    assert st.resize_epoch == 1
+    assert st.world_size == 3  # coordinator + 2 surviving workers
+    assert st.last_restart_cause == CAUSE_RESIZE_SHRINK
+    d = st.resize_directive
+    assert d["direction"] == "shrink" and d["epoch"] == 1
+    assert d["members"] == [
+        "trainer-coordinator-0", "trainer-worker-0", "trainer-worker-1",
+    ]
+    assert st.resize_history and st.resize_history[-1]["direction"] == "shrink"
+    # only the dead member is torn down — survivors keep running
+    assert h.fake.deleted == ["default/trainer-worker-2"]
+    assert not has_condition(st, ConditionType.FAILED)
+
+
+def test_shrink_never_charged_to_backoff():
+    # backoff_limit=0 would fail the job on the FIRST counted restart; an
+    # elastic shrink must sail past it
+    job = elastic_job(workers=3, backoff_limit=0)
+    h = Harness(job, seeded(job, failed_worker=1))
+    h.sync()
+    st = h.stored_job().status
+    assert not has_condition(st, ConditionType.FAILED)
+    assert st.resize_count == 1 and st.restart_count == 0
+
+
+def test_chief_death_takes_full_restart_path():
+    job = elastic_job(workers=2)
+    procs = seeded(job)
+    procs[0] = make_process(
+        job, ReplicaType.COORDINATOR, 0, ProcessPhase.FAILED, exit_code=137
+    )
+    h = Harness(job, procs)
+    h.sync()
+    st = h.stored_job().status
+    assert st.resize_count == 0 and st.restart_count == 1
+    assert len(h.fake.deleted) == 3  # whole gang
+
+
+def test_non_elastic_mesh_takes_full_restart_path():
+    job = elastic_job(workers=2)
+    job.spec.topology.mesh_axes = {"tp": 4}
+    assert not _elastic_mesh_ok(job)
+    h = Harness(job, seeded(job, failed_worker=1))
+    h.sync()
+    st = h.stored_job().status
+    assert st.resize_count == 0 and st.restart_count == 1
+
+
+def test_dcn_fsdp_axis_is_not_elastic():
+    job = elastic_job(workers=2)
+    job.spec.topology.mesh_axes = {"dp": 2, "fsdp": 4}
+    assert _elastic_mesh_ok(job)
+    job.spec.topology.dcn_mesh_axes = {"fsdp": 2}
+    assert not _elastic_mesh_ok(job)
+    job.spec.topology.dcn_mesh_axes = {"dp": 2}
+    assert _elastic_mesh_ok(job)
+
+
+def test_elastic_off_takes_full_restart_path():
+    job = make_job(workers=3)  # run_policy.elastic defaults off
+    h = Harness(job, seeded(job, failed_worker=2))
+    h.sync()
+    st = h.stored_job().status
+    assert st.resize_count == 0 and st.restart_count == 1
+
+
+def test_preemption_exit_takes_full_restart_not_shrink():
+    # exit 143 classifies as preemption: the whole gang must move off the
+    # draining host — shrinking would leave survivors on it
+    job = elastic_job(workers=2)
+    h = Harness(job, seeded(job, failed_worker=0, exit_code=143))
+    h.sync()
+    st = h.stored_job().status
+    assert st.resize_count == 0
+    assert len(h.fake.deleted) == 3
+
+
+# ---- symmetric re-grow --------------------------------------------------
+
+
+def shrunk_job(workers=3):
+    """A job mid-shrink: worker-2 died at epoch 1, survivors running."""
+    job = elastic_job(workers=workers)
+    members = ["trainer-coordinator-0"] + [
+        f"trainer-worker-{i}" for i in range(workers - 1)
+    ]
+    job.status.resize_epoch = 1
+    job.status.resize_count = 1
+    job.status.world_size = workers  # coord + (workers-1) survivors
+    job.status.last_restart_cause = CAUSE_RESIZE_SHRINK
+    job.status.resize_directive = {
+        "epoch": 1, "direction": "shrink", "world_size": workers,
+        "members": members, "time": 0.0,
+    }
+    job.status.resize_history = [
+        {"epoch": 1, "direction": "shrink", "world_size": workers,
+         "cause": "crash", "time": 0.0},
+    ]
+    procs = [make_process(job, ReplicaType.COORDINATOR, 0, ProcessPhase.RUNNING)]
+    for i in range(workers - 1):
+        procs.append(make_process(job, ReplicaType.WORKER, i, ProcessPhase.RUNNING))
+    return job, procs
+
+
+def test_regrow_recreates_lost_member_with_resize_epoch_env():
+    job, procs = shrunk_job(workers=3)
+    h = Harness(job, procs)
+    h.sync()
+    created = {p.metadata.name: p for p in h.fake.created}
+    assert set(created) == {"trainer-worker-2"}
+    # the re-grown member is stamped with the GROW epoch so it waits for
+    # the published directive before joining
+    assert created["trainer-worker-2"].spec.env[ENV_RESIZE_EPOCH] == "2"
+    st = h.stored_job().status
+    assert st.resize_epoch == 2
+    assert st.resize_count == 2
+    assert st.world_size == 4
+    assert st.restart_count == 0
+    assert st.last_restart_cause == CAUSE_RESIZE_GROW
+    d = st.resize_directive
+    assert d["direction"] == "grow" and d["epoch"] == 2
+    assert len(d["members"]) == 4
+    assert st.resize_history[-1]["direction"] == "grow"
+
+
+def test_regrow_waits_until_all_survivors_running():
+    job, procs = shrunk_job(workers=3)
+    procs[1].status.phase = ProcessPhase.PENDING  # worker-0 still settling
+    h = Harness(job, procs)
+    h.sync()
+    assert not h.fake.created  # re-grow would stack resizes; deferred
+    st = h.stored_job().status
+    assert st.resize_epoch == 1
+    assert st.resize_directive["direction"] == "shrink"
+
+
+# ---- world-size tagging + mixed-world refusal ---------------------------
+
+
+def _save_step(directory, world, step=1):
+    mgr = CheckpointManager(
+        directory, backend="npy", async_save=False, world_size=world
+    )
+    assert mgr.save(step, {"w": np.arange(8, dtype=np.float32)}, wait=True)
+    return mgr
+
+
+def test_manifest_tagged_with_writing_world_size(tmp_path):
+    _save_step(str(tmp_path), world=3)
+    assert checkpoint_world_size(str(tmp_path), 1) == 3
+    with open(tmp_path / "step_1" / "manifest.json") as f:
+        assert json.load(f)["world_size"] == 3
+
+
+def test_restore_refuses_world_mismatch_loudly(tmp_path):
+    _save_step(str(tmp_path), world=3)
+    template = {"w": np.zeros(8, dtype=np.float32)}
+    reader = CheckpointManager(
+        str(tmp_path), backend="npy", readonly=True, world_size=2
+    )
+    with pytest.raises(ValueError, match="world of 3.*world of 2"):
+        reader.restore(template)
+    # same world: fine
+    ok = CheckpointManager(
+        str(tmp_path), backend="npy", readonly=True, world_size=3
+    )
+    restored = ok.restore(template)
+    assert np.array_equal(restored["w"], np.arange(8, dtype=np.float32))
+    # explicit resize restore: the elastic path declares it
+    elastic = CheckpointManager(
+        str(tmp_path), backend="npy", readonly=True, world_size=2,
+        allow_world_resize=True,
+    )
+    restored = elastic.restore(template)
+    assert np.array_equal(restored["w"], np.arange(8, dtype=np.float32))
+
+
+def test_depot_commit_tags_world_and_restore_skips_mismatch(tmp_path):
+    depot = ShardDepot()
+    try:
+        ns, jb = "default", "trainer"
+        # step 1 written by world 3, step 2 by world 2 (post-shrink)
+        for step, world in ((1, 3), (2, 2)):
+            manifest = json.dumps({"step": step, "world_size": world,
+                                   "leaves": []}).encode()
+            depot.stage(ns, jb, step, "manifest.json", manifest)
+            depot.stage(ns, jb, step, "leaf_0.npy", b"x" * 16)
+            assert depot.commit(ns, jb, step)
+        assert depot.step_worlds(ns, jb) == {1: 3, 2: 2}
+
+        client = DepotClient(timeout=5.0)
+        # a world-3 restorer must NOT resume from the world-2 step 2
+        url, step = client.best_peer([depot.url], ns, jb, expect_world_size=3)
+        assert (url, step) == (depot.url, 1)
+        # unconstrained (non-elastic) restore still sees the newest step
+        url, step = client.best_peer([depot.url], ns, jb)
+        assert (url, step) == (depot.url, 2)
+        # the full decision: peer chosen at the world-compatible step
+        source, url, step = choose_restore_source(
+            [depot.url], ns, jb, disk_step=0, client=client,
+            expect_world_size=3,
+        )
+        assert (source, step) == ("peer", 1)
+        # fetch_step re-checks the manifest tag: a lying listing still
+        # cannot make a mismatched step a resume point
+        got = client.fetch_step(depot.url, ns, jb, 2, str(tmp_path / "a"),
+                                expect_world_size=3)
+        assert got is None
+        got = client.fetch_step(depot.url, ns, jb, 1, str(tmp_path / "b"),
+                                expect_world_size=3)
+        assert got is not None
+        assert checkpoint_world_size(str(tmp_path / "b"), 1) == 3
+    finally:
+        depot.stop()
+
+
+def test_untagged_legacy_depot_steps_still_restorable(tmp_path):
+    # a pre-r12 push (no world tag) must not be refused — the manager's
+    # restore-time check remains the authoritative gate
+    depot = ShardDepot()
+    try:
+        ns, jb = "default", "legacy"
+        depot.stage(ns, jb, 5, "manifest.json",
+                    json.dumps({"step": 5, "leaves": []}).encode())
+        assert depot.commit(ns, jb, 5)
+        assert depot.step_worlds(ns, jb) == {5: 0}
+        client = DepotClient(timeout=5.0)
+        url, step = client.best_peer([depot.url], ns, jb, expect_world_size=4)
+        assert (url, step) == (depot.url, 5)
+    finally:
+        depot.stop()

@@ -235,6 +235,14 @@ class RunPolicy:
     # tighten it; jobs on flaky networks loosen it instead of eating
     # spurious gang restarts.
     heartbeat_ttl_seconds: Optional[float] = None
+    # Elastic gangs (r12): opt-in shrink/re-grow on member loss instead of
+    # full gang restart. Only honored for dp/fsdp-only meshes (tp/pp/ep
+    # shard the model program itself — losing a rank there severs the SPMD
+    # program and a full restart is the only sound recovery); the
+    # reconciler falls back to _restart_gang whenever the mesh, the lost
+    # member (the coordinator anchors rendezvous), or survivor count makes
+    # a resize unsound.
+    elastic: bool = False
 
 
 @dataclass
@@ -313,7 +321,28 @@ class TPUJobStatus:
     # Cause of the most recent gang restart: "preemption" |
     # "retryable-failure" | "node-lost" ("" before any restart) — lets
     # status surfaces report preempted vs failed restarts distinctly.
+    # Elastic jobs (r12) additionally report "resize_shrink"/"resize_grow"
+    # here, but resizes increment resize_count, never restart_count.
     last_restart_cause: str = ""
+    # Elastic-gang state (r12). resize_epoch is the monotonic barrier
+    # counter stamped into the gang env (TPUJOB_RESIZE_EPOCH) and into
+    # every resize directive; world_size is the CURRENT gang size (0 ⇒
+    # never resized: the spec-derived size applies). resize_count mirrors
+    # restart_count for resizes and is deliberately NOT charged against
+    # backoff_limit (same rule as preemptions: losing a member is
+    # infrastructure's doing, not the workload's).
+    resize_epoch: int = 0
+    resize_count: int = 0
+    world_size: int = 0
+    # The live resize directive the controller offers the survivors:
+    # {"epoch": int, "direction": "shrink"|"grow", "world_size": int,
+    #  "members": [process names, rank order], "time": ts} plus any
+    # barrier fields the chief publishes back (boundary/offset/ack). Empty
+    # when the gang runs at spec size with no resize in flight.
+    resize_directive: Dict[str, Any] = field(default_factory=dict)
+    # Append-only audit of resizes: [{"epoch", "direction", "world_size",
+    # "time"}] — the dashboard/CLI surface for "visibly degraded".
+    resize_history: List[Dict[str, Any]] = field(default_factory=list)
     # Latest evaluator-reported scores, written by the Evaluator replica
     # through the API (workloads/eval.py → JobContext.report_eval_metrics):
     # {"step": int, "metrics": {name: value}, "time": ts}. The reference
@@ -446,5 +475,10 @@ def _tpujob_from_dict(data: Dict[str, Any]) -> TPUJob:
         preemption_count=status_d.get("preemption_count", 0),
         last_restart_cause=status_d.get("last_restart_cause", ""),
         eval_metrics=status_d.get("eval_metrics", {}) or {},
+        resize_epoch=status_d.get("resize_epoch", 0),
+        resize_count=status_d.get("resize_count", 0),
+        world_size=status_d.get("world_size", 0),
+        resize_directive=status_d.get("resize_directive", {}) or {},
+        resize_history=list(status_d.get("resize_history", []) or []),
     )
     return TPUJob(metadata=meta, spec=spec, status=status, kind=data.get("kind", KIND_TPUJOB))

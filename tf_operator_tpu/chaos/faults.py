@@ -50,6 +50,13 @@ class FaultKind(str, enum.Enum):
     # restarted operator recovers from its --data-dir and re-adopts the
     # live gang (runtime/persist.py + controller.record_recovery).
     OPERATOR_CRASH = "operator-crash"
+    # Elastic member churn: SIGKILL a non-chief gang process AND pause
+    # its host's heartbeats so the reconciler sees a hard member loss
+    # (not a clean exit), then — ``duration_s`` later — resume the
+    # heartbeats so the host comes back and the returning member can be
+    # re-created. On an elastic job this drives a shrink followed by a
+    # symmetric re-grow instead of two full gang restarts.
+    KILL_RETURN = "kill-return"
 
 
 @dataclass(frozen=True)
@@ -181,6 +188,39 @@ class FaultSchedule:
                     FaultKind.STORE_ERROR,
                     at_s=rng.uniform(0.0, spread_s),
                     errors=rng.randint(1, 3),
+                )
+            )
+        return FaultSchedule(seed=seed, faults=tuple(faults))
+
+    @staticmethod
+    def generate_elastic(
+        seed: int,
+        kills: int = 2,
+        first_step: int = 1,
+        spread_s: float = 12.0,
+        return_after_s: Tuple[float, float] = (4.0, 9.0),
+    ) -> "FaultSchedule":
+        """Seeded kill/return schedule for the elastic soak.
+
+        Every fault is KILL_RETURN: lose one non-chief member, get it
+        back ``duration_s`` later. Gates are wall-clock + checkpoint
+        progress only — ``after_restarts`` stays 0 because the whole
+        point of an elastic job is that the restart counter never
+        advances, so a restart-gated fault would wait forever. The
+        injector resolves ``target`` over the sorted *non-chief*
+        candidate list, so rank 0 is never the victim (losing the chief
+        is a legitimate full restart, which the elastic soak forbids)."""
+        rng = random.Random(seed)
+        faults = []
+        for _ in range(max(1, kills)):
+            faults.append(
+                Fault(
+                    FaultKind.KILL_RETURN,
+                    at_s=rng.uniform(0.0, spread_s),
+                    at_step=first_step,
+                    target=rng.randrange(16),
+                    exit_code=137,
+                    duration_s=rng.uniform(*return_after_s),
                 )
             )
         return FaultSchedule(seed=seed, faults=tuple(faults))

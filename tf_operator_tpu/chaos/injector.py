@@ -180,6 +180,8 @@ class ChaosInjector:
         self.knobs = _Knobs()
         # Applied faults, in order: {"kind", "target", "t_s", ...detail}.
         self.applied: List[Dict[str, Any]] = []
+        # KILL_RETURN hosts waiting to come back: {"host", "resume_at"}.
+        self._pending_returns: List[Dict[str, Any]] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._t0 = 0.0
@@ -207,7 +209,13 @@ class ChaosInjector:
 
     @property
     def done(self) -> bool:
-        return len(self.applied) >= len(self.schedule.faults)
+        # A KILL_RETURN fault is only half-applied until its host has
+        # come back; the soak must not declare the churn finished while
+        # a member is still gone.
+        return (
+            len(self.applied) >= len(self.schedule.faults)
+            and not self._pending_returns
+        )
 
     # -- trigger state ----------------------------------------------------
 
@@ -242,6 +250,7 @@ class ChaosInjector:
     def _loop(self) -> None:
         for fault in self.schedule.faults:
             while not self._stop.is_set():
+                self._tick_returns()
                 try:
                     if self._ready(fault) and self._fire(fault):
                         break
@@ -251,6 +260,37 @@ class ChaosInjector:
                     return
             if self._stop.is_set():
                 return
+        # All faults fired; keep ticking until every killed host is back.
+        while not self._stop.is_set() and self._pending_returns:
+            self._tick_returns()
+            if self._stop.wait(self.poll_interval):
+                return
+
+    def _tick_returns(self) -> None:
+        """Resume heartbeats on killed hosts whose return is due."""
+        now = time.monotonic()
+        due = [r for r in self._pending_returns if now >= r["resume_at"]]
+        for rec in due:
+            def ready(cur):
+                cur.status.phase = HostPhase.READY
+                cur.status.message = "chaos: kill-return — host back"
+
+            try:
+                self.store.update_with_retry(
+                    KIND_HOST, "default", rec["host"], ready
+                )
+            except Exception:
+                log.exception("chaos: re-ready(%s) failed", rec["host"])
+            agent = self.agents.get(rec["host"])
+            if agent is not None:
+                try:
+                    agent.resume_heartbeats()
+                except Exception:
+                    log.exception("chaos: resume_heartbeats(%s) failed",
+                                  rec["host"])
+            self._pending_returns.remove(rec)
+            log.warning("chaos: host %s returned after %.1fs",
+                        rec["host"], now - rec["killed_at"])
 
     def _record(self, fault: Fault, target: str, **detail: Any) -> None:
         rec = {"kind": fault.kind.value, "target": target,
@@ -292,6 +332,8 @@ class ChaosInjector:
             return True
         if fault.kind is FaultKind.OPERATOR_CRASH:
             return self._fire_operator_crash(fault)
+        if fault.kind is FaultKind.KILL_RETURN:
+            return self._fire_kill_return(fault)
         raise ValueError(f"unknown fault kind {fault.kind!r}")
 
     def _fire_operator_crash(self, fault: Fault) -> bool:
@@ -364,6 +406,115 @@ class ChaosInjector:
         if self.store.update_with_retry(KIND_PROCESS, ns, name, mutate) is None:
             return False
         self._record(fault, victim.metadata.key(), exit_code=code, via="store")
+        return True
+
+    def _chief_name(self) -> Optional[str]:
+        """Deterministic chief process name (chief-present vs worker-0,
+        mirroring the reconciler's _chief_role)."""
+        if not self.job_name:
+            return None
+        try:
+            job = self.store.get(KIND_TPUJOB, self.namespace, self.job_name)
+        except Exception:
+            return None
+        rtype = (
+            ReplicaType.COORDINATOR
+            if ReplicaType.COORDINATOR in job.spec.replica_specs
+            else ReplicaType.WORKER
+        )
+        return f"{self.job_name}-{rtype.value.lower()}-0"
+
+    def _fire_kill_return(self, fault: Fault) -> bool:
+        """SIGKILL a non-chief member AND silence its host, then bring the
+        host back ``duration_s`` later (via _tick_returns).
+
+        Gated on a FULLY RUNNING gang so that consecutive kill/return
+        faults always see the previous cycle's re-grow completed — the
+        shrink→grow sequence stays deterministic. The chief is never a
+        victim: every member's rendezvous points at it, so losing it is a
+        legitimate full restart, which the elastic soak forbids. The
+        host's heartbeats are PAUSED, not the agent stopped: stopping the
+        agent would SIGTERM its children (exit 143 ⇒ preemption class ⇒
+        full restart) and tear down its shard depot, which the survivors
+        need as a peer restore source."""
+        if self._pending_returns:
+            # A previous kill's host is still gone: firing now would race
+            # the store's view of the last victim (it can read RUNNING for
+            # milliseconds after the SIGKILL) and stack cycles.
+            return False
+        running = [
+            p for p in self._live_processes()
+            if p.status.phase is ProcessPhase.RUNNING
+        ]
+        gang = self._gang_size()
+        if not running or (gang and len(running) < gang):
+            return False
+        chief = self._chief_name()
+        victims = [p for p in running
+                   if p.metadata.name != chief and p.spec.node_name]
+        if not victims:
+            return False
+        victim = victims[fault.target % len(victims)]
+        host = victim.spec.node_name
+        agent = self.agents.get(host)
+        # Silence the host FIRST so the reconciler never sees a fresh
+        # heartbeat from a host whose member just died — the loss must
+        # read as a hard host loss, not a crashed process on a live host
+        # (which would be recreated in place instead of shrunk around).
+        if agent is not None and getattr(agent, "pause_heartbeats", None):
+            agent.pause_heartbeats()
+        code = fault.exit_code
+        signum = code - 128 if 128 < code < 160 else _signal.SIGKILL
+        ns, name = victim.metadata.namespace, victim.metadata.name
+        killed = False
+        backend = getattr(agent, "backend", None)
+        if backend is not None and getattr(backend, "signal_local", None):
+            killed = bool(backend.signal_local(ns, name, signum))
+        if not killed and victim.status.pid:
+            import os
+
+            try:
+                os.kill(victim.status.pid, signum)
+                killed = True
+            except OSError:
+                killed = False
+        if not killed:
+            # Store-only rigs: declare the failure, uid-guarded.
+            uid = victim.metadata.uid
+
+            def mutate(cur):
+                if cur.metadata.uid != uid or cur.is_finished():
+                    return False
+                cur.status.phase = ProcessPhase.FAILED
+                cur.status.exit_code = code
+                cur.status.finish_time = time.time()
+                cur.status.message = "chaos: injected kill-return"
+
+            killed = (
+                self.store.update_with_retry(KIND_PROCESS, ns, name, mutate)
+                is not None
+            )
+        if not killed:
+            if agent is not None and getattr(agent, "resume_heartbeats", None):
+                agent.resume_heartbeats()
+            return False
+        # Close the within-TTL window: a paused host still carries a fresh
+        # heartbeat for up to heartbeat_ttl, during which the re-grow
+        # could place straight back onto the "gone" host (its agent is
+        # alive, only silenced). NOT_READY is the cloud provider's
+        # instant instance-terminated signal; _tick_returns flips it back.
+        def not_ready(cur):
+            cur.status.phase = HostPhase.NOT_READY
+            cur.status.message = "chaos: kill-return — host gone"
+
+        self.store.update_with_retry(KIND_HOST, "default", host, not_ready)
+        now = time.monotonic()
+        self._pending_returns.append(
+            {"host": host, "resume_at": now + fault.duration_s,
+             "killed_at": now}
+        )
+        self._record(fault, victim.metadata.key(), exit_code=code,
+                     host=host, return_after_s=round(fault.duration_s, 3))
         return True
 
     def _candidate_hosts(self) -> List[str]:

@@ -365,11 +365,16 @@ class _InvariantWatcher:
     evidence either way)."""
 
     def __init__(self, store: Any, job_name: str, gang_names: List[str],
-                 grace_s: float = 10.0) -> None:
+                 grace_s: float = 10.0, allowed_subset_fn=None) -> None:
         self.store = store
         self.job_name = job_name
         self.gang_names = set(gang_names)
         self.grace_s = grace_s
+        # Elastic soak: a DELIBERATE shrink is a sanctioned strict subset
+        # — the callback returns the set of member names the job's live
+        # resize directive currently blesses (or None for "full gang
+        # only"). A subset that matches neither is still a violation.
+        self.allowed_subset_fn = allowed_subset_fn
         self.violations: List[str] = []
         self.resume_steps: List[int] = []
         # name -> set of uids observed for it (distinct incarnations
@@ -428,6 +433,14 @@ class _InvariantWatcher:
             except TransientStoreError:
                 self._partial_since = None  # store dark (operator outage)
                 continue
+            if live and live != self.gang_names and self.allowed_subset_fn:
+                try:
+                    allowed = self.allowed_subset_fn()
+                except Exception:
+                    allowed = None
+                if allowed is not None and live == allowed:
+                    self._partial_since = None
+                    continue
             if live and live != self.gang_names:
                 now = time.monotonic()
                 if self._partial_since is None:
@@ -719,6 +732,375 @@ def run_soak(
     return result
 
 
+def default_elastic_schedule(
+    seed: int, kills: int = 2, spread_s: float = 6.0
+) -> FaultSchedule:
+    """The elastic acceptance recipe: ``kills`` kill/return faults against
+    non-chief members, each returning 3-6s later. Pure function of the
+    seed."""
+    return FaultSchedule.generate_elastic(
+        seed, kills=kills, first_step=1, spread_s=spread_s,
+        return_after_s=(3.0, 6.0),
+    )
+
+
+@dataclass
+class ElasticSoakResult:
+    """Observations of one elastic soak (see check for the gates)."""
+
+    succeeded: bool = False
+    restart_count: int = 0
+    preemption_count: int = 0
+    resize_count: int = 0
+    resize_epoch: int = 0
+    world_size: int = 0
+    last_restart_cause: str = ""
+    resize_history: List[dict] = field(default_factory=list)
+    conditions: List[tuple] = field(default_factory=list)
+    applied: List[dict] = field(default_factory=list)
+    schedule: Optional[FaultSchedule] = None
+    partial_gang_violations: List[str] = field(default_factory=list)
+    # Eval digests: the faulted run's (from workdir/gang/done.json) vs the
+    # uninterrupted stream's (position-ordered canonical consumption) —
+    # equality IS the bit-identical gate.
+    digest: str = ""
+    expected_digest: str = ""
+    # Controller resize spans from the trace: direction + downtime_s
+    # (None = never closed).
+    resize_windows: List[dict] = field(default_factory=list)
+    restore_sources: List[str] = field(default_factory=list)
+    # Consumption rate (positions/s) before the first shrink, while
+    # shrunk, and after the first re-grow.
+    tokens_per_s: Dict[str, Optional[float]] = field(default_factory=dict)
+    downtime_bound_s: float = 60.0
+
+    @property
+    def bit_identical(self) -> bool:
+        return bool(self.digest) and self.digest == self.expected_digest
+
+    @property
+    def peer_restores(self) -> int:
+        return sum(1 for s in self.restore_sources if s == "peer")
+
+    def check(self) -> List[str]:
+        errs = []
+        if not self.succeeded:
+            errs.append(f"job did not succeed: {self.conditions}")
+        # THE tentpole gate: member loss + return handled entirely by
+        # shrink/re-grow — zero full gang restarts of any flavor.
+        if self.restart_count or self.preemption_count:
+            errs.append(
+                f"full gang restart happened (restarts="
+                f"{self.restart_count} preemptions={self.preemption_count} "
+                f"last_cause={self.last_restart_cause!r}) — member loss "
+                "must resize, not restart"
+            )
+        kills = sum(
+            1 for f in (self.schedule.faults if self.schedule else ())
+            if f.kind is FaultKind.KILL_RETURN
+        )
+        if self.resize_count < 2 * kills:
+            errs.append(
+                f"resize_count {self.resize_count} < {2 * kills} "
+                f"(each of {kills} kill/returns must shrink AND re-grow)"
+            )
+        directions = [h.get("direction") for h in self.resize_history]
+        if "shrink" not in directions or "grow" not in directions:
+            errs.append(f"resize history lacks a direction: {directions}")
+        if self.partial_gang_violations:
+            errs.append(
+                f"unsanctioned partial gang: {self.partial_gang_violations}"
+            )
+        sched_kinds = [
+            f.kind.value for f in (self.schedule.faults if self.schedule else ())
+        ]
+        applied_kinds = [a["kind"] for a in self.applied]
+        if applied_kinds != sched_kinds:
+            errs.append(
+                f"applied fault sequence {applied_kinds} != schedule "
+                f"{sched_kinds}"
+            )
+        if not self.bit_identical:
+            errs.append(
+                f"eval digest mismatch after resizes: got "
+                f"{self.digest[:16] or '<none>'} want "
+                f"{self.expected_digest[:16]} — a token was dropped, "
+                "duplicated, or reordered"
+            )
+        if self.peer_restores < 1:
+            errs.append(
+                "no resize restored from a peer depot (restore sources: "
+                f"{self.restore_sources}) — the re-grown member must pull "
+                "missing shards from survivors, disk is last resort"
+            )
+        for w in self.resize_windows:
+            if w.get("downtime_s") is None:
+                errs.append(f"resize span never closed: {w}")
+            elif w["downtime_s"] > self.downtime_bound_s:
+                errs.append(
+                    f"resize downtime {w['downtime_s']:.1f}s exceeds bound "
+                    f"{self.downtime_bound_s:.0f}s: {w}"
+                )
+        return errs
+
+
+def _percentile(xs: List[float], q: float) -> Optional[float]:
+    vals = sorted(xs)
+    if not vals:
+        return None
+    idx = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
+    return vals[idx]
+
+
+def _elastic_phase_rates(
+    records: List[dict], history: List[dict]
+) -> Dict[str, Optional[float]]:
+    """Positions/s before the first shrink, while shrunk, and after the
+    first re-grow — from the durable consumption records' timestamps
+    against the resize history's wall-clock marks."""
+    ts = sorted(float(r["t"]) for r in records if "t" in r)
+    shrinks = [float(h["time"]) for h in history
+               if h.get("direction") == "shrink" and h.get("time")]
+    if not ts or not shrinks:
+        return {}
+    s1 = shrinks[0]
+    g1 = next((float(h["time"]) for h in history
+               if h.get("direction") == "grow"
+               and float(h.get("time", 0) or 0) > s1), None)
+
+    def rate(a: float, b: Optional[float]) -> Optional[float]:
+        if b is None or b <= a:
+            return None
+        n = sum(1 for t in ts if a <= t < b)
+        return round(n / (b - a), 2)
+
+    return {
+        "before": rate(ts[0], s1),
+        "during_shrink": rate(s1, g1),
+        "after_regrow": rate(g1, ts[-1] + 1e-9) if g1 else None,
+    }
+
+
+def run_elastic_soak(
+    seed: int = 0,
+    schedule: Optional[FaultSchedule] = None,
+    kills: int = 2,
+    workers: int = 3,
+    total_windows: int = 900,
+    step_sleep_s: float = 0.06,
+    checkpoint_every: int = 10,
+    backoff_limit: int = 2,
+    timeout: float = 150.0,
+    workdir: Optional[str] = None,
+    heartbeat_ttl: float = 2.0,
+    downtime_bound_s: float = 60.0,
+) -> ElasticSoakResult:
+    """Seeded kill/return soak over an ELASTIC job (run_policy.elastic):
+    every member loss must be absorbed by a shrink directive and every
+    host return by a symmetric re-grow — zero full gang restarts, the
+    consumed stream bit-identical to an uninterrupted run, and the
+    re-grown member restoring from a surviving peer's shard depot.
+
+    One member per host (each agent holds exactly one chip), so a killed
+    member IS a lost host; agents run host-lifetime shard depots."""
+    from tf_operator_tpu.train.data import elastic_global_order
+    from tf_operator_tpu.workloads.elastic import _digest, _read_records
+
+    schedule = (
+        schedule if schedule is not None
+        else default_elastic_schedule(seed, kills=kills)
+    )
+    tmp = workdir or tempfile.mkdtemp(prefix="tpujob-elastic-soak-")
+    ckpt_dir = os.path.join(tmp, "ckpt")
+    gang_dir = os.path.join(tmp, "gang")
+    os.makedirs(gang_dir, exist_ok=True)
+    job_name = "soak-elastic"
+
+    store = Store()
+    injector = ChaosInjector(
+        schedule, store, job_name=job_name, checkpoint_dir=ckpt_dir,
+    )
+    agents = [
+        HostAgent(
+            injector.wrap(),
+            f"soak-h{i}",
+            total_chips=1,  # one member per host: a kill IS a host loss
+            heartbeat_interval=0.25,
+            backend=LocalProcessControl(
+                injector.wrap(), log_dir=os.path.join(tmp, "logs")
+            ),
+            depot=True,  # survivors' depots are the re-grow restore source
+        )
+        for i in range(workers)
+    ]
+    injector.agents = {a.name: a for a in agents}
+    fake = FakeProcessControl()
+    ctl = TPUJobController(store, fake, resync_period=0.5)
+    ctl.scheduler.heartbeat_ttl = heartbeat_ttl
+    from tf_operator_tpu.dashboard import DashboardServer
+
+    dashboard = DashboardServer(store, host="127.0.0.1", port=0)
+    dashboard.start()
+    ctl.api_url = dashboard.url
+
+    env = dict(DATAPLANE_ENV)
+    env["PYTHONPATH"] = _ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")
+    job = TPUJob(
+        metadata=ObjectMeta(name=job_name),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=workers,
+                    template=ProcessTemplate(
+                        entrypoint="tf_operator_tpu.workloads.elastic:main",
+                        env=env,
+                        chips_per_process=1,
+                    ),
+                )
+            },
+            topology=TopologySpec(num_hosts=workers, chips_per_host=1),
+        ),
+    )
+    job.spec.run_policy.backoff_limit = backoff_limit
+    job.spec.run_policy.heartbeat_ttl_seconds = heartbeat_ttl
+    job.spec.run_policy.elastic = True
+    job.spec.workload = {
+        "workdir": gang_dir,
+        "total_windows": total_windows,
+        "step_sleep_s": step_sleep_s,
+        "data_seed": seed,
+        "checkpoint_dir": ckpt_dir,
+        "checkpoint_every": checkpoint_every,
+        "checkpoint_backend": "npy",
+        "elastic": True,
+    }
+
+    gang_names = [f"{job_name}-worker-{i}" for i in range(workers)]
+
+    def sanctioned_subset() -> Optional[set]:
+        """The member set the live shrink directive blesses, if any."""
+        try:
+            st = store.get("TPUJob", "default", job_name).status
+        except Exception:
+            return None
+        d = st.resize_directive or {}
+        if d.get("direction") == "shrink" and d.get("members"):
+            return set(d["members"])
+        return None
+
+    watcher = _InvariantWatcher(
+        store, job_name, gang_names, allowed_subset_fn=sanctioned_subset
+    )
+    result = ElasticSoakResult(
+        schedule=schedule, downtime_bound_s=downtime_bound_s
+    )
+    for a in agents:
+        a.start()
+    ctl.run(workers=2)
+    watcher.start()
+    try:
+        store.create(job)
+        injector.arm()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = store.get("TPUJob", "default", job_name).status
+            if is_finished(st) and injector.done:
+                break
+            time.sleep(0.25)
+        st = store.get("TPUJob", "default", job_name).status
+        result.succeeded = has_condition(st, ConditionType.SUCCEEDED)
+        result.restart_count = st.restart_count
+        result.preemption_count = st.preemption_count
+        result.resize_count = st.resize_count
+        result.resize_epoch = st.resize_epoch
+        result.world_size = st.world_size
+        result.last_restart_cause = st.last_restart_cause
+        result.resize_history = list(st.resize_history or [])
+        result.conditions = [
+            (c.type.value, c.reason, c.message) for c in st.conditions
+        ]
+        trace = job_trace(store, "default", job_name)
+        result.resize_windows = [
+            {
+                "direction": s.attrs.get("direction", ""),
+                "epoch": s.attrs.get("epoch", ""),
+                "downtime_s": (
+                    round(s.end_time - s.start_time, 3) if s.end_time else None
+                ),
+            }
+            for s in trace if s.op == "resize"
+        ]
+        result.restore_sources = [
+            s.attrs.get("source", "disk")
+            for s in sorted(
+                (s for s in trace if s.op == "restore" and s.end_time),
+                key=lambda s: s.start_time,
+            )
+        ]
+        records = _read_records(gang_dir)
+        result.tokens_per_s = _elastic_phase_rates(
+            records, result.resize_history
+        )
+        digest_path = os.path.join(gang_dir, "eval_digest.txt")
+        if os.path.exists(digest_path):
+            with open(digest_path) as f:
+                result.digest = f.read().strip()
+        order = elastic_global_order(total_windows, seed=seed)
+        result.expected_digest = _digest(
+            [{"p": p, "w": int(order[p])} for p in range(total_windows)],
+            total_windows,
+        )
+    finally:
+        injector.stop()
+        watcher.stop()
+        ctl.stop()
+        for a in agents:
+            a.stop()
+        dashboard.stop()
+        fake.clear()
+    result.applied = list(injector.applied)
+    result.partial_gang_violations = list(watcher.violations)
+    leaked = [p.metadata.name for p in fake.created]
+    if leaked:
+        result.partial_gang_violations.append(
+            "controller launched through its own backend in managed mode: "
+            f"{leaked}"
+        )
+    return result
+
+
+def elastic_artifact(result: ElasticSoakResult, seed: int) -> Dict[str, Any]:
+    """The elasticbench receipt (one JSON object; CI writes it to
+    ``artifacts/elasticbench_r12.json`` and ``genjob --bench-elastic``
+    prints it on one line)."""
+    downtimes = [
+        w["downtime_s"] for w in result.resize_windows
+        if w.get("downtime_s") is not None
+    ]
+    return {
+        "bench": "elastic-soak",
+        "seed": seed,
+        "resize_count": result.resize_count,
+        "resize_epoch": result.resize_epoch,
+        "resizes": result.resize_windows,
+        "resize_downtime_p50_s": _percentile(downtimes, 0.5),
+        "resize_downtime_p99_s": _percentile(downtimes, 0.99),
+        "tokens_per_s": result.tokens_per_s,
+        "zero_full_restarts": (
+            result.restart_count == 0 and result.preemption_count == 0
+        ),
+        "restart_count": result.restart_count,
+        "preemption_count": result.preemption_count,
+        "digest": result.digest,
+        "expected_digest": result.expected_digest,
+        "bit_identical": result.bit_identical,
+        "peer_restores": result.peer_restores,
+        "restore_sources": result.restore_sources,
+        "applied": result.applied,
+        "pass": not result.check(),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="tpujob-soak", description="seeded chaos soak runner"
@@ -764,6 +1146,19 @@ def main(argv=None) -> int:
                         "p2p) and assert the p2p effective-downtime p50 "
                         "cuts the disk baseline by >2x; writes "
                         "restore-compare.json under --workdir")
+    p.add_argument("--elastic", action="store_true",
+                   help="elastic soak: seeded kill/return schedule over an "
+                        "elastic job — member loss must shrink (never full "
+                        "restart), host return must re-grow, the consumed "
+                        "stream must be bit-identical to an uninterrupted "
+                        "run, and >=1 resize must restore from a peer depot")
+    p.add_argument("--kills", type=int, default=2,
+                   help="elastic soak: number of kill/return faults")
+    p.add_argument("--total-windows", type=int, default=900,
+                   help="elastic soak: corpus positions to consume")
+    p.add_argument("--artifact", default=None,
+                   help="elastic soak: also write the bench receipt JSON "
+                        "to this path")
     args = p.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
@@ -810,6 +1205,29 @@ def main(argv=None) -> int:
         for e in errors:
             print(f"INVARIANT VIOLATED{tag}: {e}", file=sys.stderr)
         return errors
+
+    if args.elastic:
+        import json as _json
+
+        eresult = run_elastic_soak(
+            seed=args.seed, kills=args.kills, workers=args.workers,
+            total_windows=args.total_windows, timeout=args.timeout,
+            workdir=args.workdir, backoff_limit=args.backoff_limit,
+            downtime_bound_s=args.downtime_bound,
+        )
+        artifact = elastic_artifact(eresult, args.seed)
+        print(_json.dumps(artifact))
+        if args.artifact:
+            os.makedirs(
+                os.path.dirname(os.path.abspath(args.artifact)), exist_ok=True
+            )
+            with open(args.artifact, "w") as f:
+                _json.dump(artifact, f, indent=2)
+            print(f"elastic soak receipt -> {args.artifact}")
+        errors = eresult.check()
+        for e in errors:
+            print(f"ELASTIC INVARIANT VIOLATED: {e}", file=sys.stderr)
+        return 1 if errors else 0
 
     if not args.compare_restore:
         result = one(args.p2p, args.workdir)

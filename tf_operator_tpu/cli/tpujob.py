@@ -144,15 +144,19 @@ def main(argv=None) -> int:
             jobs = client.list(args.namespace)
             print(
                 f"{'NAMESPACE':<12} {'NAME':<24} {'PHASE':<10} "
-                f"{'QUEUE':<12} {'PRIORITY':<10} {'RESTARTS':<8} {'PREEMPTED':<9}"
+                f"{'QUEUE':<12} {'PRIORITY':<10} {'RESTARTS':<8} "
+                f"{'PREEMPTED':<9} {'WORLD':<6} {'RESIZES':<7}"
             )
             for j in jobs:
+                # world_size 0 = never resized: the spec-derived size applies
+                world = j.status.world_size or "-"
                 print(
                     f"{j.metadata.namespace:<12} {j.metadata.name:<24} "
                     f"{j.status.phase().value or '-':<10} "
                     f"{j.spec.scheduling.queue or '-':<12} "
                     f"{j.spec.scheduling.priority_class or '-':<10} "
-                    f"{j.status.restart_count:<8} {j.status.preemption_count:<9}"
+                    f"{j.status.restart_count:<8} {j.status.preemption_count:<9} "
+                    f"{world:<6} {j.status.resize_count:<7}"
                 )
         elif args.cmd == "get":
             print(json.dumps(client.get(args.namespace, args.name), indent=2))

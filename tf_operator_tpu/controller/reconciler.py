@@ -89,6 +89,7 @@ from tf_operator_tpu.rendezvous.env import (
     ENV_MESH_AXES,
     ENV_NUM_PROCESSES,
     ENV_PROCESS_ID,
+    ENV_RESIZE_EPOCH,
     ENV_RESTORE_PEERS,
     ENV_RESUME_STEP,
     ENV_TRACE_ID,
@@ -137,6 +138,31 @@ CAUSE_NODE_LOST = "node-lost"
 # just OOMs again) — but when they do restart, the cause must say so:
 # an OOM loop and a preemption storm need different operator responses.
 CAUSE_OOM = "oom"
+# Elastic resizes (r12, run_policy.elastic): NOT restarts. A resize kills
+# no survivor, bumps neither restart_count nor preemption_count, and is
+# never charged to backoff_limit — the values exist so last_restart_cause
+# answers "what happened to this gang last" uniformly.
+CAUSE_RESIZE_SHRINK = "resize_shrink"
+CAUSE_RESIZE_GROW = "resize_grow"
+# Mesh axes an elastic resize may re-carve. dp/fsdp shard DATA and
+# replicated/re-shardable optimizer+param state; tp/pp/ep shard the model
+# PROGRAM itself — losing a member there removes layers/experts/operand
+# slices no survivor holds, so those meshes always take the full-restart
+# path regardless of run_policy.elastic (docs/design.md §4.11).
+ELASTIC_AXES = ("dp", "fsdp")
+
+
+def _elastic_mesh_ok(job: TPUJob) -> bool:
+    """True when the job's mesh is elastically re-carvable: every ICI axis
+    with extent > 1 is dp/fsdp, and every DCN axis with extent > 1 is dp
+    (a cross-slice fsdp axis would strip param shards with a lost slice)."""
+    for ax, size in (job.spec.topology.mesh_axes or {}).items():
+        if ax not in ELASTIC_AXES and int(size or 1) > 1:
+            return False
+    for ax, size in (job.spec.topology.dcn_mesh_axes or {}).items():
+        if ax != "dp" and int(size or 1) > 1:
+            return False
+    return True
 
 
 def _default_host_resolver(process: Process) -> str:
@@ -221,6 +247,7 @@ class TPUJobController:
         self._open_restart: Dict[str, Dict[str, Any]] = {}  # uid -> span info
         self._open_schedwait: Dict[str, Dict[str, Any]] = {}
         self._open_queued: Dict[str, Dict[str, Any]] = {}  # uid -> span info
+        self._open_resize: Dict[str, Dict[str, Any]] = {}  # uid -> span info
         # Workqueue shards (run(shards=N) expands): keys hash by NAMESPACE,
         # so one tenant's burst cannot head-of-line-block another tenant's
         # keys behind a single queue mutex, while all of one job's events
@@ -449,6 +476,13 @@ class TPUJobController:
                     "ns": s.metadata.namespace, "name": s.metadata.name,
                     "start": s.start_time,
                     "cause": s.attrs.get("cause", CAUSE_FAILURE),
+                }
+            elif s.op == "resize" and uid not in self._open_resize:
+                self._open_resize[uid] = {
+                    "ns": s.metadata.namespace, "name": s.metadata.name,
+                    "start": s.start_time,
+                    "direction": s.attrs.get("direction", "shrink"),
+                    "epoch": int(s.attrs.get("epoch", "0") or 0),
                 }
             elif s.op == "scheduling-wait" and uid not in self._open_schedwait:
                 self._open_schedwait[uid] = {
@@ -779,6 +813,11 @@ class TPUJobController:
                 else 0
             )
         ]
+        # Elastic (r12): the live membership. Equal to ``gang`` except
+        # while a shrink directive is in force, when the lost members are
+        # deliberately absent — they must be neither recreated (the
+        # symmetric re-grow handles that) nor counted as missing/failed.
+        active = self._active_members(job, gang)
 
         if not has_condition(job.status, ConditionType.CREATED):
             set_condition(
@@ -887,7 +926,7 @@ class TPUJobController:
         }
         gang_failed = [
             observed[(r[0].value, r[1])]
-            for r in gang
+            for r in active
             if _failed(observed.get((r[0].value, r[1])))
         ]
         permanent_msgs: List[str] = []
@@ -916,6 +955,14 @@ class TPUJobController:
 
         if retry_needed:
             cause = _restart_cause(gang_failed)
+            # Elastic shrink (r12): offer the survivors a smaller world
+            # instead of tearing every one of them down. Falls through to
+            # the full gang restart whenever the resize would be unsound
+            # (non-dp/fsdp mesh, chief among the dead, OOM, no survivor).
+            if self._try_resize_shrink(
+                job, active, observed, gang_failed, exp_key, cause
+            ):
+                return
             if cause is not CAUSE_PREEMPTION:
                 # Freshen restart_count from the store BEFORE the limit
                 # check: the informer cache may not have absorbed a previous
@@ -980,17 +1027,29 @@ class TPUJobController:
         # succeeded member stays finished (job completion handles it).
 
         # -- create missing gang members ---------------------------------
-        missing = [r for r in gang + evaluators if (r[0].value, r[1]) not in observed]
+        # Missing = expected-but-absent ACTIVE members (+ evaluators): the
+        # members a shrink declared inactive are not missing — the
+        # symmetric re-grow below recreates them when capacity returns.
+        missing = [r for r in active + evaluators if (r[0].value, r[1]) not in observed]
         if missing:
             self._create_processes(job, missing, exp_key, observed)
+        elif active != gang:
+            if self._try_regrow(job, gang, active, observed, exp_key):
+                return
 
         # -- running condition -------------------------------------------
-        gang_running = gang and all(
+        gang_running = active and all(
             (r[0].value, r[1]) in observed
             and observed[(r[0].value, r[1])].status.phase is ProcessPhase.RUNNING
-            for r in gang
+            for r in active
         )
         if gang_running:
+            now_running = time.time()
+            # Close the open resize span (if any): shrink closes when the
+            # survivors are confirmed running; grow when the recreated
+            # members report RUNNING. Its width is the control-plane
+            # resize downtime, by direction.
+            self._close_resize_span(job, now_running)
             if job.status.start_time is None:
                 job.status.start_time = time.time()
             if not has_condition(job.status, ConditionType.RUNNING):
@@ -1088,6 +1147,241 @@ class TPUJobController:
             "tpujob_restart_downtime_seconds",
             max(0.0, now - info["start"]),
             labels={"cause": info["cause"]},
+        )
+
+    # ---- elastic gangs (r12) --------------------------------------------
+
+    def _active_members(
+        self, job: TPUJob, gang: List[Tuple[ReplicaType, int]]
+    ) -> List[Tuple[ReplicaType, int]]:
+        """The gang roles the job's LIVE resize directive declares active:
+        the shrink directive's member list while one is in force, the
+        full gang otherwise (never resized, or re-grown)."""
+        directive = job.status.resize_directive or {}
+        if directive.get("direction") != "shrink":
+            return gang
+        names = set(directive.get("members") or [])
+        chosen = [
+            r for r in gang if self._process_name(job, r[0], r[1]) in names
+        ]
+        return chosen or gang
+
+    def _try_resize_shrink(
+        self,
+        job: TPUJob,
+        active: List[Tuple[ReplicaType, int]],
+        observed: Dict[Tuple[str, int], Process],
+        gang_failed: List[Process],
+        exp_key: str,
+        cause: str,
+    ) -> bool:
+        """Elastic shrink decision: on member loss, keep the survivors
+        running and stamp a new resize epoch into the job status instead
+        of restarting the whole gang. Returns True when the shrink was
+        taken (the caller's full-restart path is skipped).
+
+        Refused — falling back to the full restart — when:
+        - ``run_policy.elastic`` is off, or the mesh has a >1 axis outside
+          dp/fsdp (the model program itself is sharded there);
+        - the loss is a preemption drain (the WHOLE gang must move off the
+          draining host — a shrink would leave survivors on it);
+        - the loss is an OOM (fewer hosts hold MORE state per host: a
+          shrink converts one OOM into a cascade);
+        - the chief/coordinator died (every member's rendezvous points at
+          it — only a full restart can re-anchor);
+        - no survivor would remain, or nothing actually shrank.
+        """
+        if not job.spec.run_policy.elastic or not _elastic_mesh_ok(job):
+            return False
+        if cause is CAUSE_PREEMPTION or cause is CAUSE_OOM:
+            return False
+        failed_keys = {
+            (p.spec.replica_type, p.spec.replica_index) for p in gang_failed
+        }
+        chief = self._chief_role(job)
+        if (chief[0].value, chief[1]) in failed_keys:
+            return False
+        survivors = [
+            r for r in active if (r[0].value, r[1]) not in failed_keys
+        ]
+        if not survivors or len(survivors) == len(active):
+            return False
+
+        now = time.time()
+        epoch = job.status.resize_epoch + 1
+        members = [self._process_name(job, r[0], r[1]) for r in survivors]
+        job.status.resize_epoch = epoch
+        job.status.resize_count += 1
+        job.status.world_size = len(survivors)
+        job.status.last_restart_cause = CAUSE_RESIZE_SHRINK
+        job.status.resize_directive = {
+            "epoch": epoch,
+            "direction": "shrink",
+            "world_size": len(survivors),
+            "members": members,
+            "time": now,
+        }
+        job.status.resize_history.append({
+            "epoch": epoch, "direction": "shrink",
+            "world_size": len(survivors), "cause": cause, "time": now,
+        })
+        self.metrics.inc("tpujob_gang_resizes_total")
+        self.metrics.inc(
+            "tpujob_gang_resizes_by_direction_total",
+            labels={"direction": "shrink"},
+        )
+        self._open_resize_span(job, "shrink", epoch, now)
+        self.recorder.warning(
+            job, ev.REASON_JOB_RESTARTING,
+            f"elastic shrink #{job.status.resize_count} (epoch {epoch}, "
+            f"{cause}): {len(active)} -> {len(survivors)} members; "
+            "survivors re-shard at the next step boundary (not counted "
+            "against backoff)",
+        )
+        # Hold the lost members' per-host capacity for the symmetric
+        # re-grow: the job's quota is already held (no release happens on
+        # a resize); without the host-level hold a backfiller could squat
+        # on the freed chips and make the re-grow unplaceable forever.
+        lost_hosts: Dict[str, int] = {}
+        targets = [
+            observed[(r[0].value, r[1])]
+            for r in active
+            if (r[0].value, r[1]) in failed_keys
+            and (r[0].value, r[1]) in observed
+        ]
+        for p in targets:
+            if p.spec.node_name:
+                lost_hosts[p.spec.node_name] = (
+                    lost_hosts.get(p.spec.node_name, 0) + max(p.spec.chips, 0)
+                )
+        with self._sched_lock:
+            self.fleet.hold_for_regrow(job.key(), lost_hosts)
+        # Delete only the DEAD members' records. Survivors are untouched —
+        # that is the whole point.
+        if targets:
+            self.expectations.expect_deletions(exp_key, len(targets))
+            deleted = 0
+            try:
+                for p in targets:
+                    self._delete_child(p)
+                    deleted += 1
+            except Exception:
+                for _ in range(len(targets) - deleted):
+                    self.expectations.deletion_failed(exp_key)
+                raise
+        self._write_status(job)
+        return True
+
+    def _try_regrow(
+        self,
+        job: TPUJob,
+        gang: List[Tuple[ReplicaType, int]],
+        active: List[Tuple[ReplicaType, int]],
+        observed: Dict[Tuple[str, int], Process],
+        exp_key: str,
+    ) -> bool:
+        """Symmetric re-grow: a shrunk job whose survivors are all RUNNING
+        tries to recreate its lost members every sync. Success publishes a
+        ``grow`` directive at the next epoch — survivors re-carve to the
+        full world at their next step boundary, and the created members
+        (stamped ENV_RESIZE_EPOCH = the new epoch) wait for the directive
+        to reach their epoch before joining. Placement failure leaves the
+        job running shrunk — never parked in QUEUED, never failed.
+
+        Returns True when a grow was published — the caller must END the
+        sync: its ``active`` still reflects the shrink directive, and
+        falling through would close the just-opened grow span against the
+        survivors alone."""
+        lost = [r for r in gang if r not in active]
+        if not lost:
+            return False
+        for r in active:
+            p = observed.get((r[0].value, r[1]))
+            if p is None or p.status.phase is not ProcessPhase.RUNNING:
+                return False  # survivors still settling; re-grow would stack
+        epoch = job.status.resize_epoch + 1
+        if not self._create_processes(
+            job, lost, exp_key, observed, resize_epoch=epoch
+        ):
+            return False
+        now = time.time()
+        job.status.resize_epoch = epoch
+        job.status.resize_count += 1
+        job.status.world_size = len(gang)
+        job.status.last_restart_cause = CAUSE_RESIZE_GROW
+        job.status.resize_directive = {
+            "epoch": epoch,
+            "direction": "grow",
+            "world_size": len(gang),
+            "members": [self._process_name(job, r[0], r[1]) for r in gang],
+            "time": now,
+        }
+        job.status.resize_history.append({
+            "epoch": epoch, "direction": "grow",
+            "world_size": len(gang), "cause": "member-returned", "time": now,
+        })
+        self.metrics.inc("tpujob_gang_resizes_total")
+        self.metrics.inc(
+            "tpujob_gang_resizes_by_direction_total",
+            labels={"direction": "grow"},
+        )
+        self._open_resize_span(job, "grow", epoch, now)
+        self.recorder.normal(
+            job, ev.REASON_JOB_RUNNING,
+            f"elastic re-grow #{job.status.resize_count} (epoch {epoch}): "
+            f"{len(active)} -> {len(gang)} members; recreated "
+            f"{len(lost)} member(s)",
+        )
+        with self._sched_lock:
+            self.fleet.clear_regrow_hold(job.key())
+        self._write_status(job)
+        return True
+
+    def _open_resize_span(
+        self, job: TPUJob, direction: str, epoch: int, now: float
+    ) -> None:
+        """Open the resize span (closed when the resized gang is RUNNING;
+        width = control-plane resize downtime, by direction). A resize
+        landing while another's span is still open closes the old window
+        first — consecutive resizes are separate downtime windows."""
+        uid = job.metadata.uid
+        if uid in self._open_resize:
+            self._close_resize_span(job, now, force=True)
+        span_name = self._span_name(job, f"resize-{job.status.resize_count}")
+        if self.tracer.record(
+            job.metadata.namespace, job.metadata.name, uid,
+            "resize", now, 0.0,
+            attrs={"direction": direction, "epoch": str(epoch),
+                   "track": "resize"},
+            name=span_name,
+        ) is not None:
+            self._open_resize[uid] = {
+                "ns": job.metadata.namespace, "name": span_name,
+                "start": now, "direction": direction, "epoch": epoch,
+            }
+
+    def _close_resize_span(
+        self, job: TPUJob, now: float, force: bool = False
+    ) -> None:
+        """Close the open resize span and observe its width into
+        ``tpujob_resize_downtime_seconds{direction}``.
+
+        A sync running from a STALE informer snapshot (status epoch behind
+        the span's) computes ``active`` against the superseded directive —
+        its all-RUNNING verdict says nothing about the resized gang, so
+        the close is refused until the caller's job reflects the span's
+        epoch. ``force`` (the terminal path) closes unconditionally."""
+        info = self._open_resize.get(job.metadata.uid)
+        if info is None:
+            return
+        if not force and job.status.resize_epoch < info.get("epoch", 0):
+            return
+        self._open_resize.pop(job.metadata.uid, None)
+        self.tracer.close(info["ns"], info["name"], now)
+        self.metrics.observe_hist(
+            "tpujob_resize_downtime_seconds",
+            max(0.0, now - info["start"]),
+            labels={"direction": info["direction"]},
         )
 
     def _observe_first_step(self, job: TPUJob) -> None:
@@ -1230,7 +1524,16 @@ class TPUJobController:
         roles: List[Tuple[ReplicaType, int]],
         exp_key: str,
         observed: Optional[Dict[Tuple[str, int], Process]] = None,
-    ) -> None:
+        resize_epoch: int = 0,
+    ) -> bool:
+        """Create the given members. Returns True when the batch proceeded
+        to creation, False when admission/placement blocked it.
+
+        ``resize_epoch`` (r12) marks this batch as an elastic re-grow at
+        that epoch: the created members get ENV_RESIZE_EPOCH stamped to it,
+        and a placement failure returns False WITHOUT parking the job in
+        QUEUED — a running shrunk gang must never be demoted because its
+        re-grow attempt found no capacity yet."""
         gang = self._gang_roles(job)
         num_processes = len(gang)
         port = self._rendezvous_port(job)
@@ -1288,6 +1591,14 @@ class TPUJobController:
             # gang restarts — agent/backend and workload spans join the
             # same timeline the controller writes into (obs/).
             env[ENV_TRACE_ID] = job.metadata.uid
+            if resize_epoch or job.status.resize_epoch:
+                # Elastic contract (rendezvous/env.py): the epoch at
+                # creation. The env of SURVIVING members is frozen — the
+                # live truth stays the status directive; this tells a
+                # created member it joins mid-resize.
+                env[ENV_RESIZE_EPOCH] = str(
+                    resize_epoch or job.status.resize_epoch
+                )
             if ckpt_dir:
                 # Warm-restart contract (rendezvous/env.py): a recreated
                 # gang is told the directory and the step it will resume
@@ -1378,15 +1689,24 @@ class TPUJobController:
                     self.recorder.warning(
                         job, ev.REASON_FAILED_SCHEDULING, str(exc)
                     )
-                    # No atomic placement: park in the admission queue
-                    # (QUEUED condition) instead of raising into the
-                    # workqueue rate limiter — the old hot loop of
-                    # SchedulingError retries. The fleet scheduler may
-                    # answer with victims to drain (preempt-by-priority)
-                    # or a host reservation that keeps backfillers from
-                    # starving this gang; either way a release or the
-                    # periodic resync retries the placement.
-                    blocked = self.fleet.on_unplaceable(job)
+                    if resize_epoch:
+                        # Elastic re-grow probe found no capacity: the
+                        # job keeps running shrunk; the resync loop
+                        # retries. on_unplaceable would park it in the
+                        # admission queue — wrong for a RUNNING gang.
+                        blocked = fleetsched.Decision(
+                            fleetsched.WAIT, reason=str(exc)
+                        )
+                    else:
+                        # No atomic placement: park in the admission queue
+                        # (QUEUED condition) instead of raising into the
+                        # workqueue rate limiter — the old hot loop of
+                        # SchedulingError retries. The fleet scheduler may
+                        # answer with victims to drain (preempt-by-priority)
+                        # or a host reservation that keeps backfillers from
+                        # starving this gang; either way a release or the
+                        # periodic resync retries the placement.
+                        blocked = self.fleet.on_unplaceable(job)
                     sched_reason = str(exc)
                 else:
                     for p in procs:
@@ -1417,15 +1737,21 @@ class TPUJobController:
                     exp_key, resume_step,
                 )
         if blocked is not None:
+            if resize_epoch:
+                # Elastic re-grow attempt blocked: never fail, preempt for,
+                # or queue a gang that is running shrunk.
+                return False
             # Handled OUTSIDE the lock: _finish and _queue_job re-enter
             # paths (_release_job) that take the same non-reentrant lock.
             if blocked.action == fleetsched.FAIL:
                 self._fail_job(job, "TPUJobQuotaUnsatisfiable", blocked.reason)
                 self._finish(job)
-                return
+                return False
             if blocked.victims:
                 self._request_preemptions(job, blocked.victims)
             self._queue_job(job, sched_reason or blocked.reason)
+            return False
+        return True
 
     def _bind_and_create(
         self,
@@ -1855,6 +2181,7 @@ class TPUJobController:
             # A restart still open at terminal (the gang never came back)
             # closes at completion time — bounded, not dangling.
             self._close_restart_span(job, end)
+            self._close_resize_span(job, end, force=True)
             wait = self._open_schedwait.pop(uid, None)
             if wait is not None:
                 self.tracer.close(wait["ns"], wait["name"], end)
@@ -1923,11 +2250,40 @@ class TPUJobController:
                 cause = fresh.status.last_restart_cause
             else:
                 cause = job.status.last_restart_cause or fresh.status.last_restart_cause
+            # Elastic resize state (r12) merges like the restart counters:
+            # epoch/count are monotonic; the directive, history, and world
+            # size travel with the side that saw the NEWER epoch. At equal
+            # epochs the store-side directive fields win the merge — the
+            # chief publishes barrier fields into the stored directive
+            # mid-epoch (publish_resize_barrier), and a reconciler sync
+            # holding a stale snapshot must not blank them.
+            rz_epoch = max(fresh.status.resize_epoch, job.status.resize_epoch)
+            rz_count = max(fresh.status.resize_count, job.status.resize_count)
+            if fresh.status.resize_epoch > job.status.resize_epoch:
+                directive = fresh.status.resize_directive
+                history = fresh.status.resize_history
+                world = fresh.status.world_size
+            else:
+                directive = dict(job.status.resize_directive or {})
+                if fresh.status.resize_epoch == job.status.resize_epoch:
+                    directive.update(fresh.status.resize_directive or {})
+                history = (
+                    fresh.status.resize_history
+                    if len(fresh.status.resize_history)
+                    > len(job.status.resize_history)
+                    else job.status.resize_history
+                )
+                world = job.status.world_size or fresh.status.world_size
             eval_metrics = fresh.status.eval_metrics
             fresh.status = job.status
             fresh.status.restart_count = count
             fresh.status.preemption_count = pcount
             fresh.status.last_restart_cause = cause
+            fresh.status.resize_epoch = rz_epoch
+            fresh.status.resize_count = rz_count
+            fresh.status.resize_directive = directive
+            fresh.status.resize_history = history
+            fresh.status.world_size = world
             fresh.status.eval_metrics = eval_metrics
             # The rendezvous-port annotation is managed store-side
             # (_rendezvous_port persists it, _clear_rendezvous removes it);
@@ -1989,9 +2345,17 @@ def _annotations_except_port(annotations: Dict[str, str]) -> Dict[str, str]:
 def _status_equal_ignoring_heartbeat(a, b) -> bool:
     """eval_metrics is excluded alongside the heartbeat: the reconciler
     never authors it (evaluator processes write it through the API), so a
-    difference there must neither trigger a write nor be overwritten."""
+    difference there must neither trigger a write nor be overwritten.
+    resize_directive is excluded for the same reason with a twist: the
+    reconciler authors it ONLY together with a resize_epoch bump (which
+    already breaks equality), while the chief publishes barrier fields
+    into it mid-epoch through the API — a chief-side difference must not
+    make every subsequent sync rewrite the status (write → MODIFIED →
+    enqueue → write: a hot loop)."""
     import dataclasses
 
     return dataclasses.replace(
-        a, last_reconcile_time=None, eval_metrics={}
-    ) == dataclasses.replace(b, last_reconcile_time=None, eval_metrics={})
+        a, last_reconcile_time=None, eval_metrics={}, resize_directive={}
+    ) == dataclasses.replace(
+        b, last_reconcile_time=None, eval_metrics={}, resize_directive={}
+    )

@@ -154,6 +154,10 @@ async function viewJob(ns, name){
     ['Gang restarts', String(j.status.restart_count||0)
        + (j.status.preemption_count ? ' (+'+j.status.preemption_count+' preempted)' : '')
        + (j.status.last_restart_cause ? ' — last: '+j.status.last_restart_cause : '')],
+    // world_size 0 = never resized (spec-derived gang size applies)
+    ['World', (j.status.world_size ? String(j.status.world_size) : 'spec')
+       + (j.status.resize_epoch ? ' @ resize epoch '+j.status.resize_epoch : '')
+       + (j.status.resize_count ? ' ('+j.status.resize_count+' resizes)' : '')],
     ['Slice', j.spec.topology.slice_type ||
        (j.spec.topology.num_hosts+'x'+j.spec.topology.chips_per_host+' chips')],
     ['Mesh', JSON.stringify(j.spec.topology.mesh_axes||{})],
@@ -182,6 +186,18 @@ async function viewJob(ns, name){
   root.appendChild(el('div',{class:'card'}, el('h2',null,'Replica status'),
     el('table',null, el('thead',null, el('tr',null,
       ...['Type','Active','Succeeded','Failed'].map(h=>el('th',null,h)))), rtb)));
+
+  // Elastic resize audit (r12): the append-only shrink/grow history.
+  if ((j.status.resize_history||[]).length){
+    const ztb = el('tbody');
+    for (const r of j.status.resize_history)
+      ztb.appendChild(el('tr',null, el('td',null,String(r.epoch)),
+        el('td',null,r.direction||''), el('td',null,String(r.world_size)),
+        el('td',null,r.cause||''), el('td',{class:'muted'}, fmtTime(r.time))));
+    root.appendChild(el('div',{class:'card'}, el('h2',null,'Resize history'),
+      el('table',null, el('thead',null, el('tr',null,
+        ...['Epoch','Direction','World','Cause','Time'].map(h=>el('th',null,h)))), ztb)));
+  }
 
   // Evaluator-reported scores (TPUJobStatus.eval_metrics).
   const em = j.status.eval_metrics||{};

@@ -31,6 +31,7 @@ from tf_operator_tpu.rendezvous.env import (
     ENV_PROCESS_ID,
     ENV_REPLICA_INDEX,
     ENV_REPLICA_TYPE,
+    ENV_RESIZE_EPOCH,
     ENV_RESTORE_PEERS,
     ENV_RESUME_STEP,
     ENV_TRACE_ID,
@@ -70,6 +71,11 @@ class JobContext:
     # touching disk. Both empty when the deployment runs without depots.
     peer_depot: str = ""
     restore_peers: List[str] = field(default_factory=list)
+    # Elastic-gang contract (r12): the job's resize epoch at this
+    # process's creation. Nonzero means this process joined an elastic
+    # gang mid-resize — the LIVE membership/world size lives in the job
+    # status (poll_resize_directive), never in this frozen env snapshot.
+    resize_epoch: int = 0
     # Trace context (obs/): the job's trace id (its uid), injected by the
     # controller so workload-recorded spans (first-step, checkpoint
     # save/restore) join the controller/scheduler/agent timeline.
@@ -96,6 +102,7 @@ class JobContext:
             checkpoint_dir=e.get(ENV_CHECKPOINT_DIR, ""),
             peer_depot=e.get(ENV_PEER_DEPOT, ""),
             restore_peers=json.loads(e.get(ENV_RESTORE_PEERS, "[]") or "[]"),
+            resize_epoch=int(e.get(ENV_RESIZE_EPOCH, "0") or 0),
             trace_id=e.get(ENV_TRACE_ID, ""),
         )
 
@@ -233,6 +240,88 @@ class JobContext:
                 "source": source, "step": str(step), "track": "checkpoint",
             },
         )
+
+    def record_resize(
+        self, direction: str, epoch: int, start: float, end: float
+    ) -> bool:
+        """Record the trainer-side half of one resize: the span from the
+        member noticing the directive to completing its re-carve/re-shard
+        at the barrier step. The controller's ``resize`` span (opened at
+        the resize decision) measures control-plane downtime; this one
+        measures the data-plane boundary cost."""
+        return self.record_span(
+            "resize-boundary", start, end,
+            attrs={
+                "direction": direction, "epoch": str(epoch),
+                "track": "resize",
+            },
+        )
+
+    # -- elastic resize barrier (r12) --------------------------------------
+    #
+    # The controller offers survivors a new world size by writing a resize
+    # directive into the job status (reconciler._resize_gang). The env of
+    # a running process is frozen, so the directive — polled through the
+    # operator API — is the only live channel. The chief (lowest surviving
+    # rank) publishes barrier fields (boundary offset etc.) back into the
+    # SAME directive via the optimistic status update the evaluator's
+    # report_eval_metrics already uses; non-chief members poll until the
+    # barrier fields appear. All methods are best-effort reads/writes over
+    # ENV_API_SERVER and degrade to None/False without it.
+
+    def poll_resize_directive(self) -> Dict[str, Any] | None:
+        """Fetch the job's live resize directive (None when the gang runs
+        at spec size, the API is unreachable, or no API is configured).
+        Members compare ``directive["epoch"]`` against the last epoch they
+        acted on; a higher epoch means a resize is pending."""
+        base = os.environ.get(ENV_API_SERVER, "")
+        if not base or not self.job_name:
+            return None
+        from tf_operator_tpu.api.types import KIND_TPUJOB
+        from tf_operator_tpu.runtime.remote_store import RemoteStore
+
+        try:
+            job = RemoteStore(base).get(KIND_TPUJOB, self.namespace, self.job_name)
+        except Exception:  # noqa: BLE001 — polling must never kill a step
+            return None
+        if job is None:
+            return None
+        directive = dict(job.status.resize_directive or {})
+        return directive or None
+
+    def publish_resize_barrier(
+        self, epoch: int, fields: Dict[str, Any]
+    ) -> bool:
+        """Chief-only: merge barrier fields (e.g. ``boundary_offset``,
+        ``orphans``, ``completed``) into the directive for ``epoch``. The
+        write is an optimistic read-modify-write; it refuses (returns
+        False) if the directive moved to a NEWER epoch underneath us — a
+        second resize superseded this barrier and the chief must re-poll
+        rather than clobber it."""
+        base = os.environ.get(ENV_API_SERVER, "")
+        if not base or not self.job_name:
+            return False
+        from tf_operator_tpu.api.types import KIND_TPUJOB
+        from tf_operator_tpu.runtime.remote_store import RemoteStore
+        from tf_operator_tpu.runtime.store import update_with_retry_loop
+
+        stale = []
+
+        def mutate(job):
+            cur = job.status.resize_directive or {}
+            if int(cur.get("epoch", 0)) != int(epoch):
+                stale.append(True)
+                return False
+            job.status.resize_directive = {**cur, **fields}
+
+        try:
+            out = update_with_retry_loop(
+                RemoteStore(base), KIND_TPUJOB, self.namespace, self.job_name,
+                mutate, transient_timeout=30.0,
+            )
+        except Exception:  # noqa: BLE001 — barrier publish retries upstream
+            return False
+        return out is not None and not stale
 
     # -- result reporting --------------------------------------------------
 

@@ -84,6 +84,23 @@ ENV_RESUME_STEP = "TPUJOB_RESUME_STEP"
 ENV_PEER_DEPOT = "TPUJOB_PEER_DEPOT"
 ENV_RESTORE_PEERS = "TPUJOB_RESTORE_PEERS"
 
+# Elastic-gang contract (r12), stamped next to the warm-restart env above:
+#
+# - ``TPUJOB_RESIZE_EPOCH`` — the job's monotonic resize epoch at the
+#                             moment this process was created (0 on a
+#                             never-resized gang). A nonzero value is the
+#                             controller's declaration that this process
+#                             joins an elastic gang mid-resize (a re-grown
+#                             member, or a member created into a shrunk
+#                             world) — it must read the live resize
+#                             directive from the job status
+#                             (JobContext.poll_resize_directive) before
+#                             carving data or joining the barrier, because
+#                             the env of SURVIVING members is frozen at
+#                             their creation: the directive in the job
+#                             object, not the env, is the live truth.
+ENV_RESIZE_EPOCH = "TPUJOB_RESIZE_EPOCH"
+
 # Sub-second TTFS contract (r11, cachesvc/ + runtime/warmpool.py):
 #
 # - ``TPUJOB_COMPILE_CACHE`` — the fleet compile-cache service URL

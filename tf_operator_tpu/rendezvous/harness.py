@@ -64,6 +64,14 @@ def main(argv=None) -> int:
         # Warm restart (rendezvous/env.py contract): the controller saw
         # checkpoints at creation; the trainer resumes from latest_step().
         log.info("warm restart: controller-declared resume step %d", ctx.resume_step)
+    if ctx.resize_epoch:
+        # Elastic join (rendezvous/env.py contract): this process was
+        # created into a resized gang — the live membership is in the job
+        # status directive, NOT this env snapshot.
+        log.info(
+            "elastic join: controller-declared resize epoch %d "
+            "(directive in job status is authoritative)", ctx.resize_epoch,
+        )
 
     # Trace (obs/): one trainer-component span per workload run, whatever
     # the workload is — the timeline shows entrypoint-entry -> exit with

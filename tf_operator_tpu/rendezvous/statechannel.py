@@ -107,6 +107,11 @@ class ShardDepot:
         self._lock = threading.Lock()
         # (ns, job) -> {step: {relpath: bytes}} — committed, servable.
         self._committed: Dict[Tuple[str, str], Dict[int, Dict[str, bytes]]] = {}
+        # (ns, job) -> {step: writing world size} parsed from the pushed
+        # manifest at commit time (0 = untagged/legacy push). Served on the
+        # steps listing so an elastic restorer can skip steps written by a
+        # different world WITHOUT downloading them (r12).
+        self._worlds: Dict[Tuple[str, str], Dict[int, int]] = {}
         # (ns, job, step) -> {relpath: bytes} — staged by PUTs, invisible
         # until the commit POST promotes it.
         self._staging: Dict[Tuple[str, str, int], Dict[str, bytes]] = {}
@@ -141,7 +146,13 @@ class ShardDepot:
                 path, q = self._q()
                 ns, jobname = q.get("ns", "default"), q.get("job", "")
                 if path == "/depot/v1/steps":
-                    self._json({"steps": depot.steps(ns, jobname)})
+                    self._json({
+                        "steps": depot.steps(ns, jobname),
+                        "worlds": {
+                            str(s): w
+                            for s, w in depot.step_worlds(ns, jobname).items()
+                        },
+                    })
                 elif path == "/depot/v1/files":
                     files = depot.files(ns, jobname, int(q.get("step", "0")))
                     if files is None:
@@ -270,13 +281,31 @@ class ShardDepot:
                 self._drop_staging_locked(key)
             per_job = self._committed.setdefault((ns, job), {})
             per_job[step] = files
+            # Record the writing world size from the pushed npy manifest
+            # (r12): best-effort — an unparsable or orbax-marker-only push
+            # is simply untagged (0), never a commit failure.
+            world = 0
+            manifest = files.get("manifest.json")
+            if manifest is not None:
+                try:
+                    world = int(json.loads(manifest.decode()).get("world_size", 0) or 0)
+                except (ValueError, UnicodeDecodeError, AttributeError):
+                    world = 0
+            worlds = self._worlds.setdefault((ns, job), {})
+            worlds[step] = world
             for old in sorted(per_job)[: max(0, len(per_job) - self.keep)]:
                 del per_job[old]
+                worlds.pop(old, None)
         return True
 
     def steps(self, ns: str, job: str) -> List[int]:
         with self._lock:
             return sorted(self._committed.get((ns, job), {}))
+
+    def step_worlds(self, ns: str, job: str) -> Dict[int, int]:
+        """{committed step: writing world size} (0 = untagged push)."""
+        with self._lock:
+            return dict(self._worlds.get((ns, job), {}))
 
     def files(self, ns: str, job: str, step: int) -> Optional[Dict[str, str]]:
         with self._lock:
@@ -352,18 +381,42 @@ class DepotClient:
         except (OSError, urllib.error.URLError, ValueError, KeyError):
             return []
 
-    def best_peer(self, peers: List[str], ns: str, job: str) -> Tuple[Optional[str], int]:
+    def step_worlds(self, depot_url: str, ns: str, job: str) -> Dict[int, int]:
+        """{committed step: writing world size} from a peer's listing;
+        {} on any failure or a pre-r12 peer that doesn't serve worlds."""
+        try:
+            body = self._json(depot_url, "/depot/v1/steps", {"ns": ns, "job": job})
+            return {int(s): int(w) for s, w in (body.get("worlds") or {}).items()}
+        except (OSError, urllib.error.URLError, ValueError, KeyError, AttributeError):
+            return {}
+
+    def best_peer(self, peers: List[str], ns: str, job: str,
+                  expect_world_size: Optional[int] = None) -> Tuple[Optional[str], int]:
         """(depot_url, step) of the highest committed step across peers;
-        (None, 0) when no peer holds anything. Dead peers are skipped."""
+        (None, 0) when no peer holds anything. Dead peers are skipped.
+
+        With ``expect_world_size`` set (elastic restore, r12), steps whose
+        advertised writing world size is tagged AND differs are skipped —
+        a shard set sharded for a different world is not a warm-restore
+        source for this one. Untagged steps (0 / pre-r12 peer) pass; the
+        manifest check in fetch_step and the restore-time refusal in
+        CheckpointManager remain the authoritative gates."""
         best_url, best_step = None, 0
         for url in peers:
             steps = self.steps(url, ns, job)
+            if expect_world_size and steps:
+                worlds = self.step_worlds(url, ns, job)
+                steps = [
+                    s for s in steps
+                    if not worlds.get(s) or worlds[s] == int(expect_world_size)
+                ]
             if steps and steps[-1] > best_step:
                 best_url, best_step = url, steps[-1]
         return best_url, best_step
 
     def fetch_step(self, depot_url: str, ns: str, job: str, step: int,
-                   dest_root: str) -> Optional[str]:
+                   dest_root: str,
+                   expect_world_size: Optional[int] = None) -> Optional[str]:
         """Materialize a peer's committed step as a COMMITTED step
         directory under ``dest_root`` (the restorer's checkpoint dir), so
         the ordinary disk-restore path loads it bit-identically.
@@ -405,6 +458,16 @@ class DepotClient:
                     want = resp.headers.get("X-Shard-SHA256", "")
                 if want and _sha256(data) != want:
                     raise ValueError(f"sha256 mismatch on {rel}")
+                if expect_world_size and os.path.basename(rel) == "manifest.json":
+                    # Elastic restore (r12): verify the writing world size
+                    # tag before this fetch can become a resume point. A
+                    # mismatch degrades to the next source, loudly.
+                    saved = int(json.loads(data.decode()).get("world_size", 0) or 0)
+                    if saved and saved != int(expect_world_size):
+                        raise ValueError(
+                            f"step {step} written by world {saved}, "
+                            f"expected {int(expect_world_size)}"
+                        )
                 full = _safe_join(tmp, rel)
                 os.makedirs(os.path.dirname(full), exist_ok=True)
                 with open(full, "wb") as f:
@@ -427,6 +490,7 @@ class DepotClient:
 def choose_restore_source(
     peers: List[str], ns: str, job: str, disk_step: int,
     client: Optional[DepotClient] = None,
+    expect_world_size: Optional[int] = None,
 ) -> Tuple[str, Optional[str], int]:
     """The restore-source decision order (docs/design.md §4.9):
 
@@ -439,7 +503,8 @@ def choose_restore_source(
     strictly BEHIND disk is never chosen — restoring older state than the
     controller-declared resume step would violate monotonic resume."""
     client = client or DepotClient()
-    url, peer_step = client.best_peer(peers, ns, job)
+    url, peer_step = client.best_peer(peers, ns, job,
+                                      expect_world_size=expect_world_size)
     if url is not None and peer_step > 0 and peer_step >= disk_step:
         return "peer", url, peer_step
     return "disk", None, disk_step

@@ -115,6 +115,14 @@ class HostAgent:
         # must not resurrect it as Ready (the scheduler would place a
         # fresh gang onto a host about to vanish).
         self._draining = False
+        # Heartbeats paused (chaos kill+return faults, r12): the agent
+        # stays alive — watch loop, children, depot all keep running — but
+        # the Host object's heartbeat goes stale, so the controller's
+        # node-lost detection fires exactly as if the machine went silent.
+        # stop() is NOT a substitute: it SIGTERMs children (exit 143 =
+        # preemption class) and tears down the depot, neither of which a
+        # "host went dark and came back" fault implies.
+        self._hb_paused = False
 
     # -- lifecycle --------------------------------------------------------
 
@@ -218,8 +226,31 @@ class HostAgent:
             # Object vanished mid-adoption (admin drain racing a restart):
             # loop and retry the create.
 
+    def pause_heartbeats(self) -> None:
+        """Stop touching the Host heartbeat WITHOUT stopping the agent —
+        the controller sees a silent host (node-lost after TTL) while
+        children, watch loop, and shard depot stay up. The chaos
+        kill+return fault's half of "host went dark"; resume_heartbeats()
+        is the return."""
+        self._hb_paused = True
+
+    def resume_heartbeats(self) -> None:
+        """The host 'returns': re-register (node-lost detection may have
+        seen the Host object age out or an admin may have deleted it) and
+        touch the heartbeat immediately rather than waiting an interval."""
+        self._hb_paused = False
+        try:
+            self._touch_heartbeat()
+        except Exception:
+            log.exception(
+                "agent %s: resume heartbeat failed; loop will retry",
+                self.name,
+            )
+
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_interval):
+            if self._hb_paused:
+                continue
             # The heartbeat thread must survive ANY error: if it died while
             # the watch loop kept launching, the host would be declared
             # NodeLost and every healthy process on it failed and fenced.

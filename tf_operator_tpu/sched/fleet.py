@@ -105,6 +105,14 @@ class FleetScheduler:
         # observes the drained gang gone — so victim and preemptor can
         # never hold the same quota headroom at once, even transiently.
         self._draining: set = set()
+        # Elastic re-grow holds (r12): job key -> {host: chips} a SHRUNK
+        # running job still claims for the members it lost. The job stays
+        # admitted (quota held — release() is never called on a resize),
+        # but placement-level capacity on the lost host would otherwise be
+        # backfillable by other jobs, making the symmetric re-grow
+        # impossible. Merged into reserved_for_others() for every OTHER
+        # job; cleared when the gang re-grows or the job ends.
+        self._regrow_holds: Dict[str, Dict[str, int]] = {}
         self._synced = False
 
     # ---- store lookups --------------------------------------------------
@@ -203,10 +211,26 @@ class FleetScheduler:
     def draining(self, key: str) -> bool:
         return key in self._draining
 
+    def hold_for_regrow(self, key: str, host_chips: Dict[str, int]) -> None:
+        """A running elastic job shrank: keep claiming the lost members'
+        per-host chips so the symmetric re-grow can place where the gang
+        lost capacity. Accumulates across consecutive shrinks."""
+        if not host_chips:
+            return
+        hold = self._regrow_holds.setdefault(key, {})
+        for host, chips in host_chips.items():
+            hold[host] = hold.get(host, 0) + max(int(chips), 0)
+
+    def clear_regrow_hold(self, key: str) -> None:
+        """The gang re-grew to full strength (or the job ended): stop
+        claiming capacity for its lost members."""
+        self._regrow_holds.pop(key, None)
+
     def release(self, key: str) -> bool:
         """Forget a job (finished / deleted / preempted). Returns True when
         it held quota — callers then kick the queue head."""
         self._draining.discard(key)
+        self._regrow_holds.pop(key, None)
         self._queued.pop(key, None)
         self._reservations.pop(key, None)
         info = self._admitted.pop(key, None)
@@ -388,17 +412,25 @@ class FleetScheduler:
         """Chips on each host held for queued jobs with precedence over
         ``job`` — the placement subtracts them from free capacity, so a
         backfilling job fits only into holes the reserved gangs don't
-        need (no starvation of the head of line)."""
+        need (no starvation of the head of line). Elastic re-grow holds
+        (r12) merge in unconditionally for every OTHER job, regardless of
+        precedence: the shrunk job's quota is still charged for those
+        chips, so letting anyone backfill them would double-book."""
         self.ensure_synced()
-        if not self._reservations:
-            return {}
         mine = job.key()
+        merged: Dict[str, int] = {}
+        for key, hold in self._regrow_holds.items():
+            if key == mine:
+                continue
+            for host, chips in hold.items():
+                merged[host] = merged.get(host, 0) + chips
+        if not self._reservations:
+            return merged
         prec = (
             self._queued[mine].precedence()
             if mine in self._queued
             else self._info(job).precedence()
         )
-        merged: Dict[str, int] = {}
         for key, res in self._reservations.items():
             w = self._queued.get(key)
             if key == mine or w is None or not (w.precedence() < prec):

@@ -74,6 +74,18 @@ def _orbax_step_complete(step_dir: str) -> bool:
     )
 
 
+def checkpoint_world_size(directory: str, step: int) -> int:
+    """World size recorded in a committed npy step's manifest (its commit
+    marker), 0 when untagged (pre-r12 checkpoints, orbax steps) or absent.
+    Dependency-free like :func:`latest_checkpoint_step` — the controller
+    and the chaos checkers read it without importing jax."""
+    try:
+        with open(os.path.join(directory, f"step_{int(step)}", "manifest.json")) as f:
+            return int(json.load(f).get("world_size", 0) or 0)
+    except (OSError, ValueError, TypeError):
+        return 0
+
+
 def latest_checkpoint_step(directory: str) -> int:
     """Latest COMPLETE checkpointed step under ``directory``, 0 when none.
 
@@ -151,6 +163,8 @@ class CheckpointManager:
         async_save: bool = True,
         chunk_bytes: int = 64 << 20,
         on_commit: Optional[Callable[[int, str], None]] = None,
+        world_size: Optional[int] = None,
+        allow_world_resize: bool = False,
     ) -> None:
         """``readonly=True`` is for consumers of someone else's checkpoint
         directory (evaluators): saves are refused and the npy orphan sweep
@@ -176,13 +190,25 @@ class CheckpointManager:
 
         ``last_save_stall_s`` after each accepted save is the wall time
         the CALLER was blocked — the step-loop stall the async pipeline
-        exists to shrink."""
+        exists to shrink.
+
+        ``world_size`` (r12): the gang world size stamped into each npy
+        manifest at save time (None ⇒ ``jax.process_count()``) and the
+        world this manager expects at restore. Elastic trainers update it
+        across resizes (``mgr.world_size = n``). A restore whose manifest
+        tag disagrees with the declared world REFUSES loudly — a
+        mixed-world resume must never materialize silently — unless
+        ``allow_world_resize=True`` explicitly declares a resize restore
+        (the elastic path, which re-shards onto the new world right
+        after)."""
         self.directory = os.path.abspath(str(directory))
         self.keep = int(keep)
         self.readonly = bool(readonly)
         self.async_save = bool(async_save)
         self.chunk_bytes = max(1 << 20, int(chunk_bytes))
         self.on_commit = on_commit
+        self.world_size = world_size
+        self.allow_world_resize = bool(allow_world_resize)
         self.last_save_stall_s = 0.0
         # npy async pipeline state: at most one drain thread in flight.
         self._drain: Optional[threading.Thread] = None
@@ -338,6 +364,34 @@ class CheckpointManager:
                 f"async checkpoint drain failed (step never committed): {err}"
             ) from err
 
+    # -- world-size tagging (r12) -----------------------------------------
+
+    def _writer_world_size(self) -> int:
+        """World size stamped into manifests: the declared gang world when
+        the caller set one (elastic trainers track the live directive),
+        else the jax runtime's process count."""
+        if self.world_size:
+            return int(self.world_size)
+        import jax
+
+        return jax.process_count()
+
+    def _check_restore_world(self, manifest: Dict[str, Any], step: int) -> None:
+        """Refuse a silent mixed-world resume: a manifest tagged with a
+        writing world size that disagrees with this manager's declared
+        world raises unless the caller explicitly declared a resize
+        restore (``allow_world_resize`` — the elastic path, which
+        re-shards immediately after loading)."""
+        saved = int(manifest.get("world_size", 0) or 0)
+        expect = int(self.world_size or 0)
+        if saved and expect and saved != expect and not self.allow_world_resize:
+            raise ValueError(
+                f"checkpoint at step {step} was written by a world of "
+                f"{saved} but this restore targets a world of {expect}; "
+                "a mixed-world resume must be an explicit resize "
+                "(allow_world_resize=True), never silent"
+            )
+
     # -- chunked async pipeline (npy backend) -----------------------------
 
     def _npy_save_async(self, step: int, tree: Any) -> bool:
@@ -375,7 +429,11 @@ class CheckpointManager:
             shutil.rmtree(tmp, ignore_errors=True)
             os.makedirs(tmp)
             leaves_with_path = jax.tree_util.tree_flatten_with_path(staged)[0]
-            manifest: Dict[str, Any] = {"step": step, "leaves": []}
+            manifest: Dict[str, Any] = {
+                "step": step,
+                "world_size": self._writer_world_size(),
+                "leaves": [],
+            }
             for i, (path, leaf) in enumerate(leaves_with_path):
                 if self._fault_hook is not None:
                     self._fault_hook("leaf", step)
@@ -451,7 +509,11 @@ class CheckpointManager:
         shutil.rmtree(tmp, ignore_errors=True)
         os.makedirs(tmp)
         leaves_with_path = jax.tree_util.tree_flatten_with_path(tree)[0]
-        manifest: Dict[str, Any] = {"step": step, "leaves": []}
+        manifest: Dict[str, Any] = {
+            "step": step,
+            "world_size": self._writer_world_size(),
+            "leaves": [],
+        }
         for i, (path, leaf) in enumerate(leaves_with_path):
             arr = np.asarray(leaf)
             np.save(os.path.join(tmp, f"leaf_{i}.npy"), arr)
@@ -580,6 +642,7 @@ class CheckpointManager:
             raise FileNotFoundError(f"no checkpoint at step {step} under {self.directory}")
         with open(manifest_path) as f:
             manifest = json.load(f)
+        self._check_restore_world(manifest, step)
         records = manifest["leaves"]
         if subtrees is not None:
             # Partial restore: only the saved leaves under these top-level
@@ -667,6 +730,8 @@ class WorkloadCheckpointer:
                 backend=str(workload.get("checkpoint_backend", "auto")),
                 async_save=bool(workload.get("checkpoint_async", True)),
                 on_commit=self._push_to_depot,
+                world_size=getattr(ctx, "num_processes", None),
+                allow_world_resize=bool(workload.get("elastic")),
             )
         self.every = int(workload.get("checkpoint_every", 0))
         self._step = 0

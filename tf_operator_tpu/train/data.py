@@ -38,6 +38,9 @@ __all__ = [
     "augment_images",
     "AugmentedImages",
     "prepare_classification_images",
+    "elastic_global_order",
+    "elastic_rank_positions",
+    "elastic_coverage",
 ]
 
 
@@ -406,6 +409,71 @@ def prepare_classification_images(images: np.ndarray,
     return images
 
 
+# ---------------------------------------------------------------------------
+# Elastic re-carve primitives (r12)
+#
+# The classic multi-host carve is ``windows[rank::nprocs]`` — a WORLD-SIZE-
+# DEPENDENT stride: change nprocs and every rank's stream silently shifts,
+# duplicating some windows and dropping others. Elastic gangs need the
+# opposite invariant: one CANONICAL, world-size-independent global order G
+# over all windows, plus a pure function from (consumed offset, rank, world
+# size) to the windows a rank owns. Then a resize is just "survivors resume
+# carving G from the global consumed offset with the new world size" — and
+# the union of all rank streams across any shrink→grow→shrink sequence is
+# exactly G[0:T], no token duplicated or dropped (tests/test_data_recarve.py
+# pins this).
+#
+# Offset accounting is POSITION-based, not step-based: the global offset C
+# counts how many positions of G the gang has consumed in total. During an
+# epoch with world size n starting at offset C0, rank r owns positions
+# C0+r, C0+r+n, C0+r+2n, ... — one position per rank per "deal row", so a
+# gang that completes k rows advances C by k*n atomically.
+# ---------------------------------------------------------------------------
+
+
+def elastic_global_order(n_windows: int, seed: int = 0,
+                         shuffle: bool = True) -> np.ndarray:
+    """The canonical global window order G: a deterministic permutation of
+    ``arange(n_windows)`` seeded by ``seed`` alone — independent of world
+    size, rank, and epoch, so every member of every incarnation of an
+    elastic gang derives the identical sequence."""
+    order = np.arange(int(n_windows))
+    if shuffle:
+        np.random.default_rng(int(seed)).shuffle(order)
+    return order
+
+
+def elastic_rank_positions(start: int, end: int, rank: int,
+                           world_size: int) -> range:
+    """Positions of G that ``rank`` (of ``world_size``) owns within the
+    half-open offset interval [start, end) — the ``rank::n`` stride
+    re-anchored at the global consumed offset. The union over ranks is
+    exactly range(start, end); disjointness and coverage are structural."""
+    if world_size < 1:
+        raise ValueError(f"world_size must be >= 1, got {world_size}")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} outside world of {world_size}")
+    return range(int(start) + int(rank), int(end), int(world_size))
+
+
+def elastic_coverage(segments) -> list:
+    """Flatten a resize history into every (position, rank) assignment.
+
+    ``segments``: iterable of ``(start, end, world_size)`` — one entry per
+    resize epoch, offsets half-open and contiguous. Returns the list of
+    (position, rank) pairs in position order; the positions are
+    range(first start, last end) each exactly once, whatever the world
+    sizes were. The verification half of the re-carve contract (used by
+    the elastic soak checker and the recarve tests)."""
+    out = []
+    for start, end, n in segments:
+        for r in range(int(n)):
+            for p in elastic_rank_positions(start, end, r, n):
+                out.append((p, r))
+    out.sort(key=lambda pr: pr[0])
+    return out
+
+
 def write_token_corpus(path: str, tokens: np.ndarray, dtype=np.uint16) -> None:
     """Persist a 1-D token stream as a raw little-endian memmap file plus a
     sidecar ``path + '.meta'`` (dtype + count) so readers need no guessing."""
@@ -467,6 +535,11 @@ class TokenMemmapDataset:
                 self._windows[-holdout:] if split == "holdout"
                 else self._windows[:-holdout]
             )
+        # Pre-shard (post-holdout) window set: the domain of the elastic
+        # canonical order (elastic_batches) — must be identical on every
+        # rank at every world size, so it is captured BEFORE the
+        # world-size-dependent rank::n carve below.
+        self._global_windows = self._windows
         if process_shard:
             import jax
 
@@ -501,6 +574,36 @@ class TokenMemmapDataset:
         while True:
             yield from self.epoch(epoch)
             epoch += 1
+
+    # -- elastic re-carve (r12) -------------------------------------------
+
+    def elastic_windows(self, start: int, end: int, rank: int,
+                        world_size: int) -> np.ndarray:
+        """This rank's window ids for offset interval [start, end) of the
+        canonical global order — the re-carve seam: after a resize the
+        caller re-invokes this with the new (rank, world_size) anchored at
+        the global consumed offset, and token accounting stays exact
+        (union over ranks and segments == the uninterrupted stream)."""
+        order = elastic_global_order(
+            self._global_windows.size, seed=self.seed, shuffle=self.shuffle
+        )
+        positions = np.fromiter(
+            elastic_rank_positions(start, end, rank, world_size), dtype=np.int64
+        )
+        return self._global_windows[order[positions]] if positions.size else positions
+
+    def elastic_batches(self, start: int, end: int, rank: int,
+                        world_size: int) -> Iterator[Any]:
+        """Batched view of :meth:`elastic_windows` (drops the ragged tail
+        like :meth:`epoch` — callers that need exact accounting consume
+        window-granular via elastic_windows)."""
+        wins = self.elastic_windows(start, end, rank, world_size)
+        for i in range(wins.size // self.batch_size):
+            idx = wins[i * self.batch_size : (i + 1) * self.batch_size]
+            batch = np.stack(
+                [self._mm[w * self.seq_len : (w + 1) * self.seq_len] for w in idx]
+            )
+            yield {"tokens": batch.astype(np.int32)}
 
 
 def local_loader(

@@ -254,6 +254,22 @@ class Trainer:
             extra=extra,
         )
 
+    def reshard_state(self, state: "TrainState") -> "TrainState":
+        """Re-lay an existing TrainState onto THIS trainer's shardings —
+        the elastic resize seam (r12). After a gang shrink/re-grow the
+        surviving members build a Trainer over the NEW mesh and pass the
+        old state through here at the next step boundary; every leaf is
+        device_put onto the new state_template's sharding (params by rule,
+        optimizer slots by param path, step/extra replicated). The same
+        sharding machinery that lays out a restore lays out the resize —
+        there is no separate elastic layout path to drift."""
+        tmpl = self.state_template()
+
+        def relay(leaf, spec):
+            return jax.device_put(leaf, spec.sharding)
+
+        return jax.tree_util.tree_map(relay, state, tmpl)
+
     def restore_or_init(self, key, ckpt=None) -> "TrainState":
         """Resume from ``ckpt``'s latest checkpoint if one exists, else
         fresh init — the restart-based recovery contract (SURVEY.md §5):

@@ -1,0 +1,330 @@
+"""Elastic-soak workload: lock-step data consumption that survives
+shrink/re-grow without losing or duplicating a single token.
+
+Control-plane-faithful, data-plane-minimal (same constraint as
+workloads/soak.py: CI containers cannot run multi-process SPMD), but
+unlike the plain soak workload this one exercises the ELASTIC data
+contract end to end:
+
+- The corpus is ``total_windows`` abstract windows consumed in the
+  canonical seeded order G (``train.data.elastic_global_order``) — the
+  world-size-independent sequence every incarnation derives identically.
+- Each epoch's active members own a round-robin deal of the REMAINING
+  (not-yet-recorded) positions: rank r of n gets ``remaining[r::n]``.
+  Consumption is durable-record-defined: a member consumes position p by
+  appending ``{"p", "w", "t", "m", "e"}`` to its own
+  ``consumed-<member>.jsonl`` in the shared workdir; a member killed
+  before the append never consumed it, so its orphans fall back into
+  ``remaining`` at the next re-carve with no bookkeeping of the corpse.
+- Members poll the job's resize directive every step
+  (``JobContext.poll_resize_directive``). On a new epoch, survivors ack
+  (``ack-<member>-<epoch>``) and stop; the chief waits for every
+  surviving ack, recomputes ``remaining`` from ALL recorded
+  consumptions, deals it to the directive's member list, writes
+  ``epoch-<E>.json`` atomically, and publishes barrier fields into the
+  directive (``publish_resize_barrier``). Everyone then consumes from
+  the new deal — the re-carve boundary the reconciler's directive
+  promised.
+- A re-grown member (created with ``TPUJOB_RESIZE_EPOCH`` > 0) waits for
+  the directive to reach its epoch, pulls the latest committed
+  checkpoint from a surviving peer's shard depot
+  (``WorkloadCheckpointer.prefetch_from_peers`` + ``record_restore``)
+  before touching disk, then joins the epoch's deal.
+- When every position is recorded, the chief merges all records, asserts
+  exactly-once coverage of [0, total_windows), and writes the eval
+  digest (sha256 over the position-ordered (p, G[p]) stream) to
+  ``workdir/eval_digest.txt`` + ``done.json``. A faulted run is
+  bit-identical to an uninterrupted run at the same token count iff the
+  digests match — the elastic soak's hard gate.
+
+Requires a workers-only gang (chief = worker 0), like the light soak
+data plane.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import logging
+import os
+import time
+from typing import Dict, List, Optional
+
+from tf_operator_tpu.rendezvous.context import JobContext
+
+log = logging.getLogger("tpujob.elasticwl")
+
+_POLL_S = 0.05
+
+
+def _member_name(ctx: JobContext) -> str:
+    return f"{ctx.job_name}-{ctx.replica_type.lower()}-{ctx.replica_index}"
+
+
+def _record_path(workdir: str, member: str) -> str:
+    return os.path.join(workdir, f"consumed-{member}.jsonl")
+
+
+def _read_records(workdir: str) -> List[dict]:
+    """All durable consumption records; a torn final line (member killed
+    mid-append) parses as nothing — that position was never consumed."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(workdir, "consumed-*.jsonl"))):
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue
+        except OSError:
+            continue
+    return out
+
+
+def _epoch_path(workdir: str, epoch: int) -> str:
+    return os.path.join(workdir, f"epoch-{epoch}.json")
+
+
+def _latest_epoch_file(workdir: str, at_least: int) -> Optional[dict]:
+    """The highest epoch-<E>.json with E >= at_least, if any."""
+    best, best_e = None, -1
+    for path in glob.glob(os.path.join(workdir, "epoch-*.json")):
+        try:
+            e = int(os.path.basename(path)[len("epoch-"):-len(".json")])
+        except ValueError:
+            continue
+        if e >= at_least and e > best_e:
+            best, best_e = path, e
+    if best is None:
+        return None
+    try:
+        with open(best) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _write_json_atomic(path: str, obj: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _deal(remaining: List[int], members: List[str]) -> Dict[str, List[int]]:
+    """Round-robin the remaining positions over the members in rank
+    order — the rank::n stride applied to whatever is left, so orphaned
+    positions interleave with the untouched tail."""
+    n = len(members)
+    return {m: remaining[r::n] for r, m in enumerate(members)}
+
+
+def _digest(records: List[dict], total: int) -> str:
+    """Sha256 over the position-ordered consumed stream, duplicates
+    included — a drop, a duplicate, or a different window at a position
+    all change the digest."""
+    h = hashlib.sha256()
+    for rec in sorted(records, key=lambda r: (int(r["p"]), int(r["w"]))):
+        h.update(f"{rec['p']}:{rec['w']};".encode())
+    h.update(str(total).encode())
+    return h.hexdigest()
+
+
+def main(ctx: JobContext) -> None:
+    import numpy as np
+
+    from tf_operator_tpu.train.checkpoint import WorkloadCheckpointer
+    from tf_operator_tpu.train.data import elastic_global_order
+
+    wl = ctx.workload
+    workdir = wl["workdir"]
+    total = int(wl.get("total_windows", 48))
+    sleep_s = float(wl.get("step_sleep_s", 0.15))
+    order = elastic_global_order(total, seed=int(wl.get("data_seed", 0)))
+    me = _member_name(ctx)
+    is_chief = ctx.replica_type == "Worker" and ctx.replica_index == 0
+    os.makedirs(workdir, exist_ok=True)
+
+    ckpt = WorkloadCheckpointer(wl, ctx=ctx)
+    mgr = ckpt.manager
+
+    # -- join ------------------------------------------------------------
+    my_epoch = 0
+    if ctx.resize_epoch > 0:
+        # Re-grown member: the controller stamped the grow epoch at
+        # creation. Do not touch the deal until the directive catches up
+        # (it is published in the same sync, after our create).
+        my_epoch = ctx.resize_epoch
+        while True:
+            d = ctx.poll_resize_directive()
+            if d and int(d.get("epoch", 0)) >= my_epoch:
+                my_epoch = int(d["epoch"])
+                break
+            time.sleep(_POLL_S)
+        # Peer warm restore: pull the latest committed step from a
+        # surviving host's shard depot before touching disk. Retried
+        # briefly — a commit can be mid-push to the depot when we land.
+        if mgr is not None:
+            t0 = time.time()
+            source = ckpt.prefetch_from_peers()
+            deadline = time.time() + 3.0
+            while source != "peer" and time.time() < deadline:
+                time.sleep(0.2)
+                source = ckpt.prefetch_from_peers()
+            start = mgr.latest_step() or 0
+            if start:
+                mgr.restore({"step": np.asarray(start)})
+                ckpt.restore_source = source
+                ctx.record_restore(source, start, t0, time.time())
+                log.info("re-grown member restored step %d (source=%s)",
+                         start, source)
+    elif is_chief:
+        # Epoch 0: the full gang in worker-index rank order, dealt the
+        # whole corpus.
+        members = [f"{ctx.job_name}-worker-{i}"
+                   for i in range(ctx.num_processes)]
+        _write_json_atomic(_epoch_path(workdir, 0), {
+            "epoch": 0, "direction": "start", "members": members,
+            "positions": _deal(list(range(total)), members),
+        })
+
+    epoch_doc = None
+    while epoch_doc is None:
+        epoch_doc = _latest_epoch_file(workdir, my_epoch)
+        if epoch_doc is None:
+            time.sleep(_POLL_S)
+    my_epoch = int(epoch_doc["epoch"])
+    assignment = list(epoch_doc["positions"].get(me, []))
+    idx = 0
+    consumed = 0
+    rec_f = open(_record_path(workdir, me), "a")
+
+    def handle_resize(directive: dict) -> None:
+        """Act on a directive whose epoch is ahead of ours."""
+        nonlocal my_epoch, assignment, idx, epoch_doc
+        t0 = time.time()
+        epoch = int(directive["epoch"])
+        direction = str(directive.get("direction", ""))
+        members = list(directive.get("members", []))
+        if me not in members:
+            # Shrunk out while still alive — not expected (the reconciler
+            # only drops dead members), but exit cleanly rather than
+            # consume positions nobody dealt us.
+            log.warning("%s not in epoch %d members; exiting", me, epoch)
+            rec_f.close()
+            raise SystemExit(0)
+        if is_chief:
+            # Wait for every SURVIVING member of the current epoch to ack
+            # (stop consuming) before recomputing the deal; dead members
+            # are exactly those missing from the new member list.
+            need = [m for m in members
+                    if m != me and m in epoch_doc.get("members", [])]
+            deadline = time.time() + 60.0
+            while True:
+                live = ctx.poll_resize_directive()
+                if live and int(live.get("epoch", 0)) > epoch:
+                    # Superseded mid-barrier; restart at the newer epoch.
+                    handle_resize(live)
+                    return
+                missing = [m for m in need if not os.path.exists(
+                    os.path.join(workdir, f"ack-{m}-{epoch}"))]
+                if not missing:
+                    break
+                if time.time() > deadline:
+                    raise AssertionError(
+                        f"resize barrier {epoch}: no ack from {missing}")
+                time.sleep(_POLL_S)
+            rec_f.flush()
+            records = _read_records(workdir)
+            seen = {int(r["p"]) for r in records}
+            remaining = [p for p in range(total) if p not in seen]
+            _write_json_atomic(_epoch_path(workdir, epoch), {
+                "epoch": epoch, "direction": direction, "members": members,
+                "positions": _deal(remaining, members),
+            })
+            ctx.publish_resize_barrier(epoch, {
+                "completed": total - len(remaining),
+                "boundary_remaining": len(remaining),
+            })
+        else:
+            # Ack, then wait for the chief's re-carve for this (or a
+            # newer, superseding) epoch.
+            with open(os.path.join(workdir, f"ack-{me}-{epoch}"), "w"):
+                pass
+            while _latest_epoch_file(workdir, epoch) is None:
+                live = ctx.poll_resize_directive()
+                if live and int(live.get("epoch", 0)) > epoch:
+                    handle_resize(live)
+                    return
+                time.sleep(_POLL_S)
+        epoch_doc = _latest_epoch_file(workdir, epoch)
+        my_epoch = int(epoch_doc["epoch"])
+        assignment = list(epoch_doc["positions"].get(me, []))
+        idx = 0
+        ctx.record_resize(direction, my_epoch, t0, time.time())
+        log.info("%s re-carved at epoch %d (%s): %d positions",
+                 me, my_epoch, direction, len(assignment))
+
+    # -- consume ---------------------------------------------------------
+    done_path = os.path.join(workdir, "done.json")
+    while True:
+        d = ctx.poll_resize_directive()
+        if d and int(d.get("epoch", 0)) > my_epoch:
+            handle_resize(d)
+            continue
+        if idx >= len(assignment):
+            if os.path.exists(done_path):
+                break
+            if is_chief and epoch_doc.get("direction") != "shrink":
+                # Eval runs on the full mesh: while the gang is shrunk a
+                # re-grow is still owed, so hold the final digest until
+                # the grow directive lands (the loop keeps polling).
+                records = _read_records(workdir)
+                if len({int(r["p"]) for r in records}) >= total:
+                    positions = sorted(int(r["p"]) for r in records)
+                    if positions != list(range(total)):
+                        raise AssertionError(
+                            f"elastic coverage broken: {len(positions)} "
+                            f"records over {len(set(positions))} distinct "
+                            f"positions, want {total} exactly once")
+                    digest = _digest(records, total)
+                    with open(os.path.join(workdir, "eval_digest.txt"),
+                              "w") as f:
+                        f.write(digest + "\n")
+                    _write_json_atomic(done_path, {
+                        "digest": digest, "total": total,
+                        "records": len(records),
+                    })
+                    log.info("elastic run complete: %d windows, digest %s",
+                             total, digest[:12])
+                    break
+            time.sleep(_POLL_S)
+            continue
+        p = assignment[idx]
+        time.sleep(sleep_s)
+        rec_f.write(json.dumps({
+            "p": int(p), "w": int(order[p]), "t": time.time(),
+            "m": me, "e": my_epoch,
+        }) + "\n")
+        rec_f.flush()
+        idx += 1
+        consumed += 1
+        if consumed == 1:
+            ctx.mark_first_step(1)
+        if is_chief and mgr is not None and ckpt.every and \
+                consumed % ckpt.every == 0:
+            mgr.save(consumed, {"step": np.asarray(consumed)})
+
+    if is_chief and mgr is not None:
+        mgr.save(max(consumed, 1), {"step": np.asarray(consumed)}, wait=True)
+        mgr.close()
+    rec_f.close()
+    log.info("%s done: consumed %d positions (final epoch %d)",
+             me, consumed, my_epoch)

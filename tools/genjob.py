@@ -560,6 +560,39 @@ def run_ttfs_bench(args) -> int:
     return 0 if ok else 1
 
 
+# ---- --bench-elastic: the elastic-gang resize oracle (r12) --------------
+
+
+def run_elastic_bench(args) -> int:
+    """The r12 elasticity receipt: drive the seeded kill/return schedule
+    through the elastic chaos soak (``chaos/soak.py``) and report one
+    JSON line — resize downtime p50/p99, tokens/s before/during/after
+    the shrink, and the hard gates the CI ``elastic-soak`` stage rides
+    on: zero full gang restarts, bit-identical eval after the re-grow
+    vs an uninterrupted run at the same token count, and at least one
+    resize restored from a peer depot rather than disk."""
+    from tf_operator_tpu.chaos.soak import elastic_artifact, run_elastic_soak
+
+    result = run_elastic_soak(
+        seed=args.seed,
+        kills=args.bench_elastic_kills,
+        workers=args.workers,
+        total_windows=args.bench_elastic_windows,
+        timeout=args.timeout,
+    )
+    artifact = elastic_artifact(result, args.seed)
+    line = json.dumps(artifact)
+    print(line)
+    if args.bench_out:
+        os.makedirs(os.path.dirname(args.bench_out) or ".", exist_ok=True)
+        with open(args.bench_out, "w") as f:
+            f.write(line + "\n")
+    violations = result.check()
+    for v in violations:
+        print(f"FAIL: {v}", file=sys.stderr)
+    return 1 if violations else 0
+
+
 # ---- --bench-tenants: the multi-tenant fleet-scheduler oracle (r7) ------
 
 
@@ -946,8 +979,27 @@ def main(argv=None) -> int:
     p.add_argument("--bench-ttfs-inflight", type=int, default=4,
                    help="bounded submission window (and warm-pool size): "
                         "repeat-submit is a stream, not one batch")
+    p.add_argument("--bench-elastic", action="store_true",
+                   help="run the r12 elastic-gang resize bench: seeded "
+                        "kill/return schedule through the elastic chaos "
+                        "soak; one JSON line with resize downtime p50/p99 "
+                        "and tokens/s before/during/after the shrink; "
+                        "exits nonzero unless zero full restarts, "
+                        "bit-identical eval, and >=1 peer-depot restore")
+    p.add_argument("--bench-elastic-kills", type=int, default=2,
+                   help="kill/return events in the elastic schedule")
+    p.add_argument("--bench-elastic-windows", type=int, default=400,
+                   help="total data windows the elastic workload consumes")
+    p.add_argument("--seed", type=int, default=12,
+                   help="schedule seed for --bench-elastic")
     args = p.parse_args(argv)
 
+    if args.bench_elastic:
+        if args.workers < 3:
+            args.workers = 3  # need a chief + >=2 killable members
+        if args.timeout > 300.0:
+            args.timeout = 150.0  # soak bound, not the submit default
+        return run_elastic_bench(args)
     if args.bench_ttfs:
         return run_ttfs_bench(args)
     if args.bench:
