@@ -1,0 +1,503 @@
+"""Fleet telemetry plane (obs/telemetry.py, r13): ring-buffer batching +
+eviction, degradation latching, straggler detection (median-ratio rule +
+flap hysteresis) and its reconciler integration, goodput decomposition,
+the on-demand profile directive, the /telemetry endpoint, `tpujob top`
+rendering, and GC with the job."""
+
+import contextlib
+import json
+import time
+import urllib.request
+
+import pytest
+
+from tf_operator_tpu.api.types import KIND_TELEMETRY, ObjectMeta
+from tf_operator_tpu.obs.spans import Span, span_labels
+from tf_operator_tpu.obs.telemetry import (
+    TELEMETRY_RING_SLOTS,
+    StepTelemetry,
+    StragglerTracker,
+    Telemetry,
+    TelemetryRecorder,
+    detect_stragglers,
+    goodput_decomposition,
+    job_telemetry,
+    telemetry_labels,
+    telemetry_slot_name,
+    telemetry_summary,
+)
+from tf_operator_tpu.runtime import Store
+from tf_operator_tpu.runtime.objects import ProcessPhase
+
+from tests.test_obs import Harness, make_job, make_process, run_job_to_completion
+
+
+def make_batch(job="traced", rank=0, seq=0, step_time=0.2, host="", **kw):
+    return Telemetry(
+        metadata=ObjectMeta(
+            name=telemetry_slot_name(job, f"uid-{job}", rank, seq),
+            labels=telemetry_labels(job),
+        ),
+        trace_id=f"uid-{job}", rank=rank, host=host, seq=seq,
+        start_step=seq * 2 + 1, end_step=seq * 2 + 2, steps=2,
+        step_time_s=step_time, **kw,
+    )
+
+
+# ---- straggler detection (pure rule) -------------------------------------
+
+
+def test_detect_stragglers_median_ratio_rule():
+    # clean window: nobody beyond 1.5x the median
+    assert detect_stragglers({0: 0.2, 1: 0.25, 2: 0.21}) == []
+    # one slow rank: 0.55 / median 0.2 = 2.75x
+    assert detect_stragglers({0: 0.2, 1: 0.55, 2: 0.2}) == [1]
+    # all slow together: the median moves with them — a job problem,
+    # not a host problem, so nobody is flagged
+    assert detect_stragglers({0: 0.9, 1: 0.95, 2: 0.91}) == []
+    # too few ranks for a meaningful median
+    assert detect_stragglers({0: 0.2, 1: 0.9}) == []
+    # zero/negative samples are ignored entirely
+    assert detect_stragglers({0: 0.0, 1: 0.0, 2: 0.0}) == []
+
+
+def test_straggler_tracker_flags_after_consecutive_windows():
+    t = StragglerTracker()
+    slow = {0: 0.2, 1: 0.55, 2: 0.2}
+    assert t.observe(slow) == ([], [])  # 1st bad window: not yet
+    assert t.observe(slow) == ([1], [])  # 2nd consecutive: flag
+    assert t.observe(slow) == ([], [])  # already flagged: no re-fire
+    assert t.flagged == {1: 2}
+
+
+def test_straggler_tracker_flapping_never_commits():
+    t = StragglerTracker()
+    slow = {0: 0.2, 1: 0.55, 2: 0.2}
+    clean = {0: 0.2, 1: 0.21, 2: 0.2}
+    for _ in range(4):  # bad, clean, bad, clean ... resets each time
+        assert t.observe(slow) == ([], [])
+        assert t.observe(clean) == ([], [])
+    assert t.flagged == {}
+
+
+def test_straggler_tracker_clears_after_consecutive_clean_windows():
+    t = StragglerTracker()
+    slow = {0: 0.2, 1: 0.55, 2: 0.2}
+    clean = {0: 0.2, 1: 0.21, 2: 0.2}
+    t.observe(slow)
+    assert t.observe(slow) == ([1], [])
+    assert t.observe(clean) == ([], [])  # 1 clean: still flagged
+    assert t.observe(clean) == ([], [1])  # 2 consecutive: cleared
+    assert t.flagged == {}
+
+
+# ---- ring buffer + recorder ----------------------------------------------
+
+
+def test_ring_eviction_overwrites_oldest_slot():
+    store = Store()
+    rep = StepTelemetry(
+        TelemetryRecorder(store), "default", "ringjob", "uid-ringjob",
+        rank=0, flush_every=1,
+    )
+    n = TELEMETRY_RING_SLOTS + 2
+    for _ in range(n):
+        rep.step(0.1)
+    live = job_telemetry(store, "default", "ringjob")
+    # hard cap: never more objects than slots x ranks
+    assert len(live) == TELEMETRY_RING_SLOTS
+    # the oldest seqs were evicted by overwrite; the newest survive
+    assert [b.seq for b in live] == sorted(range(n - TELEMETRY_RING_SLOTS, n))
+    # seq N lives in slot N % SLOTS: slot 0 now holds seq 8, not seq 0
+    slot0 = store.get(
+        KIND_TELEMETRY, "default",
+        telemetry_slot_name("ringjob", "uid-ringjob", 0, 0),
+    )
+    assert slot0.seq == TELEMETRY_RING_SLOTS
+    # step range stays attached to the batch through the overwrite
+    assert slot0.start_step == TELEMETRY_RING_SLOTS + 1
+
+
+def test_cumulative_totals_survive_ring_eviction():
+    store = Store()
+    rep = StepTelemetry(
+        TelemetryRecorder(store), "default", "evict", "uid-evict",
+        rank=0, flush_every=1,
+    )
+    n = TELEMETRY_RING_SLOTS + 4
+    for _ in range(n):
+        rep.step(0.1, data_wait_s=0.05, ckpt_stall_s=0.01)
+    live = job_telemetry(store, "default", "evict")
+    # per-window deltas only cover the surviving windows...
+    assert sum(b.data_wait_s for b in live) == pytest.approx(
+        0.05 * TELEMETRY_RING_SLOTS
+    )
+    # ...but the latest batch's run-cumulative totals cover every step,
+    # so the decomposition is eviction-proof
+    newest = max(live, key=lambda b: b.seq)
+    assert newest.data_wait_total_s == pytest.approx(0.05 * n)
+    assert newest.ckpt_stall_total_s == pytest.approx(0.01 * n)
+    g = goodput_decomposition([], live, 0.0, 100.0)
+    assert g["lost_s"]["data-wait"] == pytest.approx(0.05 * n)
+    assert g["lost_s"]["ckpt-stall"] == pytest.approx(0.01 * n)
+
+
+class _BrokenStore:
+    def create(self, obj):
+        raise OSError("api unreachable")
+
+
+def test_degraded_latches_and_recovery_batch_carries_it():
+    broken = TelemetryRecorder(_BrokenStore())
+    rep = StepTelemetry(
+        broken, "default", "deg", "uid-deg", rank=0, flush_every=1,
+    )
+    rep.step(0.1)  # write fails silently — never an exception
+    assert rep.degraded and rep.batches_sent == 0
+    # API comes back: swap in a working store underneath the recorder
+    broken._store = Store()
+    rep.step(0.1)
+    live = job_telemetry(broken._store, "default", "deg")
+    assert len(live) == 1
+    assert live[0].degraded == 1  # the gap stays visible exactly once
+    assert not rep.degraded  # latch cleared by the delivered batch
+    rep.step(0.1)
+    newest = max(
+        job_telemetry(broken._store, "default", "deg"), key=lambda b: b.seq
+    )
+    assert newest.degraded == 0
+
+
+# ---- goodput decomposition -----------------------------------------------
+
+
+def _span(op, start, end):
+    return Span(
+        metadata=ObjectMeta(name=f"{op}-{start}", labels=span_labels("j")),
+        trace_id="t", span_id=f"{op}-{start}", parent_id="t",
+        op=op, component="controller", start_time=start, end_time=end,
+    )
+
+
+def test_goodput_decomposition_folds_all_causes():
+    spans = [
+        _span("first-step", 110.0, 110.0),  # compile-init: 10s
+        _span("restart", 120.0, 125.0),  # 5s downtime
+        _span("restart", 140.0, 0.0),  # open span: not yet lost time
+        _span("resize", 150.0, 152.0),  # 2s
+    ]
+    batches = [
+        make_batch(rank=0, seq=3, data_wait_total_s=4.0, ckpt_stall_total_s=1.0),
+        make_batch(rank=1, seq=3, data_wait_total_s=2.0, ckpt_stall_total_s=1.0),
+    ]
+    g = goodput_decomposition(spans, batches, 100.0, 200.0)
+    assert g["wall_s"] == 100.0
+    assert g["lost_s"]["compile-init"] == pytest.approx(10.0)
+    assert g["lost_s"]["restart"] == pytest.approx(5.0)
+    assert g["lost_s"]["resize"] == pytest.approx(2.0)
+    # stalls average across ranks (they stall the same gang wall-clock)
+    assert g["lost_s"]["data-wait"] == pytest.approx(3.0)
+    assert g["lost_s"]["ckpt-stall"] == pytest.approx(1.0)
+    assert g["goodput_ratio"] == pytest.approx(1.0 - 21.0 / 100.0)
+
+
+def test_goodput_decomposition_falls_back_to_window_deltas():
+    # producers predating the cumulative fields: totals are zero, so the
+    # per-rank delta sums are used instead
+    batches = [
+        make_batch(rank=0, seq=s, data_wait_s=0.5) for s in range(4)
+    ]
+    g = goodput_decomposition([], batches, 0.0, 100.0)
+    assert g["lost_s"]["data-wait"] == pytest.approx(2.0)
+
+
+def test_goodput_ratio_clamped():
+    batches = [make_batch(rank=0, seq=0, data_wait_total_s=500.0)]
+    g = goodput_decomposition([], batches, 0.0, 10.0)
+    assert g["goodput_ratio"] == 0.0  # lost > wall clamps, never negative
+
+
+def test_telemetry_summary_spread_is_the_straggler_signal():
+    batches = [
+        make_batch(rank=0, seq=5, step_time=0.2, tokens_per_s=100.0),
+        make_batch(rank=1, seq=5, step_time=0.55, tokens_per_s=40.0),
+        make_batch(rank=2, seq=5, step_time=0.2, tokens_per_s=100.0),
+        make_batch(rank=2, seq=4, step_time=9.9),  # stale window: ignored
+    ]
+    s = telemetry_summary(batches)
+    assert s["ranks"] == 3
+    assert s["tokens_per_s"] == pytest.approx(240.0)
+    assert s["spread"] == pytest.approx(0.55 / 0.2, rel=1e-3)
+    assert s["last_step"] == 12
+    assert telemetry_summary([])["ranks"] == 0
+
+
+# ---- reconciler integration: straggler flag/clear + goodput export -------
+
+
+def seed_window(h, seq, times, job="traced"):
+    for rank, t in times.items():
+        h.store.create(
+            make_batch(job=job, rank=rank, seq=seq, step_time=t,
+                       host=f"h{rank}")
+        )
+
+
+def running_harness(workers=3):
+    job = make_job(workers=workers)
+    h = Harness(
+        job,
+        [make_process(job, i, ProcessPhase.RUNNING) for i in range(workers)],
+    )
+    h.sync()  # RUNNING condition; gang_running path live
+    return h
+
+
+def test_reconciler_flags_and_clears_slow_host():
+    h = running_harness()
+    slow = {0: 0.2, 1: 0.55, 2: 0.2}
+    seed_window(h, 0, slow)
+    seed_window(h, 1, slow)
+    h.sync()
+    events = [
+        e for e in h.store.list("Event", namespace="default")
+        if e.reason == "SlowHost"
+    ]
+    assert len(events) == 1
+    assert "rank 1 on host h1" in events[0].message
+    assert "after 2 windows" in events[0].message
+    assert "h1" in h.ctl._slow_hosts
+    assert 'tpujob_straggler_host{host="h1"} 1' in h.ctl.metrics.render()
+    # recovery: two consecutive clean windows clear everything
+    clean = {0: 0.2, 1: 0.21, 2: 0.2}
+    seed_window(h, 2, clean)
+    seed_window(h, 3, clean)
+    h.sync()
+    assert "h1" not in h.ctl._slow_hosts
+    assert "tpujob_straggler_host" not in h.ctl.metrics.render()
+    cleared = [
+        e for e in h.store.list("Event", namespace="default")
+        if e.reason == "SlowHostCleared"
+    ]
+    assert len(cleared) == 1
+
+
+def test_reconciler_ignores_partial_windows():
+    h = running_harness()
+    # only 2 of 3 gang members reported these seqs: windows incomplete,
+    # so the tracker must not burn flag state on them
+    seed_window(h, 0, {0: 0.2, 1: 0.55})
+    seed_window(h, 1, {0: 0.2, 1: 0.55})
+    seed_window(h, 2, {0: 0.2, 1: 0.55})
+    h.sync()
+    assert h.ctl._slow_hosts == {}
+    assert not [
+        e for e in h.store.list("Event", namespace="default")
+        if e.reason == "SlowHost"
+    ]
+
+
+def test_all_slow_gang_never_flags():
+    h = running_harness()
+    for seq in range(3):
+        seed_window(h, seq, {0: 0.9, 1: 0.95, 2: 0.91})
+        h.sync()
+    assert h.ctl._slow_hosts == {}
+
+
+def test_goodput_exported_once_at_terminal():
+    h = Harness(make_job())
+    h.store.create(make_batch(rank=0, seq=0, data_wait_total_s=2.0))
+    h.store.create(make_batch(rank=1, seq=0, data_wait_total_s=2.0))
+    run_job_to_completion(h)
+    text = h.ctl.metrics.render()
+    assert 'tpujob_goodput_ratio{job="traced",namespace="default"}' in text
+    assert 'tpujob_lost_seconds_total{cause="data-wait"} 2' in text
+    h.sync()  # terminal re-syncs must not double-count
+    assert 'tpujob_lost_seconds_total{cause="data-wait"} 2' in h.ctl.metrics.render()
+
+
+def test_telemetry_gcd_with_job_deletion():
+    h = Harness(make_job())
+    run_job_to_completion(h)
+    h.store.create(make_batch(rank=0, seq=0))
+    assert job_telemetry(h.store, "default", "traced")
+    h.store.delete("TPUJob", "default", h.job.metadata.name)
+    h.ctl.job_informer._cache.clear()
+    h.sync()
+    assert job_telemetry(h.store, "default", "traced") == []
+
+
+# ---- on-demand profiling -------------------------------------------------
+
+
+def test_profile_directive_arms_once_per_epoch(monkeypatch):
+    entered, exited = [], []
+
+    @contextlib.contextmanager
+    def fake_ctx(root):
+        entered.append(root)
+        yield
+        exited.append(root)
+
+    import tf_operator_tpu.train.profile as profile_mod
+    monkeypatch.setattr(profile_mod, "profile_ctx", fake_ctx)
+
+    directive = {"epoch": 1, "steps": 2, "dir": "/tmp/xp"}
+    captures = []
+    rep = StepTelemetry(
+        TelemetryRecorder(Store()), "default", "prof", "uid-prof",
+        rank=0, flush_every=1,
+        poll_directive=lambda: directive,
+        on_capture=lambda epoch, steps, d: captures.append((epoch, steps, d)),
+    )
+    rep.step(0.1)  # flush boundary: directive observed, capture armed
+    assert entered == ["/tmp/xp"]
+    rep.step(0.1)  # capture step 1
+    assert exited == []
+    rep.step(0.1)  # capture step 2: context exits, capture reported
+    assert exited == ["/tmp/xp"]
+    assert captures == [(1, 2, "/tmp/xp")]
+    # the same epoch never re-fires; a bumped epoch does
+    for _ in range(3):
+        rep.step(0.1)
+    assert entered == ["/tmp/xp"]
+    directive["epoch"] = 2
+    rep.step(0.1)
+    assert len(entered) == 2
+
+
+def test_profile_capture_aborted_on_close_not_reported(monkeypatch):
+    exited, captures = [], []
+
+    @contextlib.contextmanager
+    def fake_ctx(root):
+        yield
+        exited.append(root)
+
+    import tf_operator_tpu.train.profile as profile_mod
+    monkeypatch.setattr(profile_mod, "profile_ctx", fake_ctx)
+    rep = StepTelemetry(
+        TelemetryRecorder(Store()), "default", "prof2", "uid-prof2",
+        rank=0, flush_every=1,
+        poll_directive=lambda: {"epoch": 1, "steps": 50, "dir": "/tmp/xp"},
+        on_capture=lambda *a: captures.append(a),
+    )
+    rep.step(0.1)  # armed, 50 steps outstanding
+    rep.close()  # workload ends mid-capture
+    assert exited == ["/tmp/xp"]  # profiler stopped (no leak)...
+    assert captures == []  # ...but the truncated capture is not acked
+
+
+def test_profile_endpoint_bumps_monotonic_epoch():
+    from tf_operator_tpu.dashboard import DashboardServer
+
+    h = Harness(make_job(name="profjob"))
+    srv = DashboardServer(h.store, port=0)
+    srv.start()
+    try:
+        def post(body, path="/api/tpujob/default/profjob/profile"):
+            req = urllib.request.Request(
+                srv.url + path, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.loads(resp.read())
+
+        first = post({"steps": 3, "dir": "/tmp/xp"})["profile_directive"]
+        assert first["epoch"] == 1 and first["steps"] == 3
+        assert post({"steps": 5})["profile_directive"]["epoch"] == 2
+        assert h.stored_job().status.profile_directive["epoch"] == 2
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            post({"steps": 0})
+        assert exc.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            post({"steps": 1}, path="/api/tpujob/default/absent/profile")
+        assert exc.value.code == 404
+    finally:
+        srv.stop()
+
+
+# ---- surface: /telemetry endpoint + tpujob top ---------------------------
+
+
+def test_telemetry_endpoint_serves_batches_summary_goodput():
+    from tf_operator_tpu.dashboard import DashboardServer
+
+    h = Harness(make_job(name="telemjob"))
+    for rank in range(2):
+        h.store.create(make_batch(
+            job="telemjob", rank=rank, seq=0, step_time=0.2,
+            data_wait_total_s=1.0,
+        ))
+    srv = DashboardServer(h.store, port=0)
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+            srv.url + "/api/tpujob/default/telemjob/telemetry", timeout=10
+        ) as resp:
+            doc = json.loads(resp.read())
+        assert doc["job"] == "default/telemjob"
+        assert len(doc["batches"]) == 2
+        assert doc["summary"]["ranks"] == 2
+        assert doc["goodput"]["lost_s"]["data-wait"] == pytest.approx(1.0)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                srv.url + "/api/tpujob/default/absent/telemetry", timeout=10
+            )
+        assert exc.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_render_top_table():
+    from tf_operator_tpu.cli.tpujob import render_top
+
+    out = render_top({
+        "job": "default/lm",
+        "summary": {
+            "ranks": 3, "last_step": 40, "tokens_per_s": 1234.5,
+            "mfu": 0.42,
+            "step_time_s": {"0": 0.2, "1": 0.55, "10": 0.2},
+            "spread": 2.75, "degraded": 1,
+        },
+        "goodput": {
+            "goodput_ratio": 0.81, "wall_s": 100.0,
+            "lost_s": {"data-wait": 12.0, "restart": 7.0, "resize": 0.0},
+        },
+    })
+    assert "JOB        default/lm" in out
+    assert "RANKS      3" in out
+    assert "TOKENS/S   1,234.5" in out
+    assert "MFU        0.420" in out
+    # ranks sort numerically (10 after 1), each with its step time
+    assert "r0=0.200s  r1=0.550s  r10=0.200s" in out
+    assert "(spread 2.75x)" in out
+    assert "DEGRADED" in out
+    assert "GOODPUT    0.810 over 100.0s wall" in out
+    assert "lost[data-wait]  12.0s" in out
+    assert "lost[restart]  7.0s" in out
+    assert "lost[resize]" not in out  # zero causes stay quiet
+
+
+def test_render_top_without_batches():
+    from tf_operator_tpu.cli.tpujob import render_top
+
+    out = render_top({"job": "default/fresh", "summary": {}, "goodput": {}})
+    assert "no telemetry batches yet" in out
+
+
+# ---- metrics plumbing ----------------------------------------------------
+
+
+def test_labeled_gauge_set_and_clear_render():
+    from tf_operator_tpu.controller.metrics import ControllerMetrics
+
+    m = ControllerMetrics()
+    m.set_gauge("tpujob_straggler_host", 1.0, labels={"host": "a"})
+    m.set_gauge("tpujob_goodput_ratio", 0.93, labels={"job": "j", "namespace": "d"})
+    text = m.render()
+    assert 'tpujob_straggler_host{host="a"} 1' in text
+    assert 'tpujob_goodput_ratio{job="j",namespace="d"} 0.93' in text
+    m.clear_gauge("tpujob_straggler_host", labels={"host": "a"})
+    assert "tpujob_straggler_host" not in m.render()

@@ -43,6 +43,7 @@ KIND_EVENT = "Event"
 KIND_HOST = "Host"
 KIND_LEASE = "Lease"
 KIND_SPAN = "Span"
+KIND_TELEMETRY = "Telemetry"
 # Fleet-scheduler object kinds (sched/): cluster-level priority classes and
 # per-namespace admission queues with chip/job quotas. Like Spans, they ride
 # the generic store/API seam (runtime/serialize.py registers decoders).
@@ -350,6 +351,12 @@ class TPUJobStatus:
     # gave eval *results* no queryable home; here `tpujob get` and the
     # dashboard read them from the job object.
     eval_metrics: Dict[str, Any] = field(default_factory=dict)
+    # On-demand profiling directive (same monotonic-epoch protocol as
+    # resize_directive): the CLI/API publishes {"epoch": int, "steps": int,
+    # "dir": path, "time": ts}; the chief wraps the next N steps in
+    # profile_ctx and publishes back {"completed_epoch": int,
+    # "xplane": path}. Empty when no capture has ever been requested.
+    profile_directive: Dict[str, Any] = field(default_factory=dict)
 
     def phase(self) -> JobPhase:
         """Derived v1alpha1-style phase (v1alpha1/types.go:106-116).
@@ -480,5 +487,6 @@ def _tpujob_from_dict(data: Dict[str, Any]) -> TPUJob:
         world_size=status_d.get("world_size", 0),
         resize_directive=status_d.get("resize_directive", {}) or {},
         resize_history=list(status_d.get("resize_history", []) or []),
+        profile_directive=status_d.get("profile_directive", {}) or {},
     )
     return TPUJob(metadata=meta, spec=spec, status=status, kind=data.get("kind", KIND_TPUJOB))

@@ -253,6 +253,12 @@ class SoakResult:
     p2p: bool = False
     restore_sources: List[str] = field(default_factory=list)
     effective_downtimes_s: List[Optional[float]] = field(default_factory=list)
+    # Goodput attribution (invariant 10, r13): the controller's per-cause
+    # tpujob_lost_seconds_total counters, scraped before teardown.
+    # goodput_scraped=False (crash mode: counters reset with the operator)
+    # skips the invariant.
+    goodput_scraped: bool = False
+    lost_seconds: Dict[str, float] = field(default_factory=dict)
 
     def check(self) -> List[str]:
         """Invariant failures, empty when the soak passed."""
@@ -345,6 +351,28 @@ class SoakResult:
                         f"restore committed) exceeds bound "
                         f"{self.downtime_bound_s:.0f}s"
                     )
+        # Invariant 10 (r13): goodput attribution. Every closed restart
+        # window's downtime must land under lost_seconds{cause="restart"}
+        # — the counter is incremented at the same span-close point as the
+        # downtime histogram, so the sums must agree — and NONE of it may
+        # leak into cause="resize" (no resizes happen here; the two span
+        # families must never double-count one outage).
+        if self.goodput_scraped:
+            expected = sum(
+                w["downtime_s"] for w in self.restart_windows
+                if w.get("downtime_s") is not None
+            )
+            got = self.lost_seconds.get("restart", 0.0)
+            if expected > 0 and abs(got - expected) > max(0.5, 0.05 * expected):
+                errs.append(
+                    f"lost_seconds{{cause=restart}} {got:.2f}s != closed "
+                    f"restart-window downtime {expected:.2f}s"
+                )
+            if self.lost_seconds.get("resize", 0.0) > 0:
+                errs.append(
+                    "restart downtime leaked into cause=resize: "
+                    f"{self.lost_seconds}"
+                )
         return errs
 
 
@@ -698,6 +726,9 @@ def run_soak(
                 )
             else:
                 result.effective_downtimes_s.append(w.get("downtime_s"))
+        if ctl is not None:
+            result.lost_seconds = _scrape_lost_seconds(ctl.metrics)
+            result.goodput_scraped = True
     finally:
         injector.stop()
         watcher.stop()
@@ -773,6 +804,10 @@ class ElasticSoakResult:
     # shrunk, and after the first re-grow.
     tokens_per_s: Dict[str, Optional[float]] = field(default_factory=dict)
     downtime_bound_s: float = 60.0
+    # Goodput attribution (r13): per-cause lost-seconds counters scraped
+    # from the live controller before teardown.
+    goodput_scraped: bool = False
+    lost_seconds: Dict[str, float] = field(default_factory=dict)
 
     @property
     def bit_identical(self) -> bool:
@@ -841,7 +876,44 @@ class ElasticSoakResult:
                     f"resize downtime {w['downtime_s']:.1f}s exceeds bound "
                     f"{self.downtime_bound_s:.0f}s: {w}"
                 )
+        # Goodput attribution (r13): resize downtime lands under
+        # lost_seconds{cause="resize"} (same span-close point as the
+        # downtime histogram) and never doubles into cause="restart" —
+        # the elastic gate above already demands zero full restarts.
+        if self.goodput_scraped:
+            expected = sum(
+                w["downtime_s"] for w in self.resize_windows
+                if w.get("downtime_s") is not None
+            )
+            got = self.lost_seconds.get("resize", 0.0)
+            if expected > 0 and abs(got - expected) > max(0.5, 0.05 * expected):
+                errs.append(
+                    f"lost_seconds{{cause=resize}} {got:.2f}s != closed "
+                    f"resize-window downtime {expected:.2f}s"
+                )
+            if self.lost_seconds.get("restart", 0.0) > 0:
+                errs.append(
+                    "resize downtime leaked into cause=restart: "
+                    f"{self.lost_seconds}"
+                )
         return errs
+
+
+def _scrape_lost_seconds(metrics) -> Dict[str, float]:
+    """{cause: seconds} from a live ControllerMetrics'
+    ``tpujob_lost_seconds_total`` counters (parsed from exposition text so
+    the soak reads the same surface Prometheus would)."""
+    import re
+
+    out: Dict[str, float] = {}
+    for line in metrics.render().splitlines():
+        m = re.match(
+            r'tpujob_lost_seconds_total\{[^}]*cause="([^"]+)"[^}]*\} (\S+)',
+            line,
+        )
+        if m:
+            out[m.group(1)] = out.get(m.group(1), 0.0) + float(m.group(2))
+    return out
 
 
 def _percentile(xs: List[float], q: float) -> Optional[float]:
@@ -1050,6 +1122,8 @@ def run_elastic_soak(
             [{"p": p, "w": int(order[p])} for p in range(total_windows)],
             total_windows,
         )
+        result.lost_seconds = _scrape_lost_seconds(ctl.metrics)
+        result.goodput_scraped = True
     finally:
         injector.stop()
         watcher.stop()
@@ -1097,6 +1171,9 @@ def elastic_artifact(result: ElasticSoakResult, seed: int) -> Dict[str, Any]:
         "peer_restores": result.peer_restores,
         "restore_sources": result.restore_sources,
         "applied": result.applied,
+        "lost_seconds": {
+            k: round(v, 3) for k, v in sorted(result.lost_seconds.items())
+        },
         "pass": not result.check(),
     }
 

@@ -72,6 +72,33 @@ def build_parser() -> argparse.ArgumentParser:
                     help="namespace (with NAME following) or, alone, a "
                          "job name in the default namespace")
     tp.add_argument("name", nargs="?", default=None)
+    op = sub.add_parser(
+        "top",
+        help="live per-job telemetry: tokens/s, MFU, per-rank step-time "
+             "spread, goodput decomposition",
+    )
+    op.add_argument("namespace_or_name",
+                    help="namespace (with NAME following) or, alone, a "
+                         "job name in the default namespace")
+    op.add_argument("name", nargs="?", default=None)
+    op.add_argument("--json", action="store_true", dest="as_json",
+                    help="dump the raw telemetry payload instead of the "
+                         "rendered table")
+    pp = sub.add_parser(
+        "profile",
+        help="capture an on-demand profile: the chief wraps the next N "
+             "steps in a profiler trace and reports the xplane path as a "
+             "profile-capture span",
+    )
+    pp.add_argument("namespace_or_name",
+                    help="namespace (with NAME following) or, alone, a "
+                         "job name in the default namespace")
+    pp.add_argument("name", nargs="?", default=None)
+    pp.add_argument("--steps", type=int, default=5,
+                    help="number of steps to capture (default 5)")
+    pp.add_argument("--dir", default="", dest="profile_dir",
+                    help="capture directory on the chief's host "
+                         "(default: <checkpoint_dir>/profile)")
     ep = sub.add_parser("events")
     ep.add_argument("--namespace", default=None)
     ap = sub.add_parser(
@@ -112,6 +139,46 @@ def _build_workload_job(args):
         queue=args.queue,
         workload=workload,
     )
+
+
+def _default_ns(args):
+    """`VERB <job>` assumes the default namespace; `VERB <ns> <job>` is
+    explicit — same convention as `tpujob trace`."""
+    if args.name is None:
+        return "default", args.namespace_or_name
+    return args.namespace_or_name, args.name
+
+
+def render_top(payload: dict) -> str:
+    """Render a /telemetry payload as the `tpujob top` table (separated
+    from main() so tests can golden-check it without a live server)."""
+    summary = payload.get("summary") or {}
+    goodput = payload.get("goodput") or {}
+    lines = [f"JOB        {payload.get('job', '-')}"]
+    if not summary.get("ranks"):
+        lines.append("no telemetry batches yet")
+    else:
+        lines.append(f"RANKS      {summary['ranks']}")
+        lines.append(f"LAST-STEP  {summary.get('last_step', 0)}")
+        lines.append(f"TOKENS/S   {summary.get('tokens_per_s', 0.0):,.1f}")
+        lines.append(f"MFU        {summary.get('mfu', 0.0):.3f}")
+        step_times = summary.get("step_time_s") or {}
+        spread = summary.get("spread", 0.0)
+        per_rank = "  ".join(
+            f"r{r}={step_times[r]:.3f}s"
+            for r in sorted(step_times, key=lambda k: int(k))
+        )
+        lines.append(f"STEP-TIME  {per_rank}  (spread {spread:.2f}x)")
+        if summary.get("degraded"):
+            lines.append("DEGRADED   some ranks report local-only telemetry")
+    ratio = goodput.get("goodput_ratio")
+    if ratio is not None:
+        lines.append(f"GOODPUT    {ratio:.3f} over {goodput.get('wall_s', 0.0):.1f}s wall")
+        lost = goodput.get("lost_s") or {}
+        for cause in sorted(lost):
+            if lost[cause] > 0:
+                lines.append(f"  lost[{cause}]  {lost[cause]:.1f}s")
+    return "\n".join(lines)
 
 
 def main(argv=None) -> int:
@@ -171,13 +238,26 @@ def main(argv=None) -> int:
         elif args.cmd == "logs":
             sys.stdout.write(client.logs(args.namespace, args.process_name))
         elif args.cmd == "trace":
-            # `tpujob trace <job>` assumes the default namespace;
-            # `tpujob trace <ns> <job>` is explicit.
-            if args.name is None:
-                ns, name = "default", args.namespace_or_name
-            else:
-                ns, name = args.namespace_or_name, args.name
+            ns, name = _default_ns(args)
             print(json.dumps(client.trace(ns, name), indent=2))
+        elif args.cmd == "top":
+            ns, name = _default_ns(args)
+            payload = client.telemetry(ns, name)
+            if args.as_json:
+                print(json.dumps(payload, indent=2))
+            else:
+                print(render_top(payload))
+        elif args.cmd == "profile":
+            ns, name = _default_ns(args)
+            out = client.profile(ns, name, args.steps, args.profile_dir)
+            d = out.get("profile_directive", {})
+            print(
+                f"profile directive epoch {d.get('epoch')} published for "
+                f"{ns}/{name}: {d.get('steps')} steps"
+                + (f" -> {d['dir']}" if d.get("dir") else "")
+            )
+            print("watch: tpujob trace "
+                  f"{ns} {name}  (profile-capture span carries the xplane path)")
         elif args.cmd == "events":
             for e in client.events(args.namespace):
                 print(f"{e['type']:<8} {e['reason']:<28} x{e['count']:<4} {e['message']}")

@@ -40,6 +40,12 @@ REASON_JOB_PREEMPTING = "TPUJobPreempting"
 # Control-plane crash-recovery: a restarted operator recovered this job
 # from the durable store and re-adopted its children (record_recovery).
 REASON_CONTROLLER_RESTARTED = "ControllerRestarted"
+# Straggler detection (obs/telemetry.py): a gang member's step time sat
+# above the cross-rank median-ratio bar for enough consecutive windows;
+# its host is flagged (SlowHost annotation + by-host gauge) and
+# deprioritized for new gang placements until it clears.
+REASON_SLOW_HOST = "SlowHost"
+REASON_SLOW_HOST_CLEARED = "SlowHostCleared"
 
 
 class EventRecorder:

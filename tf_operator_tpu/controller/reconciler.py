@@ -32,6 +32,7 @@ from __future__ import annotations
 import json
 import logging
 import socket
+import statistics
 import threading
 import time
 import zlib
@@ -43,6 +44,7 @@ from tf_operator_tpu.api.types import (
     KIND_HOST,
     KIND_PROCESS,
     KIND_SPAN,
+    KIND_TELEMETRY,
     KIND_TPUJOB,
     LABEL_GROUP,
     LABEL_JOB_NAME,
@@ -79,6 +81,16 @@ from tf_operator_tpu.obs.spans import (
     first_step_span_name,
     job_trace,
     trace8,
+)
+from tf_operator_tpu.obs.telemetry import (
+    CAUSE_CKPT_STALL,
+    CAUSE_COMPILE_INIT,
+    CAUSE_DATA_WAIT,
+    CAUSE_RESIZE as GOODPUT_RESIZE,
+    CAUSE_RESTART as GOODPUT_RESTART,
+    StragglerTracker,
+    goodput_decomposition,
+    job_telemetry,
 )
 from tf_operator_tpu.rendezvous.env import (
     ENV_API_SERVER,
@@ -126,6 +138,10 @@ ANNOTATION_PORT = "tpujob.dev/rendezvous-port"
 # preemption lifecycle (cause ``preemption``, warm-resumed, backoff-exempt)
 # and clears the annotation store-side.
 ANNOTATION_PREEMPT = "tpujob.dev/preempt"
+# Straggler flag: stamped on a gang member Process whose host the detector
+# flagged (value = the host name); cleared when the host's step times
+# return under the bar for the hysteresis window.
+ANNOTATION_SLOW_HOST = "tpujob.dev/slow-host"
 
 # Gang-restart causes (status.last_restart_cause + the by-cause metric).
 # Preemption restarts are graceful — checkpoint-resumed and NOT counted
@@ -248,6 +264,14 @@ class TPUJobController:
         self._open_schedwait: Dict[str, Dict[str, Any]] = {}
         self._open_queued: Dict[str, Dict[str, Any]] = {}  # uid -> span info
         self._open_resize: Dict[str, Dict[str, Any]] = {}  # uid -> span info
+        self._goodput_observed: set = set()  # uids whose goodput was folded
+        # Straggler detection (obs/telemetry.py): per-job flap-damped
+        # trackers over the live telemetry stream, plus the fleet-wide
+        # slow-host set place_gang deprioritizes for NEW gangs. Same
+        # race-freedom argument as the span maps: single-flight-per-key.
+        self._stragglers: Dict[str, StragglerTracker] = {}  # uid -> tracker
+        self._straggler_seen_seq: Dict[str, int] = {}  # uid -> last window seq
+        self._slow_hosts: Dict[str, float] = {}  # host -> flagged-at time
         # Workqueue shards (run(shards=N) expands): keys hash by NAMESPACE,
         # so one tenant's burst cannot head-of-line-block another tenant's
         # keys behind a single queue mutex, while all of one job's events
@@ -559,6 +583,7 @@ class TPUJobController:
             # COMPLETION (they are the timeline) but not deletion.
             self._delete_children(namespace, name, cleanup=CleanupPolicy.ALL)
             self._delete_spans(namespace, name)
+            self._delete_telemetry(namespace, name)
             self.expectations.delete_expectations(self._exp_key(key))
             self._release_job(key)
             return
@@ -703,6 +728,23 @@ class TPUJobController:
         for s in spans:
             try:
                 self.store.delete(KIND_SPAN, namespace, s.metadata.name)
+            except NotFoundError:
+                pass
+
+    def _delete_telemetry(self, namespace: str, job_name: str) -> None:
+        """GC a deleted job's telemetry ring alongside its spans — the
+        stream is live-observability, not an archive; it goes with the
+        job (same rule as spans: survives completion, not deletion)."""
+        try:
+            batches = self.store.list(
+                KIND_TELEMETRY, namespace=namespace,
+                label_selector={LABEL_JOB_NAME: job_name},
+            )
+        except Exception:  # noqa: BLE001 — GC of telemetry is best-effort
+            return
+        for b in batches:
+            try:
+                self.store.delete(KIND_TELEMETRY, namespace, b.metadata.name)
             except NotFoundError:
                 pass
 
@@ -1070,6 +1112,10 @@ class TPUJobController:
                     attrs={"track": "running"},
                     name=self._span_name(job, "running"),
                 )
+            # Live telemetry consumer: evaluate any new cross-rank
+            # step-time windows for stragglers (resync ticks drive this
+            # between watch events).
+            self._check_stragglers(job, processes)
 
         # -- evaluator restarts (per-replica, not gang) -------------------
         for r in evaluators:
@@ -1143,10 +1189,18 @@ class TPUJobController:
         if info is None:
             return
         self.tracer.close(info["ns"], info["name"], now)
+        downtime = max(0.0, now - info["start"])
         self.metrics.observe_hist(
             "tpujob_restart_downtime_seconds",
-            max(0.0, now - info["start"]),
+            downtime,
             labels={"cause": info["cause"]},
+        )
+        # Goodput: the SAME width feeds lost-seconds under cause
+        # "restart" — one close point, so the histogram and the goodput
+        # surface can never double-count each other.
+        self.metrics.inc(
+            "tpujob_lost_seconds_total", downtime,
+            labels={"cause": GOODPUT_RESTART},
         )
 
     # ---- elastic gangs (r12) --------------------------------------------
@@ -1378,10 +1432,17 @@ class TPUJobController:
             return
         self._open_resize.pop(job.metadata.uid, None)
         self.tracer.close(info["ns"], info["name"], now)
+        downtime = max(0.0, now - info["start"])
         self.metrics.observe_hist(
             "tpujob_resize_downtime_seconds",
-            max(0.0, now - info["start"]),
+            downtime,
             labels={"direction": info["direction"]},
+        )
+        # Goodput: resize downtime lost-seconds from the same close (see
+        # _close_restart_span — one source per cause, never double-counted).
+        self.metrics.inc(
+            "tpujob_lost_seconds_total", downtime,
+            labels={"cause": GOODPUT_RESIZE},
         )
 
     def _observe_first_step(self, job: TPUJob) -> None:
@@ -1473,6 +1534,185 @@ class TPUJobController:
                     tokens = 0.0
                 if tokens > 0:
                     self.metrics.inc("tpujob_request_tokens_total", tokens)
+
+    def _observe_goodput(self, job: TPUJob, end: float) -> None:
+        """Fold the job's goodput decomposition into metrics, once per
+        job at terminal: the telemetry/first-step-derived causes
+        (compile-init, data-wait, ckpt-stall) increment
+        ``tpujob_lost_seconds_total`` here — restart and resize already
+        did at their span closes, the single source both the downtime
+        histograms and the counter share — and the per-job ratio lands
+        in the ``tpujob_goodput_ratio`` gauge."""
+        uid = job.metadata.uid
+        if uid in self._goodput_observed:
+            return
+        self._goodput_observed.add(uid)
+        try:
+            spans = job_trace(self.store, job.metadata.namespace, job.metadata.name)
+            batches = job_telemetry(
+                self.store, job.metadata.namespace, job.metadata.name
+            )
+        except Exception:  # noqa: BLE001 — telemetry read is best-effort
+            return
+        g = goodput_decomposition(
+            spans, batches, job.metadata.creation_timestamp, end
+        )
+        for cause in (CAUSE_COMPILE_INIT, CAUSE_DATA_WAIT, CAUSE_CKPT_STALL):
+            v = g["lost_s"].get(cause, 0.0)
+            if v > 0:
+                self.metrics.inc(
+                    "tpujob_lost_seconds_total", v, labels={"cause": cause}
+                )
+        self.metrics.set_gauge(
+            "tpujob_goodput_ratio", g["goodput_ratio"],
+            labels={
+                "namespace": job.metadata.namespace,
+                "job": job.metadata.name,
+            },
+        )
+
+    def _check_stragglers(self, job: TPUJob, processes: List[Process]) -> None:
+        """Evaluate new cross-rank telemetry windows for stragglers.
+
+        A window is one batch seq with a report from EVERY reporting
+        rank; each unevaluated complete window feeds the job's
+        flap-damped tracker (median-ratio rule, obs/telemetry.py). A
+        flag annotates the member's Process with ANNOTATION_SLOW_HOST,
+        emits a SlowHost event (message carries window count and ratio —
+        the bench's oracle), raises the by-host gauge, and enters the
+        host into the fleet-wide deprioritized set place_gang consults
+        for NEW gangs. Clean windows clear all four. Best-effort end to
+        end — a telemetry read failure never fails a sync."""
+        uid = job.metadata.uid
+        try:
+            batches = job_telemetry(
+                self.store, job.metadata.namespace, job.metadata.name
+            )
+        except Exception:  # noqa: BLE001
+            return
+        if not batches:
+            return
+        by_seq: Dict[int, Dict[int, float]] = {}
+        ranks: set = set()
+        rank_host: Dict[int, str] = {}
+        for b in batches:
+            ranks.add(b.rank)
+            if b.host:
+                rank_host[b.rank] = b.host
+            if b.step_time_s > 0:
+                by_seq.setdefault(b.seq, {})[b.rank] = b.step_time_s
+        # Host binding from the scheduler beats the worker-reported
+        # hostname (single-machine test rigs share one HOSTNAME).
+        gang = self._gang_roles(job)
+        by_role = {
+            (p.spec.replica_type, p.spec.replica_index): p for p in processes
+        }
+        for i, r in enumerate(gang):
+            p = by_role.get((r[0].value, r[1]))
+            if p is not None and p.spec.node_name:
+                rank_host[i] = p.spec.node_name
+        last = self._straggler_seen_seq.get(uid, -1)
+        # A window only counts once EVERY gang member has reported it —
+        # gating on ranks-seen would evaluate (and burn tracker windows
+        # on) early partial windows while slower ranks are still flushing.
+        need = len(gang) if gang else len(ranks)
+        complete = sorted(
+            s for s, w in by_seq.items() if s > last and len(w) >= need
+        )
+        if not complete:
+            return
+        tracker = self._stragglers.setdefault(uid, StragglerTracker())
+        for seq in complete:
+            window = by_seq[seq]
+            med = statistics.median(window.values())
+            flagged, cleared = tracker.observe(window)
+            for rank in flagged:
+                host = rank_host.get(rank, "")
+                self._flag_slow_host(
+                    job, rank, host, by_role, gang,
+                    windows=tracker.windows_seen,
+                    ratio=(window[rank] / med) if med > 0 else 0.0,
+                )
+            for rank in cleared:
+                self._clear_slow_host(job, rank, rank_host.get(rank, ""), by_role, gang)
+        self._straggler_seen_seq[uid] = complete[-1]
+
+    def _flag_slow_host(
+        self,
+        job: TPUJob,
+        rank: int,
+        host: str,
+        by_role: Dict[Tuple[str, int], Process],
+        gang: List[Tuple[ReplicaType, int]],
+        windows: int,
+        ratio: float,
+    ) -> None:
+        label = host or f"rank-{rank}"
+        self.recorder.warning(
+            job, ev.REASON_SLOW_HOST,
+            f"rank {rank} on host {label} flagged as straggler after "
+            f"{windows} windows (step time {ratio:.2f}x gang median); "
+            f"deprioritizing host for new gangs",
+        )
+        self.metrics.set_gauge(
+            "tpujob_straggler_host", 1.0, labels={"host": label}
+        )
+        if host:
+            self._slow_hosts[host] = time.time()
+        if rank < len(gang):
+            r = gang[rank]
+            p = by_role.get((r[0].value, r[1]))
+            if p is not None:
+                self._annotate_process(p, ANNOTATION_SLOW_HOST, label)
+
+    def _clear_slow_host(
+        self,
+        job: TPUJob,
+        rank: int,
+        host: str,
+        by_role: Dict[Tuple[str, int], Process],
+        gang: List[Tuple[ReplicaType, int]],
+    ) -> None:
+        label = host or f"rank-{rank}"
+        self.recorder.normal(
+            job, ev.REASON_SLOW_HOST_CLEARED,
+            f"rank {rank} on host {label} back under the straggler bar; "
+            f"host eligible for new gangs again",
+        )
+        self.metrics.clear_gauge(
+            "tpujob_straggler_host", labels={"host": label}
+        )
+        if host:
+            self._slow_hosts.pop(host, None)
+        if rank < len(gang):
+            r = gang[rank]
+            p = by_role.get((r[0].value, r[1]))
+            if p is not None:
+                self._annotate_process(p, ANNOTATION_SLOW_HOST, None)
+
+    def _annotate_process(
+        self, process: Process, key: str, value: Optional[str]
+    ) -> None:
+        """Set (value) or remove (None) one annotation on a child process,
+        best-effort."""
+
+        def mutate(cur):
+            if value is None:
+                if key not in cur.metadata.annotations:
+                    return False
+                cur.metadata.annotations.pop(key, None)
+            else:
+                if cur.metadata.annotations.get(key) == value:
+                    return False
+                cur.metadata.annotations[key] = value
+
+        try:
+            self.store.update_with_retry(
+                KIND_PROCESS, process.metadata.namespace,
+                process.metadata.name, mutate,
+            )
+        except Exception:  # noqa: BLE001 — the flag is advisory
+            pass
 
     def _depot_peers(self) -> List[str]:
         """Depot URLs of hosts that can serve peer warm restores: every
@@ -1684,6 +1924,7 @@ class TPUJobController:
                         job, procs, ranks=ranks, bound_slots=bound_slots,
                         ttl=self._job_heartbeat_ttl(job),
                         reserved=self.fleet.reserved_for_others(job),
+                        deprioritized=set(self._slow_hosts),
                     )
                 except SchedulingError as exc:
                     self.recorder.warning(
@@ -2191,10 +2432,17 @@ class TPUJobController:
             self._observe_first_step(job)
             self._observe_ckpt_spans(job)
             self._observe_serve_spans(job)
+            self._observe_goodput(job, end)
             self._sched_observed.discard(uid)
             self._ttfs_observed.discard(uid)
             self._ckpt_observed.discard(uid)
             self._serve_observed.discard(uid)
+            self._goodput_observed.discard(uid)
+        # Straggler bookkeeping dies with the job; a host the job flagged
+        # stays flagged (the signal is about the HOST) until a later
+        # running job's clean windows clear it.
+        self._stragglers.pop(uid, None)
+        self._straggler_seen_seq.pop(uid, None)
         self._delete_children(
             job.metadata.namespace, job.metadata.name, job.spec.run_policy.cleanup_policy
         )
@@ -2275,6 +2523,10 @@ class TPUJobController:
                 )
                 world = job.status.world_size or fresh.status.world_size
             eval_metrics = fresh.status.eval_metrics
+            # profile_directive is API-authored end to end (the CLI/server
+            # publishes requests, the chief acks captures) — always keep
+            # the store's copy, exactly like eval_metrics.
+            profile_directive = fresh.status.profile_directive
             fresh.status = job.status
             fresh.status.restart_count = count
             fresh.status.preemption_count = pcount
@@ -2285,6 +2537,7 @@ class TPUJobController:
             fresh.status.resize_history = history
             fresh.status.world_size = world
             fresh.status.eval_metrics = eval_metrics
+            fresh.status.profile_directive = profile_directive
             # The rendezvous-port annotation is managed store-side
             # (_rendezvous_port persists it, _clear_rendezvous removes it);
             # merging it from a stale cached copy here would resurrect a
@@ -2355,7 +2608,9 @@ def _status_equal_ignoring_heartbeat(a, b) -> bool:
     import dataclasses
 
     return dataclasses.replace(
-        a, last_reconcile_time=None, eval_metrics={}, resize_directive={}
+        a, last_reconcile_time=None, eval_metrics={}, resize_directive={},
+        profile_directive={},
     ) == dataclasses.replace(
-        b, last_reconcile_time=None, eval_metrics={}, resize_directive={}
+        b, last_reconcile_time=None, eval_metrics={}, resize_directive={},
+        profile_directive={},
     )

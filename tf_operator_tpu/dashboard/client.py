@@ -105,6 +105,24 @@ class TPUJobClient:
         (Perfetto-loadable: traceEvents + derived timings in otherData)."""
         return self._request("GET", f"/api/tpujob/{namespace}/{name}/trace")
 
+    def telemetry(self, namespace: str, name: str) -> Dict[str, Any]:
+        """Live step telemetry: {"job", "batches", "summary", "goodput"} —
+        per-rank ring batches plus the gang summary (tokens/s, MFU,
+        step-time spread) and the goodput decomposition."""
+        return self._request("GET", f"/api/tpujob/{namespace}/{name}/telemetry")
+
+    def profile(self, namespace: str, name: str, steps: int,
+                profile_dir: str = "") -> Dict[str, Any]:
+        """Publish an on-demand profile directive: the chief wraps the
+        next ``steps`` steps in profile_ctx and acks with a
+        profile-capture span carrying the xplane path."""
+        body: Dict[str, Any] = {"steps": int(steps)}
+        if profile_dir:
+            body["dir"] = profile_dir
+        return self._request(
+            "POST", f"/api/tpujob/{namespace}/{name}/profile", body
+        )
+
     def logs(self, namespace: str, process_name: str) -> str:
         raw = self._request("GET", f"/api/process/{namespace}/{process_name}/logs")
         return raw if isinstance(raw, str) else raw.decode(errors="replace")

@@ -8,6 +8,11 @@ Routes (reference: dashboard/backend/handler/api_handler.go:74-113):
 - DELETE /api/tpujob/{ns}/{name}          — delete job (controller GCs children)
 - GET    /api/tpujob/{ns}/{name}/trace    — the job's lifecycle trace as
   Chrome trace-event JSON (Perfetto-loadable; obs/export.py)
+- GET    /api/tpujob/{ns}/{name}/telemetry — the job's live telemetry ring
+  (per-rank step batches + gang summary + goodput decomposition)
+- POST   /api/tpujob/{ns}/{name}/profile  — publish an on-demand profile
+  directive (body: {"steps": N, "dir": path?}); the chief captures the
+  next N steps and acks with a profile-capture span
 - GET    /api/process/{ns}/{name}/logs    — process logs (kubelet-log analogue)
 - GET    /api/events?namespace=           — events (the test oracle surface)
 - GET    /api/namespaces                  — namespaces in use
@@ -43,6 +48,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, unquote, urlparse
@@ -73,6 +79,8 @@ from tf_operator_tpu.dashboard.ui import UI_HTML as _UI_HTML
 
 _JOB_RE = re.compile(r"^/api/tpujob/([^/]+)/([^/]+)$")
 _TRACE_RE = re.compile(r"^/api/tpujob/([^/]+)/([^/]+)/trace$")
+_TELEMETRY_RE = re.compile(r"^/api/tpujob/([^/]+)/([^/]+)/telemetry$")
+_PROFILE_RE = re.compile(r"^/api/tpujob/([^/]+)/([^/]+)/profile$")
 _LOGS_RE = re.compile(r"^/api/process/([^/]+)/([^/]+)/logs$")
 _OBJ_KIND_RE = re.compile(r"^/api/v1/([A-Za-z]+)$")
 _OBJ_RE = re.compile(r"^/api/v1/([A-Za-z]+)/([^/]+)/([^/]+)$")
@@ -214,6 +222,39 @@ class _Handler(BaseHTTPRequestHandler):
             if job is None and not spans:
                 return self._error(404, f"no trace for tpujob {tns}/{tname}")
             return self._json(200, to_chrome_trace(spans, job=job))
+
+        m = _TELEMETRY_RE.match(path)
+        if m:
+            segs = _decode_segments(m)
+            if segs is None:
+                return self._error(400, "invalid name in path (empty or contains '/')")
+            tns, tname = segs
+            from tf_operator_tpu.obs.spans import job_trace
+            from tf_operator_tpu.obs.telemetry import (
+                goodput_decomposition,
+                job_telemetry,
+                telemetry_summary,
+            )
+
+            try:
+                job = self.store.get(KIND_TPUJOB, tns, tname)
+            except NotFoundError:
+                job = None
+            batches = job_telemetry(self.store, tns, tname)
+            if job is None and not batches:
+                return self._error(404, f"no telemetry for tpujob {tns}/{tname}")
+            spans = job_trace(self.store, tns, tname)
+            submit = job.metadata.creation_timestamp if job else 0.0
+            end = (job.status.completion_time if job else None) or time.time()
+            return self._json(
+                200,
+                {
+                    "job": f"{tns}/{tname}",
+                    "batches": [to_doc(b) for b in batches],
+                    "summary": telemetry_summary(batches),
+                    "goodput": goodput_decomposition(spans, batches, submit, end),
+                },
+            )
 
         m = _JOB_RE.match(path)
         if m:
@@ -444,6 +485,45 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json(201, to_doc(self.store.create(obj)))
             except AlreadyExistsError as exc:
                 return self._json(409, {"error": str(exc), "code": "already_exists"})
+        m = _PROFILE_RE.match(path)
+        if m:
+            # On-demand profiling: bump the monotonic profile-directive
+            # epoch on status (same protocol as resize_directive — the
+            # chief observes the new epoch at its next flush boundary,
+            # wraps N steps in profile_ctx, and acks completed_epoch).
+            segs = _decode_segments(m)
+            if segs is None:
+                return self._error(400, "invalid name in path (empty or contains '/')")
+            pns, pname = segs
+            try:
+                body = self._read_body()
+            except (ValueError, TypeError) as exc:
+                return self._error(400, f"invalid body: {exc}")
+            try:
+                steps = int(body.get("steps", 0))
+            except (ValueError, TypeError):
+                return self._error(400, "steps must be an integer")
+            if steps <= 0:
+                return self._error(400, "steps must be > 0")
+            prof_dir = str(body.get("dir", "") or "")
+            issued = {}
+
+            def arm(job):
+                cur = job.status.profile_directive or {}
+                issued.clear()
+                issued.update(
+                    {
+                        "epoch": int(cur.get("epoch", 0)) + 1,
+                        "steps": steps,
+                        "dir": prof_dir,
+                        "time": time.time(),
+                    }
+                )
+                job.status.profile_directive = dict(issued)
+
+            if not self.store.update_with_retry(KIND_TPUJOB, pns, pname, arm):
+                return self._error(404, f"tpujob {pns}/{pname} not found")
+            return self._json(200, {"profile_directive": issued})
         if path != "/api/tpujob":
             return self._error(404, "POST only at /api/tpujob or /api/v1/{kind}")
         length = int(self.headers.get("Content-Length", 0))

@@ -46,6 +46,7 @@ UI_HTML = r"""<!doctype html>
  label{font-size:13px;color:var(--muted);display:block}
  .replica{border:1px dashed #ccc;border-radius:6px;padding:.6rem;margin:.4rem 0}
  textarea{width:100%;min-height:180px}
+ svg.spark{background:#f7f7f7;border:1px solid var(--line);border-radius:3px}
 </style></head>
 <body>
 <header>
@@ -89,6 +90,29 @@ function age(ts){
   return (s/3600).toFixed(1) + 'h';
 }
 function fmtTime(ts){ return ts ? new Date(ts*1000).toLocaleString() : ''; }
+// Inline SVG sparkline over a numeric series (newest telemetry window last).
+function spark(values, color){
+  const W = 160, H = 34, P = 2;
+  const svg = document.createElementNS('http://www.w3.org/2000/svg','svg');
+  svg.setAttribute('width', W); svg.setAttribute('height', H);
+  svg.setAttribute('class','spark');
+  if (values.length){
+    const mx = Math.max(...values), mn = Math.min(...values);
+    const span = (mx - mn) || 1;
+    const pts = values.map((v,i)=>{
+      const x = values.length > 1 ? P + i*(W-2*P)/(values.length-1) : W/2;
+      const y = H - P - (v - mn)*(H-2*P)/span;
+      return x.toFixed(1)+','+y.toFixed(1);
+    }).join(' ');
+    const pl = document.createElementNS('http://www.w3.org/2000/svg','polyline');
+    pl.setAttribute('points', pts);
+    pl.setAttribute('fill','none');
+    pl.setAttribute('stroke', color||'#1a6fb5');
+    pl.setAttribute('stroke-width','1.5');
+    svg.appendChild(pl);
+  }
+  return svg;
+}
 function qns(){ return $ns.value ? ('?namespace=' + encodeURIComponent($ns.value)) : ''; }
 
 async function refreshNamespaces(){
@@ -198,6 +222,45 @@ async function viewJob(ns, name){
       el('table',null, el('thead',null, el('tr',null,
         ...['Epoch','Direction','World','Cause','Time'].map(h=>el('th',null,h)))), ztb)));
   }
+
+  // Live step telemetry (r13): sparklines over the per-rank ring batches
+  // plus the gang summary and goodput decomposition.
+  try{
+    const t = await api('/api/tpujob/'+ns+'/'+name+'/telemetry');
+    if ((t.batches||[]).length){
+      const s = t.summary||{}, g = t.goodput||{};
+      const bySeq = {};
+      for (const b of t.batches){
+        const k = b.seq;
+        if (!bySeq[k]) bySeq[k] = {tok:0, mfu:0, n:0};
+        bySeq[k].tok += (b.tokens_per_s||0);
+        bySeq[k].mfu += (b.mfu||0); bySeq[k].n += 1;
+      }
+      const seqs = Object.keys(bySeq).map(Number).sort((a,b)=>a-b);
+      const tok = seqs.map(k=>bySeq[k].tok);
+      const mfu = seqs.map(k=>bySeq[k].mfu/(bySeq[k].n||1));
+      const tkv = el('div',{class:'kv'});
+      const spread = s.spread ? s.spread.toFixed(2)+'x' : '';
+      const tpairs = [
+        ['Tokens/s', (s.tokens_per_s||0).toLocaleString(undefined,{maximumFractionDigits:1})],
+        ['MFU', (s.mfu||0).toFixed(3)],
+        ['Step', String(s.last_step||0) + ' (ranks: '+(s.ranks||0)+')'],
+        ['Step-time spread', spread],
+      ];
+      if (g.goodput_ratio !== undefined){
+        const lost = Object.entries(g.lost_s||{}).filter(([,v])=>v>0)
+          .map(([c,v])=>c+': '+v.toFixed(1)+'s').join('  ');
+        tpairs.push(['Goodput', g.goodput_ratio.toFixed(3) + (lost? '  ('+lost+')':'')]);
+      }
+      if (s.degraded) tpairs.push(['Degraded', 'some ranks report local-only']);
+      for (const [k,v] of tpairs){ tkv.appendChild(el('b',null,k)); tkv.appendChild(el('span',null,v)); }
+      root.appendChild(el('div',{class:'card'}, el('h2',null,'Telemetry'),
+        tkv,
+        el('div',{class:'row'},
+          el('span',null, el('label',null,'tokens/s'), spark(tok,'#1a6fb5')),
+          el('span',null, el('label',null,'MFU'), spark(mfu,'#0a7d32')))));
+    }
+  }catch(err){/* telemetry is best-effort; the card simply stays absent */}
 
   // Evaluator-reported scores (TPUJobStatus.eval_metrics).
   const em = j.status.eval_metrics||{};

@@ -257,6 +257,133 @@ class JobContext:
             },
         )
 
+    # -- live telemetry (obs/telemetry.py) ---------------------------------
+
+    def telemetry(
+        self,
+        flush_every: int = 10,
+        tokens_per_step: float = 0.0,
+        flops_per_step: float = 0.0,
+        n_chips: int = 0,
+        host: str = "",
+        profile_root: str = "",
+    ):
+        """Build this rank's :class:`~tf_operator_tpu.obs.telemetry.StepTelemetry`
+        reporter. The workload calls ``rep.step(duration_s, ...)`` once per
+        step and ``rep.close()`` at exit; every ``flush_every`` steps one
+        compact batch ships through the operator API into the job's
+        telemetry ring. Without an API server (ENV_API_SERVER unset) or
+        when it dies mid-run, the reporter degrades to local-only
+        accounting — a telemetry failure is never a job failure; the gap
+        surfaces as ``degraded`` on the next delivered batch and a
+        ``telemetry-degraded`` span attribute at close (PR 11 contract).
+
+        Flush boundaries double as the on-demand-profiling poll point:
+        rank 0 checks ``status.profile_directive`` and wraps the next N
+        steps in ``profile_ctx``, reporting the capture back as a
+        ``profile-capture`` span + directive ack."""
+        from tf_operator_tpu.obs.telemetry import StepTelemetry, TelemetryRecorder
+
+        base = os.environ.get(ENV_API_SERVER, "")
+        recorder = None
+        if base and self.trace_id and self.job_name:
+            from tf_operator_tpu.runtime.remote_store import RemoteStore
+
+            recorder = TelemetryRecorder(RemoteStore(base))
+        chief = self.process_id == 0
+        rep = StepTelemetry(
+            recorder,
+            namespace=self.namespace,
+            job_name=self.job_name,
+            trace_id=self.trace_id,
+            rank=self.process_id,
+            host=host or os.environ.get("HOSTNAME", ""),
+            flush_every=flush_every,
+            tokens_per_step=tokens_per_step,
+            flops_per_step=flops_per_step,
+            n_chips=n_chips or self.chips or 1,
+            start_step=self.resume_step,
+            poll_directive=self.poll_profile_directive if chief else None,
+            on_capture=self._report_profile_capture if chief else None,
+            profile_root=profile_root
+            or (os.path.join(self.checkpoint_dir, "profile")
+                if self.checkpoint_dir else ""),
+        )
+        return rep
+
+    def close_telemetry(self, rep) -> None:
+        """Final flush; if any batches were lost to API unreachability,
+        leave the degradation receipt as a span attribute."""
+        if rep is None:  # telemetry() returns None outside the operator
+            return
+        try:
+            degraded = bool(rep.degraded)
+            rep.close()
+            if degraded:
+                now = time.time()
+                self.record_span(
+                    "telemetry", now, now,
+                    attrs={"telemetry_degraded": "1", "track": "telemetry"},
+                )
+        except Exception:  # noqa: BLE001 — teardown is never fatal
+            pass
+
+    # -- on-demand profiling directive (same protocol as resize) -----------
+
+    def poll_profile_directive(self) -> Dict[str, Any] | None:
+        """Fetch the job's live profile directive ({"epoch", "steps",
+        "dir", ...}; None when never requested or the API is unreachable).
+        Workers compare ``epoch`` against the last epoch they captured."""
+        base = os.environ.get(ENV_API_SERVER, "")
+        if not base or not self.job_name:
+            return None
+        from tf_operator_tpu.api.types import KIND_TPUJOB
+        from tf_operator_tpu.runtime.remote_store import RemoteStore
+
+        try:
+            job = RemoteStore(base).get(KIND_TPUJOB, self.namespace, self.job_name)
+        except Exception:  # noqa: BLE001 — polling must never kill a step
+            return None
+        if job is None:
+            return None
+        directive = dict(job.status.profile_directive or {})
+        return directive or None
+
+    def _report_profile_capture(self, epoch: int, steps: int, path: str) -> None:
+        """Chief-only capture receipt: a ``profile-capture`` span carrying
+        the xplane directory, plus ``completed_epoch``/``xplane`` acked
+        back into the directive so `tpujob profile` can see it landed."""
+        now = time.time()
+        self.record_span(
+            "profile-capture", now, now,
+            attrs={
+                "xplane": str(path), "epoch": str(epoch),
+                "steps": str(steps), "track": "profile",
+            },
+        )
+        base = os.environ.get(ENV_API_SERVER, "")
+        if not base or not self.job_name:
+            return
+        from tf_operator_tpu.api.types import KIND_TPUJOB
+        from tf_operator_tpu.runtime.remote_store import RemoteStore
+        from tf_operator_tpu.runtime.store import update_with_retry_loop
+
+        def mutate(job):
+            cur = job.status.profile_directive or {}
+            if int(cur.get("epoch", 0)) != int(epoch):
+                return False  # a newer request superseded this capture
+            job.status.profile_directive = {
+                **cur, "completed_epoch": int(epoch), "xplane": str(path),
+            }
+
+        try:
+            update_with_retry_loop(
+                RemoteStore(base), KIND_TPUJOB, self.namespace, self.job_name,
+                mutate, transient_timeout=30.0,
+            )
+        except Exception:  # noqa: BLE001 — the span is the primary receipt
+            pass
+
     # -- elastic resize barrier (r12) --------------------------------------
     #
     # The controller offers survivors a new world size by writing a resize

@@ -178,6 +178,7 @@ class GangScheduler:
         bound_slots: Optional[Dict[int, str]] = None,
         ttl: Optional[float] = None,
         reserved: Optional[Dict[str, int]] = None,
+        deprioritized: Optional[set] = None,
     ) -> Dict[str, Host]:
         """Atomically choose a Host for every process in ``procs``.
 
@@ -205,6 +206,12 @@ class GangScheduler:
         name, so placement is deterministic under equal scores. Best-fit
         leaves the emptiest hosts intact for large gangs; small jobs land
         in fragmentation holes instead of carving up fresh hosts.
+
+        ``deprioritized`` names hosts the straggler detector has flagged
+        (obs/telemetry.py): new gangs avoid them whenever the remaining
+        fleet can hold the gang, but they stay SCHEDULABLE — a flagged
+        host is slow, not broken, and refusing it outright would turn a
+        soft signal into artificial capacity loss.
         """
         want_hosts = max(1, job.spec.topology.num_hosts)
         states = self._states(job.spec.topology.slice_type, now, ttl)
@@ -246,12 +253,29 @@ class GangScheduler:
                     f"slice {job.spec.topology.slice_type or '(any)'}, have "
                     f"{len(states)}"
                 )
-            chosen = _pack_hosts(
-                candidates,
-                k=len(open_slots),
-                need=max(slot_need[s] for s in open_slots),
-                pinned_domains={_domain(st.host) for st in slot_host.values()},
-            )
+            pinned_domains = {_domain(st.host) for st in slot_host.values()}
+            need = max(slot_need[s] for s in open_slots)
+            chosen = None
+            if deprioritized:
+                # Straggler avoidance: pack on the unflagged fleet first;
+                # only when that cannot hold the gang do flagged hosts
+                # re-enter the pool (soft preference, not a cordon).
+                preferred = [
+                    s for s in candidates
+                    if s.host.metadata.name not in deprioritized
+                ]
+                if len(preferred) >= len(open_slots):
+                    chosen = _pack_hosts(
+                        preferred, k=len(open_slots), need=need,
+                        pinned_domains=pinned_domains,
+                    )
+            if chosen is None:
+                chosen = _pack_hosts(
+                    candidates,
+                    k=len(open_slots),
+                    need=need,
+                    pinned_domains=pinned_domains,
+                )
             if chosen is not None:
                 for slot, state in zip(open_slots, chosen):
                     slot_host[slot] = state
@@ -263,7 +287,11 @@ class GangScheduler:
                 # capacity") and heterogeneous slot demands still place.
                 by_free = sorted(
                     candidates,
-                    key=lambda s: (-s.free_chips, s.host.metadata.name),
+                    key=lambda s: (
+                        1 if s.host.metadata.name in (deprioritized or ()) else 0,
+                        -s.free_chips,
+                        s.host.metadata.name,
+                    ),
                 )[: len(open_slots)]
                 heaviest = sorted(open_slots, key=lambda s: (-slot_need[s], s))
                 for slot, state in zip(heaviest, by_free):

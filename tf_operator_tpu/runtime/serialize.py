@@ -21,12 +21,14 @@ from tf_operator_tpu.api.types import (
     KIND_PROCESS,
     KIND_QUEUE,
     KIND_SPAN,
+    KIND_TELEMETRY,
     KIND_TPUJOB,
     ObjectMeta,
     TPUJob,
     _to_jsonable,
 )
 from tf_operator_tpu.obs.spans import Span
+from tf_operator_tpu.obs.telemetry import Telemetry
 from tf_operator_tpu.sched.objects import PriorityClass, Queue, QueueSpec
 from tf_operator_tpu.runtime.objects import (
     Endpoint,
@@ -98,6 +100,11 @@ def _span_from_doc(doc: Dict[str, Any]) -> Span:
     return Span(metadata=_meta(doc), **d)
 
 
+def _telemetry_from_doc(doc: Dict[str, Any]) -> Telemetry:
+    d = {k: v for k, v in doc.items() if k not in ("metadata", "kind")}
+    return Telemetry(metadata=_meta(doc), **d)
+
+
 def _priority_class_from_doc(doc: Dict[str, Any]) -> PriorityClass:
     d = {k: v for k, v in doc.items() if k not in ("metadata", "kind")}
     return PriorityClass(metadata=_meta(doc), **d)
@@ -114,6 +121,7 @@ _DECODERS = {
     KIND_EVENT: _event_from_doc,
     KIND_LEASE: _lease_from_doc,
     KIND_SPAN: _span_from_doc,
+    KIND_TELEMETRY: _telemetry_from_doc,
     KIND_PRIORITY_CLASS: _priority_class_from_doc,
     KIND_QUEUE: _queue_from_doc,
     KIND_TPUJOB: lambda doc: TPUJob.from_dict(doc),

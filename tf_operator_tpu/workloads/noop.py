@@ -22,9 +22,16 @@ def main(ctx: JobContext) -> None:
     # step" is workload code running at all — submit -> here is exactly
     # the control-plane share of time-to-first-step.
     ctx.mark_first_step(0)
+    # Emit one telemetry batch so even the cheapest payload exercises the
+    # ring end to end (trace-smoke golden-checks /telemetry on noop jobs).
+    rep = ctx.telemetry(flush_every=1)
     sleep_s = float(ctx.workload.get("sleep_s", 0))
+    t0 = time.time()
     if sleep_s:
         time.sleep(sleep_s)
+    if rep:
+        rep.step(max(time.time() - t0, 1e-6))
+    ctx.close_telemetry(rep)
     code = int(ctx.workload.get("exit_code", 0))
     if code:
         sys.exit(code)

@@ -48,13 +48,33 @@ def main(ctx: JobContext) -> None:
         ctx.replica_type == "Worker" and ctx.replica_index == 0
     )
 
+    # Step telemetry (r13): every member reports step batches through the
+    # ring; `slow_ranks` + `slow_extra_s` let the telemetry bench model a
+    # deliberately slow host, `data_wait_s` injects input-pipeline stall
+    # that goodput accounting must attribute to cause data-wait.
+    data_wait_s = float(wl.get("data_wait_s", 0.0))
+    extra_s = (
+        float(wl.get("slow_extra_s", 0.0))
+        if ctx.process_id in [int(r) for r in wl.get("slow_ranks", [])]
+        else 0.0
+    )
+    rep = ctx.telemetry(
+        flush_every=int(wl.get("telemetry_every", 2)),
+        tokens_per_step=float(wl.get("tokens_per_step", 0.0)),
+        flops_per_step=float(wl.get("flops_per_step", 0.0)),
+    )
+
     if not (is_chief and wl.get("checkpoint_dir")):
         # Non-chief members just pace the same wall clock; gang restart /
         # drain semantics act on them via signals, not their own logic.
         for i in range(steps):
-            time.sleep(sleep_s)
+            t0 = time.time()
+            time.sleep(sleep_s + data_wait_s + extra_s)
             if i == 0:
                 ctx.mark_first_step(1)
+            if rep:
+                rep.step(time.time() - t0, data_wait_s=data_wait_s)
+        ctx.close_telemetry(rep)
         return
 
     import numpy as np
@@ -88,14 +108,23 @@ def main(ctx: JobContext) -> None:
             f"has only {start} — the warm-restart env over-promised"
         )
     for s in range(start + 1, steps + 1):
-        time.sleep(sleep_s)
+        t0 = time.time()
+        time.sleep(sleep_s + data_wait_s + extra_s)
         state = {"step": np.asarray(s)}
         if s == start + 1:
             ctx.mark_first_step(s)
+        stall = 0.0
         if every and s % every == 0:
             if mgr.save(s, state):
                 now = time.time()
-                ctx.record_save_stall(s, now - mgr.last_save_stall_s, now)
+                stall = mgr.last_save_stall_s
+                ctx.record_save_stall(s, now - stall, now)
+        if rep:
+            rep.step(
+                time.time() - t0, data_wait_s=data_wait_s,
+                ckpt_stall_s=max(0.0, stall),
+            )
+    ctx.close_telemetry(rep)
     mgr.save(steps, state, wait=True)  # final save (no-op if step exists)
     mgr.close()
     log.info("soak workload done: steps=%d (resumed from %d)", steps, start)

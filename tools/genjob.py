@@ -227,6 +227,39 @@ def _scrape_sync_latency(server: str) -> dict:
         out["save_stall_p99_ms"] = round(
             _histogram_quantile(sb, sn, 0.99) * 1e3, 2
         )
+    # Goodput accounting (r13): the per-job goodput ratio gauge (mean over
+    # jobs that reported one) and the per-cause lost-seconds counters.
+    ratios = _parse_labeled_gauges(text, "tpujob_goodput_ratio")
+    if ratios:
+        out["goodput_jobs"] = len(ratios)
+        out["goodput_ratio"] = round(sum(ratios) / len(ratios), 4)
+    lost = _parse_cause_counters(text, "tpujob_lost_seconds_total")
+    if lost:
+        out["lost_seconds"] = {k: round(v, 3) for k, v in sorted(lost.items())}
+    return out
+
+
+def _parse_labeled_gauges(text: str, family: str) -> list:
+    """All sample values of one labeled gauge family from exposition text."""
+    import re
+
+    return [
+        float(m.group(1))
+        for line in text.splitlines()
+        for m in [re.match(rf"{family}\{{[^}}]*\}} (\S+)", line)]
+        if m
+    ]
+
+
+def _parse_cause_counters(text: str, family: str) -> dict:
+    """{cause: value} for a counter family labeled with cause="..."."""
+    import re
+
+    out: dict = {}
+    for line in text.splitlines():
+        m = re.match(rf'{family}\{{[^}}]*cause="([^"]+)"[^}}]*\}} (\S+)', line)
+        if m:
+            out[m.group(1)] = out.get(m.group(1), 0.0) + float(m.group(2))
     return out
 
 

@@ -1,0 +1,364 @@
+"""Telemetry-plane acceptance bench: the r13 CI receipt.
+
+In-process rig (Store + controller + dashboard API + 3 one-chip host
+agents — the elastic-soak topology) that runs a soak job whose rank 1 is
+deliberately slowed (``slow_ranks``/``slow_extra_s``) and every rank pays
+a known per-step input stall (``data_wait_s``), then gates the four
+things the telemetry plane promises:
+
+1. **Straggler flagged fast** — the reconciler's cross-rank median-ratio
+   detector raises the ``SlowHost`` event within <= 3 complete telemetry
+   windows of the slow rank's first report.
+2. **Placement avoids the flagged host** — a second gang submitted after
+   the flag lands only on unflagged hosts (``place_gang``
+   deprioritization).
+3. **On-demand profiling round-trips** — a ``/profile`` directive
+   published mid-run produces a ``profile-capture`` span whose ``xplane``
+   attribute points at an artifact directory that exists and is
+   non-empty.
+4. **Goodput attribution is arithmetic, not vibes** — the reported
+   ``tpujob_goodput_ratio`` matches the hand-computed lost time
+   (trace-derived compile/init + the injected data-wait schedule) within
+   5% of wall.
+
+Writes the one-line JSON receipt CI checks in
+``artifacts/telemetrybench_r13.json``.
+
+Usage:
+    python -m tools.telemetrybench --out artifacts/telemetrybench_r13.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+from tf_operator_tpu.api.types import (
+    LABEL_JOB_NAME,
+    ConditionType,
+    ObjectMeta,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    TopologySpec,
+    TPUJob,
+    TPUJobSpec,
+)
+from tf_operator_tpu.chaos.soak import DATAPLANE_ENV, _ROOT
+from tf_operator_tpu.controller import TPUJobController
+from tf_operator_tpu.controller.status import has_condition, is_finished
+from tf_operator_tpu.dashboard import DashboardServer
+from tf_operator_tpu.dashboard.client import TPUJobClient
+from tf_operator_tpu.obs.spans import job_trace
+from tf_operator_tpu.runtime import (
+    FakeProcessControl,
+    HostAgent,
+    LocalProcessControl,
+    Store,
+)
+
+# The injected schedule: every rank pays DATA_WAIT_S of input stall per
+# step; rank 1 additionally sleeps SLOW_EXTRA_S (the modeled slow host).
+# STEPS leaves the chief enough runway to absorb the profiler's first-use
+# initialization stall (~3s for jax.profiler.start_trace on CPU) after
+# the straggler flag lands and still capture PROFILE_STEPS steps.
+STEPS = 36
+STEP_SLEEP_S = 0.05
+DATA_WAIT_S = 0.15
+SLOW_EXTRA_S = 0.35
+TELEMETRY_EVERY = 2
+PROFILE_STEPS = 3
+FLAG_WINDOW_BOUND = 3
+
+
+def _bench_job(name: str, workers: int, workload: Dict[str, Any]) -> TPUJob:
+    env = dict(DATAPLANE_ENV)
+    env["PYTHONPATH"] = _ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")
+    entry = workload.pop("__entrypoint__", "tf_operator_tpu.workloads.soak:main")
+    job = TPUJob(
+        metadata=ObjectMeta(name=name),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=workers,
+                    template=ProcessTemplate(
+                        entrypoint=entry, env=env, chips_per_process=1,
+                    ),
+                )
+            },
+            topology=TopologySpec(num_hosts=workers, chips_per_host=1),
+        ),
+    )
+    job.spec.workload = workload
+    return job
+
+
+def _wait(store: Store, name: str, timeout: float) -> Any:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = store.get("TPUJob", "default", name).status
+        if is_finished(st):
+            return st
+        time.sleep(0.25)
+    raise TimeoutError(f"job {name} not finished after {timeout}s")
+
+
+def _slow_host_event(store: Store, job_name: str) -> Optional[Any]:
+    for e in store.list("Event", namespace="default"):
+        if e.reason == "SlowHost" and e.involved_name == job_name:
+            return e
+    return None
+
+
+def run(seed: int, timeout: float) -> Dict[str, Any]:
+    tmp = tempfile.mkdtemp(prefix="tpujob-telembench-")
+    ckpt_dir = os.path.join(tmp, "ckpt")
+    job1, job2 = "telem-soak", "telem-follow"
+    errs: List[str] = []
+
+    store = Store()
+    fake = FakeProcessControl()
+    ctl = TPUJobController(store, fake, resync_period=0.3)
+    dashboard = DashboardServer(store, host="127.0.0.1", port=0)
+    dashboard.start()
+    ctl.api_url = dashboard.url
+    agents = [
+        HostAgent(
+            store, f"telem-h{i}", total_chips=1, heartbeat_interval=0.25,
+            backend=LocalProcessControl(
+                store, log_dir=os.path.join(tmp, "logs")
+            ),
+        )
+        for i in range(3)
+    ]
+    client = TPUJobClient(dashboard.url)
+
+    flag_windows = None
+    flagged_host = ""
+    profile: Dict[str, Any] = {}
+    goodput: Dict[str, Any] = {}
+    job2_hosts: List[str] = []
+    try:
+        for a in agents:
+            a.start()
+        ctl.run(workers=2)
+        store.create(_bench_job(job1, 3, {
+            "steps": STEPS,
+            "step_sleep_s": STEP_SLEEP_S,
+            "data_wait_s": DATA_WAIT_S,
+            "slow_ranks": [1],
+            "slow_extra_s": SLOW_EXTRA_S,
+            "telemetry_every": TELEMETRY_EVERY,
+            "checkpoint_dir": ckpt_dir,
+            "checkpoint_every": 8,
+            "checkpoint_backend": "npy",
+        }))
+        submit_t = time.time()
+
+        # Gate 1: the SlowHost event must land while the gang runs, and
+        # its window count (parsed from the event message the operator
+        # shows humans) must be within the bound. This gate runs FIRST:
+        # profiling stalls the chief ~3s (first-use jax profiler init),
+        # which would hold every window incomplete until the flag
+        # deadline passed.
+        ev = None
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and ev is None:
+            ev = _slow_host_event(store, job1)
+            if ev is None:
+                time.sleep(0.2)
+
+        # Gate 3 setup: publish the profile directive mid-run — the
+        # chief still has >10 steps of budget when the flag lands.
+        directive = None
+        while time.monotonic() < deadline and directive is None:
+            try:
+                directive = client.profile(
+                    "default", job1, PROFILE_STEPS,
+                )["profile_directive"]
+            except Exception:
+                time.sleep(0.25)  # job not admitted yet
+        if directive is None:
+            errs.append("profile directive could not be published")
+        if ev is None:
+            errs.append("no SlowHost event before timeout")
+        else:
+            m = re.search(r"on host (\S+) .*after (\d+) windows", ev.message)
+            if m:
+                flagged_host = m.group(1)
+                flag_windows = int(m.group(2))
+                if flag_windows > FLAG_WINDOW_BOUND:
+                    errs.append(
+                        f"straggler flagged after {flag_windows} windows "
+                        f"(bound {FLAG_WINDOW_BOUND}): {ev.message}"
+                    )
+            else:
+                errs.append(f"unparseable SlowHost message: {ev.message!r}")
+
+        st1 = _wait(store, job1, timeout)
+        if not has_condition(st1, ConditionType.SUCCEEDED):
+            errs.append(f"job 1 did not succeed: {st1.conditions}")
+
+        # Gate 3: the capture span + on-disk artifact.
+        trace = job_trace(store, "default", job1)
+        cap = next((s for s in trace if s.op == "profile-capture"), None)
+        if cap is None:
+            errs.append(
+                "no profile-capture span in trace "
+                f"(ops: {sorted({s.op for s in trace})})"
+            )
+        else:
+            xplane = cap.attrs.get("xplane", "")
+            profile = {
+                "xplane": xplane,
+                "epoch": cap.attrs.get("epoch"),
+                "steps": cap.attrs.get("steps"),
+            }
+            if not xplane or not os.path.exists(xplane):
+                errs.append(f"profile capture path missing: {xplane!r}")
+            else:
+                found = [
+                    os.path.join(r, f)
+                    for r, _, fs in os.walk(xplane) for f in fs
+                ]
+                profile["artifacts"] = len(found)
+                if not found:
+                    errs.append(f"profile capture dir empty: {xplane}")
+        ack = (st1.profile_directive or {}).get("completed_epoch")
+        profile["completed_epoch"] = ack
+        if directive is not None and ack != directive.get("epoch"):
+            errs.append(
+                f"profile ack epoch {ack} != directive "
+                f"{directive.get('epoch')}"
+            )
+
+        # Gate 4: reported goodput vs the hand-computed injected lost
+        # time. Lost = compile/init (trace: submit -> first-step start)
+        # + the injected data-wait schedule. Every step pays DATA_WAIT_S,
+        # but the job completes on chief success — the slow rank is
+        # reaped mid-schedule, so hand-compute from each rank's actual
+        # completed-step count (max end_step it reported) rather than
+        # assuming all ranks ran the full budget.
+        job_obj = store.get("TPUJob", "default", job1)
+        wall = (st1.completion_time or time.time()) - (
+            job_obj.metadata.creation_timestamp or submit_t
+        )
+        first_step = min(
+            (s.start_time for s in trace if s.op == "first-step"),
+            default=None,
+        )
+        ttfs = (
+            max(0.0, first_step - job_obj.metadata.creation_timestamp)
+            if first_step else 0.0
+        )
+        steps_by_rank: Dict[int, int] = {}
+        for b in client.telemetry("default", job1).get("batches", []):
+            r = int(b.get("rank", -1))
+            steps_by_rank[r] = max(steps_by_rank.get(r, 0), int(b.get("end_step", 0)))
+        mean_steps = (
+            sum(steps_by_rank.values()) / len(steps_by_rank)
+            if steps_by_rank else STEPS
+        )
+        expected_lost = ttfs + mean_steps * DATA_WAIT_S
+        gauge = re.search(
+            r'tpujob_goodput_ratio\{[^}]*job="%s"[^}]*\} (\S+)' % job1,
+            ctl.metrics.render(),
+        )
+        if gauge is None:
+            errs.append("tpujob_goodput_ratio gauge not exported for job 1")
+        else:
+            ratio = float(gauge.group(1))
+            reported_lost = (1.0 - ratio) * wall
+            tolerance = max(0.5, 0.05 * wall)
+            goodput = {
+                "ratio": round(ratio, 4),
+                "wall_s": round(wall, 3),
+                "reported_lost_s": round(reported_lost, 3),
+                "expected_lost_s": round(expected_lost, 3),
+                "tolerance_s": round(tolerance, 3),
+            }
+            if abs(reported_lost - expected_lost) > tolerance:
+                errs.append(
+                    f"goodput mismatch: reported lost {reported_lost:.2f}s "
+                    f"vs hand-computed {expected_lost:.2f}s "
+                    f"(tolerance {tolerance:.2f}s, ratio {ratio:.3f})"
+                )
+
+        # Gate 2: a gang submitted AFTER the flag avoids the slow host.
+        store.create(_bench_job(job2, 2, {
+            "__entrypoint__": "tf_operator_tpu.workloads.noop:main",
+            "sleep_s": 0.3,
+        }))
+        _wait(store, job2, timeout)
+        job2_hosts = sorted({
+            p.spec.node_name
+            for p in store.list(
+                "Process", namespace="default",
+                label_selector={LABEL_JOB_NAME: job2},
+            )
+            if p.spec.node_name
+        })
+        if not job2_hosts:
+            errs.append("job 2 left no placed processes to inspect")
+        elif flagged_host and flagged_host in job2_hosts:
+            errs.append(
+                f"gang placed on flagged host {flagged_host}: {job2_hosts}"
+            )
+    finally:
+        ctl.stop()
+        for a in agents:
+            a.stop()
+        dashboard.stop()
+        fake.clear()
+
+    return {
+        "bench": "telemetry",
+        "seed": seed,
+        "flag_windows": flag_windows,
+        "flag_window_bound": FLAG_WINDOW_BOUND,
+        "flagged_host": flagged_host,
+        "job2_hosts": job2_hosts,
+        "avoided_flagged_host": bool(
+            flagged_host and job2_hosts and flagged_host not in job2_hosts
+        ),
+        "profile": profile,
+        "goodput": goodput,
+        "injected": {
+            "steps": STEPS,
+            "data_wait_s": DATA_WAIT_S,
+            "slow_ranks": [1],
+            "slow_extra_s": SLOW_EXTRA_S,
+        },
+        "errors": errs,
+        "pass": not errs,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpujob-telemetry-bench")
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--timeout", type=float, default=120.0)
+    p.add_argument("--out", default=None)
+    args = p.parse_args(argv)
+    artifact = run(args.seed, args.timeout)
+    line = json.dumps(artifact)
+    print(line)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    if not artifact["pass"]:
+        for e in artifact["errors"]:
+            print(f"FAIL: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

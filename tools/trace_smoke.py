@@ -17,7 +17,10 @@ the observability subsystem exists to keep:
   (``request-admitted`` → ``first-token`` → ``finished``, one finished
   span per request, each with a ``tokens`` attr — the rows the
   reconciler folds into ``tpujob_request_ttft_seconds`` /
-  ``tpujob_request_tokens_total`` at terminal).
+  ``tpujob_request_tokens_total`` at terminal);
+- the job's ``/telemetry`` payload carries >= 1 ring batch with
+  per-rank monotonic step ranges and finite MFU (the r13 telemetry
+  plane works end to end even for a no-op payload).
 
 Usage:
     python -m tools.trace_smoke --server http://127.0.0.1:8080
@@ -53,6 +56,39 @@ def validate_chrome_trace(doc: dict) -> list:
             errs.append(f"event {i} ({ph}) missing ts")
         if ph == "X" and "dur" not in ev:
             errs.append(f"event {i} (X) missing dur")
+    return errs
+
+
+def telemetry_errors(payload: dict) -> list:
+    """Schema violations in a /telemetry payload; [] = valid. The golden
+    contract: >= 1 batch, per-rank monotonic step ranges, finite MFU."""
+    import math
+
+    errs = []
+    batches = payload.get("batches")
+    if not isinstance(batches, list) or not batches:
+        return [f"telemetry batches missing/empty: {batches!r}"]
+    by_rank: dict = {}
+    for i, b in enumerate(batches):
+        for k in ("rank", "seq", "start_step", "end_step", "step_time_s", "mfu"):
+            if k not in b:
+                errs.append(f"batch {i} missing {k!r}: {sorted(b)}")
+        if not math.isfinite(float(b.get("mfu", 0.0))):
+            errs.append(f"batch {i} has non-finite mfu: {b.get('mfu')!r}")
+        if int(b.get("end_step", 0)) < int(b.get("start_step", 0)):
+            errs.append(f"batch {i} step range inverted: {b}")
+        by_rank.setdefault(int(b.get("rank", -1)), []).append(b)
+    for rank, bs in by_rank.items():
+        bs.sort(key=lambda b: int(b.get("seq", 0)))
+        for prev, cur in zip(bs, bs[1:]):
+            if int(cur["end_step"]) <= int(prev["end_step"]):
+                errs.append(
+                    f"rank {rank} steps not monotonic across seqs: "
+                    f"{prev['end_step']} -> {cur['end_step']}"
+                )
+    summary = payload.get("summary") or {}
+    if not summary.get("ranks"):
+        errs.append(f"summary missing/empty: {summary!r}")
     return errs
 
 
@@ -152,6 +188,17 @@ def run(server: str, jobs: int, workers: int, timeout: float) -> int:
     timings = doc.get("otherData", {})
     if timings.get("time_to_first_step_s") is None:
         errs.append("otherData.time_to_first_step_s not derived")
+
+    # The telemetry plane rides the same smoke job: even a no-op payload
+    # must land >= 1 ring batch with a sane schema (r13).
+    telemetry = client.telemetry("default", target)
+    terrs = telemetry_errors(telemetry)
+    if not terrs:
+        print(
+            f"telemetry ok: {target} batches={len(telemetry['batches'])} "
+            f"ranks={telemetry['summary']['ranks']}"
+        )
+    errs.extend(terrs)
 
     errs.extend(run_serve_smoke(client, timeout))
 
