@@ -1,0 +1,460 @@
+"""Hang detection + flight-recorder postmortems (r15): the GangWatchdog
+state machine (arm/clear hysteresis, pre-first-step grace, resize epoch
+guard, one-verdict latch), the straggler/hang disambiguation rule pinned
+over ONE shared telemetry fixture, the reconciler's declare → sweep →
+freeze → recover path with cause attribution, bounded + GC'd forensics,
+and the loud-failure contract of /postmortem + `tpujob debug`."""
+
+import json
+import tarfile
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tf_operator_tpu.api.types import ConditionType
+from tf_operator_tpu.controller import events as ev
+from tf_operator_tpu.controller.status import has_condition
+from tf_operator_tpu.obs.blackbox import (
+    STACKDUMP_MAX_CHARS,
+    TRUNCATION_MARKER,
+    Blackbox,
+    cap_text,
+    delete_forensics,
+    job_stackdumps,
+    load_postmortem,
+    ship_stackdump,
+)
+from tf_operator_tpu.obs.telemetry import StragglerTracker, Telemetry
+from tf_operator_tpu.obs.watchdog import GangWatchdog
+from tf_operator_tpu.runtime import Store
+from tf_operator_tpu.runtime.objects import ProcessPhase
+
+from tests.test_obs import Harness, make_job, make_process
+from tests.test_telemetry import make_batch, seed_window
+
+
+def win(steps, t):
+    """One latest_window view: {rank: newest batch} with end_step/time."""
+    return {
+        r: Telemetry(rank=r, end_step=s, time=t, step_time_s=0.2)
+        for r, s in steps.items()
+    }
+
+
+# ---- GangWatchdog: the pure state machine --------------------------------
+
+
+def test_watchdog_pre_first_step_grace():
+    wd = GangWatchdog(5.0)
+    # no telemetry, no TTFS span: compile/init can take forever — idle
+    assert wd.observe({}, now=100.0) is None
+    assert wd.observe({}, now=10_000.0) is None
+    assert not wd.stalled
+    # the first step is marked but no window ever flushed: silence IS
+    # the signal from then on
+    assert wd.observe({}, now=1002.0, first_step_time=1000.0) is None
+    v = wd.observe({}, now=1006.0, first_step_time=1000.0)
+    assert v is not None
+    assert v.stuck_step == 0 and v.since == 1000.0
+
+
+def test_watchdog_flush_boundary_hysteresis_and_verdict_scene():
+    wd = GangWatchdog(5.0)
+    assert wd.observe(win({0: 4, 1: 4}, 10.0), now=10.0) is None  # mark=4
+    # re-flushing the same window is not progress — but not a hang yet
+    assert wd.observe(win({0: 4, 1: 4}, 12.0), now=12.0) is None
+    # a rank re-surfacing an OLDER window never regresses the mark
+    assert wd.observe(win({0: 3, 1: 4}, 14.0), now=14.0) is None
+    assert not wd.stalled
+    v = wd.observe(win({0: 3, 1: 4}, 16.0), now=16.0)
+    assert v is not None
+    assert v.stuck_step == 4
+    assert v.since == 10.0  # backdated to when progress actually stopped
+    assert v.stalled_for == pytest.approx(6.0)
+    # rank 1 was still on the high-water window; rank 0 froze earlier
+    assert v.last_moving_ranks == [1]
+    assert wd.hung and wd.stalled
+
+
+def test_watchdog_one_hang_one_verdict_then_first_advance_clears():
+    wd = GangWatchdog(5.0)
+    wd.observe(win({0: 4}, 10.0), now=10.0)
+    assert wd.observe(win({0: 4}, 16.0), now=16.0) is not None
+    # latched: however long the stall lasts, no second verdict
+    assert wd.observe(win({0: 4}, 30.0), now=30.0) is None
+    assert wd.observe(win({0: 4}, 300.0), now=300.0) is None
+    # the FIRST marker advance clears armed + hung in one observation
+    assert wd.observe(win({0: 5}, 301.0), now=301.0) is None
+    assert not wd.hung and not wd.stalled
+    # ... and a second stall re-fires with a fresh scene
+    v2 = wd.observe(win({0: 5}, 310.0), now=310.0)
+    assert v2 is not None and v2.since == 301.0
+
+
+def test_watchdog_resize_epoch_resets_the_clock():
+    wd = GangWatchdog(5.0)
+    wd.observe(win({0: 4}, 10.0), now=10.0, resize_epoch=0)
+    # 20s of silence — but the gang resized: re-forming, not hung
+    assert wd.observe(win({0: 4}, 30.0), now=30.0, resize_epoch=1) is None
+    assert not wd.stalled
+    # the clock restarted at the epoch change; a stall AFTER it still fires
+    v = wd.observe(win({0: 4}, 36.0), now=36.0, resize_epoch=1)
+    assert v is not None and v.since == 30.0
+
+
+def test_watchdog_reset_accepts_backward_steps_as_progress():
+    wd = GangWatchdog(5.0)
+    wd.observe(win({0: 8}, 10.0), now=10.0)
+    assert wd.observe(win({0: 8}, 16.0), now=16.0) is not None
+    wd.reset(now=50.0)
+    assert not wd.stalled
+    # the restarted gang resumes from the checkpoint at step 2 — LOWER
+    # than the old mark; the fresh incarnation must count it as progress
+    assert wd.observe(win({0: 2}, 51.0), now=51.0) is None
+    v = wd.observe(win({0: 2}, 57.0), now=57.0)
+    assert v is not None and v.since == 51.0 and v.stuck_step == 2
+
+
+def test_watchdog_disabled_without_timeout():
+    wd = GangWatchdog(0.0)
+    assert wd.observe(win({0: 4}, 10.0), now=10.0) is None
+    assert wd.observe(win({0: 4}, 9_999.0), now=9_999.0) is None
+    assert not wd.stalled
+
+
+# ---- disambiguation: ONE fixture, two planes -----------------------------
+
+
+def gang_history(slow_rank=None, freeze_after=None, n=6):
+    """The shared telemetry fixture both planes read: per-window
+    {rank: batch} for a 3-rank gang, 1s flush cadence. ``slow_rank``
+    makes one rank 2.75x the median every window (straggler shape);
+    ``freeze_after`` stops EVERY rank's end_step after that many moving
+    windows (hang shape — the ring keeps re-flushing the frozen scene)."""
+    wins = []
+    for seq in range(n):
+        moving_seq = seq if freeze_after is None else min(seq, freeze_after - 1)
+        step = 2 * (moving_seq + 1)
+        wins.append({
+            r: Telemetry(
+                rank=r, seq=seq, end_step=step, time=10.0 + seq,
+                step_time_s=0.55 if r == slow_rank else 0.2,
+            )
+            for r in range(3)
+        })
+    return wins
+
+
+def test_all_ranks_stall_routes_to_watchdog_never_straggler():
+    wd, tracker = GangWatchdog(2.0), StragglerTracker()
+    verdicts = []
+    for i, w in enumerate(gang_history(freeze_after=2)):
+        v = wd.observe(w, now=10.0 + i)
+        if v is not None:
+            verdicts.append(v)
+        tracker.observe({r: b.step_time_s for r, b in w.items()})
+    # the watchdog owns this: exactly one verdict, frozen at the last
+    # moving window's step
+    assert len(verdicts) == 1
+    assert verdicts[0].stuck_step == 4
+    assert verdicts[0].since == 11.0
+    # the median-ratio rule stays silent by design — the median froze
+    # with the gang, nobody is an outlier
+    assert tracker.flagged == {}
+
+
+def test_one_slow_rank_routes_to_straggler_never_watchdog():
+    wd, tracker = GangWatchdog(2.0), StragglerTracker()
+    flagged = []
+    for i, w in enumerate(gang_history(slow_rank=1)):
+        assert wd.observe(w, now=10.0 + i) is None  # steps keep advancing
+        f, _ = tracker.observe({r: b.step_time_s for r, b in w.items()})
+        flagged.extend(f)
+    assert not wd.stalled and not wd.hung
+    assert flagged == [1]  # flagged once, after the flap hysteresis
+
+
+# ---- reconciler: declare → suppress → sweep → freeze → recover -----------
+
+
+def hang_harness(workers=3, timeout=0.25, **rp):
+    job = make_job(workers=workers, hang_timeout_seconds=timeout, **rp)
+    h = Harness(
+        job,
+        [make_process(job, i, ProcessPhase.RUNNING) for i in range(workers)],
+    )
+    rsync(h)  # RUNNING condition; watchdog idle (pre-first-step grace)
+    return h
+
+
+def rsync(h):
+    """Sync with a CURRENT informer view. The Harness has no watch pump,
+    so without reseeding every sync replays the pre-RUNNING cached job,
+    re-enters the freshly-RUNNING branch, and closes the hang span the
+    declare path just opened — a fixture artifact, not operator behavior
+    (live informers ride the store watch)."""
+    h.reseed()
+    h.sync()
+
+
+def frozen_batch(seq, rank, step_time):
+    """A ring flush with a FRESH seq but the gang's end_step frozen at 2
+    — what re-flushes look like while every rank is wedged."""
+    b = make_batch(rank=rank, seq=seq, step_time=step_time, host=f"h{rank}")
+    b.start_step, b.end_step = 1, 2
+    return b
+
+
+def hung_events(h, reason=ev.REASON_JOB_HUNG):
+    return [
+        e for e in h.store.list("Event", namespace="default")
+        if e.reason == reason
+    ]
+
+
+def test_reconciler_hang_lifecycle_with_cause_attribution():
+    h = hang_harness()
+    seed_window(h, 0, {0: 0.2, 1: 0.2, 2: 0.2})
+    rsync(h)  # progress: high-water mark = step 2
+    time.sleep(0.3)  # past hang_timeout_seconds with zero flushes
+    rsync(h)
+    # -- declared: counted, scene stamped, sweep directive published
+    st = h.stored_job().status
+    assert st.hang_count == 1
+    assert st.hang_state["stuck_step"] == 2
+    assert st.stackdump_directive["epoch"] == 1
+    assert len(hung_events(h)) == 1
+    text = h.ctl.metrics.render()
+    assert "tpujob_hangs_total 1" in text
+    assert "tpujob_stackdump_sweeps_total 1" in text
+    # -- latched: re-syncs never re-declare or re-sweep (epoch dedup)
+    rsync(h)
+    assert h.stored_job().status.stackdump_directive["epoch"] == 1
+    assert len(hung_events(h)) == 1
+    assert "tpujob_stackdump_sweeps_total 1" in h.ctl.metrics.render()
+    # -- disambiguation at the reconciler: straggler-SHAPED re-flushes
+    # (fresh seqs, one rank 2.75x the median, steps frozen) arrive while
+    # the stall is pending; without suppression two consecutive windows
+    # would flag rank 1
+    for seq in (1, 2):
+        for rank, t in {0: 0.2, 1: 0.55, 2: 0.2}.items():
+            h.store.create(frozen_batch(seq, rank, t))
+        rsync(h)
+    assert h.ctl._slow_hosts == {}
+    assert not hung_events(h, reason="SlowHost")
+    # -- all ranks acked their stack dumps: freeze + recover
+    for rank in range(3):
+        ship_stackdump(
+            h.store, "default", "traced", h.job.metadata.uid, rank, 1,
+            f"Thread MainThread:\n  File wl.py in _fake_collective r{rank}",
+        )
+    j = h.stored_job()
+    j.status.stackdump_directive["acks"] = {"0": 1.0, "1": 1.0, "2": 1.0}
+    h.store.update(j)
+    h.reseed()
+    rsync(h)
+    bundle = load_postmortem(h.store, "default", "traced")
+    assert bundle is not None and bundle.reason == "hang"
+    assert len(bundle.payload["stackdumps"]) == 3
+    assert bundle.payload["detail"]["stuck_step"] == 2
+    assert hung_events(h, reason=ev.REASON_POSTMORTEM_FROZEN)
+    st = h.stored_job().status
+    # a hang consumes the failure budget exactly like a crash...
+    assert st.restart_count == 1
+    assert st.last_restart_cause == "hang"
+    # ... and never leaks into the preemption/resize ledgers
+    assert st.preemption_count == 0 and st.resize_count == 0
+    # -- the recovered gang comes back RUNNING: the hang span closes and
+    # its width (progress stopped -> RUNNING again) is the ONLY source
+    # of hang downtime in the goodput ledger
+    job = h.stored_job()
+    h.set_processes(
+        [make_process(job, i, ProcessPhase.RUNNING) for i in range(3)]
+    )
+    rsync(h)
+    st = h.stored_job().status
+    assert st.hang_state == {}  # recovered: the declared scene clears
+    text = h.ctl.metrics.render()
+    assert "tpujob_hang_downtime_seconds_count 1" in text
+    assert 'tpujob_lost_seconds_total{cause="hang"}' in text
+
+
+def test_hang_at_backoff_limit_fails_terminally_with_residue():
+    h = hang_harness(workers=2, timeout=0.2, backoff_limit=0)
+    seed_window(h, 0, {0: 0.2, 1: 0.2})
+    rsync(h)
+    time.sleep(0.25)
+    rsync(h)  # declared; sweep in flight
+    j = h.stored_job()
+    assert j.status.hang_state
+    j.status.stackdump_directive["acks"] = {"0": 1.0, "1": 1.0}
+    h.store.update(j)
+    h.reseed()
+    rsync(h)  # budget exhausted: terminal, not another restart
+    st = h.stored_job().status
+    assert has_condition(st, ConditionType.FAILED)
+    assert st.restart_count == 0  # never charged — the job just died
+    # hang_state survives at terminal: the job never recovered and the
+    # frozen scene is the forensic residue
+    assert st.hang_state["stuck_step"] == 2
+    bundle = load_postmortem(h.store, "default", "traced")
+    assert bundle is not None and bundle.reason == "hang"
+
+
+def test_jobs_without_hang_timeout_are_untouched():
+    job = make_job(workers=2)  # hang_timeout_seconds defaults to None
+    h = Harness(
+        job, [make_process(job, i, ProcessPhase.RUNNING) for i in range(2)]
+    )
+    rsync(h)
+    seed_window(h, 0, {0: 0.2, 1: 0.2})
+    rsync(h)
+    time.sleep(0.25)
+    rsync(h)
+    st = h.stored_job().status
+    assert st.hang_count == 0 and st.hang_state == {}
+    assert "tpujob_hangs_total 0" in h.ctl.metrics.render()
+
+
+# ---- forensics: bounded, GC'd with the job, loud when gone ---------------
+
+
+def test_cap_text_keeps_the_tail_with_visible_marker():
+    text = "x" * (STACKDUMP_MAX_CHARS * 2) + "\nwedged in _fake_collective"
+    capped, truncated = cap_text(text)
+    assert truncated
+    # the tail survives — faulthandler prints the wedged frame LAST
+    assert capped.endswith("wedged in _fake_collective")
+    assert TRUNCATION_MARKER.lstrip("\n") in capped
+    assert len(capped) <= STACKDUMP_MAX_CHARS + 1
+    small, t = cap_text("tiny")
+    assert small == "tiny" and not t
+
+
+def test_ship_stackdump_idempotent_and_gcd_with_job():
+    store = Store()
+    job = make_job(name="gone")
+    for rank in range(2):
+        art = ship_stackdump(
+            store, "default", "gone", job.metadata.uid, rank, 1, f"stack r{rank}"
+        )
+        assert art is not None
+    # re-shipping the same (rank, epoch) is success, not a duplicate
+    assert ship_stackdump(
+        store, "default", "gone", job.metadata.uid, 0, 1, "stack again"
+    ) is not None
+    assert len(job_stackdumps(store, "default", "gone")) == 2
+    bb = Blackbox()
+    bb.observe_status(job)
+    assert bb.freeze(store, job, reason="hang") is not None
+    # GC: one call wipes dumps AND bundle — forensics die with the job
+    assert delete_forensics(store, "default", "gone") == 3
+    assert job_stackdumps(store, "default", "gone") == []
+    assert load_postmortem(store, "default", "gone") is None
+    assert delete_forensics(store, "default", "gone") == 0  # idempotent
+
+
+def test_postmortem_route_distinguishes_not_frozen_from_gcd():
+    from tf_operator_tpu.dashboard import DashboardServer
+
+    h = Harness(make_job(name="pmjob"))
+    srv = DashboardServer(h.store, port=0)
+    srv.start()
+    try:
+        url = srv.url + "/api/tpujob/default/pmjob/postmortem"
+        # live job, nothing frozen: loud 404 naming the reason
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(url, timeout=10)
+        assert exc.value.code == 404
+        assert "no postmortem has been frozen" in json.loads(
+            exc.value.read()
+        )["error"]
+        # freeze + one dump: the payload carries both
+        job = h.stored_job()
+        ship_stackdump(
+            h.store, "default", "pmjob", job.metadata.uid, 0, 1, "stack r0"
+        )
+        Blackbox().freeze(h.store, job, reason="hang")
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            doc = json.loads(resp.read())
+        assert doc["reason"] == "hang"
+        assert doc["stackdumps"][0]["text"] == "stack r0"
+        assert doc["bundle"]["job"] == "default/pmjob"
+        # job deleted + forensics GC'd: 404 again, naming the GC
+        delete_forensics(h.store, "default", "pmjob")
+        h.store.delete("TPUJob", "default", "pmjob")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(url, timeout=10)
+        assert exc.value.code == 404
+        assert "GC'd with the job" in json.loads(exc.value.read())["error"]
+    finally:
+        srv.stop()
+
+
+def test_debug_tar_assembly_and_loud_fail_on_missing(tmp_path):
+    from tf_operator_tpu.cli.tpujob import assemble_debug_tar
+    from tf_operator_tpu.dashboard import DashboardServer
+    from tf_operator_tpu.dashboard.client import TPUJobApiError, TPUJobClient
+
+    out = str(tmp_path / "pm.tar.gz")
+    members = assemble_debug_tar(
+        {
+            "job": "default/x", "reason": "hang", "frozen_at": 1000.0,
+            "bundle": {"job": "default/x", "events": []},
+            "stackdumps": [
+                {"rank": 0, "epoch": 1, "text": "stack r0"},
+                {"rank": 1, "epoch": 1, "text": "stack r1"},
+            ],
+        },
+        out,
+    )
+    assert members == [
+        "bundle.json",
+        "stackdumps/rank-0-e1.stack",
+        "stackdumps/rank-1-e1.stack",
+        "README.txt",
+    ]
+    with tarfile.open(out) as tf:
+        assert sorted(tf.getnames()) == sorted(members)
+        bundle = json.loads(tf.extractfile("bundle.json").read())
+        assert bundle["job"] == "default/x"
+        assert tf.extractfile(
+            "stackdumps/rank-1-e1.stack"
+        ).read().decode() == "stack r1"
+        assert "reason: hang" in tf.extractfile("README.txt").read().decode()
+    # `tpujob debug` on a job with nothing frozen (or GC'd) raises —
+    # NEVER writes an empty-but-successful tar
+    h = Harness(make_job(name="nothing"))
+    srv = DashboardServer(h.store, port=0)
+    srv.start()
+    try:
+        client = TPUJobClient(srv.url)
+        with pytest.raises(TPUJobApiError) as exc:
+            client.postmortem("default", "nothing")
+        assert exc.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_render_top_headlines_the_hang():
+    from tf_operator_tpu.cli.tpujob import render_top
+
+    out = render_top(
+        {"job": "default/lm", "summary": {}, "goodput": {}},
+        job={"status": {"hang_state": {
+            "stuck_step": 42, "since": 900.0, "last_moving_ranks": [0, 3],
+            "time": 910.0,
+        }}},
+        now=960.0,
+    )
+    assert "HUNG       stuck at step 42" in out
+    assert "no progress for 60s" in out
+    assert "last moving ranks [0, 3]" in out
+    assert "POSTMORTEM tpujob debug default lm" in out
+    # healthy jobs render exactly as before
+    assert "HUNG" not in render_top(
+        {"job": "default/lm", "summary": {}, "goodput": {}},
+        job={"status": {"hang_state": {}}},
+    )

@@ -49,6 +49,10 @@ KIND_TELEMETRY = "Telemetry"
 # the generic store/API seam (runtime/serialize.py registers decoders).
 KIND_PRIORITY_CLASS = "PriorityClass"
 KIND_QUEUE = "Queue"
+# Forensics objects (obs/blackbox.py, r15): per-rank stack-dump shipments
+# and the frozen per-job postmortem bundle. Ride the generic store/API
+# seam like Spans/Telemetry and are GC'd with the owning job.
+KIND_POSTMORTEM = "Postmortem"
 
 # Serving job classes (SchedulingSpec.job_class, r10): "serving" marks a
 # latency-sensitive decode workload — the fleet scheduler gives it a high
@@ -244,6 +248,14 @@ class RunPolicy:
     # member (the coordinator anchors rendezvous), or survivor count makes
     # a resize unsound.
     elastic: bool = False
+    # Hang detection window (r15): the gang is declared HUNG when NO rank
+    # has advanced past its last reported step for this many seconds while
+    # host heartbeats stay live (the silent wedged-collective failure the
+    # exit taxonomy can never see — no process exits). None ⇒ watchdog
+    # disabled for this job; the straggler median-rule still runs. Must be
+    # comfortably larger than the workload's telemetry flush interval or
+    # slow-but-moving jobs would be shot.
+    hang_timeout_seconds: Optional[float] = None
 
 
 @dataclass
@@ -357,6 +369,23 @@ class TPUJobStatus:
     # profile_ctx and publishes back {"completed_epoch": int,
     # "xplane": path}. Empty when no capture has ever been requested.
     profile_directive: Dict[str, Any] = field(default_factory=dict)
+    # Hang plane (r15). hang_count mirrors restart_count for hang-caused
+    # gang restarts; hangs ARE charged against backoff_limit under
+    # ON_FAILURE/EXIT_CODE (a wedged collective is the workload's doing
+    # until proven otherwise) via the ordinary restart_count bump.
+    hang_count: int = 0
+    # Live watchdog verdict: {"stuck_step": int, "since": ts,
+    # "last_moving_ranks": [ranks that reported the newest window],
+    # "time": ts}. Present only while a hang is declared-but-unrecovered;
+    # cleared when the gang restarts or progress resumes.
+    hang_state: Dict[str, Any] = field(default_factory=dict)
+    # Stack-sweep directive (same monotonic-epoch protocol as
+    # profile_directive): the reconciler publishes {"epoch": int,
+    # "dir": path, "time": ts} when it declares a hang; each HostAgent
+    # SIGUSR2s its wedged members exactly once per epoch and publishes
+    # back acks under "acks": {rank: stack_file_path}. Empty when no
+    # sweep has ever been requested.
+    stackdump_directive: Dict[str, Any] = field(default_factory=dict)
 
     def phase(self) -> JobPhase:
         """Derived v1alpha1-style phase (v1alpha1/types.go:106-116).
@@ -488,5 +517,8 @@ def _tpujob_from_dict(data: Dict[str, Any]) -> TPUJob:
         resize_directive=status_d.get("resize_directive", {}) or {},
         resize_history=list(status_d.get("resize_history", []) or []),
         profile_directive=status_d.get("profile_directive", {}) or {},
+        hang_count=status_d.get("hang_count", 0),
+        hang_state=status_d.get("hang_state", {}) or {},
+        stackdump_directive=status_d.get("stackdump_directive", {}) or {},
     )
     return TPUJob(metadata=meta, spec=spec, status=status, kind=data.get("kind", KIND_TPUJOB))

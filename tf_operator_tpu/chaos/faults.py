@@ -57,6 +57,21 @@ class FaultKind(str, enum.Enum):
     # re-created. On an elastic job this drives a shrink followed by a
     # symmetric re-grow instead of two full gang restarts.
     KILL_RETURN = "kill-return"
+    # Whole-gang wedge (r15): drop a marker file into the job's checkpoint
+    # directory; every COLD-incarnation gang member of the soak workload
+    # checks for it each step and, on sight, blocks forever inside a named
+    # fake collective (`_fake_collective_all_reduce`). Processes stay
+    # alive and heartbeating — only step progress stops — which is exactly
+    # the failure the hang watchdog exists to catch. Recovered (warm,
+    # resume_step > 0) incarnations ignore the marker, so one fault is
+    # one wedge.
+    HANG = "hang"
+
+
+# Marker-file name both sides of the HANG contract compute independently:
+# the injector writes ``<checkpoint_dir>/WEDGE_MARKER``, the soak workload
+# polls for it (workloads/soak.py).
+WEDGE_MARKER = "chaos-wedge.marker"
 
 
 @dataclass(frozen=True)
@@ -224,3 +239,29 @@ class FaultSchedule:
                 )
             )
         return FaultSchedule(seed=seed, faults=tuple(faults))
+
+    @staticmethod
+    def generate_hang(
+        seed: int,
+        first_step: int = 2,
+        spread_s: float = 2.0,
+    ) -> "FaultSchedule":
+        """Seeded schedule for the hang soak: ONE whole-gang wedge.
+
+        Gated on checkpoint progress (``at_step``) for two reasons: the
+        recovery must be *warm* (a pre-checkpoint wedge would resume from
+        step 0 and the soak's resume assertions would be vacuous), and
+        every rank must have flushed at least one telemetry batch before
+        progress freezes — a watchdog staring at an empty ring is the
+        TTFS-grace path, not the stall path under test."""
+        rng = random.Random(seed)
+        return FaultSchedule(
+            seed=seed,
+            faults=(
+                Fault(
+                    FaultKind.HANG,
+                    at_s=rng.uniform(0.0, spread_s),
+                    at_step=first_step,
+                ),
+            ),
+        )

@@ -45,7 +45,12 @@ from tf_operator_tpu.api.types import (
     KIND_TPUJOB,
     ReplicaType,
 )
-from tf_operator_tpu.chaos.faults import Fault, FaultKind, FaultSchedule
+from tf_operator_tpu.chaos.faults import (
+    WEDGE_MARKER,
+    Fault,
+    FaultKind,
+    FaultSchedule,
+)
 from tf_operator_tpu.runtime.objects import HostPhase, ProcessPhase
 from tf_operator_tpu.runtime.store import (
     NotFoundError,
@@ -334,7 +339,38 @@ class ChaosInjector:
             return self._fire_operator_crash(fault)
         if fault.kind is FaultKind.KILL_RETURN:
             return self._fire_kill_return(fault)
+        if fault.kind is FaultKind.HANG:
+            return self._fire_hang(fault)
         raise ValueError(f"unknown fault kind {fault.kind!r}")
+
+    def _fire_hang(self, fault: Fault) -> bool:
+        """Wedge the whole gang: write the marker file the soak workload
+        polls for (chaos/faults.py WEDGE_MARKER). Gated on a fully
+        RUNNING gang so every rank is mid-step-loop and stops within one
+        step of the marker landing — the stall the watchdog sees is then
+        whole-gang, never a half-launched partial. The marker is left in
+        place afterwards: only COLD (resume_step == 0) incarnations obey
+        it, so the warm-resumed gang runs through."""
+        if not self.checkpoint_dir:
+            raise ValueError(
+                "schedule contains HANG but the injector has no "
+                "checkpoint_dir (the wedge marker lives there)"
+            )
+        running = [
+            p for p in self._live_processes()
+            if p.status.phase is ProcessPhase.RUNNING
+        ]
+        gang = self._gang_size()
+        if not running or (gang and len(running) < gang):
+            return False
+        import os
+
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        marker = os.path.join(self.checkpoint_dir, WEDGE_MARKER)
+        with open(marker, "w") as f:
+            f.write(f"chaos: wedge armed at t={self._elapsed():.3f}s\n")
+        self._record(fault, marker, wall_time=time.time())
+        return True
 
     def _fire_operator_crash(self, fault: Fault) -> bool:
         """Kill + restart the control plane over a live gang. Gated on a

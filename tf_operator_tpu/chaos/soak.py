@@ -1178,6 +1178,378 @@ def elastic_artifact(result: ElasticSoakResult, seed: int) -> Dict[str, Any]:
     }
 
 
+def default_hang_schedule(seed: int) -> FaultSchedule:
+    """The hang acceptance recipe: ONE whole-gang wedge, gated on the
+    first checkpoint (warm recovery + at least one telemetry flush per
+    rank before progress freezes). Pure function of the seed."""
+    return FaultSchedule.generate_hang(seed, first_step=2, spread_s=0.0)
+
+
+@dataclass
+class HangSoakResult:
+    """Observations of one hang soak (see check for the gates)."""
+
+    succeeded: bool = False
+    hang_count: int = 0
+    restart_count: int = 0
+    preemption_count: int = 0
+    last_restart_cause: str = ""
+    conditions: List[tuple] = field(default_factory=list)
+    applied: List[dict] = field(default_factory=list)
+    schedule: Optional[FaultSchedule] = None
+    resume_steps: List[int] = field(default_factory=list)
+    partial_gang_violations: List[str] = field(default_factory=list)
+    # Hang spans from the trace: stuck step + measured downtime (span
+    # start is BACKDATED to when progress stopped; close is gang-RUNNING
+    # again — the span width IS the wedge window as charged to goodput).
+    hang_windows: List[dict] = field(default_factory=list)
+    # Declaration latency: stackdump_directive["time"] (when the
+    # reconciler declared HUNG) minus the hang span's backdated start
+    # (when progress actually stopped). >= hang_timeout by construction;
+    # the gate bounds the slack above it.
+    detect_latency_s: Optional[float] = None
+    directive_epoch: int = 0
+    ack_ranks: List[str] = field(default_factory=list)
+    # The frozen bundle's payload (None = never frozen) and the shipped
+    # per-rank stack dumps.
+    bundle: Optional[Dict[str, Any]] = None
+    bundle_reason: str = ""
+    stackdumps: List[dict] = field(default_factory=list)
+    goodput_scraped: bool = False
+    lost_seconds: Dict[str, float] = field(default_factory=dict)
+    workers: int = 0
+    hang_timeout_s: float = 0.0
+    detect_bound_s: float = 10.0
+    downtime_bound_s: float = 60.0
+
+    WEDGE_FRAME = "_fake_collective_all_reduce"
+
+    def check(self) -> List[str]:
+        errs = []
+        if not self.succeeded:
+            errs.append(f"job did not succeed: {self.conditions}")
+        sched_kinds = [
+            f.kind.value for f in (self.schedule.faults if self.schedule else ())
+        ]
+        applied_kinds = [a["kind"] for a in self.applied]
+        if applied_kinds != sched_kinds:
+            errs.append(
+                f"applied fault sequence {applied_kinds} != schedule "
+                f"{sched_kinds}"
+            )
+        if self.hang_count != 1:
+            errs.append(
+                f"hang_count {self.hang_count} != 1 (one wedge must yield "
+                "exactly one declaration — the verdict latch failed)"
+            )
+        # Cause attribution: a hang restart is charged to restart_count
+        # under ON_FAILURE (it consumes backoff budget) with the hang
+        # cause, and it never reads as a preemption.
+        if self.restart_count != 1 or self.last_restart_cause != "hang":
+            errs.append(
+                f"hang recovery miscounted: restart_count="
+                f"{self.restart_count} last_restart_cause="
+                f"{self.last_restart_cause!r} (want 1 / 'hang')"
+            )
+        if self.preemption_count:
+            errs.append(
+                f"hang leaked into preemption_count={self.preemption_count}"
+            )
+        if self.partial_gang_violations:
+            errs.append(f"partial gang persisted: {self.partial_gang_violations}")
+        # Detection bound: declared within hang_timeout + slack of the
+        # moment progress stopped.
+        if self.detect_latency_s is None:
+            errs.append("no detection latency measurable (no declaration)")
+        elif not (
+            self.hang_timeout_s - 0.5
+            <= self.detect_latency_s
+            <= self.hang_timeout_s + self.detect_bound_s
+        ):
+            errs.append(
+                f"detection latency {self.detect_latency_s:.2f}s outside "
+                f"[{self.hang_timeout_s:.1f}, "
+                f"{self.hang_timeout_s + self.detect_bound_s:.1f}]s"
+            )
+        # The wedge window, from the trace: exactly one hang span, closed
+        # (the gang came back RUNNING), at least the timeout wide, under
+        # the bound.
+        if len(self.hang_windows) != 1:
+            errs.append(f"expected exactly one hang span: {self.hang_windows}")
+        for w in self.hang_windows:
+            if w.get("downtime_s") is None:
+                errs.append(f"hang span never closed: {w}")
+            elif w["downtime_s"] > self.downtime_bound_s:
+                errs.append(
+                    f"hang downtime {w['downtime_s']:.1f}s exceeds bound "
+                    f"{self.downtime_bound_s:.0f}s: {w}"
+                )
+            elif w["downtime_s"] < self.hang_timeout_s - 0.5:
+                errs.append(
+                    f"hang span {w['downtime_s']:.1f}s narrower than the "
+                    f"timeout {self.hang_timeout_s:.1f}s — the start was "
+                    "not backdated to when progress stopped"
+                )
+        # Warm recovery: the post-hang incarnation resumed, not retrained.
+        if not any(s > 0 for s in self.resume_steps):
+            errs.append(
+                f"no warm restart observed (resume steps {self.resume_steps})"
+            )
+        # Bundle completeness: frozen with reason=hang, every rank's
+        # stack present and naming the wedged frame, last telemetry
+        # windows and the open hang span captured in the scene.
+        if self.bundle is None:
+            errs.append("no postmortem bundle was frozen")
+        else:
+            if self.bundle_reason != "hang":
+                errs.append(f"bundle reason {self.bundle_reason!r} != 'hang'")
+            stacks = self.bundle.get("stackdumps", [])
+            got_ranks = sorted(int(s.get("rank", -1)) for s in stacks)
+            if got_ranks != list(range(self.workers)):
+                errs.append(
+                    f"bundle stack ranks {got_ranks} != all ranks "
+                    f"{list(range(self.workers))}"
+                )
+            for s in stacks:
+                if self.WEDGE_FRAME not in s.get("text", ""):
+                    errs.append(
+                        f"rank {s.get('rank')} stack does not name the "
+                        f"wedged frame {self.WEDGE_FRAME!r}"
+                    )
+            if not self.bundle.get("telemetry"):
+                errs.append("bundle has no last-telemetry windows")
+            if not any(
+                sp.get("op") == "hang" and sp.get("open")
+                for sp in self.bundle.get("spans", [])
+            ):
+                errs.append(
+                    "bundle spans do not include the open hang span "
+                    "(the scene was frozen after recovery, not before)"
+                )
+        # One hang ⇒ one stack sweep: every shipped dump belongs to the
+        # single directive epoch, exactly one per rank.
+        epochs = sorted({d["epoch"] for d in self.stackdumps})
+        if self.stackdumps and epochs != [self.directive_epoch]:
+            errs.append(
+                f"stack dumps span sweep epochs {epochs} "
+                f"(directive epoch {self.directive_epoch}) — sweep dedup "
+                "failed"
+            )
+        if len(self.stackdumps) != self.workers:
+            errs.append(
+                f"{len(self.stackdumps)} stack dumps shipped for "
+                f"{self.workers} ranks"
+            )
+        # Goodput attribution: the wedge window lands under
+        # lost_seconds{cause="hang"} within 5%, with ZERO leakage into
+        # the restart/resize causes (a hang recovery opens no restart
+        # span).
+        if self.goodput_scraped:
+            expected = sum(
+                w["downtime_s"] for w in self.hang_windows
+                if w.get("downtime_s") is not None
+            )
+            got = self.lost_seconds.get("hang", 0.0)
+            if expected > 0 and abs(got - expected) > max(0.5, 0.05 * expected):
+                errs.append(
+                    f"lost_seconds{{cause=hang}} {got:.2f}s != hang-window "
+                    f"downtime {expected:.2f}s (±5%)"
+                )
+            for leak in ("restart", "preemption", "resize", "resize-shrink",
+                         "resize-grow"):
+                if self.lost_seconds.get(leak, 0.0) > 0:
+                    errs.append(
+                        f"hang downtime leaked into cause={leak}: "
+                        f"{self.lost_seconds}"
+                    )
+        return errs
+
+
+def run_hang_soak(
+    seed: int = 0,
+    schedule: Optional[FaultSchedule] = None,
+    hosts: int = 2,
+    num_hosts: int = 2,
+    workers: int = 2,
+    steps: int = 10,
+    checkpoint_every: int = 2,
+    backoff_limit: int = 2,
+    hang_timeout: float = 4.0,
+    timeout: float = 150.0,
+    workdir: Optional[str] = None,
+    heartbeat_ttl: float = 3.0,
+    step_sleep_s: float = 0.4,
+    detect_bound_s: float = 10.0,
+    downtime_bound_s: float = 60.0,
+) -> HangSoakResult:
+    """Seeded whole-gang-wedge soak (the r15 acceptance rig).
+
+    A HANG fault wedges every rank inside a named fake collective while
+    heartbeats stay live. The gates: the watchdog declares within bound,
+    the SIGUSR2 sweep ships every rank's stack naming the wedged frame,
+    the bundle freezes the scene BEFORE recovery destroys it, the victim
+    warm-resumes to Succeeded with ``last_restart_cause=hang`` and the
+    restart charged per ON_FAILURE, and goodput attributes the wedge
+    window to ``cause="hang"`` with zero leakage into restart/resize."""
+    from tf_operator_tpu.obs.blackbox import job_stackdumps, load_postmortem
+
+    schedule = (
+        schedule if schedule is not None else default_hang_schedule(seed)
+    )
+    tmp = workdir or tempfile.mkdtemp(prefix="tpujob-hang-soak-")
+    ckpt_dir = os.path.join(tmp, "ckpt")
+    job_name = "soak-hang"
+
+    store = Store()
+    injector = ChaosInjector(
+        schedule, store, job_name=job_name, checkpoint_dir=ckpt_dir,
+    )
+    agents = [
+        HostAgent(
+            injector.wrap(),
+            f"soak-h{i}",
+            total_chips=workers,
+            heartbeat_interval=0.25,
+            backend=LocalProcessControl(
+                injector.wrap(), log_dir=os.path.join(tmp, "logs")
+            ),
+            stackdump_dir=os.path.join(tmp, "stackdumps", f"soak-h{i}"),
+        )
+        for i in range(hosts)
+    ]
+    injector.agents = {a.name: a for a in agents}
+    fake = FakeProcessControl()
+    ctl = TPUJobController(store, fake, resync_period=0.5)
+    ctl.scheduler.heartbeat_ttl = heartbeat_ttl
+    from tf_operator_tpu.dashboard import DashboardServer
+
+    dashboard = DashboardServer(store, host="127.0.0.1", port=0)
+    dashboard.start()
+    ctl.api_url = dashboard.url
+
+    job = _soak_job(
+        job_name, workers, num_hosts, ckpt_dir, steps, checkpoint_every,
+        backoff_limit, heartbeat_ttl, data_plane="light",
+        step_sleep_s=step_sleep_s,
+    )
+    job.spec.run_policy.hang_timeout_seconds = hang_timeout
+
+    gang_names = [f"{job_name}-worker-{i}" for i in range(workers)]
+    watcher = _InvariantWatcher(store, job_name, gang_names)
+    result = HangSoakResult(
+        schedule=schedule, workers=workers, hang_timeout_s=hang_timeout,
+        detect_bound_s=detect_bound_s, downtime_bound_s=downtime_bound_s,
+    )
+    for a in agents:
+        a.start()
+    ctl.run(workers=2)
+    watcher.start()
+    try:
+        store.create(job)
+        injector.arm()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = store.get("TPUJob", "default", job_name).status
+            if is_finished(st) and injector.done:
+                break
+            time.sleep(0.25)
+        st = store.get("TPUJob", "default", job_name).status
+        result.succeeded = has_condition(st, ConditionType.SUCCEEDED)
+        result.hang_count = st.hang_count
+        result.restart_count = st.restart_count
+        result.preemption_count = st.preemption_count
+        result.last_restart_cause = st.last_restart_cause
+        result.conditions = [
+            (c.type.value, c.reason, c.message) for c in st.conditions
+        ]
+        directive = st.stackdump_directive or {}
+        result.directive_epoch = int(directive.get("epoch", 0) or 0)
+        result.ack_ranks = sorted((directive.get("acks") or {}).keys())
+        trace = job_trace(store, "default", job_name)
+        result.hang_windows = [
+            {
+                "stuck_step": s.attrs.get("stuck_step", ""),
+                "start": s.start_time,
+                "downtime_s": (
+                    round(s.end_time - s.start_time, 3) if s.end_time else None
+                ),
+            }
+            for s in trace if s.op == "hang"
+        ]
+        declared_at = float(directive.get("time", 0.0) or 0.0)
+        hang_starts = [s.start_time for s in trace if s.op == "hang"]
+        if declared_at and hang_starts:
+            result.detect_latency_s = round(declared_at - min(hang_starts), 3)
+        bundle = load_postmortem(store, "default", job_name)
+        if bundle is not None:
+            result.bundle = bundle.payload
+            result.bundle_reason = bundle.reason
+        result.stackdumps = [
+            {
+                "rank": d.rank, "epoch": d.epoch,
+                "host": d.payload.get("host", ""),
+                "names_wedge_frame": (
+                    HangSoakResult.WEDGE_FRAME in d.payload.get("text", "")
+                ),
+            }
+            for d in job_stackdumps(store, "default", job_name)
+        ]
+        result.lost_seconds = _scrape_lost_seconds(ctl.metrics)
+        result.goodput_scraped = True
+    finally:
+        injector.stop()
+        watcher.stop()
+        ctl.stop()
+        for a in agents:
+            a.stop()
+        dashboard.stop()
+        fake.clear()
+    result.resume_steps = list(watcher.resume_steps)
+    result.partial_gang_violations = list(watcher.violations)
+    result.applied = list(injector.applied)
+    leaked = [p.metadata.name for p in fake.created]
+    if leaked:
+        result.partial_gang_violations.append(
+            "controller launched through its own backend in managed mode: "
+            f"{leaked}"
+        )
+    return result
+
+
+def hang_artifact(result: HangSoakResult, seed: int) -> Dict[str, Any]:
+    """The hangbench receipt (one JSON object; CI writes it to
+    ``artifacts/hangbench_r15.json``)."""
+    downtimes = [
+        w["downtime_s"] for w in result.hang_windows
+        if w.get("downtime_s") is not None
+    ]
+    return {
+        "bench": "hang-soak",
+        "seed": seed,
+        "hang_timeout_s": result.hang_timeout_s,
+        "hangs_total": result.hang_count,
+        "detect_latency_s": result.detect_latency_s,
+        "hang_windows": result.hang_windows,
+        "hang_downtime_p50_s": _percentile(downtimes, 0.5),
+        "wedge_frame": HangSoakResult.WEDGE_FRAME,
+        "stackdumps": result.stackdumps,
+        "all_ranks_named_wedge_frame": (
+            len(result.stackdumps) == result.workers
+            and all(d["names_wedge_frame"] for d in result.stackdumps)
+        ),
+        "bundle_frozen": result.bundle is not None,
+        "bundle_reason": result.bundle_reason,
+        "resume_steps": result.resume_steps,
+        "restart_count": result.restart_count,
+        "last_restart_cause": result.last_restart_cause,
+        "lost_seconds": {
+            k: round(v, 3) for k, v in sorted(result.lost_seconds.items())
+        },
+        "applied": result.applied,
+        "pass": not result.check(),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="tpujob-soak", description="seeded chaos soak runner"
@@ -1229,6 +1601,19 @@ def main(argv=None) -> int:
                         "restart), host return must re-grow, the consumed "
                         "stream must be bit-identical to an uninterrupted "
                         "run, and >=1 resize must restore from a peer depot")
+    p.add_argument("--hang", action="store_true",
+                   help="hang soak: a HANG fault wedges every rank inside "
+                        "a fake collective (heartbeats stay live); gates "
+                        "watchdog detection latency, the SIGUSR2 stack "
+                        "sweep naming the wedged frame on every rank, the "
+                        "frozen postmortem bundle, warm recovery with "
+                        "last_restart_cause=hang, and goodput attribution "
+                        "of the wedge window to cause=hang")
+    p.add_argument("--hang-timeout", type=float, default=4.0,
+                   help="hang soak: run_policy.hang_timeout_seconds")
+    p.add_argument("--detect-bound", type=float, default=10.0,
+                   help="hang soak: max allowed slack (seconds) of the "
+                        "declaration past the hang timeout")
     p.add_argument("--kills", type=int, default=2,
                    help="elastic soak: number of kill/return faults")
     p.add_argument("--total-windows", type=int, default=900,
@@ -1282,6 +1667,32 @@ def main(argv=None) -> int:
         for e in errors:
             print(f"INVARIANT VIOLATED{tag}: {e}", file=sys.stderr)
         return errors
+
+    if args.hang:
+        import json as _json
+
+        hresult = run_hang_soak(
+            seed=args.seed, workers=args.workers, steps=args.steps,
+            checkpoint_every=args.checkpoint_every,
+            backoff_limit=args.backoff_limit,
+            hang_timeout=args.hang_timeout, timeout=args.timeout,
+            workdir=args.workdir, step_sleep_s=args.step_sleep,
+            detect_bound_s=args.detect_bound,
+            downtime_bound_s=args.downtime_bound,
+        )
+        artifact = hang_artifact(hresult, args.seed)
+        print(_json.dumps(artifact))
+        if args.artifact:
+            os.makedirs(
+                os.path.dirname(os.path.abspath(args.artifact)), exist_ok=True
+            )
+            with open(args.artifact, "w") as f:
+                _json.dump(artifact, f, indent=2)
+            print(f"hang soak receipt -> {args.artifact}")
+        errors = hresult.check()
+        for e in errors:
+            print(f"HANG INVARIANT VIOLATED: {e}", file=sys.stderr)
+        return 1 if errors else 0
 
     if args.elastic:
         import json as _json

@@ -99,6 +99,19 @@ def build_parser() -> argparse.ArgumentParser:
     pp.add_argument("--dir", default="", dest="profile_dir",
                     help="capture directory on the chief's host "
                          "(default: <checkpoint_dir>/profile)")
+    dp = sub.add_parser(
+        "debug",
+        help="assemble a job's frozen postmortem (hang/failure bundle + "
+             "per-rank stack dumps) into a single tar; fails LOUDLY when "
+             "no postmortem exists or the job was GC'd — never an empty "
+             "tar",
+    )
+    dp.add_argument("namespace_or_name",
+                    help="namespace (with NAME following) or, alone, a "
+                         "job name in the default namespace")
+    dp.add_argument("name", nargs="?", default=None)
+    dp.add_argument("-o", "--output", default=None,
+                    help="tar path (default <name>-postmortem.tar.gz)")
     ep = sub.add_parser("events")
     ep.add_argument("--namespace", default=None)
     ap = sub.add_parser(
@@ -149,12 +162,70 @@ def _default_ns(args):
     return args.namespace_or_name, args.name
 
 
-def render_top(payload: dict) -> str:
+def assemble_debug_tar(payload: dict, out_path: str) -> list:
+    """Write a /postmortem payload as one tar.gz: the bundle JSON, each
+    rank's stack dump as its own file, and a README naming the scene.
+    Returns the member names written (separated from main() so tests can
+    exercise it without a live server)."""
+    import tarfile
+    import time as _time
+    from io import BytesIO
+
+    def add(tf, name, text):
+        data = text.encode()
+        info = tarfile.TarInfo(name)
+        info.size = len(data)
+        info.mtime = int(payload.get("frozen_at") or _time.time())
+        tf.addfile(info, BytesIO(data))
+        return name
+
+    members = []
+    with tarfile.open(out_path, "w:gz") as tf:
+        members.append(add(tf, "bundle.json",
+                           json.dumps(payload.get("bundle") or {}, indent=2)))
+        for d in payload.get("stackdumps") or []:
+            members.append(add(
+                tf,
+                f"stackdumps/rank-{d.get('rank')}-e{d.get('epoch')}.stack",
+                d.get("text", ""),
+            ))
+        members.append(add(
+            tf, "README.txt",
+            f"postmortem for tpujob {payload.get('job', '?')}\n"
+            f"reason: {payload.get('reason', '?')}\n"
+            f"frozen_at: {payload.get('frozen_at')}\n"
+            f"stack dumps: {len(payload.get('stackdumps') or [])}\n"
+            "bundle.json: status history, events, spans (open spans "
+            "included), last telemetry window per rank, hang verdict.\n",
+        ))
+    return members
+
+
+def render_top(payload: dict, job: dict = None, now: float = None) -> str:
     """Render a /telemetry payload as the `tpujob top` table (separated
-    from main() so tests can golden-check it without a live server)."""
+    from main() so tests can golden-check it without a live server).
+    ``job`` is the /api/tpujob job payload, used to surface a declared
+    hang: a HUNG job shows the stuck step and seconds-since-progress
+    instead of leaving stale tokens/s as the headline."""
+    import time as _time
+
     summary = payload.get("summary") or {}
     goodput = payload.get("goodput") or {}
     lines = [f"JOB        {payload.get('job', '-')}"]
+    hang = ((job or {}).get("status") or {}).get("hang_state") or {}
+    if hang:
+        since = float(hang.get("since", 0.0) or 0.0)
+        stalled = max(0.0, (_time.time() if now is None else now) - since)
+        ranks = hang.get("last_moving_ranks") or []
+        lines.append(
+            f"HUNG       stuck at step {hang.get('stuck_step', '?')} — no "
+            f"progress for {stalled:.0f}s (last moving ranks {ranks})"
+        )
+        ns_name = (payload.get("job") or "/").split("/")
+        lines.append(
+            f"POSTMORTEM tpujob debug {' '.join(ns_name)}  "
+            "(stack dumps + frozen scene)"
+        )
     if not summary.get("ranks"):
         lines.append("no telemetry batches yet")
     else:
@@ -246,7 +317,24 @@ def main(argv=None) -> int:
             if args.as_json:
                 print(json.dumps(payload, indent=2))
             else:
-                print(render_top(payload))
+                try:
+                    jobd = client.get(ns, name).get("job")
+                except TPUJobApiError:
+                    jobd = None  # telemetry may outlive the job object
+                print(render_top(payload, job=jobd))
+        elif args.cmd == "debug":
+            ns, name = _default_ns(args)
+            # 404 (never frozen, or GC'd with the job) raises and exits
+            # loudly below — a missing postmortem must never produce an
+            # empty-but-plausible tar.
+            payload = client.postmortem(ns, name)
+            out = args.output or f"{name}-postmortem.tar.gz"
+            members = assemble_debug_tar(payload, out)
+            print(
+                f"postmortem for {ns}/{name} (reason={payload.get('reason')}, "
+                f"{len(payload.get('stackdumps') or [])} rank stacks) -> "
+                f"{out} ({len(members)} files)"
+            )
         elif args.cmd == "profile":
             ns, name = _default_ns(args)
             out = client.profile(ns, name, args.steps, args.profile_dir)

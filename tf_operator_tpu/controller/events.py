@@ -46,6 +46,13 @@ REASON_CONTROLLER_RESTARTED = "ControllerRestarted"
 # deprioritized for new gang placements until it clears.
 REASON_SLOW_HOST = "SlowHost"
 REASON_SLOW_HOST_CLEARED = "SlowHostCleared"
+# Hang plane (obs/watchdog.py, r15): the gang-progress watchdog declared
+# the job HUNG (no rank advanced a step for hang_timeout_seconds with
+# heartbeats live); a stack sweep + postmortem freeze precede recovery.
+REASON_JOB_HUNG = "TPUJobHung"
+# A frozen postmortem bundle is available for this job
+# (GET /api/tpujob/<ns>/<name>/postmortem, `tpujob debug`).
+REASON_POSTMORTEM_FROZEN = "PostmortemFrozen"
 
 
 class EventRecorder:

@@ -82,16 +82,20 @@ from tf_operator_tpu.obs.spans import (
     job_trace,
     trace8,
 )
+from tf_operator_tpu.obs.blackbox import Blackbox, delete_forensics
 from tf_operator_tpu.obs.telemetry import (
     CAUSE_CKPT_STALL,
     CAUSE_COMPILE_INIT,
     CAUSE_DATA_WAIT,
+    CAUSE_HANG as GOODPUT_HANG,
     CAUSE_RESIZE as GOODPUT_RESIZE,
     CAUSE_RESTART as GOODPUT_RESTART,
     StragglerTracker,
     goodput_decomposition,
     job_telemetry,
+    latest_window,
 )
+from tf_operator_tpu.obs.watchdog import GangWatchdog, HangVerdict
 from tf_operator_tpu.rendezvous.env import (
     ENV_API_SERVER,
     ENV_CHECKPOINT_DIR,
@@ -160,6 +164,18 @@ CAUSE_OOM = "oom"
 # answers "what happened to this gang last" uniformly.
 CAUSE_RESIZE_SHRINK = "resize_shrink"
 CAUSE_RESIZE_GROW = "resize_grow"
+# Gang-progress hang (r15, obs/watchdog.py): no rank advanced a step for
+# hang_timeout_seconds while heartbeats stayed live. Retryable under
+# ALWAYS/ON_FAILURE/EXIT_CODE and charged to restart_count/backoff_limit
+# like a crash — but its downtime is the HANG span's width (backdated to
+# when progress stopped), so _restart_gang opens NO restart span for it:
+# one window, one cause, never double-counted (docs/design.md §6.3).
+CAUSE_HANG = "hang"
+# How long the reconciler holds a declared-HUNG gang alive waiting for
+# every rank's stack dump to be acked before shooting it anyway — the
+# forensics window must never stall recovery indefinitely (a wedged
+# harness cannot run its own signal handler's file flush forever).
+FORENSICS_GRACE_SECONDS = 5.0
 # Mesh axes an elastic resize may re-carve. dp/fsdp shard DATA and
 # replicated/re-shardable optimizer+param state; tp/pp/ep shard the model
 # PROGRAM itself — losing a member there removes layers/experts/operand
@@ -272,6 +288,13 @@ class TPUJobController:
         self._stragglers: Dict[str, StragglerTracker] = {}  # uid -> tracker
         self._straggler_seen_seq: Dict[str, int] = {}  # uid -> last window seq
         self._slow_hosts: Dict[str, float] = {}  # host -> flagged-at time
+        # Hang plane (r15): per-job gang-progress watchdogs over the same
+        # telemetry stream, the bounded flight recorders frozen into
+        # postmortem bundles, and the open hang span per uid (closed when
+        # the recovered gang is RUNNING again — the hang-downtime source).
+        self._watchdogs: Dict[str, GangWatchdog] = {}  # uid -> watchdog
+        self._blackboxes: Dict[str, Blackbox] = {}  # uid -> flight recorder
+        self._open_hang: Dict[str, Dict[str, Any]] = {}  # uid -> span info
         # Workqueue shards (run(shards=N) expands): keys hash by NAMESPACE,
         # so one tenant's burst cannot head-of-line-block another tenant's
         # keys behind a single queue mutex, while all of one job's events
@@ -508,6 +531,11 @@ class TPUJobController:
                     "direction": s.attrs.get("direction", "shrink"),
                     "epoch": int(s.attrs.get("epoch", "0") or 0),
                 }
+            elif s.op == "hang" and uid not in self._open_hang:
+                self._open_hang[uid] = {
+                    "ns": s.metadata.namespace, "name": s.metadata.name,
+                    "start": s.start_time,
+                }
             elif s.op == "scheduling-wait" and uid not in self._open_schedwait:
                 self._open_schedwait[uid] = {
                     "ns": s.metadata.namespace, "name": s.metadata.name,
@@ -584,6 +612,10 @@ class TPUJobController:
             self._delete_children(namespace, name, cleanup=CleanupPolicy.ALL)
             self._delete_spans(namespace, name)
             self._delete_telemetry(namespace, name)
+            # Forensics (postmortem bundle + stack dumps) are GC'd with the
+            # job exactly like spans/telemetry; `tpujob debug` on a GC'd
+            # job then 404s loudly instead of returning an empty tar.
+            delete_forensics(self.store, namespace, name)
             self.expectations.delete_expectations(self._exp_key(key))
             self._release_job(key)
             return
@@ -1106,12 +1138,22 @@ class TPUJobController:
                 # Trace: the gang is (back) up — close any open restart
                 # span; its width IS the recovery downtime (MTTR).
                 self._close_restart_span(job, now)
+                # ... and the hang span: progress stopped -> RUNNING again
+                # is the whole wedge window (detection wait included).
+                self._close_hang_span(job, now)
                 self.tracer.record(
                     job.metadata.namespace, job.metadata.name,
                     job.metadata.uid, "running", now, now,
                     attrs={"track": "running"},
                     name=self._span_name(job, "running"),
                 )
+            # Hang watchdog first (r15): a whole-gang step-progress stall
+            # is HIS, not the straggler tracker's (whose median-ratio rule
+            # is silent by design when every rank stops together). When
+            # the hang path shot the gang (or failed the job at the
+            # backoff limit) this sync is done.
+            if self._check_hang(job, gang, active, observed, exp_key):
+                return
             # Live telemetry consumer: evaluate any new cross-rank
             # step-time windows for stragglers (resync ticks drive this
             # between watch events).
@@ -1202,6 +1244,193 @@ class TPUJobController:
             "tpujob_lost_seconds_total", downtime,
             labels={"cause": GOODPUT_RESTART},
         )
+
+    # ---- hang plane (r15, obs/watchdog.py + obs/blackbox.py) -------------
+
+    def _check_hang(
+        self,
+        job: TPUJob,
+        gang: List[Tuple[ReplicaType, int]],
+        active: List[Tuple[ReplicaType, int]],
+        observed: Dict[Tuple[str, int], Process],
+        exp_key: str,
+    ) -> bool:
+        """Drive the job's gang-progress watchdog from the telemetry ring;
+        declare HUNG, run the forensics sweep, and recover. Returns True
+        when the hang path consumed this sync (gang restarted or job
+        failed terminally) — the caller stops reconciling.
+
+        Only reached from the all-members-RUNNING block, so heartbeats
+        are live by construction: a heartbeat-dead host fails its members
+        (node-lost) before this point and routes to the LOUD retry path,
+        never here."""
+        rp = job.spec.run_policy
+        if rp.hang_timeout_seconds is None:
+            return False
+        uid = job.metadata.uid
+        wd = self._watchdogs.get(uid)
+        if wd is None:
+            wd = self._watchdogs[uid] = GangWatchdog(rp.hang_timeout_seconds)
+        now = time.time()
+        try:
+            window = latest_window(
+                job_telemetry(
+                    self.store, job.metadata.namespace, job.metadata.name
+                )
+            )
+        except Exception:  # noqa: BLE001 — telemetry read is best-effort
+            return False
+        first_step_time: Optional[float] = None
+        try:
+            span = self.store.get(
+                KIND_SPAN, job.metadata.namespace,
+                first_step_span_name(job.metadata.name, uid),
+            )
+            first_step_time = span.start_time
+        except Exception:  # noqa: BLE001 — pre-first-step grace applies
+            pass
+        verdict = wd.observe(
+            window, now,
+            resize_epoch=job.status.resize_epoch,
+            first_step_time=first_step_time,
+        )
+        if verdict is not None:
+            self._declare_hang(job, verdict, now)
+        if wd.hung and job.status.hang_state:
+            return self._maybe_recover_hang(job, gang, active, observed, exp_key, now)
+        return False
+
+    def _declare_hang(self, job: TPUJob, verdict: HangVerdict, now: float) -> None:
+        """Latch one declared hang: count it, stamp hang_state (what
+        ``tpujob top`` renders), publish the stack-sweep directive (a
+        monotonic epoch the HostAgents act on exactly once — the
+        profile_directive protocol), and open the hang span BACKDATED to
+        when progress stopped, so its eventual width is the full wedge
+        window under cause ``hang`` and nothing leaks into restart."""
+        uid = job.metadata.uid
+        job.status.hang_count += 1
+        epoch = int((job.status.stackdump_directive or {}).get("epoch", 0)) + 1
+        job.status.hang_state = {
+            "stuck_step": verdict.stuck_step,
+            "since": verdict.since,
+            "last_moving_ranks": list(verdict.last_moving_ranks),
+            "time": now,
+        }
+        job.status.stackdump_directive = {"epoch": epoch, "time": now, "acks": {}}
+        self.metrics.inc("tpujob_hangs_total")
+        self.metrics.inc("tpujob_stackdump_sweeps_total")
+        self.recorder.warning(
+            job, ev.REASON_JOB_HUNG,
+            f"gang hung at step {verdict.stuck_step}: no rank advanced for "
+            f"{verdict.stalled_for:.0f}s (hang_timeout_seconds="
+            f"{job.spec.run_policy.hang_timeout_seconds}); last-moving "
+            f"ranks {verdict.last_moving_ranks}; sweeping stacks "
+            f"(epoch {epoch}) before recovery",
+        )
+        span_name = self._span_name(job, f"hang-{job.status.hang_count}")
+        if uid not in self._open_hang and self.tracer.record(
+            job.metadata.namespace, job.metadata.name, uid,
+            "hang", verdict.since, 0.0,
+            attrs={"stuck_step": str(verdict.stuck_step),
+                   "sweep_epoch": str(epoch), "track": "hang"},
+            name=span_name,
+        ) is not None:
+            self._open_hang[uid] = {
+                "ns": job.metadata.namespace, "name": span_name,
+                "start": verdict.since,
+            }
+        self._write_status(job)
+
+    def _maybe_recover_hang(
+        self,
+        job: TPUJob,
+        gang: List[Tuple[ReplicaType, int]],
+        active: List[Tuple[ReplicaType, int]],
+        observed: Dict[Tuple[str, int], Process],
+        exp_key: str,
+        now: float,
+    ) -> bool:
+        """After declaration: hold the wedged gang alive until every
+        active rank's stack dump is acked (or the forensics grace runs
+        out), freeze the postmortem bundle, then recover — a hang-caused
+        gang restart charged to restart_count, or a terminal failure at
+        the backoff limit. Returns True once recovery was issued."""
+        directive = job.status.stackdump_directive or {}
+        acks = directive.get("acks") or {}
+        declared_at = float((job.status.hang_state or {}).get("time") or now)
+        if (
+            len(acks) < len(active)
+            and now - declared_at < FORENSICS_GRACE_SECONDS
+        ):
+            # Sweep still in flight: each agent ack re-enqueues us via the
+            # job MODIFIED event; the rate-limited requeue is the backstop
+            # that ends the wait when an agent never acks.
+            self._route(job.key()).add_rate_limited(job.key())
+            return False
+        bb = self._blackboxes.setdefault(job.metadata.uid, Blackbox())
+        bb.observe_status(job)
+        art = bb.freeze(
+            self.store, job, reason="hang",
+            detail=dict(job.status.hang_state or {}),
+        )
+        if art is not None:
+            self.recorder.normal(
+                job, ev.REASON_POSTMORTEM_FROZEN,
+                f"postmortem bundle frozen ({len(acks)}/{len(active)} rank "
+                f"stack dumps shipped): tpujob debug {job.metadata.name}",
+            )
+        rp = job.spec.run_policy
+        # Hangs consume the failure budget exactly like crashes: freshen
+        # restart_count from the store first (same staleness rule as the
+        # retry path), then fail at the limit.
+        try:
+            stored = self.store.get(
+                KIND_TPUJOB, job.metadata.namespace, job.metadata.name
+            )
+            job.status.restart_count = max(
+                job.status.restart_count, stored.status.restart_count
+            )
+        except NotFoundError:
+            pass
+        if (
+            rp.backoff_limit is not None
+            and job.status.restart_count >= rp.backoff_limit
+        ):
+            self._fail_job(
+                job, ev.REASON_JOB_FAILED,
+                f"hung at step {(job.status.hang_state or {}).get('stuck_step')} "
+                f"and backoff limit {rp.backoff_limit} exceeded "
+                f"({job.status.restart_count} restarts)",
+            )
+            self._finish(job)
+            return True
+        self._restart_gang(job, gang, observed, exp_key, cause=CAUSE_HANG)
+        wd = self._watchdogs.get(job.metadata.uid)
+        if wd is not None:
+            wd.reset(now)
+        return True
+
+    def _close_hang_span(
+        self, job: TPUJob, now: float, terminal: bool = False
+    ) -> None:
+        """Close the open hang span (opened backdated at declaration) and
+        observe its width — last observed progress -> recovered gang
+        RUNNING — as hang downtime; the SAME width feeds lost-seconds
+        under cause ``hang`` (single source, like restart/resize). On
+        recovery the declared state clears; at terminal it stays — the
+        job never recovered, and hang_state is the forensic residue."""
+        info = self._open_hang.pop(job.metadata.uid, None)
+        if info is None:
+            return
+        self.tracer.close(info["ns"], info["name"], now)
+        downtime = max(0.0, now - info["start"])
+        self.metrics.observe_hist("tpujob_hang_downtime_seconds", downtime)
+        self.metrics.inc(
+            "tpujob_lost_seconds_total", downtime,
+            labels={"cause": GOODPUT_HANG},
+        )
+        if not terminal:
+            job.status.hang_state = {}
 
     # ---- elastic gangs (r12) --------------------------------------------
 
@@ -1584,6 +1813,14 @@ class TPUJobController:
         for NEW gangs. Clean windows clear all four. Best-effort end to
         end — a telemetry read failure never fails a sync."""
         uid = job.metadata.uid
+        # Disambiguation (r15): while the gang-progress watchdog has a
+        # stall pending or declared, EVERY rank has stopped — that is a
+        # hang, not a straggler; feeding the frozen windows to the
+        # median-ratio tracker would burn its flap hysteresis on
+        # non-movement and could flag arbitrary ranks on resume.
+        wd = self._watchdogs.get(uid)
+        if wd is not None and wd.stalled:
+            return
         try:
             batches = job_telemetry(
                 self.store, job.metadata.namespace, job.metadata.name
@@ -2284,6 +2521,10 @@ class TPUJobController:
         full = (
             job.spec.run_policy.gang_restart
             or cause is CAUSE_PREEMPTION
+            # A hang wedges every rank in the same dead collective — no
+            # member has FAILED, so a partial restart would select zero
+            # targets; the whole (still-alive) gang goes down together.
+            or cause is CAUSE_HANG
             or _failed(observed.get((chief[0].value, chief[1])))
             or any(_failed(p) and p.status.node_lost for p in targets)
         )
@@ -2312,7 +2553,21 @@ class TPUJobController:
         # Trace: open the restart span NOW — the gang is going down; it
         # closes when the recreated gang reports RUNNING again, so its
         # width is the job's actual recovery downtime (MTTR), by cause.
+        # EXCEPT cause hang: the hang span (opened at declaration,
+        # backdated to when progress stopped) is already the open window;
+        # opening a restart span too would double-count the same outage
+        # across two lost-seconds causes (docs/design.md §6.3).
         now = time.time()
+        if cause is CAUSE_HANG:
+            set_condition(
+                job.status,
+                new_condition(ConditionType.RESTARTING, reason, message),
+            )
+            self.recorder.normal(
+                job, reason, f"{message} ({len(targets)} processes)"
+            )
+            self._delete_gang_targets(job, targets, exp_key, full)
+            return
         open_info = self._open_restart.get(job.metadata.uid)
         if open_info is not None and open_info["cause"] != cause:
             # A differently-caused restart supersedes the open window: a
@@ -2344,6 +2599,14 @@ class TPUJobController:
         self.recorder.normal(
             job, reason, f"{message} ({len(targets)} processes)"
         )
+        self._delete_gang_targets(job, targets, exp_key, full)
+
+    def _delete_gang_targets(
+        self, job: TPUJob, targets: List[Process], exp_key: str, full: bool
+    ) -> None:
+        """The teardown half of a gang restart (shared by every cause,
+        hang included): delete the targets under deletion expectations,
+        fence the rendezvous on a full restart, persist status."""
         if targets:
             self.expectations.expect_deletions(exp_key, len(targets))
             deleted = 0
@@ -2393,6 +2656,22 @@ class TPUJobController:
 
     def _finish(self, job: TPUJob) -> None:
         """Terminal transition: persist status, then clean up children."""
+        # Forensics first (r15): freeze the flight recorder into the
+        # postmortem bundle for ANY terminal failure — the children are
+        # about to be GC'd and the scene with them. Idempotent (the
+        # first freeze of the incarnation wins; a hang already froze).
+        if has_condition(job.status, ConditionType.FAILED):
+            bb = self._blackboxes.setdefault(job.metadata.uid, Blackbox())
+            bb.observe_status(job)
+            if bb.freeze(
+                self.store, job,
+                reason="hang" if job.status.hang_state else "failed",
+            ) is not None:
+                self.recorder.normal(
+                    job, ev.REASON_POSTMORTEM_FROZEN,
+                    f"postmortem bundle frozen: "
+                    f"tpujob debug {job.metadata.name}",
+                )
         self._write_status(job)
         # Trace: seal the timeline. The root span (span_id = trace id —
         # what every other span parents to) covers submit -> completion;
@@ -2423,6 +2702,7 @@ class TPUJobController:
             # closes at completion time — bounded, not dangling.
             self._close_restart_span(job, end)
             self._close_resize_span(job, end, force=True)
+            self._close_hang_span(job, end, terminal=True)
             wait = self._open_schedwait.pop(uid, None)
             if wait is not None:
                 self.tracer.close(wait["ns"], wait["name"], end)
@@ -2443,6 +2723,9 @@ class TPUJobController:
         # running job's clean windows clear it.
         self._stragglers.pop(uid, None)
         self._straggler_seen_seq.pop(uid, None)
+        self._watchdogs.pop(uid, None)
+        self._blackboxes.pop(uid, None)
+        self._open_hang.pop(uid, None)
         self._delete_children(
             job.metadata.namespace, job.metadata.name, job.spec.run_policy.cleanup_policy
         )
@@ -2527,6 +2810,24 @@ class TPUJobController:
             # publishes requests, the chief acks captures) — always keep
             # the store's copy, exactly like eval_metrics.
             profile_directive = fresh.status.profile_directive
+            # Hang plane (r15): hang_count is monotonic like the restart
+            # counters. The stackdump directive merges by epoch — the
+            # reconciler authors epoch bumps, the HostAgents write acks
+            # store-side; a higher epoch wins wholesale, and at equal
+            # epochs the ack maps UNION (neither a stale reconciler
+            # snapshot nor a racing agent write may drop a shipped rank).
+            hang_count = max(fresh.status.hang_count, job.status.hang_count)
+            sd_fresh = fresh.status.stackdump_directive or {}
+            sd_job = job.status.stackdump_directive or {}
+            if sd_fresh.get("epoch", 0) > sd_job.get("epoch", 0):
+                stackdump = sd_fresh
+            else:
+                stackdump = dict(sd_job)
+                if sd_fresh.get("epoch", 0) == sd_job.get("epoch", 0):
+                    acks = dict(sd_job.get("acks") or {})
+                    acks.update(sd_fresh.get("acks") or {})
+                    if acks:
+                        stackdump["acks"] = acks
             fresh.status = job.status
             fresh.status.restart_count = count
             fresh.status.preemption_count = pcount
@@ -2538,6 +2839,8 @@ class TPUJobController:
             fresh.status.world_size = world
             fresh.status.eval_metrics = eval_metrics
             fresh.status.profile_directive = profile_directive
+            fresh.status.hang_count = hang_count
+            fresh.status.stackdump_directive = stackdump
             # The rendezvous-port annotation is managed store-side
             # (_rendezvous_port persists it, _clear_rendezvous removes it);
             # merging it from a stale cached copy here would resurrect a
@@ -2607,10 +2910,14 @@ def _status_equal_ignoring_heartbeat(a, b) -> bool:
     enqueue → write: a hot loop)."""
     import dataclasses
 
+    # stackdump_directive follows the resize_directive rule: the
+    # reconciler authors it only together with a hang declaration (which
+    # breaks equality through hang_count/hang_state anyway), while the
+    # HostAgents write acks into it through the API mid-sweep.
     return dataclasses.replace(
         a, last_reconcile_time=None, eval_metrics={}, resize_directive={},
-        profile_directive={},
+        profile_directive={}, stackdump_directive={},
     ) == dataclasses.replace(
         b, last_reconcile_time=None, eval_metrics={}, resize_directive={},
-        profile_directive={},
+        profile_directive={}, stackdump_directive={},
     )

@@ -111,6 +111,15 @@ class TPUJobClient:
         step-time spread) and the goodput decomposition."""
         return self._request("GET", f"/api/tpujob/{namespace}/{name}/telemetry")
 
+    def postmortem(self, namespace: str, name: str) -> Dict[str, Any]:
+        """The job's frozen postmortem: {"job", "reason", "frozen_at",
+        "bundle", "stackdumps"}. Raises TPUJobApiError(404) when nothing
+        was ever frozen OR the job (and its forensics) was GC'd — callers
+        must surface that loudly, never as an empty result."""
+        return self._request(
+            "GET", f"/api/tpujob/{namespace}/{name}/postmortem"
+        )
+
     def profile(self, namespace: str, name: str, steps: int,
                 profile_dir: str = "") -> Dict[str, Any]:
         """Publish an on-demand profile directive: the chief wraps the

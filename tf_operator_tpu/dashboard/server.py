@@ -10,6 +10,9 @@ Routes (reference: dashboard/backend/handler/api_handler.go:74-113):
   Chrome trace-event JSON (Perfetto-loadable; obs/export.py)
 - GET    /api/tpujob/{ns}/{name}/telemetry — the job's live telemetry ring
   (per-rank step batches + gang summary + goodput decomposition)
+- GET    /api/tpujob/{ns}/{name}/postmortem — the frozen hang/failure
+  bundle + shipped per-rank stack dumps (404 LOUDLY when never frozen or
+  GC'd with the job — never an empty tar)
 - POST   /api/tpujob/{ns}/{name}/profile  — publish an on-demand profile
   directive (body: {"steps": N, "dir": path?}); the chief captures the
   next N steps and acks with a profile-capture span
@@ -81,6 +84,7 @@ _JOB_RE = re.compile(r"^/api/tpujob/([^/]+)/([^/]+)$")
 _TRACE_RE = re.compile(r"^/api/tpujob/([^/]+)/([^/]+)/trace$")
 _TELEMETRY_RE = re.compile(r"^/api/tpujob/([^/]+)/([^/]+)/telemetry$")
 _PROFILE_RE = re.compile(r"^/api/tpujob/([^/]+)/([^/]+)/profile$")
+_POSTMORTEM_RE = re.compile(r"^/api/tpujob/([^/]+)/([^/]+)/postmortem$")
 _LOGS_RE = re.compile(r"^/api/process/([^/]+)/([^/]+)/logs$")
 _OBJ_KIND_RE = re.compile(r"^/api/v1/([A-Za-z]+)$")
 _OBJ_RE = re.compile(r"^/api/v1/([A-Za-z]+)/([^/]+)/([^/]+)$")
@@ -253,6 +257,53 @@ class _Handler(BaseHTTPRequestHandler):
                     "batches": [to_doc(b) for b in batches],
                     "summary": telemetry_summary(batches),
                     "goodput": goodput_decomposition(spans, batches, submit, end),
+                },
+            )
+
+        m = _POSTMORTEM_RE.match(path)
+        if m:
+            segs = _decode_segments(m)
+            if segs is None:
+                return self._error(400, "invalid name in path (empty or contains '/')")
+            pns, pname = segs
+            from tf_operator_tpu.obs.blackbox import (
+                job_stackdumps,
+                load_postmortem,
+            )
+
+            bundle = load_postmortem(self.store, pns, pname)
+            if bundle is None:
+                # LOUD by design: a GC'd job's forensics are gone with it,
+                # and a live job without a bundle has nothing frozen yet —
+                # neither case may read as an empty-but-successful result.
+                try:
+                    self.store.get(KIND_TPUJOB, pns, pname)
+                    detail = "job exists but no postmortem has been frozen"
+                except NotFoundError:
+                    detail = (
+                        "job deleted — forensics are GC'd with the job"
+                    )
+                return self._error(
+                    404, f"no postmortem for tpujob {pns}/{pname} ({detail})"
+                )
+            dumps = job_stackdumps(self.store, pns, pname)
+            return self._json(
+                200,
+                {
+                    "job": f"{pns}/{pname}",
+                    "reason": bundle.reason,
+                    "frozen_at": bundle.time,
+                    "truncated": bundle.truncated,
+                    "bundle": bundle.payload,
+                    "stackdumps": [
+                        {
+                            "rank": d.rank, "epoch": d.epoch,
+                            "host": d.payload.get("host", ""),
+                            "truncated": d.truncated,
+                            "text": d.payload.get("text", ""),
+                        }
+                        for d in dumps
+                    ],
                 },
             )
 

@@ -223,6 +223,32 @@ async function viewJob(ns, name){
         ...['Epoch','Direction','World','Cause','Time'].map(h=>el('th',null,h)))), ztb)));
   }
 
+  // Hang forensics (r15): a declared hang is the headline — stuck step +
+  // seconds-since-progress, not stale tokens/s.
+  if (j.status.hang_state && Object.keys(j.status.hang_state).length){
+    const h = j.status.hang_state;
+    const ago = h.since ? Math.max(0, Date.now()/1000 - h.since).toFixed(0)+'s' : '?';
+    const hkv = el('div',{class:'kv'});
+    const hpairs = [
+      ['Stuck at step', String(h.stuck_step!==undefined ? h.stuck_step : '?')],
+      ['No progress for', ago],
+      ['Last moving ranks', JSON.stringify(h.last_moving_ranks||[])],
+      ['Declared', fmtTime(h.time)],
+    ];
+    for (const [k,v] of hpairs){ hkv.appendChild(el('b',null,k)); hkv.appendChild(el('span',null,v)); }
+    root.appendChild(el('div',{class:'card'}, el('h2',null,'HUNG'), hkv));
+  }
+  // Postmortem link: rendered only when a bundle is actually frozen
+  // (the route 404s otherwise — loud for tools, absent for the UI).
+  try{
+    const pm = await api('/api/tpujob/'+ns+'/'+name+'/postmortem');
+    root.appendChild(el('div',{class:'card'}, el('h2',null,'Postmortem'),
+      el('div',null,
+        'frozen: '+pm.reason+', '+(pm.stackdumps||[]).length+' rank stack dump(s) — ',
+        el('a',{href:'/api/tpujob/'+ns+'/'+name+'/postmortem'}, 'bundle JSON'),
+        el('span',{class:'muted'}, '  (tar: tpujob debug '+ns+' '+name+')'))));
+  }catch(err){/* no postmortem frozen — the card simply stays absent */}
+
   // Live step telemetry (r13): sparklines over the per-rank ring batches
   // plus the gang summary and goodput decomposition.
   try{

@@ -24,8 +24,19 @@ from tf_operator_tpu.obs.spans import (
     span_labels,
 )
 from tf_operator_tpu.obs.export import derive_timings, to_chrome_trace
+from tf_operator_tpu.obs.blackbox import (
+    Blackbox,
+    PostmortemArtifact,
+    load_postmortem,
+)
+from tf_operator_tpu.obs.watchdog import GangWatchdog, HangVerdict
 
 __all__ = [
+    "Blackbox",
+    "GangWatchdog",
+    "HangVerdict",
+    "PostmortemArtifact",
+    "load_postmortem",
     "COMPONENT_AGENT",
     "COMPONENT_CONTROLLER",
     "COMPONENT_SCHEDULER",

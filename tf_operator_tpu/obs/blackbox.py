@@ -1,0 +1,373 @@
+"""Blackbox flight recorder: frozen forensics for hangs and terminal failures.
+
+When a job dies loudly, the exit code says why. When it hangs, or fails
+in a way the operator must diagnose after the fact, the scene is gone by
+the time anyone looks: the ring evicted old telemetry, processes were
+killed and GC'd, and the only artifact is a terminal condition string.
+MegaScale-style production postmortems need the opposite — capture the
+scene BEFORE recovery destroys it.
+
+Two store-object roles share one kind (:data:`KIND_POSTMORTEM`), both
+labeled with the indexed job-name label so listing/GC is one bucket read
+(same rule as spans/telemetry):
+
+- **Stack dumps** (``section="stackdump"``): one object per rank per
+  stack-sweep epoch, shipped by the HostAgent after SIGUSR2 made the
+  harness's faulthandler hook write all-thread stacks to a per-rank
+  file. Text is size-capped with an explicit truncation marker —
+  forensics are bounded, never unbounded, and truncation is visible,
+  never silent.
+- **The bundle** (``section="bundle"``): the per-job flight recorder
+  frozen at declaration of a hang or any terminal failure: last N
+  events, open + recent spans, the last telemetry window per rank,
+  bounded status history (the in-memory part — the store only keeps the
+  LATEST status), the hang verdict, and whatever stack dumps had been
+  shipped. Served at ``GET /api/tpujob/<ns>/<name>/postmortem`` and
+  assembled into a tar by ``tpujob debug``.
+
+Everything here is best-effort (a forensics failure must never break
+recovery) and GC'd with the job alongside spans/telemetry — after which
+``tpujob debug`` fails LOUDLY (404), not with an empty tar.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional
+
+from tf_operator_tpu.api.types import (
+    API_GROUP,
+    KIND_EVENT,
+    KIND_POSTMORTEM,
+    LABEL_GROUP,
+    LABEL_JOB_NAME,
+    ObjectMeta,
+)
+from tf_operator_tpu.obs.spans import job_trace, trace8
+from tf_operator_tpu.obs.telemetry import job_telemetry, latest_window
+
+# NOTE: same import rule as spans.py/telemetry.py — no module-level import
+# from tf_operator_tpu.runtime (runtime imports obs); store exception
+# types are resolved lazily.
+
+log = logging.getLogger("tpujob.obs")
+
+# Bounds (truncate-with-marker, never drop silently; never unbounded).
+BLACKBOX_MAX_EVENTS = 50  # newest events kept in the bundle
+BLACKBOX_MAX_SPANS = 120  # newest spans kept (open spans always kept)
+BLACKBOX_MAX_STATUS = 50  # in-memory status-transition ring depth
+STACKDUMP_MAX_CHARS = 16_000  # per-rank stack text cap
+TRUNCATION_MARKER = "\n...[truncated by blackbox size cap]"
+
+
+@dataclass
+class PostmortemArtifact:
+    """One forensics store object — a rank's stack dump or the frozen
+    per-job bundle (discriminated by ``section``)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    trace_id: str = ""  # job uid
+    section: str = ""  # "stackdump" | "bundle"
+    reason: str = ""  # bundle: "hang" | "failed"; stackdump: ""
+    rank: int = -1  # stackdump only
+    epoch: int = 0  # stackdump: sweep epoch that produced it
+    payload: Dict[str, Any] = field(default_factory=dict)
+    truncated: bool = False  # a size cap bit (marker is in the text too)
+    time: float = 0.0
+    kind: str = KIND_POSTMORTEM
+
+    def key(self) -> str:
+        return self.metadata.key()
+
+
+def postmortem_labels(job_name: str) -> Dict[str, str]:
+    return {LABEL_GROUP: API_GROUP, LABEL_JOB_NAME: job_name}
+
+
+def postmortem_name(job_name: str, trace_id: str) -> str:
+    """Deterministic bundle name: one frozen bundle per job incarnation;
+    a second freeze attempt is an AlreadyExists no-op (first scene wins —
+    later freezes would capture the recovery, not the failure)."""
+    return f"{job_name}-{trace8(trace_id)}-postmortem"
+
+
+def stackdump_name(job_name: str, trace_id: str, rank: int, epoch: int) -> str:
+    """Deterministic per-(rank, sweep-epoch) name — the agent's shipment
+    is idempotent and one hang yields exactly one dump per rank."""
+    return f"{job_name}-{trace8(trace_id)}-stack-r{rank}-e{epoch}"
+
+
+def cap_text(text: str, limit: int = STACKDUMP_MAX_CHARS) -> "tuple[str, bool]":
+    """Bound a forensic text blob: keep the TAIL (faulthandler prints the
+    current — wedged — frame last in each thread block, and the newest
+    threads matter most) and mark the cut explicitly."""
+    if len(text) <= limit:
+        return text, False
+    keep = max(0, limit - len(TRUNCATION_MARKER))
+    return TRUNCATION_MARKER.lstrip("\n") + "\n" + text[-keep:], True
+
+
+def ship_stackdump(
+    store: Any,
+    namespace: str,
+    job_name: str,
+    trace_id: str,
+    rank: int,
+    epoch: int,
+    text: str,
+    host: str = "",
+) -> Optional[PostmortemArtifact]:
+    """Agent-side: publish one rank's stack text through the store/API
+    seam (size-capped). Best-effort; AlreadyExists is success (another
+    sweep pass already shipped this rank/epoch)."""
+    capped, truncated = cap_text(text)
+    art = PostmortemArtifact(
+        metadata=ObjectMeta(
+            name=stackdump_name(job_name, trace_id, rank, epoch),
+            namespace=namespace,
+            labels=postmortem_labels(job_name),
+        ),
+        trace_id=trace_id,
+        section="stackdump",
+        rank=rank,
+        epoch=epoch,
+        payload={"text": capped, "host": host},
+        truncated=truncated,
+        time=time.time(),
+    )
+    try:
+        return store.create(art)
+    except Exception as exc:  # noqa: BLE001 — forensics are best-effort
+        try:
+            from tf_operator_tpu.runtime.store import AlreadyExistsError
+
+            if isinstance(exc, AlreadyExistsError):
+                return art
+        except Exception:  # noqa: BLE001
+            pass
+        log.debug("stackdump %s/%s not shipped: %s",
+                  namespace, art.metadata.name, exc)
+        return None
+
+
+def job_stackdumps(
+    store: Any, namespace: str, job_name: str, epoch: Optional[int] = None
+) -> List[PostmortemArtifact]:
+    """All shipped stack dumps of a job (optionally one sweep epoch),
+    rank order."""
+    arts = store.list(
+        KIND_POSTMORTEM, namespace=namespace,
+        label_selector={LABEL_JOB_NAME: job_name},
+    )
+    dumps = [a for a in arts if a.section == "stackdump"
+             and (epoch is None or a.epoch == epoch)]
+    dumps.sort(key=lambda a: (a.epoch, a.rank))
+    return dumps
+
+
+def load_postmortem(
+    store: Any, namespace: str, job_name: str
+) -> Optional[PostmortemArtifact]:
+    """The job's frozen bundle, or None (not yet frozen, or GC'd —
+    callers surface that distinction loudly, never as an empty result)."""
+    arts = store.list(
+        KIND_POSTMORTEM, namespace=namespace,
+        label_selector={LABEL_JOB_NAME: job_name},
+    )
+    for a in arts:
+        if a.section == "bundle":
+            return a
+    return None
+
+
+class Blackbox:
+    """Bounded in-memory flight recorder for ONE job.
+
+    The reconciler owns one per job and feeds it status transitions as
+    they happen (the only signal the store does NOT retain history for);
+    events/spans/telemetry are pulled from the store at freeze time —
+    they are already durable and job-labeled. ``freeze`` assembles and
+    persists the bundle exactly once per incarnation.
+    """
+
+    def __init__(self, max_status: int = BLACKBOX_MAX_STATUS) -> None:
+        self._status: Deque[Dict[str, Any]] = deque(maxlen=max_status)
+        self._last_sig: Optional[tuple] = None
+
+    def observe_status(self, job: Any, now: Optional[float] = None) -> None:
+        """Record one status snapshot iff it differs from the last one
+        (phase/conditions/counters — heartbeat-only churn is skipped)."""
+        st = job.status
+        conds = [(c.type.value, bool(c.status), c.reason) for c in st.conditions]
+        sig = (
+            st.phase().value, tuple(conds), st.restart_count,
+            st.preemption_count, st.resize_count, st.hang_count,
+            st.last_restart_cause,
+        )
+        if sig == self._last_sig:
+            return
+        self._last_sig = sig
+        self._status.append({
+            "time": time.time() if now is None else now,
+            "phase": st.phase().value,
+            "conditions": [
+                {"type": t, "status": s, "reason": r} for t, s, r in conds
+            ],
+            "restart_count": st.restart_count,
+            "preemption_count": st.preemption_count,
+            "resize_count": st.resize_count,
+            "hang_count": st.hang_count,
+            "last_restart_cause": st.last_restart_cause,
+        })
+
+    def status_history(self) -> List[Dict[str, Any]]:
+        return list(self._status)
+
+    def freeze(
+        self,
+        store: Any,
+        job: Any,
+        reason: str,
+        detail: Optional[Dict[str, Any]] = None,
+        now: Optional[float] = None,
+    ) -> Optional[PostmortemArtifact]:
+        """Assemble + persist the postmortem bundle (idempotent: the
+        first freeze of an incarnation wins). Returns the artifact, or
+        None when the store write failed. Never raises."""
+        now = time.time() if now is None else now
+        ns = job.metadata.namespace
+        name = job.metadata.name
+        uid = job.metadata.uid
+        truncated: List[str] = []
+        try:
+            events = self._collect_events(store, ns, name, truncated)
+            spans = self._collect_spans(store, ns, name, truncated)
+            telem = self._collect_telemetry(store, ns, name)
+            stacks = [
+                {
+                    "rank": d.rank, "epoch": d.epoch,
+                    "host": d.payload.get("host", ""),
+                    "truncated": d.truncated,
+                    "text": d.payload.get("text", ""),
+                }
+                for d in job_stackdumps(store, ns, name)
+            ]
+        except Exception as exc:  # noqa: BLE001 — forensics are best-effort
+            log.debug("postmortem collection for %s/%s degraded: %s",
+                      ns, name, exc)
+            events, spans, telem, stacks = [], [], {}, []
+            truncated.append("collection-error")
+        art = PostmortemArtifact(
+            metadata=ObjectMeta(
+                name=postmortem_name(name, uid),
+                namespace=ns,
+                labels=postmortem_labels(name),
+            ),
+            trace_id=uid,
+            section="bundle",
+            reason=reason,
+            payload={
+                "job": f"{ns}/{name}",
+                "reason": reason,
+                "frozen_at": now,
+                "detail": dict(detail or {}),
+                "status_history": self.status_history(),
+                "events": events,
+                "spans": spans,
+                "telemetry": telem,
+                "stackdumps": stacks,
+            },
+            truncated=bool(truncated),
+            time=now,
+        )
+        if truncated:
+            art.payload["truncated_sections"] = truncated
+        try:
+            return store.create(art)
+        except Exception as exc:  # noqa: BLE001
+            try:
+                from tf_operator_tpu.runtime.store import AlreadyExistsError
+
+                if isinstance(exc, AlreadyExistsError):
+                    return art  # first scene already frozen — keep it
+            except Exception:  # noqa: BLE001
+                pass
+            log.debug("postmortem for %s/%s not frozen: %s", ns, name, exc)
+            return None
+
+    # -- collection helpers (store → bounded JSON) --------------------------
+
+    @staticmethod
+    def _collect_events(store, ns, job_name, truncated) -> List[Dict[str, Any]]:
+        evs = [
+            e for e in store.list(KIND_EVENT, namespace=ns)
+            if e.involved_name == job_name
+            or e.involved_name.startswith(job_name + "-")
+        ]
+        evs.sort(key=lambda e: e.timestamp)
+        if len(evs) > BLACKBOX_MAX_EVENTS:
+            truncated.append("events")
+            evs = evs[-BLACKBOX_MAX_EVENTS:]
+        return [
+            {
+                "time": e.timestamp, "type": e.type.value, "reason": e.reason,
+                "object": e.involved_name, "count": e.count,
+                "message": e.message,
+            }
+            for e in evs
+        ]
+
+    @staticmethod
+    def _collect_spans(store, ns, job_name, truncated) -> List[Dict[str, Any]]:
+        spans = job_trace(store, ns, job_name)
+        open_spans = [s for s in spans if not s.end_time]
+        closed = [s for s in spans if s.end_time]
+        keep = BLACKBOX_MAX_SPANS - len(open_spans)
+        if len(closed) > keep > 0:
+            truncated.append("spans")
+            closed = closed[-keep:]
+        return [
+            {
+                "name": s.metadata.name, "op": s.op, "component": s.component,
+                "start": s.start_time, "end": s.end_time, "attrs": s.attrs,
+                "open": not s.end_time,
+            }
+            for s in (closed + open_spans)
+        ]
+
+    @staticmethod
+    def _collect_telemetry(store, ns, job_name) -> Dict[str, Any]:
+        window = latest_window(job_telemetry(store, ns, job_name))
+        return {
+            str(rank): {
+                "seq": b.seq, "end_step": b.end_step,
+                "step_time_s": b.step_time_s, "tokens_per_s": b.tokens_per_s,
+                "data_wait_s": b.data_wait_s, "ckpt_stall_s": b.ckpt_stall_s,
+                "time": b.time, "degraded": b.degraded,
+            }
+            for rank, b in sorted(window.items())
+        }
+
+
+def delete_forensics(store: Any, namespace: str, job_name: str) -> int:
+    """GC every forensics object of a job (stack dumps + frozen bundle) —
+    called from the reconciler's deletion path next to span/telemetry GC.
+    Returns the number deleted; never raises."""
+    deleted = 0
+    try:
+        arts = store.list(
+            KIND_POSTMORTEM, namespace=namespace,
+            label_selector={LABEL_JOB_NAME: job_name},
+        )
+    except Exception:  # noqa: BLE001
+        return 0
+    for a in arts:
+        try:
+            store.delete(KIND_POSTMORTEM, namespace, a.metadata.name)
+            deleted += 1
+        except Exception:  # noqa: BLE001 — already gone is fine
+            pass
+    return deleted

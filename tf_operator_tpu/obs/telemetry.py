@@ -71,12 +71,19 @@ CAUSE_DATA_WAIT = "data-wait"
 CAUSE_CKPT_STALL = "ckpt-stall"
 CAUSE_RESTART = "restart"
 CAUSE_RESIZE = "resize"
+# Hang (r15): span-derived like restart/resize — the watchdog opens a
+# dedicated "hang" span at declaration and the reconciler closes it when
+# the recovered gang is running again, so hang downtime is attributed to
+# exactly one cause (the recovery restart deliberately does NOT open a
+# "restart" span; docs/design.md §6.3 cause-attribution rule).
+CAUSE_HANG = "hang"
 GOODPUT_CAUSES = (
     CAUSE_COMPILE_INIT,
     CAUSE_DATA_WAIT,
     CAUSE_CKPT_STALL,
     CAUSE_RESTART,
     CAUSE_RESIZE,
+    CAUSE_HANG,
 )
 
 
@@ -353,6 +360,8 @@ def goodput_decomposition(
             lost[CAUSE_RESTART] += max(0.0, s.end_time - s.start_time)
         elif s.op == "resize" and s.end_time:
             lost[CAUSE_RESIZE] += max(0.0, s.end_time - s.start_time)
+        elif s.op == "hang" and s.end_time:
+            lost[CAUSE_HANG] += max(0.0, s.end_time - s.start_time)
     # Per-rank stall totals: prefer the run-cumulative counters on each
     # rank's LATEST batch (eviction-proof — the ring drops old windows but
     # never the newest), falling back to summing window deltas for

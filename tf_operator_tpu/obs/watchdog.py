@@ -1,0 +1,187 @@
+"""Gang-progress watchdog: detect the silent hang the exit taxonomy misses.
+
+The operator's whole failure model is exit-code classification — but the
+dominant silent failure in real fleets is a *hang*: one rank wedges in a
+collective, every other rank blocks with it, no process exits, and every
+existing signal stays green:
+
+- the exit taxonomy (utils/exit_codes.py) sees no exit;
+- host heartbeats (runtime/agent.py) keep beating — the AGENT is fine;
+- the straggler median-rule (obs/telemetry.py detect_stragglers) is
+  *designed* to stay silent when all ranks stop together: the median
+  moves with the gang, nobody is an outlier.
+
+:class:`GangWatchdog` fills exactly that gap. It is a pure per-job state
+machine the reconciler drives from the same Telemetry ring the straggler
+tracker reads: the gang's progress marker is ``max(end_step)`` over the
+newest window per rank, and the gang is declared HUNG when that marker
+has not advanced for ``run_policy.hang_timeout_seconds`` while host
+heartbeats stay live (heartbeat-dead hosts route to node-lost handling,
+never here — a dead host is a LOUD failure).
+
+Disambiguation rule (the straggler/hang boundary): a single slow rank
+moves while the median holds → straggler plane. ALL ranks stop → the
+progress marker freezes → watchdog. While a stall is pending
+(``stalled`` is True), the reconciler suppresses straggler observation
+so a gang-wide freeze can never leak flap-hysteresis state into
+:class:`~tf_operator_tpu.obs.telemetry.StragglerTracker`.
+
+False-positive guards:
+
+- **Pre-first-step grace**: before the job's TTFS span exists
+  (obs/spans.py first_step_span_name) there is no progress to measure —
+  compile/init can legitimately take minutes; the watchdog stays idle.
+  Once the first step is marked, the progress clock starts at the LATER
+  of the TTFS time and the newest telemetry flush.
+- **Resize windows are not hangs**: every observation carries the job's
+  resize_epoch; an epoch change resets the progress clock (the gang is
+  re-forming — the same epoch-guard rule resize spans use).
+- **Flush-boundary hysteresis**: progress is measured against the
+  monotonic step high-water mark, not against flush arrival times — a
+  rank re-flushing the same window, or ranks flushing out of phase,
+  never advances (or regresses) the marker. One observation past the
+  timeout arms; the FIRST marker advance clears, no matter how long the
+  stall lasted.
+- **One hang ⇒ one verdict**: after firing, the watchdog latches
+  (``hung``) and returns no further verdicts until progress resumes or
+  :meth:`reset` (gang restart) — the reconciler's stack-sweep directive
+  epoch dedup rides this latch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tf_operator_tpu.obs.telemetry import Telemetry
+
+__all__ = ["HangVerdict", "GangWatchdog"]
+
+
+@dataclass
+class HangVerdict:
+    """One declared hang: the scene as the watchdog saw it."""
+
+    stuck_step: int  # the step high-water mark nobody advanced past
+    since: float  # wall-clock when progress last advanced
+    stalled_for: float  # seconds of stall at declaration time
+    # Ranks whose newest window reports the stuck step — the last ranks
+    # that were still moving when the gang froze. The complement (ranks
+    # stuck on an EARLIER step) is the first place to look for the
+    # wedge's origin.
+    last_moving_ranks: List[int] = field(default_factory=list)
+
+
+class GangWatchdog:
+    """Per-job hang state machine (one per job incarnation).
+
+    The reconciler calls :meth:`observe` on every reconcile of a running
+    gang; a non-None return is a freshly declared hang. All state is in
+    memory — an operator restart simply re-arms from the live telemetry
+    (the stall, if real, is still there ``timeout_s`` later; detection
+    latency degrades, correctness doesn't).
+    """
+
+    def __init__(self, timeout_s: float) -> None:
+        self.timeout_s = max(0.0, float(timeout_s))
+        self._max_step = -1  # progress high-water mark (-1: no telemetry yet)
+        self._progress_time: Optional[float] = None
+        self._epoch: Optional[int] = None  # resize epoch last observed
+        self._armed = False  # stall crossed the timeout at least once
+        self.hung = False  # latched verdict; cleared on progress or reset()
+
+    # -- derived state ------------------------------------------------------
+
+    @property
+    def stalled(self) -> bool:
+        """True while a stall is pending or declared — the reconciler's
+        cue to suppress straggler observation (disambiguation rule)."""
+        return self.hung or self._armed
+
+    def seconds_since_progress(self, now: float) -> Optional[float]:
+        if self._progress_time is None:
+            return None
+        return max(0.0, now - self._progress_time)
+
+    # -- the state machine --------------------------------------------------
+
+    def observe(
+        self,
+        window: Dict[int, Telemetry],
+        now: float,
+        resize_epoch: int = 0,
+        first_step_time: Optional[float] = None,
+    ) -> Optional[HangVerdict]:
+        """Consume one reconcile's view of the gang; return a verdict the
+        FIRST time the stall crosses the timeout, None otherwise.
+
+        ``window`` is latest_window() over the job's telemetry;
+        ``first_step_time`` is the TTFS span's start (None before the
+        first step — pre-first-step grace keeps the watchdog idle).
+        """
+        if self.timeout_s <= 0:
+            return None
+        # Resize in flight / just landed: the gang is re-forming, steps
+        # legitimately pause. Reset the clock, keep the high-water mark
+        # (post-resize progress must still ADVANCE it to count).
+        if self._epoch is not None and resize_epoch != self._epoch:
+            self._progress_time = now
+            self._armed = False
+            self.hung = False
+        self._epoch = resize_epoch
+
+        if not window:
+            # No telemetry yet. Idle until the TTFS span proves the data
+            # plane produced a first step; from then on, silence itself
+            # is the signal (a gang that marked step 1 then never flushed
+            # a window is exactly as wedged as one that froze mid-run).
+            if first_step_time is None:
+                return None
+            if self._progress_time is None:
+                self._progress_time = min(first_step_time, now)
+            return self._check(now, stuck_step=0, moving=[])
+
+        max_step = max(b.end_step for b in window.values())
+        if max_step > self._max_step:
+            # Progress: advance the mark, restart the clock, clear any
+            # armed/declared state (first advance wins, flush cadence
+            # irrelevant).
+            self._max_step = max_step
+            self._progress_time = now
+            self._armed = False
+            self.hung = False
+            return None
+        if self._progress_time is None:
+            self._progress_time = now if first_step_time is None else max(
+                first_step_time, min(b.time for b in window.values())
+            )
+        moving = sorted(
+            r for r, b in window.items() if b.end_step >= self._max_step
+        )
+        return self._check(now, stuck_step=max(self._max_step, 0), moving=moving)
+
+    def _check(
+        self, now: float, stuck_step: int, moving: List[int]
+    ) -> Optional[HangVerdict]:
+        stalled_for = now - (self._progress_time or now)
+        if stalled_for < self.timeout_s:
+            return None
+        self._armed = True
+        if self.hung:
+            return None  # latched: one hang, one verdict, one stack sweep
+        self.hung = True
+        return HangVerdict(
+            stuck_step=stuck_step,
+            since=self._progress_time or now,
+            stalled_for=stalled_for,
+            last_moving_ranks=moving,
+        )
+
+    def reset(self, now: Optional[float] = None) -> None:
+        """Forget everything — called when the gang restarts (the new
+        incarnation re-earns its progress baseline)."""
+        self._max_step = -1
+        self._progress_time = now
+        self._epoch = None
+        self._armed = False
+        self.hung = False

@@ -106,6 +106,53 @@ class JobContext:
             trace_id=e.get(ENV_TRACE_ID, ""),
         )
 
+    # -- hang forensics (r15, obs/blackbox.py) ----------------------------
+
+    def install_stackdump_hook(self) -> str:
+        """Install the SIGUSR2 → all-thread-stack-dump hook (faulthandler)
+        the hang plane's stack sweep relies on: when the reconciler
+        declares the gang HUNG, each HostAgent delivers SIGUSR2 to its
+        wedged members and reads back the file this hook writes.
+
+        Known limit (docs/design.md §6.3): faulthandler dumps PYTHON
+        frames from the signal handler — a rank wedged inside a native
+        extension (a real collective blocks in C++) still dumps, because
+        faulthandler is C-level and async-signal-safe, but the stack shows
+        the Python frame that CALLED into the extension, not the native
+        frames below it. That is exactly the forensic we need: which
+        collective, from where.
+
+        Returns the dump-file path, or "" when no ENV_STACKDUMP_DIR was
+        injected (not running under an agent) or installation failed —
+        never raises; a missing hook degrades the postmortem, not the
+        workload."""
+        import faulthandler
+        import signal
+
+        from tf_operator_tpu.rendezvous.env import (
+            ENV_STACKDUMP_DIR,
+            stackdump_path,
+        )
+
+        dump_dir = os.environ.get(ENV_STACKDUMP_DIR, "")
+        if not dump_dir or not hasattr(faulthandler, "register"):
+            return ""
+        try:
+            os.makedirs(dump_dir, exist_ok=True)
+            path = stackdump_path(
+                dump_dir, self.namespace, self.job_name,
+                self.replica_type, self.replica_index,
+            )
+            f = open(path, "w")  # noqa: SIM115 — faulthandler holds the fd
+            faulthandler.register(signal.SIGUSR2, file=f, all_threads=True)
+            # Keep the file object alive for the process lifetime:
+            # faulthandler writes to the raw fd, and a GC'd file object
+            # would close it out from under the handler.
+            self._stackdump_file = f
+            return path
+        except Exception:  # noqa: BLE001 — forensics must never block launch
+            return ""
+
     # -- device plane helpers (used by workloads after rendezvous) --------
 
     def initialize_distributed(self) -> None:

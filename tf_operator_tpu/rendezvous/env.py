@@ -128,6 +128,34 @@ ENV_WARM_SLOT = "TPUJOB_WARM_SLOT"
 # the job, not one incarnation.
 ENV_TRACE_ID = "TPUJOB_TRACE_ID"
 
+# Hang forensics (r15, obs/blackbox.py): directory where the harness's
+# faulthandler hook writes all-thread stack dumps when the host agent
+# delivers SIGUSR2 during a stack sweep. Injected by the HOST AGENT's
+# backend (like TPUJOB_PEER_DEPOT — the path is host-local, the
+# controller cannot know it); the harness writes one file per process,
+# ``{namespace}_{process-name}.stack``, which the agent reads back and
+# ships through the store/API seam. Unset = no hook installed (a plain
+# SIGUSR2 then kills the process — the default disposition).
+ENV_STACKDUMP_DIR = "TPUJOB_STACKDUMP_DIR"
+
+
+def stackdump_path(
+    dump_dir: str, namespace: str, job_name: str,
+    replica_type: str, replica_index: int,
+) -> str:
+    """The per-process stack-dump file BOTH sides of the SIGUSR2 contract
+    compute independently: the harness writes here when the signal lands,
+    the host agent reads here after delivering it. Mirrors the backend's
+    log-path sanitization (basename() forecloses traversal via crafted
+    names; validation also rejects them at admission)."""
+    import os as _os
+
+    return _os.path.join(
+        dump_dir,
+        f"{_os.path.basename(namespace)}_{_os.path.basename(job_name)}"
+        f"-{replica_type.lower()}-{int(replica_index)}.stack",
+    )
+
 
 def identity_env(spec: "ProcessSpec", namespace: str) -> Dict[str, str]:
     """Identity env derived from a ProcessSpec; the backend injects this so

@@ -46,6 +46,12 @@ def main(argv=None) -> int:
         stream=sys.stderr,
     )
     ctx = JobContext.from_env()
+    # Hang forensics (r15): arm SIGUSR2 → all-thread stack dump before the
+    # workload runs, so a stack sweep can read a wedged gang even when the
+    # wedge is inside the entrypoint's very first step.
+    dump_path = ctx.install_stackdump_hook()
+    if dump_path:
+        log.info("stack-dump hook armed: SIGUSR2 -> %s", dump_path)
     if not ctx.entrypoint:
         log.error("no TPUJOB_ENTRYPOINT set")
         return 2

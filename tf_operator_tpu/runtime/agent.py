@@ -17,11 +17,14 @@ detects node loss and triggers gang restart (runtime/scheduler.py TTL).
 from __future__ import annotations
 
 import logging
+import os
+import signal as _signal
+import tempfile
 import threading
 import time
-from typing import Optional
+from typing import Dict, List, Optional, Tuple
 
-from tf_operator_tpu.api.types import KIND_HOST, KIND_PROCESS, ObjectMeta
+from tf_operator_tpu.api.types import KIND_HOST, KIND_PROCESS, KIND_TPUJOB, ObjectMeta
 from tf_operator_tpu.runtime.objects import (
     Host,
     HostPhase,
@@ -59,6 +62,7 @@ class HostAgent:
         depot_keep: int = 2,
         warm_pool: int = 0,
         warm_import_jax: bool = False,
+        stackdump_dir: Optional[str] = None,
     ) -> None:
         """``depot=True`` starts a host-lifetime shard depot
         (rendezvous/statechannel.py): workloads on this host push each
@@ -98,6 +102,31 @@ class HostAgent:
                 warm_pool, topology=slice_type, import_jax=warm_import_jax
             )
             self.backend.warm_pool = self.warm_pool
+        # Hang forensics (r15, obs/blackbox.py): host-local directory the
+        # harness's SIGUSR2 faulthandler hook dumps stacks into. Injected
+        # through the backend's host-local env exactly like the depot URL
+        # — the path is per-host knowledge the controller cannot stamp.
+        from tf_operator_tpu.rendezvous.env import ENV_STACKDUMP_DIR
+
+        self.stackdump_dir = stackdump_dir or (
+            os.path.join(log_dir, "stackdumps") if log_dir
+            else os.path.join(tempfile.gettempdir(), f"tpujob-stacks-{name}")
+        )
+        try:
+            os.makedirs(self.stackdump_dir, exist_ok=True)
+            # Backends without an env-injection seam (FakeProcessControl)
+            # simply get no harness-side dump hook; the agent-side sweep
+            # still works against whatever the harness wrote elsewhere.
+            if hasattr(self.backend, "extra_env"):
+                self.backend.extra_env[ENV_STACKDUMP_DIR] = self.stackdump_dir
+        except OSError:
+            # Unwritable dump dir degrades the postmortem (no stacks from
+            # this host), never the agent.
+            self.stackdump_dir = ""
+        # (job key, rank) -> directive epoch already swept, so a heartbeat
+        # tick never re-signals a rank for the same hang (one hang ⇒ one
+        # SIGUSR2 per rank; a NEW epoch sweeps again).
+        self._stack_epochs: Dict[Tuple[str, int], int] = {}
         self.heartbeat_interval = heartbeat_interval
         self._stop = threading.Event()
         self._threads: list = []
@@ -258,6 +287,14 @@ class HostAgent:
                 self._touch_heartbeat()
             except Exception:
                 log.exception("agent %s: heartbeat failed; retrying", self.name)
+            # Stack-sweep poll (r15) rides the same cadence: the wedged
+            # gang produces no process events, so the watch loop never
+            # fires — the heartbeat tick is the agent's only live pulse
+            # during a hang.
+            try:
+                self._sweep_stackdumps()
+            except Exception:
+                log.exception("agent %s: stack sweep failed; retrying", self.name)
 
     def _touch_heartbeat(self) -> None:
         def touch(cur):
@@ -365,3 +402,141 @@ class HostAgent:
                         "declared orphaned process %s/%s lost",
                         proc.metadata.namespace, proc.metadata.name,
                     )
+
+    # -- hang forensics: the stack sweep (r15, obs/blackbox.py) -----------
+
+    # How long after SIGUSR2 delivery to wait before reading the dump
+    # file: faulthandler writes synchronously inside the signal handler,
+    # but delivery itself is asynchronous to os.kill returning.
+    STACKDUMP_SETTLE_SECONDS = 0.3
+
+    def _sweep_stackdumps(self) -> None:
+        """Act on pending stackdump directives for jobs whose members this
+        agent supervises: deliver SIGUSR2 to each wedged child (the
+        harness's faulthandler hook dumps all-thread stacks to the
+        per-process file), read the dump back, ship it through the
+        store/API seam, and ack the rank into the directive. Epoch-deduped
+        per (job, rank): one hang ⇒ one signal per rank, idempotent
+        across heartbeat ticks and agent restarts (already-acked ranks
+        are skipped store-side). Best-effort end to end."""
+        if not self.stackdump_dir:
+            return
+        tracked = self.backend.tracked_keys()
+        if not tracked:
+            return
+        by_job: Dict[Tuple[str, str], List[Process]] = {}
+        for key in tracked:
+            ns, _, pname = key.partition("/")
+            try:
+                proc = self.store.get(KIND_PROCESS, ns, pname)
+            except Exception:  # noqa: BLE001 — gone/unreachable: skip
+                continue
+            if proc.spec.job_name:
+                by_job.setdefault((ns, proc.spec.job_name), []).append(proc)
+        for (ns, job_name), procs in by_job.items():
+            try:
+                job = self.store.get(KIND_TPUJOB, ns, job_name)
+            except Exception:  # noqa: BLE001
+                continue
+            directive = job.status.stackdump_directive or {}
+            epoch = int(directive.get("epoch", 0) or 0)
+            if epoch <= 0:
+                continue
+            acks = directive.get("acks") or {}
+            jkey = f"{ns}/{job_name}"
+            signaled = []
+            for proc in procs:
+                rank = self._proc_rank(proc)
+                if str(rank) in acks:
+                    self._stack_epochs[(jkey, rank)] = epoch
+                    continue
+                if self._stack_epochs.get((jkey, rank)) == epoch:
+                    continue
+                if self.backend.signal_local(
+                    proc.metadata.namespace, proc.metadata.name,
+                    _signal.SIGUSR2,
+                ):
+                    signaled.append((proc, rank))
+                self._stack_epochs[(jkey, rank)] = epoch
+            if not signaled:
+                continue
+            time.sleep(self.STACKDUMP_SETTLE_SECONDS)
+            for proc, rank in signaled:
+                self._ship_dump(job, proc, rank, epoch)
+
+    @staticmethod
+    def _proc_rank(proc: Process) -> int:
+        """The process's gang rank — the controller-stamped rendezvous
+        rank when present (matches the telemetry ring's rank axis), the
+        replica index otherwise."""
+        from tf_operator_tpu.rendezvous.env import ENV_PROCESS_ID
+
+        try:
+            return int(
+                (proc.spec.env or {}).get(
+                    ENV_PROCESS_ID, proc.spec.replica_index
+                )
+            )
+        except (TypeError, ValueError):
+            return proc.spec.replica_index
+
+    def _ship_dump(self, job, proc: Process, rank: int, epoch: int) -> None:
+        from tf_operator_tpu.obs.blackbox import ship_stackdump
+        from tf_operator_tpu.rendezvous.env import ENV_TRACE_ID, stackdump_path
+
+        path = stackdump_path(
+            self.stackdump_dir, proc.metadata.namespace,
+            proc.spec.job_name, proc.spec.replica_type,
+            proc.spec.replica_index,
+        )
+        try:
+            with open(path, "r", errors="replace") as f:
+                text = f.read()
+        except OSError:
+            # No dump file: the harness never installed the hook (old
+            # entrypoint, exec failure) — ack with an explicit marker so
+            # the reconciler's sweep completes instead of waiting out the
+            # grace for a dump that will never come.
+            text = ""
+        trace_id = (proc.spec.env or {}).get(ENV_TRACE_ID) or (
+            proc.metadata.owner_uid or job.metadata.uid
+        )
+        shipped = None
+        if text:
+            shipped = ship_stackdump(
+                self.store, proc.metadata.namespace, proc.spec.job_name,
+                trace_id, rank, epoch, text, host=self.name,
+            )
+        self._ack_dump(
+            proc.metadata.namespace, proc.spec.job_name, rank, epoch,
+            shipped.metadata.name if shipped is not None else "",
+        )
+
+    def _ack_dump(
+        self, namespace: str, job_name: str, rank: int, epoch: int, ref: str
+    ) -> None:
+        """Publish this rank's ack into the job's stackdump directive
+        (refusing superseded epochs — the profile-directive rule). The
+        ack value is the shipped artifact's store name, or "" when no
+        dump could be produced (hookless harness): the reconciler counts
+        EITHER as sweep completion for the rank."""
+
+        def mutate(cur):
+            d = cur.status.stackdump_directive or {}
+            if int(d.get("epoch", 0) or 0) != epoch:
+                return False  # a newer hang's sweep superseded this one
+            acks = dict(d.get("acks") or {})
+            if str(rank) in acks:
+                return False
+            acks[str(rank)] = ref
+            cur.status.stackdump_directive = {**d, "acks": acks}
+
+        try:
+            self.store.update_with_retry(
+                KIND_TPUJOB, namespace, job_name, mutate
+            )
+        except Exception:  # noqa: BLE001 — the reconciler's grace bounds us
+            log.exception(
+                "agent %s: stackdump ack for %s/%s rank %d failed",
+                self.name, namespace, job_name, rank,
+            )

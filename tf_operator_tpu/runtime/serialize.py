@@ -17,6 +17,7 @@ from tf_operator_tpu.api.types import (
     KIND_EVENT,
     KIND_HOST,
     KIND_LEASE,
+    KIND_POSTMORTEM,
     KIND_PRIORITY_CLASS,
     KIND_PROCESS,
     KIND_QUEUE,
@@ -27,6 +28,7 @@ from tf_operator_tpu.api.types import (
     TPUJob,
     _to_jsonable,
 )
+from tf_operator_tpu.obs.blackbox import PostmortemArtifact
 from tf_operator_tpu.obs.spans import Span
 from tf_operator_tpu.obs.telemetry import Telemetry
 from tf_operator_tpu.sched.objects import PriorityClass, Queue, QueueSpec
@@ -105,6 +107,11 @@ def _telemetry_from_doc(doc: Dict[str, Any]) -> Telemetry:
     return Telemetry(metadata=_meta(doc), **d)
 
 
+def _postmortem_from_doc(doc: Dict[str, Any]) -> PostmortemArtifact:
+    d = {k: v for k, v in doc.items() if k not in ("metadata", "kind")}
+    return PostmortemArtifact(metadata=_meta(doc), **d)
+
+
 def _priority_class_from_doc(doc: Dict[str, Any]) -> PriorityClass:
     d = {k: v for k, v in doc.items() if k not in ("metadata", "kind")}
     return PriorityClass(metadata=_meta(doc), **d)
@@ -122,6 +129,7 @@ _DECODERS = {
     KIND_LEASE: _lease_from_doc,
     KIND_SPAN: _span_from_doc,
     KIND_TELEMETRY: _telemetry_from_doc,
+    KIND_POSTMORTEM: _postmortem_from_doc,
     KIND_PRIORITY_CLASS: _priority_class_from_doc,
     KIND_QUEUE: _queue_from_doc,
     KIND_TPUJOB: lambda doc: TPUJob.from_dict(doc),

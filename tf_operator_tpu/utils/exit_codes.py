@@ -34,6 +34,15 @@ class ExitClass(enum.Enum):
     # workload masquerade as preemption churn in every restart metric.
     OOM = "OOMKilled"
     PERMANENT = "Permanent"
+    # Declared hung by the gang-progress watchdog (obs/watchdog.py): no
+    # rank advanced a step for run_policy.hang_timeout_seconds while
+    # heartbeats stayed live. Never produced by classify_exit_code — a
+    # hang by definition has NO exit; the reconciler assigns this class
+    # out-of-band when it shoots a wedged gang, so the resulting
+    # controller-driven SIGKILLs are attributed to cause "hang" rather
+    # than misread as infrastructure loss. Retryable under
+    # ON_FAILURE/ALWAYS/EXIT_CODE and charged against backoff_limit.
+    HUNG = "Hung"
 
 
 # Semantics preserved from train_util.go:18-53. Retryable codes are

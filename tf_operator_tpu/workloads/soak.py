@@ -33,11 +33,39 @@ and restore, and the controller fences nothing on it)."""
 from __future__ import annotations
 
 import logging
+import os
 import time
 
+from tf_operator_tpu.chaos.faults import WEDGE_MARKER
 from tf_operator_tpu.rendezvous.context import JobContext
 
 log = logging.getLogger("tpujob.soakwl")
+
+
+def _wedge_marker(ctx: JobContext, wl: dict) -> str:
+    """Path this member polls for the chaos HANG wedge, or "" when the
+    wedge cannot apply. Warm incarnations (resume_step > 0) never wedge:
+    the marker is left on disk after the fault, and obeying it again
+    would hang the recovery the soak is trying to prove."""
+    if ctx.resume_step or not wl.get("checkpoint_dir"):
+        return ""
+    return os.path.join(str(wl["checkpoint_dir"]), WEDGE_MARKER)
+
+
+def _fake_collective_all_reduce(ctx: JobContext, step: int) -> None:
+    """The wedge: block forever, exactly like an all-reduce whose peer
+    never arrives. Deliberately a NAMED function — the hang soak greps
+    every rank's SIGUSR2 stack dump for this frame, proving the
+    faulthandler hook captures *where* each rank is stuck, not just that
+    it is. The process stays alive and signal-handling (PEP 475 retries
+    the sleep after SIGUSR2), so heartbeats keep flowing while step
+    progress is dead — the watchdog's exact target."""
+    log.warning(
+        "chaos wedge: rank %d entering fake collective at step %d "
+        "(will never return)", ctx.process_id, step,
+    )
+    while True:
+        time.sleep(1.0)
 
 
 def main(ctx: JobContext) -> None:
@@ -64,10 +92,14 @@ def main(ctx: JobContext) -> None:
         flops_per_step=float(wl.get("flops_per_step", 0.0)),
     )
 
+    wedge = _wedge_marker(ctx, wl)
+
     if not (is_chief and wl.get("checkpoint_dir")):
         # Non-chief members just pace the same wall clock; gang restart /
         # drain semantics act on them via signals, not their own logic.
         for i in range(steps):
+            if wedge and os.path.exists(wedge):
+                _fake_collective_all_reduce(ctx, i + 1)
             t0 = time.time()
             time.sleep(sleep_s + data_wait_s + extra_s)
             if i == 0:
@@ -108,6 +140,8 @@ def main(ctx: JobContext) -> None:
             f"has only {start} — the warm-restart env over-promised"
         )
     for s in range(start + 1, steps + 1):
+        if wedge and os.path.exists(wedge):
+            _fake_collective_all_reduce(ctx, s)
         t0 = time.time()
         time.sleep(sleep_s + data_wait_s + extra_s)
         state = {"step": np.asarray(s)}

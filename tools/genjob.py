@@ -236,6 +236,16 @@ def _scrape_sync_latency(server: str) -> dict:
     lost = _parse_cause_counters(text, "tpujob_lost_seconds_total")
     if lost:
         out["lost_seconds"] = {k: round(v, 3) for k, v in sorted(lost.items())}
+    # Hang plane (r15): declared-hang count plus the hang-downtime
+    # histogram (declaration-backdated span widths, closed at recovered
+    # gang-RUNNING). Zero hangs is the healthy bench case — report
+    # hangs_total: 0 and omit the downtime quantile (no samples).
+    out["hangs_total"] = _parse_counter(text, "tpujob_hangs_total")
+    hb, hn = _parse_histogram(text, "tpujob_hang_downtime_seconds")
+    if hn:
+        out["hang_downtime_p50_ms"] = round(
+            _histogram_quantile(hb, hn, 0.5) * 1e3, 1
+        )
     return out
 
 
@@ -249,6 +259,17 @@ def _parse_labeled_gauges(text: str, family: str) -> list:
         for m in [re.match(rf"{family}\{{[^}}]*\}} (\S+)", line)]
         if m
     ]
+
+
+def _parse_counter(text: str, family: str) -> int:
+    """Value of one unlabeled counter family (0 when absent)."""
+    import re
+
+    for line in text.splitlines():
+        m = re.match(rf"{family} (\S+)", line)
+        if m:
+            return int(float(m.group(1)))
+    return 0
 
 
 def _parse_cause_counters(text: str, family: str) -> dict:
