@@ -1,0 +1,521 @@
+"""Goodput autopilot (autopilot/, r16): Young/Daly cadence policy vs
+hand-computed optima (with every degenerate input), the failure-cause →
+recovery-action table, decision hysteresis (confirm ticks + cooldown,
+never faster than the straggler tracker's own damping), warm-pool
+sizing, the JobAutopilot decision step over hand-built TickInputs, the
+StragglerTracker.host_risk() typed snapshot, and the satellite-1
+checkpoint-cadence directive round-trip through WorkloadCheckpointer."""
+
+import math
+
+import pytest
+
+from tf_operator_tpu.autopilot.controller import (
+    DECISION_CADENCE,
+    DECISION_DEPRIORITIZE,
+    DECISION_MIGRATE,
+    DECISION_WARMPOOL,
+    AutopilotConfig,
+    JobAutopilot,
+    TickInputs,
+)
+from tf_operator_tpu.autopilot.policy import (
+    ACTION_MIGRATE,
+    ACTION_RESIZE,
+    ACTION_RESTART,
+    Hysteresis,
+    cadence_worth_changing,
+    host_risk_actionable,
+    optimal_checkpoint_every,
+    recovery_action,
+    warmpool_target,
+)
+from tf_operator_tpu.obs.telemetry import HostRisk, StragglerTracker
+
+
+# ---------------------------------------------------------------------------
+# Young/Daly cadence
+# ---------------------------------------------------------------------------
+
+
+class TestOptimalCheckpointEvery:
+    def test_matches_hand_computed_optimum(self):
+        # δ=2s, M=3600s ⇒ τ = sqrt(2·2·3600) = 120s; step 5s ⇒ every 24.
+        dec = optimal_checkpoint_every(
+            save_stall_s=2.0, mtbf_s=3600.0, step_time_s=5.0
+        )
+        assert dec.every == 24
+        assert dec.tau_s == pytest.approx(math.sqrt(2 * 2.0 * 3600.0))
+        assert dec.clamped == ""
+
+    def test_rounds_to_nearest_step(self):
+        # τ = sqrt(2·1·450) = 30s; step 4s ⇒ 7.5 steps ⇒ rounds to 8.
+        dec = optimal_checkpoint_every(
+            save_stall_s=1.0, mtbf_s=450.0, step_time_s=4.0
+        )
+        assert dec.every == 8
+
+    def test_zero_save_stall_clamps_min(self):
+        # Free checkpoints ⇒ save every chance you get.
+        dec = optimal_checkpoint_every(
+            save_stall_s=0.0, mtbf_s=600.0, step_time_s=1.0
+        )
+        assert dec.every == 1
+        assert dec.clamped == "min"
+
+    def test_zero_restart_history_clamps_max(self):
+        # No failures ever observed ⇒ MTBF is infinite ⇒ stretch to max.
+        for mtbf in (math.inf, 0.0, -1.0):
+            dec = optimal_checkpoint_every(
+                save_stall_s=2.0, mtbf_s=mtbf, step_time_s=1.0
+            )
+            assert dec.every == 64
+            assert dec.clamped == "max"
+
+    def test_zero_step_time_clamps_max(self):
+        dec = optimal_checkpoint_every(
+            save_stall_s=2.0, mtbf_s=600.0, step_time_s=0.0
+        )
+        assert dec.every == 64
+        assert dec.clamped == "max"
+        assert dec.tau_s == pytest.approx(math.sqrt(2 * 2.0 * 600.0))
+
+    def test_custom_clamps(self):
+        dec = optimal_checkpoint_every(
+            save_stall_s=2.0, mtbf_s=3600.0, step_time_s=5.0,
+            min_every=30, max_every=40,
+        )
+        assert dec.every == 30  # unclamped optimum is 24
+        assert dec.clamped == "min"
+        dec = optimal_checkpoint_every(
+            save_stall_s=2.0, mtbf_s=3600.0, step_time_s=5.0,
+            min_every=1, max_every=10,
+        )
+        assert dec.every == 10
+        assert dec.clamped == "max"
+
+    def test_sub_step_tau_floors_at_one(self):
+        # τ shorter than one step can never mean "every 0 steps".
+        dec = optimal_checkpoint_every(
+            save_stall_s=0.01, mtbf_s=1.0, step_time_s=10.0
+        )
+        assert dec.every == 1
+
+    def test_decision_carries_inputs(self):
+        dec = optimal_checkpoint_every(
+            save_stall_s=2.0, mtbf_s=3600.0, step_time_s=5.0
+        )
+        assert (dec.save_stall_s, dec.mtbf_s, dec.step_time_s) == (
+            2.0, 3600.0, 5.0
+        )
+
+
+class TestCadenceWorthChanging:
+    def test_equal_never_worth_it(self):
+        assert not cadence_worth_changing(8, 8)
+
+    def test_small_relative_change_suppressed(self):
+        assert not cadence_worth_changing(8, 9)  # 12.5% < 25% deadband
+
+    def test_large_change_passes(self):
+        assert cadence_worth_changing(1, 8)
+        assert cadence_worth_changing(8, 1)
+
+    def test_unset_current_always_worth_it(self):
+        assert cadence_worth_changing(0, 4)
+
+
+# ---------------------------------------------------------------------------
+# Failure-cause → recovery-action table
+# ---------------------------------------------------------------------------
+
+
+class TestRecoveryAction:
+    @pytest.mark.parametrize("cause,expected", [
+        ("preemption", ACTION_RESTART),  # capacity vanished; shrink can't help
+        ("oom", ACTION_RESTART),  # shrinking RAISES per-member memory
+        ("hang", ACTION_RESTART),  # wedged collective: full teardown
+        ("node-lost", ACTION_RESIZE),
+        ("node_lost", ACTION_RESIZE),
+        ("crash", ACTION_RESIZE),
+        ("retryable-failure", ACTION_RESIZE),
+        ("straggler", ACTION_RESIZE),
+        ("unknown-cause", ACTION_RESTART),  # unknowns take the safe path
+    ])
+    def test_elastic_table(self, cause, expected):
+        assert recovery_action(cause, elastic=True) is expected
+
+    def test_non_elastic_always_restarts(self):
+        for cause in ("node-lost", "crash", "straggler", "oom"):
+            assert recovery_action(cause, elastic=False) is ACTION_RESTART
+
+    def test_flagged_host_upgrades_resize_to_migrate(self):
+        assert (
+            recovery_action("node-lost", elastic=True, host_flagged=True)
+            is ACTION_MIGRATE
+        )
+        # restart-only causes are never upgraded.
+        assert (
+            recovery_action("oom", elastic=True, host_flagged=True)
+            is ACTION_RESTART
+        )
+
+
+# ---------------------------------------------------------------------------
+# Hysteresis
+# ---------------------------------------------------------------------------
+
+
+class TestHysteresis:
+    def test_needs_confirm_ticks(self):
+        h = Hysteresis(confirm_ticks=3, cooldown_s=0.0)
+        assert not h.propose("k", 8, now=0.0)
+        assert not h.propose("k", 8, now=1.0)
+        assert h.propose("k", 8, now=2.0)
+
+    def test_changed_value_resets_streak(self):
+        h = Hysteresis(confirm_ticks=2, cooldown_s=0.0)
+        assert not h.propose("k", 8, now=0.0)
+        assert not h.propose("k", 16, now=1.0)  # new value: streak back to 1
+        assert h.propose("k", 16, now=2.0)
+
+    def test_cooldown_blocks_refire(self):
+        h = Hysteresis(confirm_ticks=1, cooldown_s=10.0)
+        assert h.propose("k", 8, now=0.0)
+        assert not h.propose("k", 16, now=5.0)  # confirmed but cooling down
+        assert h.propose("k", 16, now=11.0)
+
+    def test_withdraw_resets_streak_not_cooldown(self):
+        h = Hysteresis(confirm_ticks=2, cooldown_s=100.0)
+        assert not h.propose("k", 8, now=0.0)
+        assert h.propose("k", 8, now=1.0)
+        h.withdraw("k")
+        # Streak is gone AND the cooldown clock still runs.
+        assert not h.propose("k", 8, now=2.0)
+        assert not h.propose("k", 8, now=3.0)  # streak met, cooldown not
+        assert h.in_cooldown("k", now=50.0)
+        assert not h.in_cooldown("k", now=200.0)
+
+    def test_keys_are_independent(self):
+        h = Hysteresis(confirm_ticks=1, cooldown_s=100.0)
+        assert h.propose("a", 1, now=0.0)
+        assert h.propose("b", 1, now=0.0)  # a's cooldown doesn't gate b
+
+    def test_never_faster_than_straggler_tracker(self):
+        # The anti-flap contract: the autopilot needs >= as many
+        # confirming observations as the tracker needs windows to flag,
+        # so the two hysteresis loops cannot disagree-oscillate.
+        cfg = AutopilotConfig()
+        tracker = StragglerTracker()
+        assert cfg.confirm_ticks >= tracker.flag_windows
+
+
+# ---------------------------------------------------------------------------
+# Warm-pool sizing
+# ---------------------------------------------------------------------------
+
+
+class TestWarmpoolTarget:
+    def test_holds_under_evidence_floor(self):
+        assert warmpool_target(1, 1, current_target=2) == 2
+
+    def test_grows_on_cold_miss_rate(self):
+        # 3 cold / 5 total = 60% miss ⇒ grow by one.
+        assert warmpool_target(3, 2, current_target=1) == 2
+
+    def test_shrinks_when_all_warm(self):
+        assert warmpool_target(0, 8, current_target=2) == 1
+
+    def test_clamps(self):
+        assert warmpool_target(8, 0, current_target=4, max_slots=4) == 4
+        assert warmpool_target(0, 8, current_target=0, min_slots=0) == 0
+
+
+# ---------------------------------------------------------------------------
+# Host-risk gate
+# ---------------------------------------------------------------------------
+
+
+def risk(**kw):
+    base = dict(rank=3, host="h1", flagged=True, flag_age_windows=2,
+                slow_ratio=2.0, flap_count=0)
+    base.update(kw)
+    return HostRisk(**base)
+
+
+class TestHostRiskActionable:
+    def test_actionable(self):
+        assert host_risk_actionable(risk())
+
+    def test_unflagged_is_not(self):
+        assert not host_risk_actionable(risk(flagged=False))
+
+    def test_young_flag_is_not(self):
+        assert not host_risk_actionable(risk(flag_age_windows=1))
+
+    def test_mild_ratio_is_not(self):
+        assert not host_risk_actionable(risk(slow_ratio=1.2))
+
+    def test_chronic_flapper_is_not(self):
+        # A host that flaps in and out is a detection artifact, not a
+        # migration target — acting on it is exactly the flapping the
+        # hysteresis contract forbids.
+        assert not host_risk_actionable(risk(flap_count=3))
+
+
+# ---------------------------------------------------------------------------
+# StragglerTracker.host_risk() snapshot (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+class TestHostRiskSnapshot:
+    def test_snapshot_tracks_flag_age_ratio_and_flaps(self):
+        t = StragglerTracker()  # flag after 2 bad windows, clear after 2
+        slow = {0: 0.2, 1: 0.2, 2: 0.2, 3: 0.8}
+        clean = {0: 0.2, 1: 0.2, 2: 0.2, 3: 0.2}
+        t.observe(slow)
+        r = t.host_risk()[3]
+        assert not r.flagged and r.consecutive_bad == 1
+        assert r.slow_ratio == pytest.approx(4.0)
+        t.observe(slow)  # second consecutive bad window: flag fires
+        r = t.host_risk()[3]
+        assert r.flagged and r.flag_age_windows == 0
+        t.observe(slow)
+        assert t.host_risk()[3].flag_age_windows == 1
+        t.observe(clean)
+        t.observe(clean)  # second clean window: clears ⇒ one flap cycle
+        r = t.host_risk()[3]
+        assert not r.flagged and r.flap_count == 1
+        assert r.flag_age_windows == 0
+
+    def test_healthy_ranks_present_with_zero_risk(self):
+        t = StragglerTracker()
+        t.observe({0: 0.2, 1: 0.2, 2: 0.2})
+        r = t.host_risk()[0]
+        assert not r.flagged and r.flap_count == 0
+        assert r.slow_ratio == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# JobAutopilot decision step
+# ---------------------------------------------------------------------------
+
+
+def cadence_inputs(now=0.0, **kw):
+    base = dict(
+        now=now, step_time_s=5.0, save_stall_s=2.0, saves_observed=3,
+        failures=1, run_elapsed_s=3600.0, restart_downtime_s=12.0,
+        current_every=1, directive_epoch=0, directive_acked=True,
+    )
+    base.update(kw)
+    return TickInputs(**base)
+
+
+class TestJobAutopilotTick:
+    def ap(self, **cfg):
+        base = dict(confirm_ticks=2, cooldown_s=0.0)
+        base.update(cfg)
+        return JobAutopilot(AutopilotConfig(**base))
+
+    def test_cadence_decision_after_confirm(self):
+        ap = self.ap()
+        assert ap.tick(cadence_inputs(now=0.0)) == []
+        (d,) = ap.tick(cadence_inputs(now=1.0))
+        assert d.kind == DECISION_CADENCE
+        assert d.checkpoint_every == 24  # sqrt(2·2·3600)/5
+        # The receipt carries every justifying number.
+        assert d.attrs["from_every"] == "1" and d.attrs["to_every"] == "24"
+        assert float(d.attrs["save_stall_s"]) == pytest.approx(2.0)
+        assert float(d.attrs["mtbf_s"]) == pytest.approx(3600.0)
+        assert float(d.attrs["tau_s"]) == pytest.approx(120.0)
+        assert d.attrs["restart_downtime_s"]
+
+    def test_no_evidence_no_decision(self):
+        ap = self.ap(confirm_ticks=1)
+        assert ap.tick(cadence_inputs(saves_observed=0)) == []
+        assert ap.tick(cadence_inputs(step_time_s=0.0)) == []
+
+    def test_inflight_directive_blocks(self):
+        ap = self.ap(confirm_ticks=1)
+        assert ap.tick(cadence_inputs(directive_acked=False)) == []
+
+    def test_zero_failures_stretches_to_max(self):
+        (d,) = self.ap(confirm_ticks=1).tick(cadence_inputs(failures=0))
+        assert d.checkpoint_every == 64
+        assert d.attrs["mtbf_s"] == "inf" and d.attrs["clamped"] == "max"
+
+    def test_already_optimal_withdraws(self):
+        ap = self.ap(confirm_ticks=1)
+        assert ap.tick(cadence_inputs(current_every=24)) == []
+
+    def test_watchdog_stall_suppresses_everything(self):
+        ap = self.ap(confirm_ticks=1)
+        inp = cadence_inputs(watchdog_stalled=True,
+                             host_risk={"h1": risk()}, elastic_ok=True,
+                             world_size=4, min_world_size=2)
+        assert ap.tick(inp) == []
+
+    def test_risky_host_yields_deprioritize_and_migrate(self):
+        ap = self.ap(confirm_ticks=1)
+        inp = cadence_inputs(step_time_s=0.0, host_risk={"h1": risk()},
+                             elastic_ok=True, world_size=4, min_world_size=2)
+        kinds = {d.kind for d in ap.tick(inp)}
+        assert kinds == {DECISION_DEPRIORITIZE, DECISION_MIGRATE}
+
+    def test_migrate_respects_min_world_size(self):
+        ap = self.ap(confirm_ticks=1)
+        inp = cadence_inputs(step_time_s=0.0, host_risk={"h1": risk()},
+                             elastic_ok=True, world_size=2, min_world_size=2)
+        kinds = {d.kind for d in ap.tick(inp)}
+        assert kinds == {DECISION_DEPRIORITIZE}
+
+    def test_migrate_requires_elastic(self):
+        ap = self.ap(confirm_ticks=1)
+        inp = cadence_inputs(step_time_s=0.0, host_risk={"h1": risk()},
+                             elastic_ok=False, world_size=4, min_world_size=2)
+        kinds = {d.kind for d in ap.tick(inp)}
+        assert DECISION_MIGRATE not in kinds
+
+    def test_migrate_gate_off(self):
+        ap = self.ap(confirm_ticks=1, migrate=False)
+        inp = cadence_inputs(step_time_s=0.0, host_risk={"h1": risk()},
+                             elastic_ok=True, world_size=4, min_world_size=2)
+        assert DECISION_MIGRATE not in {d.kind for d in ap.tick(inp)}
+
+    def test_risk_recovery_withdraws_pending_migrate(self):
+        # One risky tick, then the host recovers: the half-confirmed
+        # migrate must not fire on later risky-again ticks counted from
+        # the stale streak.
+        ap = self.ap(confirm_ticks=2)
+        risky = cadence_inputs(step_time_s=0.0, host_risk={"h1": risk()},
+                               elastic_ok=True, world_size=4,
+                               min_world_size=2)
+        healthy = cadence_inputs(step_time_s=0.0,
+                                 host_risk={"h1": risk(flagged=False)},
+                                 elastic_ok=True, world_size=4,
+                                 min_world_size=2)
+        assert ap.tick(risky) == []
+        assert ap.tick(healthy) == []
+        assert ap.tick(risky) == []  # streak restarted, not resumed
+
+    def test_warmpool_decision(self):
+        ap = self.ap(confirm_ticks=1)
+        inp = cadence_inputs(step_time_s=0.0, cold_starts=3, warm_starts=1,
+                             warmpool_current=1)
+        (d,) = ap.tick(inp)
+        assert d.kind == DECISION_WARMPOOL and d.warmpool_target == 2
+        assert d.attrs["cold_starts"] == "3"
+
+    def test_warmpool_gate_off(self):
+        ap = self.ap(confirm_ticks=1, warmpool=False)
+        inp = cadence_inputs(step_time_s=0.0, cold_starts=3, warm_starts=1,
+                             warmpool_current=1)
+        assert ap.tick(inp) == []
+
+
+class TestAutopilotConfig:
+    def test_falsy_knob_disables(self):
+        assert AutopilotConfig.from_run_policy(None) is None
+        assert AutopilotConfig.from_run_policy({}) is None
+        assert AutopilotConfig.from_run_policy(False) is None
+
+    def test_enabled_false_disables(self):
+        assert AutopilotConfig.from_run_policy({"enabled": False}) is None
+
+    def test_truthy_non_dict_defaults(self):
+        cfg = AutopilotConfig.from_run_policy(True)
+        assert cfg is not None and cfg.cadence and cfg.migrate
+
+    def test_dict_overrides(self):
+        cfg = AutopilotConfig.from_run_policy({
+            "enabled": True, "cooldown_s": 5, "confirm_ticks": 1,
+            "max_checkpoint_every": 16, "migrate": False,
+        })
+        assert cfg.cooldown_s == 5.0 and cfg.confirm_ticks == 1
+        assert cfg.max_checkpoint_every == 16 and not cfg.migrate
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint-cadence directive round-trip (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+class FakeCadenceCtx:
+    """The slice of JobContext poll_cadence_directive speaks to."""
+
+    def __init__(self, process_id=0, directive=None):
+        self.process_id = process_id
+        self.directive = directive or {}
+        self.acks = []
+
+    def poll_checkpoint_cadence_directive(self):
+        return dict(self.directive) if self.directive else None
+
+    def ack_checkpoint_cadence(self, epoch, step):
+        self.acks.append((epoch, step))
+
+
+def make_checkpointer(ctx, every=1):
+    from tf_operator_tpu.train.checkpoint import WorkloadCheckpointer
+
+    # No checkpoint_dir: the manager stays None, which is irrelevant to
+    # the cadence protocol; cadence_poll_s=0 disables the poll throttle.
+    return WorkloadCheckpointer(
+        {"checkpoint_every": every, "cadence_poll_s": 0.0}, ctx=ctx
+    )
+
+
+class TestCadenceDirectiveRoundTrip:
+    def test_applies_epoch_once_and_acks(self):
+        ctx = FakeCadenceCtx(
+            directive={"epoch": 1, "checkpoint_every": 8, "time": 1.0}
+        )
+        ckpt = make_checkpointer(ctx, every=1)
+        assert ckpt.poll_cadence_directive(step=5) is True
+        assert ckpt.every == 8
+        assert ctx.acks == [(1, 5)]
+        # The same epoch never re-applies (or re-acks).
+        assert ckpt.poll_cadence_directive(step=6) is False
+        assert ctx.acks == [(1, 5)]
+
+    def test_newer_epoch_reapplies(self):
+        ctx = FakeCadenceCtx(
+            directive={"epoch": 1, "checkpoint_every": 8}
+        )
+        ckpt = make_checkpointer(ctx)
+        assert ckpt.poll_cadence_directive(step=1)
+        ctx.directive = {"epoch": 2, "checkpoint_every": 16}
+        assert ckpt.poll_cadence_directive(step=9)
+        assert ckpt.every == 16
+        assert ctx.acks == [(1, 1), (2, 9)]
+
+    def test_stale_epoch_refused(self):
+        ctx = FakeCadenceCtx(
+            directive={"epoch": 3, "checkpoint_every": 8}
+        )
+        ckpt = make_checkpointer(ctx)
+        assert ckpt.poll_cadence_directive(step=1)
+        ctx.directive = {"epoch": 2, "checkpoint_every": 32}
+        assert ckpt.poll_cadence_directive(step=2) is False
+        assert ckpt.every == 8
+
+    def test_non_chief_never_polls(self):
+        ctx = FakeCadenceCtx(
+            process_id=1, directive={"epoch": 1, "checkpoint_every": 8}
+        )
+        ckpt = make_checkpointer(ctx)
+        assert ckpt.poll_cadence_directive(step=1) is False
+        assert ckpt.every == 1 and ctx.acks == []
+
+    def test_no_ctx_is_noop(self):
+        ckpt = make_checkpointer(None)
+        assert ckpt.poll_cadence_directive(step=1) is False
+
+    def test_zero_every_directive_acked_but_not_applied(self):
+        # A malformed directive (every=0) must not wedge the protocol:
+        # the epoch is consumed and acked, the interval is untouched.
+        ctx = FakeCadenceCtx(directive={"epoch": 1, "checkpoint_every": 0})
+        ckpt = make_checkpointer(ctx, every=4)
+        assert ckpt.poll_cadence_directive(step=1) is True
+        assert ckpt.every == 4
+        assert ctx.acks == [(1, 1)]

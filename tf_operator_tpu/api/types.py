@@ -256,6 +256,18 @@ class RunPolicy:
     # comfortably larger than the workload's telemetry flush interval or
     # slow-but-moving jobs would be shot.
     hang_timeout_seconds: Optional[float] = None
+    # Goodput autopilot (r16): opt-in per-job knob for the fleet
+    # controller that turns telemetry into policy (autopilot/). None ⇒
+    # disabled (the default: no job gets auto-tuned without asking).
+    # Recognized keys, all optional:
+    #   {"enabled": bool (default True when the dict is present),
+    #    "cooldown_s": float        — min seconds between actions per kind,
+    #    "confirm_ticks": int       — consecutive agreeing ticks to act,
+    #    "min_checkpoint_every": int, "max_checkpoint_every": int
+    #                               — Young/Daly cadence clamps (steps),
+    #    "cadence": bool, "migrate": bool, "warmpool": bool
+    #                               — per-actuator gates (default True)}.
+    autopilot: Optional[Dict[str, Any]] = None
 
 
 @dataclass
@@ -386,6 +398,20 @@ class TPUJobStatus:
     # back acks under "acks": {rank: stack_file_path}. Empty when no
     # sweep has ever been requested.
     stackdump_directive: Dict[str, Any] = field(default_factory=dict)
+    # Checkpoint-cadence directive (r16, same monotonic-epoch protocol as
+    # profile_directive): the autopilot publishes {"epoch": int,
+    # "checkpoint_every": int, "time": ts} when Young/Daly says the
+    # interval should move; the chief applies it at the next step
+    # boundary and acks back {"applied_epoch": int, "applied_step": int}.
+    # Empty when the cadence has never been retuned.
+    checkpoint_cadence_directive: Dict[str, Any] = field(default_factory=dict)
+    # Autopilot receipt surface (r16), reconciler-authored: {"last_decision":
+    # {"kind", "action", "time", ...inputs}, "decisions_total": int,
+    # "active_checkpoint_every": int} — what `tpujob top` and the
+    # dashboard job view show. Empty while the autopilot is disabled or
+    # has never acted. The authoritative receipts are the
+    # autopilot-decision spans; this is the at-a-glance mirror.
+    autopilot: Dict[str, Any] = field(default_factory=dict)
 
     def phase(self) -> JobPhase:
         """Derived v1alpha1-style phase (v1alpha1/types.go:106-116).
@@ -520,5 +546,9 @@ def _tpujob_from_dict(data: Dict[str, Any]) -> TPUJob:
         hang_count=status_d.get("hang_count", 0),
         hang_state=status_d.get("hang_state", {}) or {},
         stackdump_directive=status_d.get("stackdump_directive", {}) or {},
+        checkpoint_cadence_directive=(
+            status_d.get("checkpoint_cadence_directive", {}) or {}
+        ),
+        autopilot=status_d.get("autopilot", {}) or {},
     )
     return TPUJob(metadata=meta, spec=spec, status=status, kind=data.get("kind", KIND_TPUJOB))

@@ -1,0 +1,33 @@
+"""Goodput autopilot (r16): the L4 loop that turns telemetry into policy.
+
+PRs 12/13/15 made the fleet observable (goodput decomposition,
+``tpujob_lost_seconds_total{cause}``, straggler flags, hang verdicts)
+and gracefully degradable (elastic resize) — but every number still
+terminated in a dashboard. This package closes the loop:
+
+- :mod:`~tf_operator_tpu.autopilot.policy` — pure, unit-testable
+  decision math (Young/Daly optimal checkpoint cadence from *measured*
+  save-stall vs *measured* restart downtime, the per-cause
+  restart/resize/migrate table, warm-pool sizing from observed TTFS
+  cold-miss rates, and the hysteresis helper every actuator shares).
+- :mod:`~tf_operator_tpu.autopilot.controller` — the per-job decision
+  step the reconciler drives on each sync, acting through EXISTING
+  actuators only (the no-new-actuators rule, docs/design.md §4.12).
+
+Every decision is receipted as an ``autopilot-decision`` span carrying
+the input numbers and the chosen action, and counted per decision kind
+(``tpujob_autopilot_decisions_total{kind}``).
+"""
+
+from tf_operator_tpu.autopilot.policy import (  # noqa: F401
+    ACTION_MIGRATE,
+    ACTION_RESIZE,
+    ACTION_RESTART,
+    CadenceDecision,
+    Hysteresis,
+    cadence_worth_changing,
+    host_risk_actionable,
+    optimal_checkpoint_every,
+    recovery_action,
+    warmpool_target,
+)

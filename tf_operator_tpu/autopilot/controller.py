@@ -1,0 +1,256 @@
+"""The autopilot decision step the reconciler drives once per job sync.
+
+Split deliberately in two:
+
+- :class:`JobAutopilot` here holds ONLY decision state (hysteresis
+  streaks, cooldown clocks) and pure policy calls — ``tick(inputs)``
+  returns a list of :class:`Decision` records. No store, no spans, no
+  metrics: the whole class is drivable by tests with hand-built
+  :class:`TickInputs`.
+- The reconciler (controller/reconciler.py ``_autopilot_tick``) gathers
+  the inputs from surfaces that already exist (telemetry windows,
+  save-stall spans, the cause ledger, StragglerTracker.host_risk(),
+  warm-pool gauges) and EXECUTES the decisions through actuators that
+  already exist (the checkpoint-cadence status directive,
+  ``_try_resize_shrink``, the ``place_gang`` deprioritized set, the
+  warm-pool host annotation). The no-new-actuators rule
+  (docs/design.md §4.12) lives at that boundary: a Decision can only
+  name an actuator the fleet already trusts.
+
+Every executed decision becomes an ``autopilot-decision`` span whose
+attrs are exactly ``Decision.attrs`` — the measured numbers that
+justified the action ride in the receipt, not in a log line.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from tf_operator_tpu.autopilot.policy import (
+    Hysteresis,
+    cadence_worth_changing,
+    host_risk_actionable,
+    optimal_checkpoint_every,
+    warmpool_target,
+)
+from tf_operator_tpu.obs.telemetry import HostRisk
+
+# Decision kinds — the ``kind`` label on
+# ``tpujob_autopilot_decisions_total`` and in the span attrs.
+DECISION_CADENCE = "cadence"  # retune checkpoint_every (status directive)
+DECISION_MIGRATE = "migrate"  # pre-emptive shrink away from a risky host
+DECISION_DEPRIORITIZE = "deprioritize"  # feed host into place_gang's avoid set
+DECISION_WARMPOOL = "warmpool"  # retarget a host's warm-pool size
+
+
+@dataclass
+class AutopilotConfig:
+    """Parsed ``run_policy.autopilot`` knob (api/types.py)."""
+
+    cooldown_s: float = 30.0  # min seconds between actions per decision key
+    confirm_ticks: int = 2  # consecutive agreeing ticks before acting
+    min_checkpoint_every: int = 1
+    max_checkpoint_every: int = 64
+    cadence: bool = True  # per-actuator gates
+    migrate: bool = True
+    warmpool: bool = True
+
+    @staticmethod
+    def from_run_policy(knob: Any) -> Optional["AutopilotConfig"]:
+        """None ⇒ autopilot disabled for this job (the default)."""
+        if not knob:
+            return None
+        if not isinstance(knob, dict):
+            return AutopilotConfig()
+        if not knob.get("enabled", True):
+            return None
+        cfg = AutopilotConfig()
+        for key in (
+            "cooldown_s", "confirm_ticks", "min_checkpoint_every",
+            "max_checkpoint_every", "cadence", "migrate", "warmpool",
+        ):
+            if key in knob:
+                setattr(cfg, key, type(getattr(cfg, key))(knob[key]))
+        return cfg
+
+
+@dataclass
+class TickInputs:
+    """Everything one decision step reads, gathered by the reconciler.
+
+    All numbers are MEASURED: nothing here is an assumed constant, which
+    is the whole point of closing the telemetry→policy loop."""
+
+    now: float = 0.0
+    # Cadence inputs.
+    step_time_s: float = 0.0  # cross-rank median step seconds, latest window
+    save_stall_s: float = 0.0  # mean measured stall per accepted save (δ)
+    saves_observed: int = 0  # save-stall spans seen (evidence floor for δ)
+    failures: int = 0  # restart+preemption+hang events (MTBF denominator)
+    run_elapsed_s: float = 0.0  # submit → now (MTBF numerator)
+    restart_downtime_s: float = 0.0  # cause-ledger lost seconds (receipt)
+    current_every: int = 0  # the checkpoint interval governing the gang now
+    directive_epoch: int = 0  # last cadence-directive epoch published
+    directive_acked: bool = True  # chief acked the last epoch (or none sent)
+    # Placement inputs.
+    host_risk: Dict[str, HostRisk] = field(default_factory=dict)
+    watchdog_stalled: bool = False  # hang watchdog armed or hung
+    elastic_ok: bool = False  # elastic + mesh resizable + chief safe
+    world_size: int = 0
+    min_world_size: int = 1
+    # Warm-pool inputs.
+    cold_starts: int = 0  # TTFS cold-classified first-step marks
+    warm_starts: int = 0
+    warmpool_current: int = 0  # the target currently annotated/default
+
+
+@dataclass
+class Decision:
+    """One action the reconciler must execute and receipt."""
+
+    kind: str  # DECISION_*
+    action: str  # human-readable choice, e.g. "checkpoint_every 1->8"
+    attrs: Dict[str, str] = field(default_factory=dict)  # span payload
+    checkpoint_every: int = 0  # DECISION_CADENCE
+    host: str = ""  # DECISION_MIGRATE / DECISION_DEPRIORITIZE / DECISION_WARMPOOL
+    warmpool_target: int = 0  # DECISION_WARMPOOL
+
+
+def _fmt(x: float) -> str:
+    return "inf" if math.isinf(x) else f"{x:.3f}"
+
+
+class JobAutopilot:
+    """Decision state for one job: hysteresis streaks and cooldown
+    clocks. Lives exactly as long as the job's StragglerTracker (both
+    are uid-keyed reconciler state, dropped together when the job
+    ends), so the two hysteresis loops always observe the same world."""
+
+    def __init__(self, config: AutopilotConfig) -> None:
+        self.config = config
+        self._hys = Hysteresis(
+            confirm_ticks=config.confirm_ticks, cooldown_s=config.cooldown_s
+        )
+
+    # -- the decision step -------------------------------------------------
+
+    def tick(self, inp: TickInputs) -> List[Decision]:
+        cfg = self.config
+        if inp.watchdog_stalled:
+            # Never act while the hang plane is armed: a resize or a
+            # cadence round-trip against a gang that may be wedged only
+            # confuses the watchdog's no-progress clock. The hang path
+            # owns recovery; we resume when progress does.
+            return []
+        decisions: List[Decision] = []
+        if cfg.cadence:
+            decisions.extend(self._tick_cadence(inp))
+        decisions.extend(self._tick_placement(inp))
+        if cfg.warmpool:
+            decisions.extend(self._tick_warmpool(inp))
+        return decisions
+
+    def _tick_cadence(self, inp: TickInputs) -> List[Decision]:
+        cfg = self.config
+        if inp.step_time_s <= 0 or inp.saves_observed < 1:
+            return []  # no measured δ or step time yet: no evidence, no move
+        if not inp.directive_acked:
+            return []  # the last directive is still in flight — one at a time
+        mtbf = (
+            inp.run_elapsed_s / inp.failures if inp.failures > 0 else math.inf
+        )
+        dec = optimal_checkpoint_every(
+            save_stall_s=inp.save_stall_s,
+            mtbf_s=mtbf,
+            step_time_s=inp.step_time_s,
+            min_every=cfg.min_checkpoint_every,
+            max_every=cfg.max_checkpoint_every,
+        )
+        if not cadence_worth_changing(inp.current_every, dec.every):
+            self._hys.withdraw("cadence")
+            return []
+        if not self._hys.propose("cadence", dec.every, inp.now):
+            return []
+        return [Decision(
+            kind=DECISION_CADENCE,
+            action=f"checkpoint_every {inp.current_every}->{dec.every}",
+            checkpoint_every=dec.every,
+            attrs={
+                "save_stall_s": _fmt(dec.save_stall_s),
+                "mtbf_s": _fmt(dec.mtbf_s),
+                "failures": str(inp.failures),
+                "restart_downtime_s": _fmt(inp.restart_downtime_s),
+                "step_time_s": _fmt(dec.step_time_s),
+                "tau_s": _fmt(dec.tau_s),
+                "clamped": dec.clamped,
+                "from_every": str(inp.current_every),
+                "to_every": str(dec.every),
+            },
+        )]
+
+    def _tick_placement(self, inp: TickInputs) -> List[Decision]:
+        cfg = self.config
+        decisions: List[Decision] = []
+        for host in sorted(inp.host_risk):
+            risk = inp.host_risk[host]
+            if not host_risk_actionable(risk):
+                self._hys.withdraw(f"deprioritize:{host}")
+                self._hys.withdraw(f"migrate:{host}")
+                continue
+            attrs = {
+                "host": host,
+                "rank": str(risk.rank),
+                "flag_age_windows": str(risk.flag_age_windows),
+                "slow_ratio": _fmt(risk.slow_ratio),
+                "flap_count": str(risk.flap_count),
+            }
+            if self._hys.propose(f"deprioritize:{host}", True, inp.now):
+                decisions.append(Decision(
+                    kind=DECISION_DEPRIORITIZE,
+                    action=f"deprioritize {host}",
+                    host=host, attrs=dict(attrs),
+                ))
+            # Pre-emptive migrate: shrink away from the risky host BEFORE
+            # the watchdog (or the host itself) forces a full restart —
+            # only when the gang can spare a member.
+            if (
+                cfg.migrate
+                and inp.elastic_ok
+                and inp.world_size - 1 >= inp.min_world_size
+                and self._hys.propose(f"migrate:{host}", True, inp.now)
+            ):
+                decisions.append(Decision(
+                    kind=DECISION_MIGRATE,
+                    action=f"shrink away from {host}",
+                    host=host,
+                    attrs={
+                        **attrs,
+                        "world_size": str(inp.world_size),
+                    },
+                ))
+        return decisions
+
+    def _tick_warmpool(self, inp: TickInputs) -> List[Decision]:
+        target = warmpool_target(
+            cold_starts=inp.cold_starts,
+            warm_starts=inp.warm_starts,
+            current_target=inp.warmpool_current,
+        )
+        if target == inp.warmpool_current:
+            self._hys.withdraw("warmpool")
+            return []
+        if not self._hys.propose("warmpool", target, inp.now):
+            return []
+        return [Decision(
+            kind=DECISION_WARMPOOL,
+            action=f"warmpool target {inp.warmpool_current}->{target}",
+            warmpool_target=target,
+            attrs={
+                "cold_starts": str(inp.cold_starts),
+                "warm_starts": str(inp.warm_starts),
+                "from_target": str(inp.warmpool_current),
+                "to_target": str(target),
+            },
+        )]

@@ -1,0 +1,284 @@
+"""Pure autopilot decision functions (no store, no clock, no I/O).
+
+Everything here is a function of measured numbers in, decision out —
+the controller half (autopilot/controller.py) owns gathering the
+numbers and acting on the answers. Keeping this layer pure is what
+makes the policy math pinnable by tests/test_autopilot.py against
+hand-computed optima.
+
+The checkpoint-cadence half is the classic optimal-checkpoint-interval
+problem (Young 1974; Daly, FGCS 2006): with a per-checkpoint cost of
+``δ`` seconds and a mean time between failures of ``M`` seconds, the
+work interval that minimizes expected lost time is ``τ ≈ sqrt(2·δ·M)``
+(Young's first-order optimum; Daly's higher-order refinement matters
+only when ``δ`` approaches ``M``, which a sane fleet never reaches).
+What is new here is that BOTH inputs are measured live instead of
+assumed: ``δ`` from the save-stall spans the checkpointer records and
+``M`` from the cause-ledger's restart history — so the optimum tracks
+the fleet as it actually behaves.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict
+
+# -- cause → recovery-action table ------------------------------------------
+
+ACTION_RESTART = "restart"  # full gang restart (the default path)
+ACTION_RESIZE = "resize"  # elastic shrink now, re-grow when capacity returns
+ACTION_MIGRATE = "migrate"  # shrink away from the host AND deprioritize it
+
+# Causes that must NEVER route to a resize: preemption means the
+# capacity comes back (shrinking would orphan the reservation the
+# preemptor's exit restores), and OOM is the workload's own doing on
+# every member — a smaller gang OOMs harder, not softer. The reconciler's
+# _try_resize_shrink refuses these independently; the table exists so
+# the autopilot never even proposes them.
+_RESTART_ONLY_CAUSES = frozenset({"preemption", "oom"})
+
+
+def recovery_action(
+    cause: str,
+    elastic: bool,
+    host_flagged: bool = False,
+) -> str:
+    """Which recovery the autopilot prefers for a failure ``cause``.
+
+    ``elastic`` gates the resize family (non-elastic jobs can only
+    restart). ``host_flagged`` means the straggler tracker holds a live
+    risk flag against the failed member's host — the difference between
+    RESIZE (shrink in place, re-grow on the same host when it returns)
+    and MIGRATE (shrink AND deprioritize, so the re-grow lands
+    elsewhere). Hangs always restart: the watchdog owns that path and a
+    wedged collective says nothing about the host.
+    """
+    if not elastic:
+        return ACTION_RESTART
+    if cause in _RESTART_ONLY_CAUSES or cause == "hang":
+        return ACTION_RESTART
+    if cause in ("node-lost", "node_lost", "crash", "retryable-failure",
+                 "straggler"):
+        return ACTION_MIGRATE if host_flagged else ACTION_RESIZE
+    return ACTION_RESTART
+
+
+# -- Young/Daly checkpoint cadence ------------------------------------------
+
+# Cadence clamps: never checkpoint more often than every step, never
+# let the interval exceed this many steps unless the caller widens it —
+# an unbounded interval means a first failure after a quiet week loses
+# a week.
+DEFAULT_MIN_EVERY = 1
+DEFAULT_MAX_EVERY = 64
+
+
+@dataclass(frozen=True)
+class CadenceDecision:
+    """The cadence answer plus the numbers that justify it — the
+    ``autopilot-decision`` span attrs are exactly these fields."""
+
+    every: int  # recommended checkpoint_every (steps)
+    tau_s: float  # Young interval sqrt(2·δ·M), seconds (inf ⇒ no failures)
+    save_stall_s: float  # measured δ input
+    mtbf_s: float  # measured M input (inf ⇒ zero restart history)
+    step_time_s: float  # seconds/step used to convert τ into steps
+    clamped: str = ""  # "" | "min" | "max" — which clamp bound, if any
+
+
+def optimal_checkpoint_every(
+    save_stall_s: float,
+    mtbf_s: float,
+    step_time_s: float,
+    min_every: int = DEFAULT_MIN_EVERY,
+    max_every: int = DEFAULT_MAX_EVERY,
+) -> CadenceDecision:
+    """Young-optimal checkpoint interval, in steps.
+
+    τ = sqrt(2·δ·M) seconds of useful work between checkpoints, then
+    ``every = round(τ / step_time)`` clamped to [min_every, max_every].
+
+    Degenerate inputs resolve to the clamp that loses least:
+
+    - zero restart history (``mtbf_s`` ≤ 0 or inf): failures have never
+      been observed, so checkpoint as rarely as allowed → ``max_every``;
+    - free checkpoints (``save_stall_s`` ≈ 0): there is no cost to
+      saving, so save as often as allowed → ``min_every``;
+    - unusable step time (≤ 0): τ cannot be converted to steps; fall
+      back to ``max_every`` with τ reported so the receipt shows why.
+    """
+    min_every = max(1, int(min_every))
+    max_every = max(min_every, int(max_every))
+    if save_stall_s <= 0.0:
+        return CadenceDecision(
+            every=min_every, tau_s=0.0, save_stall_s=save_stall_s,
+            mtbf_s=mtbf_s, step_time_s=step_time_s, clamped="min",
+        )
+    if mtbf_s <= 0.0 or math.isinf(mtbf_s):
+        return CadenceDecision(
+            every=max_every, tau_s=math.inf, save_stall_s=save_stall_s,
+            mtbf_s=mtbf_s, step_time_s=step_time_s, clamped="max",
+        )
+    tau = math.sqrt(2.0 * save_stall_s * mtbf_s)
+    if step_time_s <= 0.0:
+        return CadenceDecision(
+            every=max_every, tau_s=tau, save_stall_s=save_stall_s,
+            mtbf_s=mtbf_s, step_time_s=step_time_s, clamped="max",
+        )
+    raw = tau / step_time_s
+    every = int(round(raw)) or 1
+    clamped = ""
+    if every < min_every:
+        every, clamped = min_every, "min"
+    elif every > max_every:
+        every, clamped = max_every, "max"
+    return CadenceDecision(
+        every=every, tau_s=tau, save_stall_s=save_stall_s, mtbf_s=mtbf_s,
+        step_time_s=step_time_s, clamped=clamped,
+    )
+
+
+def cadence_worth_changing(
+    current: int, proposed: int, deadband: float = 0.25
+) -> bool:
+    """Deadband against churn: a directive (and the worker round-trip it
+    costs) is only worth issuing when the proposal moves the interval by
+    more than ``deadband`` relative to the current value. A current of 0
+    ("final save only") always changes — any periodic cadence beats
+    none once failures are observed."""
+    if proposed == current:
+        return False
+    if current <= 0:
+        return True
+    return abs(proposed - current) / float(current) > deadband
+
+
+# -- warm-pool sizing from TTFS cold-miss rates -----------------------------
+
+
+def warmpool_target(
+    cold_starts: int,
+    warm_starts: int,
+    current_target: int,
+    min_slots: int = 0,
+    max_slots: int = 4,
+    grow_miss_rate: float = 0.25,
+    min_samples: int = 4,
+) -> int:
+    """Warm-pool slot target from the observed TTFS cold/warm split.
+
+    A cold start means a gang member paid interpreter + framework +
+    runtime init on the job's critical path because no warm slot was
+    idle — the r11 metric pair ``tpujob_time_to_first_step_{warm,cold}``
+    counts both populations. Grow one slot while the cold-miss rate
+    exceeds ``grow_miss_rate``; shrink one when a full sample window
+    saw no cold start at all (idle warm children are not free: each
+    pins an interpreter + imports). Under ``min_samples`` launches the
+    evidence is noise — hold the current target.
+    """
+    min_slots = max(0, int(min_slots))
+    max_slots = max(min_slots, int(max_slots))
+    current = max(min_slots, min(max_slots, int(current_target)))
+    total = cold_starts + warm_starts
+    if total < min_samples:
+        return current
+    miss_rate = cold_starts / float(total)
+    if miss_rate > grow_miss_rate:
+        return min(max_slots, current + 1)
+    if cold_starts == 0:
+        return max(min_slots, current - 1)
+    return current
+
+
+# -- decision hysteresis ----------------------------------------------------
+
+
+@dataclass
+class _Pending:
+    value: object = None
+    streak: int = 0
+    last_fired: float = -math.inf
+
+
+class Hysteresis:
+    """Per-decision-key damping: a proposal must repeat for
+    ``confirm_ticks`` CONSECUTIVE ticks and the key must be outside its
+    ``cooldown_s`` window before it fires.
+
+    This is deliberately the same shape as the straggler tracker's
+    flag/clear window counting (obs/telemetry.py StragglerTracker) so
+    the two never fight: the tracker needs ``flag_windows`` consecutive
+    outlier windows to flag a host, and the autopilot then needs
+    ``confirm_ticks`` consecutive ticks of that flag to act on it — the
+    autopilot can only ever be SLOWER to act than the signal it acts
+    on, so a flap the tracker damps can never leak through into a
+    resize, and a flag the tracker clears mid-confirmation resets the
+    autopilot's streak to zero.
+    """
+
+    def __init__(self, confirm_ticks: int = 2, cooldown_s: float = 30.0) -> None:
+        self.confirm_ticks = max(1, int(confirm_ticks))
+        self.cooldown_s = float(cooldown_s)
+        self._pending: Dict[str, _Pending] = {}
+
+    def propose(self, key: str, value, now: float) -> bool:
+        """Register ``value`` as this tick's proposal for ``key``;
+        returns True when the proposal just fired (confirmed + cooled
+        down). The caller must then act AND keep proposing only if it
+        still wants the action — firing starts the cooldown."""
+        p = self._pending.setdefault(key, _Pending())
+        if p.value == value:
+            p.streak += 1
+        else:
+            p.value = value
+            p.streak = 1
+        if p.streak < self.confirm_ticks:
+            return False
+        if now - p.last_fired < self.cooldown_s:
+            return False
+        p.last_fired = now
+        p.streak = 0
+        return True
+
+    def withdraw(self, key: str) -> None:
+        """The condition evaporated (e.g. the straggler flag cleared):
+        drop the streak so a re-appearance must re-confirm from zero.
+        The cooldown clock is kept — clearing it would let a flapping
+        condition fire on every other tick."""
+        p = self._pending.get(key)
+        if p is not None:
+            p.value = None
+            p.streak = 0
+
+    def in_cooldown(self, key: str, now: float) -> bool:
+        p = self._pending.get(key)
+        return p is not None and (now - p.last_fired) < self.cooldown_s
+
+
+# -- host-risk gate (reads the tracker's shared HostRisk struct) ------------
+
+# Risk gate the autopilot applies before a pre-emptive migrate: the flag
+# must have been live this many tracker windows (on top of the tracker's
+# own flag_windows ramp), the rank must still be slow by this much, and
+# a chronic flapper (≥ flap_limit completed flag→clear cycles) is never
+# migrated pre-emptively — it would re-flap on the next host too.
+RISK_MIN_FLAG_AGE_WINDOWS = 2
+RISK_MIN_SLOW_RATIO = 1.5
+RISK_FLAP_LIMIT = 3
+
+
+def host_risk_actionable(
+    risk,
+    min_flag_age: int = RISK_MIN_FLAG_AGE_WINDOWS,
+    min_slow_ratio: float = RISK_MIN_SLOW_RATIO,
+    flap_limit: int = RISK_FLAP_LIMIT,
+) -> bool:
+    """True when a :class:`~tf_operator_tpu.obs.telemetry.HostRisk`
+    snapshot justifies pre-emptive action (migrate / deprioritize)."""
+    return (
+        risk.flagged
+        and risk.flag_age_windows >= min_flag_age
+        and risk.slow_ratio >= min_slow_ratio
+        and risk.flap_count < flap_limit
+    )

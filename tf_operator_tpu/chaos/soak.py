@@ -259,6 +259,19 @@ class SoakResult:
     # skips the invariant.
     goodput_scraped: bool = False
     lost_seconds: Dict[str, float] = field(default_factory=dict)
+    # Goodput-autopilot receipts (r16, the A/B soak's raw material): the
+    # full goodput decomposition (same function the reconciler folds at
+    # terminal), the job's autopilot status mirror + cadence directive,
+    # every autopilot-decision span (attrs carry the justifying
+    # numbers), and per-op closed-span width sums for the cause-ledger
+    # cross-check (restart/resize/hang must each equal their own spans'
+    # widths, however the families interleave).
+    goodput: Dict[str, Any] = field(default_factory=dict)
+    autopilot_status: Dict[str, Any] = field(default_factory=dict)
+    cadence_directive: Dict[str, Any] = field(default_factory=dict)
+    decision_spans: List[dict] = field(default_factory=list)
+    span_widths_by_op: Dict[str, float] = field(default_factory=dict)
+    downtime_spans: List[dict] = field(default_factory=list)
 
     def check(self) -> List[str]:
         """Invariant failures, empty when the soak passed."""
@@ -495,6 +508,8 @@ def _soak_job(
     data_plane: str = "light",
     step_sleep_s: float = 1.0,
     disk_restore_delay_s: float = 0.0,
+    workload_extra: Optional[Dict[str, Any]] = None,
+    autopilot: Optional[Dict[str, Any]] = None,
 ) -> TPUJob:
     """``data_plane='light'`` (default) runs workloads/soak.py — real
     checkpoint subsystem, no cross-process collectives, so the soak works
@@ -548,8 +563,12 @@ def _soak_job(
             topology=TopologySpec(num_hosts=num_hosts, chips_per_host=1),
         ),
     )
+    if workload_extra:
+        workload.update(workload_extra)
     job.spec.run_policy.backoff_limit = backoff_limit
     job.spec.run_policy.heartbeat_ttl_seconds = heartbeat_ttl
+    if autopilot is not None:
+        job.spec.run_policy.autopilot = dict(autopilot)
     job.spec.workload = workload
     return job
 
@@ -572,6 +591,8 @@ def run_soak(
     operator_crash: bool = False,
     p2p_restore: bool = False,
     disk_restore_delay_s: float = 0.0,
+    workload_extra: Optional[Dict[str, Any]] = None,
+    autopilot: Optional[Dict[str, Any]] = None,
 ) -> SoakResult:
     """Run one seeded soak; returns the observations (see SoakResult.check).
 
@@ -671,7 +692,8 @@ def run_soak(
             _soak_job(job_name, workers, num_hosts, ckpt_dir, steps,
                       checkpoint_every, backoff_limit, heartbeat_ttl,
                       data_plane=data_plane, step_sleep_s=step_sleep_s,
-                      disk_restore_delay_s=disk_restore_delay_s)
+                      disk_restore_delay_s=disk_restore_delay_s,
+                      workload_extra=workload_extra, autopilot=autopilot)
         )
         injector.arm()
         deadline = time.monotonic() + timeout
@@ -729,6 +751,46 @@ def run_soak(
         if ctl is not None:
             result.lost_seconds = _scrape_lost_seconds(ctl.metrics)
             result.goodput_scraped = True
+            # Autopilot receipts (r16): the goodput decomposition (the
+            # SAME pure function the reconciler folds at terminal, over
+            # the same trace + telemetry — the A/B gate's numerator),
+            # the status-mirrored decisions, and per-op closed-span
+            # width sums for the cause-ledger cross-check.
+            from tf_operator_tpu.obs.telemetry import (
+                goodput_decomposition,
+                job_telemetry,
+            )
+
+            job_obj = store.get("TPUJob", "default", job_name)
+            end = st.completion_time or time.time()
+            result.goodput = goodput_decomposition(
+                trace, job_telemetry(store, "default", job_name),
+                job_obj.metadata.creation_timestamp, end,
+            )
+            result.autopilot_status = dict(job_obj.status.autopilot or {})
+            result.cadence_directive = dict(
+                job_obj.status.checkpoint_cadence_directive or {}
+            )
+            result.decision_spans = [
+                {"name": s.metadata.name, "attrs": dict(s.attrs or {})}
+                for s in trace if s.op == "autopilot-decision"
+            ]
+            result.span_widths_by_op = {
+                op: sum(
+                    max(0.0, s.end_time - s.start_time)
+                    for s in trace if s.op == op and s.end_time
+                )
+                for op in ("restart", "resize", "hang")
+            }
+            result.downtime_spans = [
+                {
+                    "name": s.metadata.name, "op": s.op,
+                    "attrs": dict(s.attrs or {}),
+                    "width_s": round(max(0.0, s.end_time - s.start_time), 6),
+                }
+                for s in trace
+                if s.op in ("restart", "resize", "hang") and s.end_time
+            ]
     finally:
         injector.stop()
         watcher.stop()
@@ -761,6 +823,238 @@ def run_soak(
             f"{leaked}"
         )
     return result
+
+
+def default_autopilot_schedule(seed: int) -> FaultSchedule:
+    """The autopilot A/B recipe: ONE mid-run crash (after checkpoint
+    progress, so recovery is warm). The crash is what gives the ON
+    lane's Young/Daly policy a finite measured MTBF — before it the
+    cadence stretches on the zero-failure clamp, after it the interval
+    re-derives from δ and the observed failure rate. Pure function of
+    the seed, shared verbatim by both lanes."""
+    return FaultSchedule.generate(
+        seed, crashes=1, preemptions=0, first_step=2, spread_s=0.0
+    )
+
+
+@dataclass
+class AutopilotSoakResult:
+    """Two same-seed, same-fault-schedule soak lanes: ``run_policy.
+    autopilot`` off then on. ``check()`` gates the goodput gain and the
+    receipt discipline (every executed decision present as an
+    autopilot-decision span carrying its justifying numbers), and
+    extends the r13 cause-attribution invariant to both lanes: each of
+    restart/resize/hang's ledger lost-seconds must equal the sum of its
+    OWN closed spans' widths — however autopilot-triggered resizes and
+    watchdog windows interleave, nothing double-counts."""
+
+    off: SoakResult
+    on: SoakResult
+    min_gain: float = 1.10
+
+    # Every numeric attr a cadence decision span must justify itself with.
+    CADENCE_RECEIPT_KEYS = (
+        "save_stall_s", "mtbf_s", "step_time_s", "tau_s",
+        "from_every", "to_every", "epoch",
+    )
+
+    def gain(self) -> Optional[float]:
+        off_r = self.off.goodput.get("goodput_ratio", 0.0)
+        on_r = self.on.goodput.get("goodput_ratio", 0.0)
+        return (on_r / off_r) if off_r else None
+
+    def check(self) -> List[str]:
+        errs: List[str] = []
+        for tag, lane in (("off", self.off), ("on", self.on)):
+            errs.extend(f"[{tag}] {e}" for e in lane.check())
+            # Satellite 6 (extends invariant 10): per-cause single-source
+            # attribution. The restart/resize/hang counters increment
+            # ONLY at their own span closes, so each must match its own
+            # spans' summed widths — an autopilot resize interleaving
+            # with a watchdog hang in one incarnation must not leak
+            # either window into the other's cause.
+            if lane.goodput_scraped:
+                for cause in ("restart", "resize", "hang"):
+                    got = lane.lost_seconds.get(cause, 0.0)
+                    want = lane.span_widths_by_op.get(cause, 0.0)
+                    if abs(got - want) > max(0.5, 0.05 * want):
+                        errs.append(
+                            f"[{tag}] lost_seconds{{cause={cause}}} "
+                            f"{got:.2f}s != closed {cause}-span widths "
+                            f"{want:.2f}s"
+                        )
+        # The off lane must be autopilot-silent: no decisions, no spans.
+        if self.off.decision_spans or self.off.autopilot_status:
+            errs.append(
+                "autopilot-off lane recorded autopilot activity: "
+                f"spans={len(self.off.decision_spans)} "
+                f"status={self.off.autopilot_status}"
+            )
+        # The on lane acted, and every action is receipted.
+        decisions_total = int(
+            self.on.autopilot_status.get("decisions_total", 0)
+        )
+        if decisions_total < 1:
+            errs.append("autopilot-on lane executed no decisions")
+        if len(self.on.decision_spans) != decisions_total:
+            errs.append(
+                f"autopilot receipt mismatch: {len(self.on.decision_spans)} "
+                f"decision spans != decisions_total {decisions_total}"
+            )
+        cadence = [
+            d for d in self.on.decision_spans
+            if d["attrs"].get("kind") == "cadence"
+        ]
+        if not cadence:
+            errs.append(
+                "autopilot-on lane never retuned the checkpoint cadence "
+                f"(decisions: {self.on.decision_spans})"
+            )
+        for d in cadence:
+            for key in self.CADENCE_RECEIPT_KEYS:
+                v = d["attrs"].get(key)
+                try:
+                    valid = v is not None and (v == "inf" or float(v) >= 0)
+                except ValueError:
+                    valid = False
+                if not valid:
+                    errs.append(
+                        f"cadence decision span {d['name']} missing "
+                        f"justifying number {key!r}: attrs={d['attrs']}"
+                    )
+        # The directive round-tripped. The controller only authors epoch
+        # N+1 after the chief acked N, so the ack may trail the final
+        # epoch by at most one (a directive issued in the run's last
+        # poll interval is legitimately still in flight at completion) —
+        # but at least one epoch must have been applied.
+        cd = self.on.cadence_directive
+        applied = int(cd.get("applied_epoch", 0))
+        epoch = int(cd.get("epoch", 0))
+        if applied < 1 or applied < epoch - 1:
+            errs.append(
+                f"cadence directive never round-tripped: epoch {epoch}, "
+                f"applied_epoch={cd.get('applied_epoch')}"
+            )
+        # The mechanism receipt: the retune actually cut save-stall loss.
+        off_stall = self.off.goodput.get("lost_s", {}).get("ckpt-stall", 0.0)
+        on_stall = self.on.goodput.get("lost_s", {}).get("ckpt-stall", 0.0)
+        if not on_stall < off_stall:
+            errs.append(
+                f"autopilot did not cut ckpt-stall loss: on {on_stall:.2f}s "
+                f">= off {off_stall:.2f}s"
+            )
+        # THE gate: autopilot-on goodput >= min_gain x the off lane.
+        off_r = self.off.goodput.get("goodput_ratio", 0.0)
+        on_r = self.on.goodput.get("goodput_ratio", 0.0)
+        if not (off_r > 0 and on_r >= self.min_gain * off_r):
+            errs.append(
+                f"goodput gain gate failed: on {on_r:.4f} < "
+                f"{self.min_gain:.2f}x off {off_r:.4f}"
+            )
+        return errs
+
+
+def run_autopilot_soak(
+    seed: int = 0,
+    steps: int = 20,
+    step_sleep_s: float = 0.2,
+    save_stall_extra_s: float = 0.8,
+    timeout: float = 180.0,
+    workdir: Optional[str] = None,
+    min_gain: float = 1.10,
+    max_checkpoint_every: int = 8,
+) -> AutopilotSoakResult:
+    """The A/B autopilot soak: the SAME seed and fault schedule, run
+    twice — ``run_policy.autopilot`` off, then on. Identical workload in
+    both lanes: ``checkpoint_every=1`` with a modeled per-save blocking
+    stall (``save_stall_extra_s``), so the off lane pays the stall on
+    every step while the on lane's measured-δ/measured-MTBF retune
+    stretches the interval and recovers the difference as goodput.
+
+    A single worker keeps the A/B clean: the telemetry-averaged
+    ckpt-stall loss is then exactly the chief's stall seconds, so the
+    gate measures the cadence policy, not rank-dilution artifacts.
+
+    Sizing: steps x save_stall_extra_s is the off lane's stall loss —
+    the A/B signal. It must dwarf the lanes' uncontrolled noise
+    (process startup / compile-init varies by a couple of seconds run
+    to run), or the 1.10x gate flakes. The defaults put ~16 s of
+    recoverable stall against ~2 s of noise."""
+    root = workdir or tempfile.mkdtemp(prefix="tpujob-autopilot-soak-")
+    workload_extra = {
+        # The modeled flagship save cost the retune amortizes.
+        "save_stall_extra_s": save_stall_extra_s,
+        # One telemetry window per step: the autopilot needs fresh
+        # step-time medians at test timescales.
+        "telemetry_every": 1,
+        # Per-step directive polling (no throttle): a retune must land
+        # at the very next step boundary.
+        "cadence_poll_s": 0.0,
+    }
+
+    def lane(tag: str, autopilot: Optional[Dict[str, Any]]) -> SoakResult:
+        return run_soak(
+            seed=seed,
+            # Re-derived per lane from the seed: pure function, so both
+            # lanes see byte-identical fault schedules.
+            schedule=default_autopilot_schedule(seed),
+            hosts=2, num_hosts=1, workers=1, steps=steps,
+            checkpoint_every=1, backoff_limit=2, timeout=timeout,
+            workdir=os.path.join(root, tag), heartbeat_ttl=3.0,
+            step_sleep_s=step_sleep_s, workload_extra=workload_extra,
+            autopilot=autopilot,
+        )
+
+    off = lane("off", None)
+    on = lane("on", {
+        "enabled": True,
+        # Test-timescale hysteresis: still >= the straggler tracker's
+        # flag_windows (the no-flap contract), just with a short cooldown.
+        "cooldown_s": 1.0,
+        "confirm_ticks": 2,
+        "max_checkpoint_every": max_checkpoint_every,
+    })
+    return AutopilotSoakResult(off=off, on=on, min_gain=min_gain)
+
+
+def autopilot_artifact(
+    result: AutopilotSoakResult, seed: int
+) -> Dict[str, Any]:
+    """The checked-in A/B receipt (artifacts/autopilotbench_r16.json)."""
+    errors = result.check()
+
+    def lane(r: SoakResult) -> Dict[str, Any]:
+        return {
+            "succeeded": r.succeeded,
+            "restarts": r.restart_count,
+            "goodput": r.goodput,
+            "lost_seconds": r.lost_seconds,
+            "span_widths_by_op": r.span_widths_by_op,
+            "downtime_spans": r.downtime_spans,
+            "resume_steps": r.resume_steps,
+            "applied": [a["kind"] for a in r.applied],
+        }
+
+    return {
+        "bench": "autopilot-ab-soak",
+        "seed": seed,
+        "gate_min_gain": result.min_gain,
+        "off": lane(result.off),
+        "on": {
+            **lane(result.on),
+            "decisions_total": result.on.autopilot_status.get(
+                "decisions_total", 0
+            ),
+            "active_checkpoint_every": result.on.autopilot_status.get(
+                "active_checkpoint_every", 0
+            ),
+            "cadence_directive": result.on.cadence_directive,
+            "decisions": result.on.decision_spans,
+        },
+        "goodput_gain": result.gain(),
+        "errors": errors,
+        "pass": not errors,
+    }
 
 
 def default_elastic_schedule(
@@ -1614,6 +1908,19 @@ def main(argv=None) -> int:
     p.add_argument("--detect-bound", type=float, default=10.0,
                    help="hang soak: max allowed slack (seconds) of the "
                         "declaration past the hang timeout")
+    p.add_argument("--autopilot-ab", action="store_true",
+                   help="goodput-autopilot A/B soak: the same seed and "
+                        "fault schedule run twice (run_policy.autopilot "
+                        "off, then on); gates autopilot-on goodput_ratio "
+                        ">= --min-goodput-gain x the off lane, the "
+                        "per-decision span receipts, and the per-cause "
+                        "lost-seconds == own-span-widths ledger invariant")
+    p.add_argument("--min-goodput-gain", type=float, default=1.10,
+                   help="autopilot A/B: required on/off goodput_ratio "
+                        "multiple")
+    p.add_argument("--save-stall-extra", type=float, default=0.8,
+                   help="autopilot A/B: modeled per-save blocking stall "
+                        "(seconds) the cadence retune amortizes")
     p.add_argument("--kills", type=int, default=2,
                    help="elastic soak: number of kill/return faults")
     p.add_argument("--total-windows", type=int, default=900,
@@ -1667,6 +1974,33 @@ def main(argv=None) -> int:
         for e in errors:
             print(f"INVARIANT VIOLATED{tag}: {e}", file=sys.stderr)
         return errors
+
+    if args.autopilot_ab:
+        import json as _json
+
+        # Deliberately NOT forwarding --steps/--step-sleep: the A/B's
+        # lane geometry is sized so the recoverable stall dwarfs startup
+        # noise (see run_autopilot_soak); the generic soak defaults
+        # would shrink the signal into the noise floor.
+        aresult = run_autopilot_soak(
+            seed=args.seed,
+            save_stall_extra_s=args.save_stall_extra,
+            timeout=args.timeout, workdir=args.workdir,
+            min_gain=args.min_goodput_gain,
+        )
+        artifact = autopilot_artifact(aresult, args.seed)
+        print(_json.dumps(artifact))
+        if args.artifact:
+            os.makedirs(
+                os.path.dirname(os.path.abspath(args.artifact)), exist_ok=True
+            )
+            with open(args.artifact, "w") as f:
+                _json.dump(artifact, f, indent=2)
+            print(f"autopilot A/B receipt -> {args.artifact}")
+        errors = aresult.check()
+        for e in errors:
+            print(f"AUTOPILOT INVARIANT VIOLATED: {e}", file=sys.stderr)
+        return 1 if errors else 0
 
     if args.hang:
         import json as _json

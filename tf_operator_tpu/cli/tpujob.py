@@ -249,6 +249,22 @@ def render_top(payload: dict, job: dict = None, now: float = None) -> str:
         for cause in sorted(lost):
             if lost[cause] > 0:
                 lines.append(f"  lost[{cause}]  {lost[cause]:.1f}s")
+    # Goodput autopilot (r16): the active checkpoint cadence and the last
+    # executed decision, from the job's status mirror — the quick answer
+    # to "is the autopilot driving, and what did it just do".
+    status = (job or {}).get("status") or {}
+    ap = status.get("autopilot") or {}
+    if ap:
+        every = ap.get("active_checkpoint_every", 0)
+        lines.append(
+            f"AUTOPILOT  {ap.get('decisions_total', 0)} decisions, "
+            f"checkpoint every {every} steps"
+        )
+        last = ap.get("last_decision") or {}
+        if last:
+            lines.append(
+                f"  last[{last.get('kind', '?')}]  {last.get('action', '?')}"
+            )
     return "\n".join(lines)
 
 

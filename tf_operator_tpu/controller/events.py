@@ -46,6 +46,11 @@ REASON_CONTROLLER_RESTARTED = "ControllerRestarted"
 # deprioritized for new gang placements until it clears.
 REASON_SLOW_HOST = "SlowHost"
 REASON_SLOW_HOST_CLEARED = "SlowHostCleared"
+# Goodput autopilot (autopilot/, r16): one event per executed decision —
+# cadence retune, pre-emptive migrate, host deprioritization, warm-pool
+# retarget. The authoritative receipt is the autopilot-decision span;
+# the event is the human-readable echo.
+REASON_AUTOPILOT = "AutopilotDecision"
 # Hang plane (obs/watchdog.py, r15): the gang-progress watchdog declared
 # the job HUNG (no rank advanced a step for hang_timeout_seconds with
 # heartbeats live); a stack sweep + postmortem freeze precede recovery.

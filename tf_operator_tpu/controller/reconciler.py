@@ -60,6 +60,16 @@ from tf_operator_tpu.api.types import (
 )
 from tf_operator_tpu.api.helpers import accelerator_env, as_owner
 from tf_operator_tpu.api.validation import ValidationError
+from tf_operator_tpu.autopilot.controller import (
+    DECISION_CADENCE,
+    DECISION_DEPRIORITIZE,
+    DECISION_MIGRATE,
+    DECISION_WARMPOOL,
+    AutopilotConfig,
+    Decision,
+    JobAutopilot,
+    TickInputs,
+)
 from tf_operator_tpu.controller import events as ev
 from tf_operator_tpu.controller.events import EventRecorder
 from tf_operator_tpu.controller.expectations import ControllerExpectations
@@ -90,6 +100,7 @@ from tf_operator_tpu.obs.telemetry import (
     CAUSE_HANG as GOODPUT_HANG,
     CAUSE_RESIZE as GOODPUT_RESIZE,
     CAUSE_RESTART as GOODPUT_RESTART,
+    HostRisk,
     StragglerTracker,
     goodput_decomposition,
     job_telemetry,
@@ -171,6 +182,21 @@ CAUSE_RESIZE_GROW = "resize_grow"
 # when progress stopped), so _restart_gang opens NO restart span for it:
 # one window, one cause, never double-counted (docs/design.md §6.3).
 CAUSE_HANG = "hang"
+# Pre-emptive autopilot migrate (r16, autopilot/): the autopilot shrank
+# the gang away from a risk-flagged host BEFORE anything died. Same
+# mechanics and accounting as any other shrink (resize_count, resize
+# span, never charged to backoff) — the cause string in resize_history
+# records that the straggler signal, not a failure, triggered it.
+CAUSE_AUTOPILOT_MIGRATE = "autopilot-straggler"
+# Host annotation the autopilot's warm-pool actuator writes (value = the
+# slot target as a decimal string); each HostAgent's heartbeat loop
+# polls its own Host object and resizes its local pool to match.
+ANNOTATION_WARMPOOL_TARGET = "tpujob.dev/warmpool-target"
+# How long one autopilot deprioritization verdict keeps a host soft-
+# avoided in place_gang (sched/fleet.py deprioritize_host). TTL-bounded:
+# after a migrate the host runs no ranks for this job, so no telemetry
+# exists to clear it the way the straggler tracker clears slow hosts.
+AUTOPILOT_DEPRIORITIZE_TTL_S = 600.0
 # How long the reconciler holds a declared-HUNG gang alive waiting for
 # every rank's stack dump to be acked before shooting it anyway — the
 # forensics window must never stall recovery indefinitely (a wedged
@@ -295,6 +321,19 @@ class TPUJobController:
         self._watchdogs: Dict[str, GangWatchdog] = {}  # uid -> watchdog
         self._blackboxes: Dict[str, Blackbox] = {}  # uid -> flight recorder
         self._open_hang: Dict[str, Dict[str, Any]] = {}  # uid -> span info
+        # Goodput autopilot (r16, autopilot/): per-job decision engines
+        # driven from the gang-running sync path, reading the SAME
+        # surfaces the dashboards read (telemetry windows, save-stall
+        # spans, the cause ledger, StragglerTracker.host_risk()) and
+        # acting through actuators that already exist. Keyed by uid like
+        # the trackers — decision state dies with the incarnation.
+        self._autopilots: Dict[str, JobAutopilot] = {}  # uid -> engine
+        self._host_risk: Dict[str, Dict[str, HostRisk]] = {}  # uid -> host -> risk
+        self._last_step_time: Dict[str, float] = {}  # uid -> last window median
+        self._ap_ttfs_seen: set = set()  # uids whose TTFS fed the cold/warm split
+        self._ttfs_cold = 0  # fleet-level cold first-step marks (warmpool input)
+        self._ttfs_warm = 0
+        self._warmpool_target = 1  # last fleet warm-pool target annotated
         # Workqueue shards (run(shards=N) expands): keys hash by NAMESPACE,
         # so one tenant's burst cannot head-of-line-block another tenant's
         # keys behind a single queue mutex, while all of one job's events
@@ -1124,6 +1163,21 @@ class TPUJobController:
             # members report RUNNING. Its width is the control-plane
             # resize downtime, by direction.
             self._close_resize_span(job, now_running)
+            # Restart-span close, condition-independent: the RUNNING
+            # edge below is the primary close point, but a lost
+            # RESTARTING status write skips the edge entirely and the
+            # span would drift open until terminal — charging the whole
+            # healthy tail to cause restart. All members RUNNING with at
+            # least one created after the outage began is the recovery
+            # receipt regardless of condition history; a stale informer
+            # snapshot (members all predating the span) is refused.
+            open_restart = self._open_restart.get(job.metadata.uid)
+            if open_restart is not None and any(
+                observed[(r[0].value, r[1])].metadata.creation_timestamp
+                > open_restart["start"]
+                for r in active
+            ):
+                self._close_restart_span(job, now_running)
             if job.status.start_time is None:
                 job.status.start_time = time.time()
             if not has_condition(job.status, ConditionType.RUNNING):
@@ -1158,6 +1212,13 @@ class TPUJobController:
             # step-time windows for stragglers (resync ticks drive this
             # between watch events).
             self._check_stragglers(job, processes)
+            # Goodput autopilot (r16): turn the numbers the two checks
+            # above maintain into policy. A pre-emptive migrate shrinks
+            # the gang — end the sync exactly like the failure-path
+            # shrink does (the directive is published; survivors
+            # re-shard; the next sync sees the new world).
+            if self._autopilot_tick(job, gang, active, observed, exp_key):
+                return
 
         # -- evaluator restarts (per-replica, not gang) -------------------
         for r in evaluators:
@@ -1861,18 +1922,37 @@ class TPUJobController:
         tracker = self._stragglers.setdefault(uid, StragglerTracker())
         for seq in complete:
             window = by_seq[seq]
-            med = statistics.median(window.values())
             flagged, cleared = tracker.observe(window)
+            # One shared struct (r16): the flag surface below and the
+            # autopilot both read the tracker's typed host_risk()
+            # snapshot instead of re-deriving ratios from the window.
+            risk = tracker.host_risk()
             for rank in flagged:
                 host = rank_host.get(rank, "")
                 self._flag_slow_host(
                     job, rank, host, by_role, gang,
                     windows=tracker.windows_seen,
-                    ratio=(window[rank] / med) if med > 0 else 0.0,
+                    ratio=risk[rank].slow_ratio if rank in risk else 0.0,
                 )
             for rank in cleared:
                 self._clear_slow_host(job, rank, rank_host.get(rank, ""), by_role, gang)
         self._straggler_seen_seq[uid] = complete[-1]
+        # Autopilot inputs (r16): the latest window's cross-rank median
+        # step time, and the rank risk snapshot keyed by HOST (the unit
+        # placement and migration act on). When several ranks share a
+        # host, the riskiest rank speaks for it.
+        self._last_step_time[uid] = statistics.median(
+            by_seq[complete[-1]].values()
+        )
+        by_host: Dict[str, HostRisk] = {}
+        for rank, r in tracker.host_risk().items():
+            r.host = rank_host.get(rank, "") or f"rank-{rank}"
+            prev = by_host.get(r.host)
+            if prev is None or (r.flagged, r.slow_ratio) > (
+                prev.flagged, prev.slow_ratio
+            ):
+                by_host[r.host] = r
+        self._host_risk[uid] = by_host
 
     def _flag_slow_host(
         self,
@@ -1950,6 +2030,277 @@ class TPUJobController:
             )
         except Exception:  # noqa: BLE001 — the flag is advisory
             pass
+
+    # ---- goodput autopilot (autopilot/, r16) ----------------------------
+
+    def _autopilot_tick(
+        self,
+        job: TPUJob,
+        gang: List[Tuple[ReplicaType, int]],
+        active: List[Tuple[ReplicaType, int]],
+        observed: Dict[Tuple[str, int], Process],
+        exp_key: str,
+    ) -> bool:
+        """One decision step for a RUNNING gang: gather measured inputs,
+        let the job's JobAutopilot decide, execute each decision through
+        an existing actuator, and receipt it (autopilot-decision span +
+        per-kind counter + status mirror). Returns True when a decision
+        shrank the gang — the caller must end the sync like the
+        failure-path shrink does. Best-effort end to end: a gather or
+        actuator failure never fails a sync."""
+        cfg = AutopilotConfig.from_run_policy(job.spec.run_policy.autopilot)
+        if cfg is None:
+            return False
+        uid = job.metadata.uid
+        ap = self._autopilots.get(uid)
+        if ap is None:
+            ap = self._autopilots[uid] = JobAutopilot(cfg)
+        now = time.time()
+        try:
+            inputs = self._autopilot_inputs(job, active, cfg, now)
+            decisions = ap.tick(inputs)
+        except Exception:  # noqa: BLE001 — advisory loop, never sync-fatal
+            log.exception("autopilot tick failed for %s", job.key())
+            return False
+        resized = False
+        # One directive in flight at a time: a new cadence epoch is only
+        # authored once the chief acked the previous one (applied_epoch
+        # catches up), so epochs can't outrun the apply loop and the
+        # final directive of a run is at most one epoch ahead of its ack.
+        cc = job.status.checkpoint_cadence_directive or {}
+        cadence_pending = int(cc.get("epoch", 0)) > int(cc.get("applied_epoch", 0))
+        for d in decisions:
+            if d.kind == DECISION_MIGRATE and resized:
+                continue  # one resize per sync; the rest re-propose later
+            if d.kind == DECISION_CADENCE and cadence_pending:
+                continue  # previous epoch not applied yet; re-propose later
+            try:
+                acted = self._autopilot_execute(
+                    job, d, active, observed, exp_key, now
+                )
+            except Exception:  # noqa: BLE001
+                log.exception(
+                    "autopilot %s failed for %s", d.kind, job.key()
+                )
+                continue
+            if acted and d.kind == DECISION_MIGRATE:
+                resized = True
+        return resized
+
+    def _autopilot_inputs(
+        self,
+        job: TPUJob,
+        active: List[Tuple[ReplicaType, int]],
+        cfg: AutopilotConfig,
+        now: float,
+    ) -> TickInputs:
+        """Measured inputs for one decision step — every number comes
+        from a surface that already exists (spans, telemetry windows,
+        the status counters, the tracker snapshot)."""
+        uid = job.metadata.uid
+        save_stall_s, saves, restart_down = 0.0, 0, 0.0
+        try:
+            spans = job_trace(
+                self.store, job.metadata.namespace, job.metadata.name
+            )
+        except Exception:  # noqa: BLE001 — telemetry read is best-effort
+            spans = []
+        stalls = [
+            s.duration() for s in spans
+            if s.op == "checkpoint-save-stall" and s.duration() is not None
+        ]
+        if stalls:
+            saves = len(stalls)
+            save_stall_s = sum(stalls) / saves
+        restart_down = sum(
+            s.duration() or 0.0 for s in spans
+            if s.op in ("restart", "hang") and s.duration() is not None
+        )
+        # Fleet-level TTFS cold/warm split (warm-pool sizing input): fold
+        # each job's first-step span exactly once, as soon as it exists.
+        if uid not in self._ap_ttfs_seen:
+            try:
+                span = self.store.get(
+                    KIND_SPAN, job.metadata.namespace,
+                    first_step_span_name(job.metadata.name, uid),
+                )
+            except Exception:  # noqa: BLE001 — not marked yet
+                span = None
+            if span is not None:
+                self._ap_ttfs_seen.add(uid)
+                if (getattr(span, "attrs", None) or {}).get("warm") == "1":
+                    self._ttfs_warm += 1
+                else:
+                    self._ttfs_cold += 1
+        directive = job.status.checkpoint_cadence_directive or {}
+        epoch = int(directive.get("epoch", 0))
+        if epoch:
+            current_every = int(directive.get("checkpoint_every", 0))
+        else:
+            current_every = int(
+                (job.spec.workload or {}).get("checkpoint_every", 0)
+            )
+        wd = self._watchdogs.get(uid)
+        failures = (
+            job.status.restart_count
+            + job.status.preemption_count
+            + job.status.hang_count
+        )
+        submit = job.metadata.creation_timestamp or job.status.start_time or now
+        return TickInputs(
+            now=now,
+            step_time_s=self._last_step_time.get(uid, 0.0),
+            save_stall_s=save_stall_s,
+            saves_observed=saves,
+            failures=failures,
+            run_elapsed_s=max(0.0, now - submit),
+            restart_downtime_s=restart_down,
+            current_every=current_every,
+            directive_epoch=epoch,
+            directive_acked=int(directive.get("applied_epoch", 0)) >= epoch,
+            host_risk=dict(self._host_risk.get(uid, {})),
+            watchdog_stalled=wd is not None and wd.stalled,
+            elastic_ok=(
+                job.spec.run_policy.elastic and _elastic_mesh_ok(job)
+            ),
+            world_size=len(active),
+            min_world_size=2,
+            cold_starts=self._ttfs_cold,
+            warm_starts=self._ttfs_warm,
+            warmpool_current=self._warmpool_target,
+        )
+
+    def _autopilot_execute(
+        self,
+        job: TPUJob,
+        d: Decision,
+        active: List[Tuple[ReplicaType, int]],
+        observed: Dict[Tuple[str, int], Process],
+        exp_key: str,
+        now: float,
+    ) -> bool:
+        """Run one decision through its EXISTING actuator (the no-new-
+        actuators rule, docs/design.md §4.12) and receipt it."""
+        acted = False
+        if d.kind == DECISION_CADENCE:
+            # Actuator: the checkpoint-cadence status directive — same
+            # monotonic-epoch protocol as profiling; the chief applies it
+            # at the next step boundary and acks back.
+            cur = job.status.checkpoint_cadence_directive or {}
+            epoch = int(cur.get("epoch", 0)) + 1
+            directive = {
+                "epoch": epoch,
+                "checkpoint_every": d.checkpoint_every,
+                "time": now,
+            }
+            # Carry the chief's last ack forward: applied_epoch means
+            # "last epoch the chief applied", which legitimately trails
+            # the live epoch by one while this directive is in flight.
+            # Without this the new-epoch wholesale write would erase the
+            # ack history the round-trip invariant reads.
+            if "applied_epoch" in cur:
+                directive["applied_epoch"] = int(cur["applied_epoch"])
+                if "applied_step" in cur:
+                    directive["applied_step"] = int(cur["applied_step"])
+            job.status.checkpoint_cadence_directive = directive
+            d.attrs["epoch"] = str(epoch)
+            acted = True
+        elif d.kind == DECISION_DEPRIORITIZE:
+            # Actuator: the fleet scheduler's deprioritized-host registry,
+            # unioned into place_gang's soft-avoid set for NEW gangs.
+            if d.host:
+                with self._sched_lock:
+                    self.fleet.deprioritize_host(
+                        d.host, now + AUTOPILOT_DEPRIORITIZE_TTL_S
+                    )
+                acted = True
+        elif d.kind == DECISION_MIGRATE:
+            # Actuator: the r12 elastic shrink, aimed at the risky host's
+            # LIVE members before the watchdog (or the host) kills them.
+            # All of _try_resize_shrink's refusals apply unchanged —
+            # chief on the host, no survivor, non-elastic mesh — so a
+            # refused migrate simply falls back to deprioritize-only.
+            victims = [
+                observed[(r[0].value, r[1])]
+                for r in active
+                if (r[0].value, r[1]) in observed
+                and observed[(r[0].value, r[1])].spec.node_name == d.host
+            ]
+            if victims:
+                acted = self._try_resize_shrink(
+                    job, active, observed, victims, exp_key,
+                    CAUSE_AUTOPILOT_MIGRATE,
+                )
+        elif d.kind == DECISION_WARMPOOL:
+            # Actuator: the warm-pool target annotation on Host objects;
+            # each HostAgent's heartbeat loop applies it locally.
+            acted = self._annotate_warmpool_targets(d.warmpool_target)
+            if acted:
+                self._warmpool_target = d.warmpool_target
+        if not acted:
+            return False
+        # The receipt: span (authoritative, carries the justifying
+        # numbers), per-kind counter, status mirror, human event.
+        self.metrics.inc(
+            "tpujob_autopilot_decisions_total", labels={"kind": d.kind}
+        )
+        seq = int((job.status.autopilot or {}).get("decisions_total", 0)) + 1
+        self.tracer.record(
+            job.metadata.namespace, job.metadata.name, job.metadata.uid,
+            "autopilot-decision", now, now,
+            attrs={
+                "kind": d.kind, "action": d.action, "track": "autopilot",
+                **d.attrs,
+            },
+            name=f"{self._span_name(job, 'autopilot')}-{d.kind}-{seq}",
+        )
+        job.status.autopilot = {
+            "last_decision": {
+                "kind": d.kind, "action": d.action, "time": now, **d.attrs,
+            },
+            "decisions_total": seq,
+            "active_checkpoint_every": (
+                d.checkpoint_every if d.kind == DECISION_CADENCE else int(
+                    (job.status.checkpoint_cadence_directive or {}).get(
+                        "checkpoint_every", 0
+                    )
+                    or (job.spec.workload or {}).get("checkpoint_every", 0)
+                    or 0
+                )
+            ),
+        }
+        self.recorder.normal(
+            job, ev.REASON_AUTOPILOT, f"autopilot: {d.action}"
+        )
+        # The migrate path already wrote status inside _try_resize_shrink,
+        # but the receipt fields above landed after that write.
+        self._write_status(job)
+        return True
+
+    def _annotate_warmpool_targets(self, target: int) -> bool:
+        """Stamp the warm-pool slot target on every registered Host; the
+        agents' heartbeat loops pick it up. Returns True when at least
+        one host was annotated."""
+        try:
+            hosts = self.store.list(KIND_HOST)
+        except Exception:  # noqa: BLE001 — advisory
+            return False
+        wrote = False
+        for h in hosts:
+            def mutate(cur, value=str(int(target))):
+                if cur.metadata.annotations.get(
+                    ANNOTATION_WARMPOOL_TARGET
+                ) == value:
+                    return False
+                cur.metadata.annotations[ANNOTATION_WARMPOOL_TARGET] = value
+            try:
+                self.store.update_with_retry(
+                    KIND_HOST, h.metadata.namespace, h.metadata.name, mutate
+                )
+                wrote = True
+            except Exception:  # noqa: BLE001 — advisory
+                continue
+        return wrote
 
     def _depot_peers(self) -> List[str]:
         """Depot URLs of hosts that can serve peer warm restores: every
@@ -2161,7 +2512,12 @@ class TPUJobController:
                         job, procs, ranks=ranks, bound_slots=bound_slots,
                         ttl=self._job_heartbeat_ttl(job),
                         reserved=self.fleet.reserved_for_others(job),
-                        deprioritized=set(self._slow_hosts),
+                        # Straggler-flagged hosts plus the autopilot's
+                        # TTL-bounded deprioritizations (r16) — both soft:
+                        # the scheduler prefers other hosts but still
+                        # places here when nothing else fits.
+                        deprioritized=set(self._slow_hosts)
+                        | self.fleet.deprioritized_hosts(time.time()),
                     )
                 except SchedulingError as exc:
                     self.recorder.warning(
@@ -2726,6 +3082,13 @@ class TPUJobController:
         self._watchdogs.pop(uid, None)
         self._blackboxes.pop(uid, None)
         self._open_hang.pop(uid, None)
+        # Autopilot state (r16): hysteresis streaks and cached inputs are
+        # per-incarnation; the fleet-level TTFS counters stay (they feed
+        # warm-pool sizing across jobs).
+        self._autopilots.pop(uid, None)
+        self._host_risk.pop(uid, None)
+        self._last_step_time.pop(uid, None)
+        self._ap_ttfs_seen.discard(uid)
         self._delete_children(
             job.metadata.namespace, job.metadata.name, job.spec.run_policy.cleanup_policy
         )
@@ -2828,6 +3191,20 @@ class TPUJobController:
                     acks.update(sd_fresh.get("acks") or {})
                     if acks:
                         stackdump["acks"] = acks
+            # Autopilot cadence directive (r16) merges by epoch exactly
+            # like the stackdump directive: the reconciler authors epoch
+            # bumps, the chief acks store-side; a higher epoch wins
+            # wholesale, at equal epochs the store-side ack fields win
+            # (a stale reconciler snapshot must not blank an ack the
+            # chief just wrote — the autopilot would re-send forever).
+            cc_fresh = fresh.status.checkpoint_cadence_directive or {}
+            cc_job = job.status.checkpoint_cadence_directive or {}
+            if cc_fresh.get("epoch", 0) > cc_job.get("epoch", 0):
+                cadence = cc_fresh
+            else:
+                cadence = dict(cc_job)
+                if cc_fresh.get("epoch", 0) == cc_job.get("epoch", 0):
+                    cadence.update(cc_fresh)
             fresh.status = job.status
             fresh.status.restart_count = count
             fresh.status.preemption_count = pcount
@@ -2841,6 +3218,7 @@ class TPUJobController:
             fresh.status.profile_directive = profile_directive
             fresh.status.hang_count = hang_count
             fresh.status.stackdump_directive = stackdump
+            fresh.status.checkpoint_cadence_directive = cadence
             # The rendezvous-port annotation is managed store-side
             # (_rendezvous_port persists it, _clear_rendezvous removes it);
             # merging it from a stale cached copy here would resurrect a
@@ -2914,10 +3292,16 @@ def _status_equal_ignoring_heartbeat(a, b) -> bool:
     # reconciler authors it only together with a hang declaration (which
     # breaks equality through hang_count/hang_state anyway), while the
     # HostAgents write acks into it through the API mid-sweep.
+    # checkpoint_cadence_directive is the same shape again: the autopilot
+    # authors epoch bumps only together with a status.autopilot update
+    # (which breaks equality), while the chief acks applied_epoch
+    # store-side — acks alone must not hot-loop the status writer.
     return dataclasses.replace(
         a, last_reconcile_time=None, eval_metrics={}, resize_directive={},
         profile_directive={}, stackdump_directive={},
+        checkpoint_cadence_directive={},
     ) == dataclasses.replace(
         b, last_reconcile_time=None, eval_metrics={}, resize_directive={},
         profile_directive={}, stackdump_directive={},
+        checkpoint_cadence_directive={},
     )

@@ -223,6 +223,22 @@ async function viewJob(ns, name){
         ...['Epoch','Direction','World','Cause','Time'].map(h=>el('th',null,h)))), ztb)));
   }
 
+  // Goodput autopilot (r16): active cadence + the last executed
+  // decision with its justifying numbers (the status mirror of the
+  // authoritative autopilot-decision span).
+  if (j.status.autopilot && Object.keys(j.status.autopilot).length){
+    const a = j.status.autopilot, last = a.last_decision||{};
+    const akv = el('div',{class:'kv'});
+    const apairs = [
+      ['Decisions', String(a.decisions_total||0)],
+      ['Checkpoint every', String(a.active_checkpoint_every||0)+' steps'],
+      ['Last decision', (last.kind||'?')+': '+(last.action||'?')],
+      ['At', fmtTime(last.time)],
+    ];
+    for (const [k,v] of apairs){ akv.appendChild(el('b',null,k)); akv.appendChild(el('span',null,v)); }
+    root.appendChild(el('div',{class:'card'}, el('h2',null,'Autopilot'), akv));
+  }
+
   // Hang forensics (r15): a declared hang is the headline — stuck step +
   // seconds-since-progress, not stale tokens/s.
   if (j.status.hang_state && Object.keys(j.status.hang_state).length){

@@ -277,6 +277,26 @@ def detect_stragglers(
     return sorted(r for r, t in times.items() if t > ratio * med)
 
 
+@dataclass
+class HostRisk:
+    """Typed straggler-risk snapshot for one rank (r16 satellite).
+
+    Produced by :meth:`StragglerTracker.host_risk` so the reconciler's
+    `_check_stragglers` surface (gauges, events, slow-host annotations)
+    and the autopilot (pre-emptive migrate, place_gang deprioritization)
+    read ONE shared struct instead of each re-deriving risk from
+    gauges. ``host`` is filled in by the reconciler's rank→host mapping
+    — the tracker itself only knows ranks."""
+
+    rank: int
+    host: str = ""
+    flagged: bool = False
+    flag_age_windows: int = 0  # windows since the flag fired (0 = unflagged)
+    slow_ratio: float = 0.0  # last window's step time / cross-rank median
+    flap_count: int = 0  # completed flag→clear cycles (chronic flapper)
+    consecutive_bad: int = 0  # current outlier streak (pre-flag ramp)
+
+
 class StragglerTracker:
     """Per-job flap damping over detect_stragglers verdicts.
 
@@ -302,12 +322,18 @@ class StragglerTracker:
         self._good: Dict[int, int] = {}  # rank -> consecutive clean windows
         self.flagged: Dict[int, int] = {}  # rank -> windows-to-flag when it fired
         self.windows_seen = 0
+        self._flaps: Dict[int, int] = {}  # rank -> completed flag→clear cycles
+        self._last_ratio: Dict[int, float] = {}  # rank -> last window t/median
 
     def observe(self, step_times: Dict[int, float]) -> Tuple[List[int], List[int]]:
         self.windows_seen += 1
         outliers = set(
             detect_stragglers(step_times, ratio=self.ratio, min_ranks=self.min_ranks)
         )
+        times = {r: t for r, t in step_times.items() if t > 0}
+        med = statistics.median(times.values()) if len(times) >= self.min_ranks else 0.0
+        for rank, t in times.items():
+            self._last_ratio[rank] = (t / med) if med > 0 else 0.0
         newly_flagged: List[int] = []
         newly_cleared: List[int] = []
         for rank in step_times:
@@ -322,8 +348,30 @@ class StragglerTracker:
                 self._bad[rank] = 0
                 if rank in self.flagged and self._good[rank] >= self.clear_windows:
                     del self.flagged[rank]
+                    self._flaps[rank] = self._flaps.get(rank, 0) + 1
                     newly_cleared.append(rank)
         return newly_flagged, newly_cleared
+
+    def host_risk(self) -> Dict[int, HostRisk]:
+        """Typed risk snapshot for every rank the tracker has seen; the
+        one struct `_check_stragglers` and the autopilot share."""
+        out: Dict[int, HostRisk] = {}
+        ranks = (
+            set(self._last_ratio) | set(self.flagged) | set(self._bad)
+        )
+        for rank in sorted(ranks):
+            flagged = rank in self.flagged
+            out[rank] = HostRisk(
+                rank=rank,
+                flagged=flagged,
+                flag_age_windows=(
+                    self.windows_seen - self.flagged[rank] if flagged else 0
+                ),
+                slow_ratio=self._last_ratio.get(rank, 0.0),
+                flap_count=self._flaps.get(rank, 0),
+                consecutive_bad=self._bad.get(rank, 0),
+            )
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -431,6 +431,57 @@ class JobContext:
         except Exception:  # noqa: BLE001 — the span is the primary receipt
             pass
 
+    # -- checkpoint-cadence directive (r16, same protocol as profiling) ----
+
+    def poll_checkpoint_cadence_directive(self) -> Dict[str, Any] | None:
+        """Fetch the job's live checkpoint-cadence directive ({"epoch",
+        "checkpoint_every", ...}; None when the autopilot has never
+        retuned the cadence or the API is unreachable). The chief
+        compares ``epoch`` against the last epoch it applied and acts
+        exactly once per epoch, at a step boundary."""
+        base = os.environ.get(ENV_API_SERVER, "")
+        if not base or not self.job_name:
+            return None
+        from tf_operator_tpu.api.types import KIND_TPUJOB
+        from tf_operator_tpu.runtime.remote_store import RemoteStore
+
+        try:
+            job = RemoteStore(base).get(KIND_TPUJOB, self.namespace, self.job_name)
+        except Exception:  # noqa: BLE001 — polling must never kill a step
+            return None
+        if job is None:
+            return None
+        directive = dict(job.status.checkpoint_cadence_directive or {})
+        return directive or None
+
+    def ack_checkpoint_cadence(self, epoch: int, step: int) -> None:
+        """Chief-only apply receipt: ``applied_epoch``/``applied_step``
+        acked back into the directive (refusing a superseded epoch), so
+        the autopilot knows its last directive landed before proposing
+        the next one."""
+        base = os.environ.get(ENV_API_SERVER, "")
+        if not base or not self.job_name:
+            return
+        from tf_operator_tpu.api.types import KIND_TPUJOB
+        from tf_operator_tpu.runtime.remote_store import RemoteStore
+        from tf_operator_tpu.runtime.store import update_with_retry_loop
+
+        def mutate(job):
+            cur = job.status.checkpoint_cadence_directive or {}
+            if int(cur.get("epoch", 0)) != int(epoch):
+                return False  # a newer directive superseded this apply
+            job.status.checkpoint_cadence_directive = {
+                **cur, "applied_epoch": int(epoch), "applied_step": int(step),
+            }
+
+        try:
+            update_with_retry_loop(
+                RemoteStore(base), KIND_TPUJOB, self.namespace, self.job_name,
+                mutate, transient_timeout=30.0,
+            )
+        except Exception:  # noqa: BLE001 — the next poll re-offers the epoch
+            pass
+
     # -- elastic resize barrier (r12) --------------------------------------
     #
     # The controller offers survivors a new world size by writing a resize

@@ -45,6 +45,14 @@ log = logging.getLogger("tpujob.agent")
 
 DEFAULT_HEARTBEAT_INTERVAL = 3.0
 
+# Goodput-autopilot warm-pool retarget (r16): the controller stamps the
+# desired per-host warm-slot count on each Host object; the agent's
+# heartbeat loop applies it to its local pool. The key mirrors
+# controller/reconciler.py's ANNOTATION_WARMPOOL_TARGET — annotation
+# keys are wire protocol, shared by value, not by import (an agent
+# process must not drag the controller module tree in).
+ANNOTATION_WARMPOOL_TARGET = "tpujob.dev/warmpool-target"
+
 
 class HostAgent:
     def __init__(
@@ -297,12 +305,31 @@ class HostAgent:
                 log.exception("agent %s: stack sweep failed; retrying", self.name)
 
     def _touch_heartbeat(self) -> None:
+        # The heartbeat's read-modify-write doubles as the warm-pool
+        # retarget poll (r16): the touch closure sees the fresh Host
+        # object, so the autopilot's target annotation rides for free —
+        # no extra store round-trip on the heartbeat path.
+        seen_target: list = []
+
         def touch(cur):
             cur.status.heartbeat_time = time.time()
+            seen_target[:] = [
+                cur.metadata.annotations.get(ANNOTATION_WARMPOOL_TARGET)
+            ]
 
         if self.store.update_with_retry(KIND_HOST, "default", self.name, touch) is None:
             # Host object deleted (drained by an admin): re-register.
             self._register()
+            return
+        raw = seen_target[0] if seen_target else None
+        if raw is not None and self.warm_pool is not None:
+            try:
+                self.warm_pool.resize(int(raw))
+            except (ValueError, TypeError):
+                log.warning(
+                    "agent %s: bad warm-pool target annotation %r",
+                    self.name, raw,
+                )
 
     def _set_phase(
         self, phase: HostPhase, message: str, transient_timeout=None

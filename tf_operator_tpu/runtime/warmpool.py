@@ -201,6 +201,29 @@ class WarmPool:
             self._refill_async()
             return slot.child
 
+    def resize(self, target: int) -> bool:
+        """Retarget the pool size (the autopilot's warm-pool actuator,
+        applied by the host agent's heartbeat loop). Growing pre-spawns
+        the shortfall asynchronously; shrinking kills surplus *idle*
+        slots only — claimed children are jobs and are never touched.
+        Returns True when the target changed."""
+        target = max(0, int(target))
+        with self._lock:
+            if self._stopping or target == self.size:
+                return False
+            old, self.size = self.size, target
+            surplus: List[_Slot] = []
+            while len(self._idle) > target:
+                surplus.append(self._idle.pop())
+        for slot in surplus:
+            self._kill(slot)
+        # _refill_async keeps replacing claimed slots; top up the idle
+        # set toward the new target here (best-effort, like __init__).
+        for _ in range(max(0, target - old)):
+            self._refill_async()
+        log.info("warm pool resized: %d -> %d slots", old, target)
+        return True
+
     def invalidate(self) -> None:
         """Drain every idle slot (agent drain / env change): claimed
         children are untouched — they are jobs now."""

@@ -113,6 +113,14 @@ class FleetScheduler:
         # impossible. Merged into reserved_for_others() for every OTHER
         # job; cleared when the gang re-grows or the job ends.
         self._regrow_holds: Dict[str, Dict[str, int]] = {}
+        # Autopilot host deprioritization (r16): host -> expiry timestamp.
+        # A risk-flagged host (straggler tracker via the autopilot) is fed
+        # into place_gang's deprioritized set fleet-wide — SOFT avoidance:
+        # placement still uses the host when nothing else fits, exactly
+        # like the reconciler's own slow-host set. TTL-bounded so a host
+        # that was migrated away from (and therefore produces no further
+        # telemetry to clear itself with) does not stay tainted forever.
+        self._deprioritized_hosts: Dict[str, float] = {}
         self._synced = False
 
     # ---- store lookups --------------------------------------------------
@@ -225,6 +233,27 @@ class FleetScheduler:
         """The gang re-grew to full strength (or the job ended): stop
         claiming capacity for its lost members."""
         self._regrow_holds.pop(key, None)
+
+    def deprioritize_host(self, host: str, until: float) -> None:
+        """Autopilot actuator (r16): soft-avoid ``host`` for new gang
+        placements until ``until`` (unix seconds). Re-flagging extends
+        the window; the registry never hard-excludes a host. Callers
+        hold the reconciler's scheduling lock, like every other method
+        here."""
+        if host:
+            self._deprioritized_hosts[host] = max(
+                until, self._deprioritized_hosts.get(host, 0.0)
+            )
+
+    def deprioritized_hosts(self, now: float) -> set:
+        """Live (unexpired) deprioritized hosts; expired entries are
+        dropped on read so the registry cannot grow unbounded."""
+        expired = [
+            h for h, t in self._deprioritized_hosts.items() if t <= now
+        ]
+        for h in expired:
+            del self._deprioritized_hosts[h]
+        return set(self._deprioritized_hosts)
 
     def release(self, key: str) -> bool:
         """Forget a job (finished / deleted / preempted). Returns True when

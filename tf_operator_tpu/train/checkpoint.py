@@ -736,6 +736,13 @@ class WorkloadCheckpointer:
         self.every = int(workload.get("checkpoint_every", 0))
         self._step = 0
         self.start_step = 0
+        # Checkpoint-cadence directive (r16): last applied epoch + poll
+        # throttle. The autopilot retunes `every` live through
+        # status.checkpoint_cadence_directive; the chief applies it at a
+        # step boundary via poll_cadence_directive().
+        self._cadence_epoch = 0
+        self._cadence_poll_s = float(workload.get("cadence_poll_s", 2.0))
+        self._cadence_last_poll = 0.0
         # Per-accepted-save caller stall (seconds) — the overlap receipt.
         self.save_stalls: List[float] = []
         # "peer" | "disk" after a warm restore; "" cold / not restored.
@@ -885,6 +892,45 @@ class WorkloadCheckpointer:
             now = _time.time()
             self.ctx.record_save_stall(step, now - stall, now)
 
+    def poll_cadence_directive(self, step: Optional[int] = None) -> bool:
+        """Apply a pending checkpoint-cadence directive (r16) at a step
+        boundary. The autopilot publishes {"epoch", "checkpoint_every"}
+        into the job status; the chief calls this between steps, applies
+        each epoch exactly once (updating ``self.every`` — run_loop's
+        chunk clipping reads it per chunk, so the new interval takes
+        effect immediately), and acks ``applied_epoch``/``applied_step``
+        back. Throttled to one API read per ``cadence_poll_s`` seconds;
+        best-effort by contract (an unreachable API changes nothing).
+        Returns True when a new epoch was applied this call."""
+        if self.ctx is None:
+            return False
+        if getattr(self.ctx, "process_id", 0) != 0:
+            return False  # the chief owns cadence, as it owns the saves
+        poll = getattr(self.ctx, "poll_checkpoint_cadence_directive", None)
+        if poll is None:
+            return False
+        import time as _time
+
+        now = _time.time()
+        if now - self._cadence_last_poll < self._cadence_poll_s:
+            return False
+        self._cadence_last_poll = now
+        directive = poll() or {}
+        epoch = int(directive.get("epoch", 0))
+        if epoch <= self._cadence_epoch:
+            return False
+        self._cadence_epoch = epoch
+        every = int(directive.get("checkpoint_every", 0))
+        if every > 0 and every != self.every:
+            log.info(
+                "checkpoint cadence directive epoch %d: every %d -> %d steps",
+                epoch, self.every, every,
+            )
+            self.every = every
+        applied_step = self._step if step is None else int(step)
+        self.ctx.ack_checkpoint_cadence(epoch, applied_step)
+        return True
+
     def final(self, state) -> None:
         """Final save — call AFTER any throughput timing is read, so the
         write never pollutes step-time/MFU telemetry. Fenced (wait=True):
@@ -986,6 +1032,7 @@ class WorkloadCheckpointer:
                 chunk, stacked = pull_chunk(k)
                 state, m = trainer.multi_step(state, chunk, k, stacked=stacked)
             self.advance(state, loss=m["loss"], n=k)
+            self.poll_cadence_directive()  # cadence retune lands at chunk boundary
             if on_step is not None:
                 on_step(self._step)
             return state, m, k

@@ -115,7 +115,6 @@ def main(ctx: JobContext) -> None:
 
     ckpt = WorkloadCheckpointer(wl, ctx=ctx)
     mgr = ckpt.manager
-    every = ckpt.every
 
     # Warm restore: peer depots first (materializes the committed step
     # locally), then disk — the same decision order run_loop follows.
@@ -142,6 +141,11 @@ def main(ctx: JobContext) -> None:
     for s in range(start + 1, steps + 1):
         if wedge and os.path.exists(wedge):
             _fake_collective_all_reduce(ctx, s)
+        # Step-boundary cadence poll (r16): the autopilot's
+        # checkpoint_cadence_directive retunes ckpt.every live; re-read
+        # it every step so the retuned interval governs THIS step's save.
+        ckpt.poll_cadence_directive(step=s - 1)
+        every = ckpt.every
         t0 = time.time()
         time.sleep(sleep_s + data_wait_s + extra_s)
         state = {"step": np.asarray(s)}
@@ -150,8 +154,17 @@ def main(ctx: JobContext) -> None:
         stall = 0.0
         if every and s % every == 0:
             if mgr.save(s, state):
+                # `save_stall_extra_s` models the flagship-scale blocking
+                # write (the multi-second device-sync + serialize a real
+                # multi-TB save pays before the async drain takes over) —
+                # the per-save cost the autopilot's Young/Daly retune
+                # exists to amortize, exactly as disk_restore_delay_s
+                # models the slow restore read.
+                extra = float(wl.get("save_stall_extra_s", 0.0))
+                if extra:
+                    time.sleep(extra)
                 now = time.time()
-                stall = mgr.last_save_stall_s
+                stall = mgr.last_save_stall_s + extra
                 ctx.record_save_stall(s, now - stall, now)
         if rep:
             rep.step(
