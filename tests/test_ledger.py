@@ -1,0 +1,470 @@
+"""Fleet ledger (obs/ledger.py + obs/priors.py): exactly-once folding
+across operator death, snapshot+suffix replay equivalence, hand-computed
+rollup arithmetic, pinned prior shrinkage, GC survival — plus the two
+satellite pins: telemetry WAL coalescing keeps the store WAL bounded
+while job/process mutations replay identically, and 100 submit->GC
+cycles leave the /metrics exposition bounded."""
+
+import json
+import os
+
+import pytest
+
+from tf_operator_tpu.api.types import (
+    KIND_PROCESS,
+    KIND_TELEMETRY,
+    KIND_TPUJOB,
+    ConditionType,
+    ObjectMeta,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    TopologySpec,
+    TPUJob,
+    TPUJobSpec,
+)
+from tf_operator_tpu.controller import TPUJobController
+from tf_operator_tpu.controller.status import new_condition, set_condition
+from tf_operator_tpu.obs.ledger import (
+    FleetLedger,
+    JobRecord,
+    _percentile,
+)
+from tf_operator_tpu.obs.priors import (
+    PRIOR_CAP,
+    CadencePrior,
+    blend_mtbf,
+    cadence_prior,
+)
+from tf_operator_tpu.obs.telemetry import Telemetry, telemetry_labels
+from tf_operator_tpu.runtime import FakeProcessControl, Store
+from tf_operator_tpu.runtime.objects import Process, ProcessSpec
+from tf_operator_tpu.runtime.persist import open_store
+
+
+def rec(uid, *, queue="", job_class="", wall=100.0, restarts=0,
+        preemptions=0, hangs=0, goodput=0.9, lost=None, stall=0.0,
+        saves=0, end_ts=1000.0, hosts=()):
+    return JobRecord(
+        uid=uid, namespace="default", name=f"job-{uid}", queue=queue,
+        job_class=job_class, phase="Succeeded" if not restarts else "Failed",
+        submit_ts=end_ts - wall, end_ts=end_ts, wall_s=wall,
+        restarts=restarts, preemptions=preemptions, hangs=hangs,
+        lost_s=dict(lost or {}), goodput_ratio=goodput,
+        save_stall_s=stall, saves=saves, hosts=list(hosts),
+    )
+
+
+def summary_bytes(ledger):
+    return json.dumps(ledger.summary(), sort_keys=True).encode()
+
+
+# ---------------------------------------------------------------------------
+# exactly-once folding, durable across operator death
+# ---------------------------------------------------------------------------
+
+
+def test_fold_exactly_once_same_incarnation(tmp_path):
+    led = FleetLedger(str(tmp_path / "ledger"))
+    assert led.fold(rec("u1")) is True
+    assert led.fold(rec("u1", wall=999.0)) is False  # uid already folded
+    assert len(led) == 1
+
+
+def test_fold_dedupe_survives_sigkill(tmp_path):
+    """SIGKILL after the fold must not double-count on the next
+    incarnation: the dedupe set IS the recovered record set."""
+    d = str(tmp_path / "ledger")
+    led = FleetLedger(d)
+    led.fold(rec("u1", wall=100.0, restarts=2))
+    # no close(): the operator was SIGKILLed
+    led2 = FleetLedger(d)
+    assert led2.has("u1")
+    assert led2.fold(rec("u1")) is False
+    assert len(led2) == 1
+    assert led2.get("u1")["wall_s"] == 100.0
+
+
+def test_summary_byte_identical_across_recovery(tmp_path):
+    """The acceptance pin: /api/fleet/summary before an operator SIGKILL
+    and after recovery serialize to the SAME bytes."""
+    d = str(tmp_path / "ledger")
+    led = FleetLedger(d, snapshot_every=3)
+    for i in range(8):  # crosses two rollup boundaries
+        led.fold(rec(f"u{i}", queue="batch" if i % 2 else "prod",
+                     wall=50.0 + i * 7.3, restarts=i % 3,
+                     goodput=0.5 + 0.05 * i,
+                     lost={"restart": 3.0 + i}, stall=0.4, saves=2,
+                     hosts=[f"host-{i % 2}"]))
+    before = summary_bytes(led)
+    led2 = FleetLedger(d)  # SIGKILL: no close
+    assert summary_bytes(led2) == before
+    assert {r["uid"] for r in led2.records()} == {f"u{i}" for i in range(8)}
+
+
+def test_snapshot_plus_suffix_replay_equals_full_replay(tmp_path):
+    """A ledger that compacted (rollup + segment suffix) recovers the
+    same record set and summary as one that only ever appended."""
+    recs = [
+        rec(f"u{i}", wall=30.0 * (i + 1), restarts=i % 2,
+            lost={"data-wait": float(i)}, goodput=0.1 * i)
+        for i in range(9)
+    ]
+    compacted = FleetLedger(str(tmp_path / "a"), snapshot_every=4)
+    appended = FleetLedger(str(tmp_path / "b"), snapshot_every=10**6)
+    for r in recs:
+        compacted.fold(r)
+        appended.fold(r)
+    # the compacted dir really did roll up and GC old segments
+    names = os.listdir(str(tmp_path / "a"))
+    assert any(n.startswith("rollup-") for n in names)
+    a = FleetLedger(str(tmp_path / "a"))
+    b = FleetLedger(str(tmp_path / "b"))
+    assert summary_bytes(a) == summary_bytes(b)
+    assert [r["uid"] for r in a.records()] == [r["uid"] for r in b.records()]
+
+
+def test_torn_tail_truncated_on_recovery(tmp_path):
+    d = str(tmp_path / "ledger")
+    led = FleetLedger(d)
+    led.fold(rec("u1"))
+    led.fold(rec("u2"))
+    led.close()
+    seg = [n for n in os.listdir(d) if n.startswith("records-")]
+    assert len(seg) == 1
+    with open(os.path.join(d, seg[0]), "ab") as f:
+        f.write(b'{"uid": "torn", "seq": 3, "cr')  # torn final record
+    led2 = FleetLedger(d)
+    assert {r["uid"] for r in led2.records()} == {"u1", "u2"}
+    led2.fold(rec("u3"))  # and the ledger keeps accepting folds
+    assert FleetLedger(d).has("u3")
+
+
+# ---------------------------------------------------------------------------
+# rollup arithmetic — hand-computed
+# ---------------------------------------------------------------------------
+
+
+def test_summary_arithmetic_hand_computed(tmp_path):
+    led = FleetLedger(str(tmp_path / "ledger"))
+    led.fold(rec("a", queue="prod", wall=100.0, restarts=2, goodput=0.9,
+                 lost={"restart": 10.0}, stall=2.0, saves=3))
+    led.fold(rec("b", queue="prod", wall=200.0, restarts=1, goodput=0.7,
+                 lost={"restart": 30.0, "data-wait": 5.0}, stall=4.0, saves=1))
+    led.fold(rec("c", queue="batch", wall=60.0, goodput=0.3))
+    s = led.summary()
+    assert s["jobs"] == 3
+    assert s["failures"] == 3
+    assert s["wall_s"] == 360.0
+    assert s["mtbf_s"] == 120.0  # 360 / 3
+    assert s["goodput_mean"] == round((0.9 + 0.7 + 0.3) / 3, 6)
+    # per-queue: prod wall 300 over 3 failures
+    assert s["queues"]["prod"]["mtbf_s"] == 100.0
+    assert s["queues"]["batch"]["mtbf_s"] is None  # no failures observed
+    # saves-weighted stall: (2*3 + 4*1) / 4 = 2.5
+    assert s["queues"]["prod"]["save_stall_s"] == 2.5
+    # causes: restart incidents [10, 30] -> p50 = 10 (nearest rank), p90 = 30
+    c = s["causes"]["restart"]
+    assert c["incidents"] == 2 and c["lost_s"] == 40.0
+    assert c["lost_p50_s"] == 10.0
+    assert c["lost_p90_s"] == 30.0
+    assert s["causes"]["data-wait"]["incidents"] == 1
+    # histogram: 0.9 and 0.7 -> (0.8,1.0] and (0.6,0.8]; 0.3 -> (0.2,0.4]
+    assert s["goodput_hist"]["0.8-1.0"] == 1
+    assert s["goodput_hist"]["0.6-0.8"] == 1
+    assert s["goodput_hist"]["0.2-0.4"] == 1
+
+
+def test_percentile_nearest_rank():
+    vals = [1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0, 8.0, 9.0, 10.0]
+    assert _percentile(vals, 0.5) == 5.0  # ceil(0.5*10)-1 = idx 4
+    assert _percentile(vals, 0.9) == 9.0
+    assert _percentile(vals, 0.99) == 10.0
+    assert _percentile([7.0], 0.5) == 7.0
+    assert _percentile([], 0.9) == 0.0
+
+
+def test_hosts_and_reputation(tmp_path):
+    led = FleetLedger(str(tmp_path / "ledger"))
+    now = 10_000.0
+    # three incident jobs on bad-host inside the hour, one clean job
+    for i in range(3):
+        led.fold(rec(f"u{i}", restarts=1, end_ts=now - 100.0 * i,
+                     hosts=["bad-host", f"other-{i}"]))
+    led.fold(rec("clean", end_ts=now, hosts=["bad-host"]))
+    led.fold(rec("old", restarts=1, end_ts=now - 7200.0, hosts=["bad-host"]))
+    h = led.hosts()
+    assert h["bad-host"]["jobs"] == 5
+    assert h["bad-host"]["incident_jobs"] == 4
+    flagged = led.host_reputation(now)
+    # only the 3 incidents inside the window count; threshold 3 met
+    assert flagged == {"bad-host": 3}
+    assert led.host_reputation(now, window_s=50.0) == {}
+
+
+# ---------------------------------------------------------------------------
+# priors — pinned, hand-computable shrinkage
+# ---------------------------------------------------------------------------
+
+
+def test_blend_worked_example():
+    """The docs/design.md §6.4 worked example: prior MTBF 100s from 4
+    fleet failures, job 50s old with 1 own failure."""
+    mtbf, weight = blend_mtbf(
+        CadencePrior(mtbf_s=100.0, failures=4), own_elapsed_s=50.0,
+        own_failures=1,
+    )
+    assert mtbf == pytest.approx(90.0)  # (4*100 + 50) / (4 + 1)
+    assert weight == pytest.approx(0.8)  # 4 / 5
+
+
+def test_blend_fresh_job_is_finite_with_weight_one():
+    """own_failures == 0 -> the fresh job escapes the mtbf=inf clamp
+    edge: the blend is finite and entirely the fleet's."""
+    mtbf, weight = blend_mtbf(
+        CadencePrior(mtbf_s=100.0, failures=4), own_elapsed_s=20.0,
+        own_failures=0,
+    )
+    assert mtbf == pytest.approx(105.0)  # (400 + 20) / 4
+    assert weight == 1.0
+
+
+def test_blend_yields_to_own_data():
+    prior = CadencePrior(mtbf_s=1000.0, failures=8)
+    own_mtbf = 10.0
+    last = None
+    for fails in (1, 4, 16, 64):
+        mtbf, weight = blend_mtbf(prior, own_elapsed_s=own_mtbf * fails,
+                                  own_failures=fails)
+        if last is not None:
+            assert mtbf < last[0] and weight < last[1]
+        last = (mtbf, weight)
+    assert last[1] == pytest.approx(8.0 / 72.0)
+    # asymptotically the blend converges to the job's own MTBF
+    mtbf, weight = blend_mtbf(prior, own_elapsed_s=own_mtbf * 10_000,
+                              own_failures=10_000)
+    assert mtbf == pytest.approx(own_mtbf, rel=0.1)
+    assert weight < 0.001
+
+
+def test_blend_prior_cap_bounds_inertia():
+    """A thousand historical failures argue with the strength of
+    PRIOR_CAP of them — own data can still move the estimate."""
+    capped = blend_mtbf(CadencePrior(mtbf_s=1000.0, failures=1000),
+                        own_elapsed_s=80.0, own_failures=8)
+    assert capped[1] == pytest.approx(PRIOR_CAP / (PRIOR_CAP + 8))
+    assert capped[0] == pytest.approx((PRIOR_CAP * 1000.0 + 80.0) / 16.0)
+
+
+def test_cadence_prior_cohort_match_and_fleet_fallback(tmp_path):
+    led = FleetLedger(str(tmp_path / "ledger"))
+    led.fold(rec("a", queue="prod", job_class="lm", wall=100.0, restarts=1,
+                 stall=2.0, saves=2))
+    led.fold(rec("b", queue="batch", job_class="etl", wall=900.0, restarts=1))
+    p = cadence_prior(led, queue="prod", workload_class="lm")
+    assert p is not None and p.mtbf_s == 100.0 and p.failures == 1
+    assert p.save_stall_s == 2.0 and p.jobs == 1
+    # unknown cohort falls back to fleet-wide history: 1000s / 2 failures
+    p = cadence_prior(led, queue="nope", workload_class="x")
+    assert p is not None and p.mtbf_s == 500.0 and p.failures == 2
+
+
+def test_cadence_prior_absent_when_no_failure_history(tmp_path):
+    led = FleetLedger(str(tmp_path / "ledger"))
+    assert cadence_prior(led) is None  # empty fleet invents no prior
+    assert cadence_prior(None) is None
+    led.fold(rec("clean", wall=500.0))  # jobs, but zero failures
+    assert cadence_prior(led) is None
+
+
+# ---------------------------------------------------------------------------
+# reconciler integration: the sweep, GC survival, metrics cardinality
+# ---------------------------------------------------------------------------
+
+
+def make_terminal_job(name, succeeded=True, restarts=0):
+    job = TPUJob(
+        metadata=ObjectMeta(name=name, uid=f"uid-{name}"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=1, template=ProcessTemplate(entrypoint="wl.m:f")
+                )
+            },
+            topology=TopologySpec(num_hosts=1, chips_per_host=4),
+        ),
+    )
+    ct = ConditionType.SUCCEEDED if succeeded else ConditionType.FAILED
+    set_condition(job.status, new_condition(ct, "done", ""))
+    job.status.completion_time = 1234.5
+    job.status.restart_count = restarts
+    return job
+
+
+def make_controller(store):
+    return TPUJobController(store, FakeProcessControl(),
+                            port_allocator=lambda: 12345)
+
+
+def test_attach_ledger_sweep_folds_terminal_jobs_exactly_once(tmp_path):
+    """The SIGKILL-between-terminal-and-fold scenario: the previous
+    incarnation wrote the terminal status but died before folding. The
+    next incarnation's attach_ledger sweep folds it; every LATER
+    incarnation's sweep is a no-op."""
+    store = Store()
+    store.create(make_terminal_job("done-1", restarts=2))
+    store.create(make_terminal_job("done-2", succeeded=False))
+    running = TPUJob(
+        metadata=ObjectMeta(name="live", uid="uid-live"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=1, template=ProcessTemplate(entrypoint="wl.m:f")
+                )
+            },
+            topology=TopologySpec(num_hosts=1, chips_per_host=4),
+        ),
+    )
+    store.create(running)
+
+    d = str(tmp_path / "ledger")
+    ctl = make_controller(store)
+    ctl.attach_ledger(FleetLedger(d))
+    assert len(ctl.ledger) == 2  # both terminals, never the running job
+    assert ctl.ledger.get("uid-done-1")["restarts"] == 2
+    assert ctl.ledger.get("uid-done-2")["phase"] == "Failed"
+    assert not ctl.ledger.has("uid-live")
+
+    # next operator incarnation: same store, recovered ledger — no
+    # double counts (durable uid dedupe, not process memory)
+    ctl2 = make_controller(store)
+    ctl2.attach_ledger(FleetLedger(d))
+    assert len(ctl2.ledger) == 2
+
+
+def test_gc_keeps_ledger_record_and_clears_goodput_gauge(tmp_path):
+    """Job GC deletes children/spans/telemetry/forensics and the per-job
+    goodput series — but the ledger record SURVIVES (its whole point)."""
+    store = Store()
+    job = store.create(make_terminal_job("ephemeral", restarts=1))
+    ctl = make_controller(store)
+    ctl.attach_ledger(FleetLedger(str(tmp_path / "ledger")))
+    assert ctl.ledger.has("uid-ephemeral")
+    ctl.metrics.set_gauge(
+        "tpujob_goodput_ratio", 0.8,
+        labels={"namespace": "default", "job": "ephemeral"},
+    )
+    # GC: the job vanishes from store + informer, then a sync runs
+    store.delete(KIND_TPUJOB, "default", "ephemeral")
+    ctl.job_informer.seed([])
+    ctl.sync_job("default/ephemeral")
+    assert 'job="ephemeral"' not in ctl.metrics.render()
+    # the record is still queryable after GC
+    assert ctl.ledger.get("uid-ephemeral")["restarts"] == 1
+    assert ctl.ledger.summary()["jobs"] == 1
+    assert job.metadata.uid == "uid-ephemeral"
+
+
+def test_hundred_submit_gc_cycles_leave_exposition_bounded(tmp_path):
+    """The cardinality satellite: per-job labeled series must not
+    accumulate across submit->GC churn."""
+    store = Store()
+    ctl = make_controller(store)
+    ctl.attach_ledger(FleetLedger(str(tmp_path / "ledger")))
+    for i in range(100):
+        name = f"churn-{i}"
+        store.create(make_terminal_job(name))
+        ctl.metrics.set_gauge(
+            "tpujob_goodput_ratio", 0.5,
+            labels={"namespace": "default", "job": name},
+        )
+        store.delete(KIND_TPUJOB, "default", name)
+        ctl.job_informer.seed([])
+        ctl.sync_job(f"default/{name}")
+    exposition = ctl.metrics.render()
+    assert "tpujob_goodput_ratio" not in exposition
+    assert exposition.count("churn-") == 0
+
+
+# ---------------------------------------------------------------------------
+# telemetry WAL coalescing (runtime/persist.py satellite)
+# ---------------------------------------------------------------------------
+
+
+def _telemetry_batch(name, seq):
+    return Telemetry(
+        metadata=ObjectMeta(
+            name=f"{name}-telem-r0-s{seq}", labels=telemetry_labels(name)
+        ),
+        trace_id=f"uid-{name}", rank=0, seq=seq, steps=10,
+        step_time_s=0.1, tokens_per_s=1000.0,
+    )
+
+
+def test_telemetry_wal_skipped_by_default_and_replay_identical(tmp_path):
+    d = str(tmp_path / "store")
+    store, _ = open_store(d)
+    store.create(TPUJob(metadata=ObjectMeta(name="j1")))
+    store.create(Process(metadata=ObjectMeta(name="p1"),
+                         spec=ProcessSpec(job_name="j1")))
+    for i in range(50):
+        store.create(_telemetry_batch("j1", i))
+    stats = store.wal_stats()
+    assert stats[KIND_TELEMETRY]["records"] == 50
+    assert stats[KIND_TELEMETRY]["skipped"] == 50
+    assert stats[KIND_TELEMETRY]["bytes"] == 0  # nothing hit disk
+    assert stats[KIND_TPUJOB]["bytes"] > 0
+    assert stats[KIND_PROCESS]["bytes"] > 0
+    # job/process WAL bytes dominate: telemetry contributed zero
+    total = sum(v["bytes"] for v in stats.values())
+    assert total == stats[KIND_TPUJOB]["bytes"] + stats[KIND_PROCESS]["bytes"]
+
+    # recovery: durable kinds replay identically, telemetry is absent
+    s2, info = open_store(d)
+    assert info.recovered
+    assert s2.get(KIND_TPUJOB, "default", "j1") is not None
+    assert s2.get(KIND_PROCESS, "default", "p1") is not None
+    assert s2.list(KIND_TELEMETRY) == []
+    # and rv allocation continues safely past the skipped records
+    s2.create(TPUJob(metadata=ObjectMeta(name="j2")))
+    assert s2.get(KIND_TPUJOB, "default", "j2") is not None
+
+
+def test_telemetry_wal_persisted_when_opted_in(tmp_path):
+    d = str(tmp_path / "store")
+    store, _ = open_store(d, persist_telemetry=True)
+    store.create(_telemetry_batch("j1", 0))
+    stats = store.wal_stats()
+    assert stats[KIND_TELEMETRY]["bytes"] > 0
+    assert stats[KIND_TELEMETRY]["skipped"] == 0
+    s2, _ = open_store(d, persist_telemetry=True)
+    assert len(s2.list(KIND_TELEMETRY)) == 1
+
+
+def test_wal_counters_rendered_in_metrics(tmp_path):
+    from tf_operator_tpu.controller.metrics import ControllerMetrics
+
+    store, _ = open_store(str(tmp_path / "store"))
+    store.create(TPUJob(metadata=ObjectMeta(name="j1")))
+    store.create(_telemetry_batch("j1", 0))
+    out = ControllerMetrics(store=store).render()
+    assert 'tpujob_wal_records_total{kind="TPUJob"} 1' in out
+    assert 'tpujob_wal_records_total{kind="Telemetry"} 1' in out
+    assert 'tpujob_wal_bytes_total{kind="Telemetry"} 0' in out
+
+
+# ---------------------------------------------------------------------------
+# compile-cache stats fold into the summary
+# ---------------------------------------------------------------------------
+
+
+def test_summary_folds_compile_cache_stats(tmp_path):
+    led = FleetLedger(str(tmp_path / "ledger"))
+    led.cachesvc_stats = lambda: {
+        "hits": 6, "misses": 2, "evictions": 1, "intents": 3,
+    }
+    cc = led.summary()["compile_cache"]
+    assert cc == {
+        "hits": 6, "misses": 2, "evictions": 1, "intents": 3,
+        "miss_rate": 0.25,
+    }

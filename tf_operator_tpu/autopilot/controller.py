@@ -56,6 +56,10 @@ class AutopilotConfig:
     cadence: bool = True  # per-actuator gates
     migrate: bool = True
     warmpool: bool = True
+    # Blend the fleet ledger's per-cohort MTBF into the cadence input
+    # (obs/priors.py) so a fresh job's FIRST decision starts from fleet
+    # history instead of the mtbf=inf clamp edge.
+    use_fleet_priors: bool = False
 
     @staticmethod
     def from_run_policy(knob: Any) -> Optional["AutopilotConfig"]:
@@ -70,6 +74,7 @@ class AutopilotConfig:
         for key in (
             "cooldown_s", "confirm_ticks", "min_checkpoint_every",
             "max_checkpoint_every", "cadence", "migrate", "warmpool",
+            "use_fleet_priors",
         ):
             if key in knob:
                 setattr(cfg, key, type(getattr(cfg, key))(knob[key]))
@@ -94,6 +99,11 @@ class TickInputs:
     current_every: int = 0  # the checkpoint interval governing the gang now
     directive_epoch: int = 0  # last cadence-directive epoch published
     directive_acked: bool = True  # chief acked the last epoch (or none sent)
+    # Fleet prior (obs/priors.py, gathered from the ledger when
+    # use_fleet_priors): 0 failures ⇒ no prior, own-data path only.
+    prior_mtbf_s: float = 0.0
+    prior_failures: int = 0
+    prior_jobs: int = 0
     # Placement inputs.
     host_risk: Dict[str, HostRisk] = field(default_factory=dict)
     watchdog_stalled: bool = False  # hang watchdog armed or hung
@@ -161,6 +171,22 @@ class JobAutopilot:
         mtbf = (
             inp.run_elapsed_s / inp.failures if inp.failures > 0 else math.inf
         )
+        prior_weight = 0.0
+        if inp.prior_failures > 0 and inp.prior_mtbf_s > 0:
+            # Fleet prior: shrink the (possibly infinite) own-data MTBF
+            # toward the ledger cohort's, with the pinned blend rule —
+            # own failures progressively buy the weight back.
+            from tf_operator_tpu.obs.priors import CadencePrior, blend_mtbf
+
+            mtbf, prior_weight = blend_mtbf(
+                CadencePrior(
+                    mtbf_s=inp.prior_mtbf_s,
+                    failures=inp.prior_failures,
+                    jobs=inp.prior_jobs,
+                ),
+                own_elapsed_s=inp.run_elapsed_s,
+                own_failures=inp.failures,
+            )
         dec = optimal_checkpoint_every(
             save_stall_s=inp.save_stall_s,
             mtbf_s=mtbf,
@@ -173,21 +199,29 @@ class JobAutopilot:
             return []
         if not self._hys.propose("cadence", dec.every, inp.now):
             return []
+        attrs = {
+            "save_stall_s": _fmt(dec.save_stall_s),
+            "mtbf_s": _fmt(dec.mtbf_s),
+            "failures": str(inp.failures),
+            "restart_downtime_s": _fmt(inp.restart_downtime_s),
+            "step_time_s": _fmt(dec.step_time_s),
+            "tau_s": _fmt(dec.tau_s),
+            "clamped": dec.clamped,
+            "from_every": str(inp.current_every),
+            "to_every": str(dec.every),
+        }
+        if prior_weight > 0:
+            # The fleet-prior receipt the acceptance check reads off the
+            # decision span: the prior's MTBF, its sample count, and how
+            # much of the blended estimate it contributed.
+            attrs["prior_mtbf_s"] = _fmt(inp.prior_mtbf_s)
+            attrs["prior_samples"] = str(inp.prior_failures)
+            attrs["prior_weight"] = _fmt(prior_weight)
         return [Decision(
             kind=DECISION_CADENCE,
             action=f"checkpoint_every {inp.current_every}->{dec.every}",
             checkpoint_every=dec.every,
-            attrs={
-                "save_stall_s": _fmt(dec.save_stall_s),
-                "mtbf_s": _fmt(dec.mtbf_s),
-                "failures": str(inp.failures),
-                "restart_downtime_s": _fmt(inp.restart_downtime_s),
-                "step_time_s": _fmt(dec.step_time_s),
-                "tau_s": _fmt(dec.tau_s),
-                "clamped": dec.clamped,
-                "from_every": str(inp.current_every),
-                "to_every": str(dec.every),
-            },
+            attrs=attrs,
         )]
 
     def _tick_placement(self, inp: TickInputs) -> List[Decision]:

@@ -117,7 +117,7 @@ class CompileCacheService:
         self._intents: Dict[str, float] = {}
         self.stats = {
             "hits": 0, "misses": 0, "waits": 0, "puts": 0,
-            "put_rejects": 0, "evictions": 0,
+            "put_rejects": 0, "evictions": 0, "intents": 0,
         }
         svc = self
 
@@ -143,7 +143,7 @@ class CompileCacheService:
                 if path == "/healthz":
                     self._reply(200, b"ok")
                     return
-                if path == "/cachesvc/v1/stats":
+                if path in ("/cachesvc/v1/stats", "/stats"):
                     self._reply(200, json.dumps(svc.snapshot()).encode(),
                                 [("Content-Type", "application/json")])
                     return
@@ -328,6 +328,7 @@ class CompileCacheService:
         with self._lock:
             if key not in self._entries:
                 self._intents[key] = time.monotonic() + self.intent_ttl
+                self.stats["intents"] += 1
 
     def intent_live(self, key: str) -> bool:
         with self._lock:
@@ -346,7 +347,7 @@ class CompileCacheService:
                 **self.stats,
                 "entries": len(self._entries),
                 "bytes": self._bytes,
-                "intents": len(self._intents),
+                "intents_live": len(self._intents),
             }
 
     def stop(self) -> None:

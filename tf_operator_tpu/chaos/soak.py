@@ -101,7 +101,11 @@ from tf_operator_tpu.runtime import (
     RemoteStore,
     Store,
 )
-from tf_operator_tpu.runtime.store import TransientStoreError, WatchEventType
+from tf_operator_tpu.runtime.store import (
+    NotFoundError,
+    TransientStoreError,
+    WatchEventType,
+)
 
 log = logging.getLogger("tpujob.soak")
 
@@ -151,11 +155,13 @@ class RestartableOperator:
         heartbeat_ttl: float,
         resync_period: float = 0.5,
         snapshot_every: int = 50,
+        ledger_dir: Optional[str] = None,
     ) -> None:
         self.data_dir = data_dir
         self.heartbeat_ttl = heartbeat_ttl
         self.resync_period = resync_period
         self.snapshot_every = snapshot_every
+        self.ledger_dir = ledger_dir
         self.port = 0  # first start picks an ephemeral port, then pins it
         self.restarts = 0
         # One FakeProcessControl per incarnation: in managed mode every
@@ -165,6 +171,7 @@ class RestartableOperator:
         self.store: Optional[Store] = None
         self.controller = None
         self.dashboard = None
+        self.ledger = None
 
     @property
     def url(self) -> str:
@@ -180,7 +187,18 @@ class RestartableOperator:
         fake = FakeProcessControl()
         ctl = TPUJobController(store, fake, resync_period=self.resync_period)
         ctl.scheduler.heartbeat_ttl = self.heartbeat_ttl
-        dashboard = DashboardServer(store, host="127.0.0.1", port=self.port)
+        ledger = None
+        if self.ledger_dir is not None:
+            from tf_operator_tpu.obs.ledger import FleetLedger
+
+            # Re-opened every incarnation: recovery is rollup + segment
+            # replay, and attach_ledger's sweep folds any terminal the
+            # dead incarnation observed but never folded.
+            ledger = FleetLedger(self.ledger_dir)
+            ctl.attach_ledger(ledger)
+        dashboard = DashboardServer(
+            store, host="127.0.0.1", port=self.port, ledger=ledger
+        )
         dashboard.start()
         self.port = dashboard.port
         ctl.api_url = dashboard.url
@@ -188,6 +206,7 @@ class RestartableOperator:
         if info.recovered:
             ctl.record_recovery(info)
         self.store, self.controller, self.dashboard = store, ctl, dashboard
+        self.ledger = ledger
         self.fakes.append(fake)
         log.warning(
             "operator up on %s (recovered=%s objects=%d rv=%d)",
@@ -199,6 +218,12 @@ class RestartableOperator:
         no handoff — durability must come from the WAL alone."""
         self.dashboard.stop()
         self.controller.stop()
+        if self.ledger is not None:
+            # fold() flushes per record, so close() adds no durability —
+            # it only releases the segment handle (the SIGKILL contract
+            # holds either way; this just avoids two writers post-restart).
+            self.ledger.close()
+            self.ledger = None
         self.store = None
 
     def restart(self) -> None:
@@ -1052,6 +1077,456 @@ def autopilot_artifact(
             "decisions": result.on.decision_spans,
         },
         "goodput_gain": result.gain(),
+        "errors": errors,
+        "pass": not errors,
+    }
+
+
+@dataclass
+class FleetLedgerSoakResult:
+    """Observations from the fleet-ledger soak (r18): durable cross-job
+    memory under operator death, job GC, and the prior-fed first cadence
+    decision of a fresh job. See run_fleet_ledger_soak."""
+
+    history: List[Dict[str, Any]] = field(default_factory=list)
+    prior_mtbf_s: float = 0.0
+    prior_failures: int = 0
+    prior_jobs: int = 0
+    summary_before: bytes = b""
+    summary_after: bytes = b""
+    operator_restarts: int = 0
+    gc_uid_present: bool = False
+    gc_jobs_folded_before: int = 0
+    gc_jobs_folded_after: int = 0
+    wal_stats: Dict[str, Any] = field(default_factory=dict)
+    on: Dict[str, Any] = field(default_factory=dict)
+    off: Dict[str, Any] = field(default_factory=dict)
+    max_checkpoint_every: int = 24
+    within: float = 1.5
+
+    @staticmethod
+    def first_decision(lane: Dict[str, Any]) -> Dict[str, Any]:
+        ds = lane.get("cadence_decisions") or []
+        return dict(ds[0]) if ds else {}
+
+    def converged_every(self) -> Optional[int]:
+        """The Young/Daly optimum the prior-fed first decision is gated
+        against: the ON lane's own measured stall and step time, but the
+        LEDGER's converged MTBF instead of the lane's (nonexistent) own
+        failure history."""
+        first = self.first_decision(self.on)
+        try:
+            stall = float(first["save_stall_s"])
+            step = float(first["step_time_s"])
+        except (KeyError, ValueError):
+            return None
+        if self.prior_mtbf_s <= 0:
+            return None
+        from tf_operator_tpu.autopilot.policy import optimal_checkpoint_every
+
+        return optimal_checkpoint_every(
+            stall, self.prior_mtbf_s, step,
+            min_every=1, max_every=self.max_checkpoint_every,
+        ).every
+
+    def check(self) -> List[str]:
+        errs: List[str] = []
+        for obs in self.history:
+            name = obs.get("name")
+            if not obs.get("succeeded"):
+                errs.append(f"history job {name} did not succeed")
+            if int(obs.get("restarts") or 0) < 1:
+                errs.append(f"history job {name} saw no crash restart")
+            if not obs.get("folded"):
+                errs.append(f"history job {name} never folded into the ledger")
+        if not (0 < self.prior_mtbf_s < float("inf")):
+            errs.append(
+                f"ledger prior MTBF not finite-positive: {self.prior_mtbf_s}"
+            )
+        if self.prior_failures < len(self.history):
+            errs.append(
+                f"ledger prior failures {self.prior_failures} < history "
+                f"incidents {len(self.history)}"
+            )
+        if self.operator_restarts < 1:
+            errs.append("operator was never killed+restarted")
+        if not self.summary_before or self.summary_before != self.summary_after:
+            errs.append(
+                "fleet summary not byte-identical across operator restart "
+                f"({len(self.summary_before)}B vs {len(self.summary_after)}B)"
+            )
+        if not self.gc_uid_present:
+            errs.append("job GC removed the ledger record (must survive)")
+        if self.gc_jobs_folded_after != self.gc_jobs_folded_before:
+            errs.append(
+                f"ledger jobs-folded count changed across GC: "
+                f"{self.gc_jobs_folded_before} -> {self.gc_jobs_folded_after}"
+            )
+        # OFF lane: a fresh job with no fleet prior has infinite own MTBF,
+        # so its first retune must sit at the clamp edge, receipt-free.
+        off1 = self.first_decision(self.off)
+        if not off1:
+            errs.append("off lane made no cadence decision")
+        else:
+            if int(off1.get("to_every") or -1) != self.max_checkpoint_every:
+                errs.append(
+                    f"off lane first decision not at clamp edge "
+                    f"{self.max_checkpoint_every}: {off1}"
+                )
+            if off1.get("mtbf_s") != "inf":
+                errs.append(
+                    f"off lane first decision has finite MTBF (fleet prior "
+                    f"leaked?): {off1}"
+                )
+            if "prior_mtbf_s" in off1:
+                errs.append(
+                    f"off lane decision carries a fleet-prior receipt: {off1}"
+                )
+        # ON lane: the first decision must be prior-receipted and land
+        # within `within`x of the converged optimum.
+        on1 = self.first_decision(self.on)
+        opt = self.converged_every()
+        if not on1:
+            errs.append("on lane made no cadence decision")
+        elif opt is None:
+            errs.append(f"on lane first decision missing its numbers: {on1}")
+        else:
+            for k in ("prior_mtbf_s", "prior_samples", "prior_weight"):
+                if k not in on1:
+                    errs.append(
+                        f"on lane first decision missing receipt attr "
+                        f"{k}: {on1}"
+                    )
+            to = int(on1.get("to_every") or -1)
+            if not (to <= self.within * opt and opt <= self.within * to):
+                errs.append(
+                    f"on lane first cadence {to} not within {self.within}x "
+                    f"of converged optimum {opt} "
+                    f"(prior mtbf {self.prior_mtbf_s:.2f}s)"
+                )
+            # Distinguishability: the clamp edge must NOT satisfy the ON
+            # gate, or the A/B proves nothing.
+            if not self.max_checkpoint_every > self.within * opt:
+                errs.append(
+                    f"A/B not distinguishable: clamp edge "
+                    f"{self.max_checkpoint_every} <= {self.within}x "
+                    f"optimum {opt}"
+                )
+        # Telemetry-heavy run, coalesced WAL: zero Telemetry bytes, the
+        # skip counter proves the traffic existed, and control-plane
+        # kinds carry all the durable bytes.
+        tel = self.wal_stats.get("Telemetry", {})
+        if tel.get("bytes", -1) != 0 or tel.get("skipped", 0) <= 0:
+            errs.append(f"telemetry WAL not coalesced: {tel}")
+        control = sum(
+            (v or {}).get("bytes", 0)
+            for k, v in self.wal_stats.items()
+            if k in ("TPUJob", KIND_PROCESS)
+        )
+        if not control > 0:
+            errs.append(
+                f"no TPUJob/Process WAL bytes recorded: {self.wal_stats}"
+            )
+        return errs
+
+
+def _run_ledger_job(
+    operator: RestartableOperator,
+    root: str,
+    name: str,
+    schedule: Optional[FaultSchedule],
+    steps: int,
+    step_sleep_s: float,
+    save_stall_extra_s: float,
+    autopilot: Optional[Dict[str, Any]],
+    timeout: float,
+    heartbeat_ttl: float = 10.0,
+) -> Dict[str, Any]:
+    """Run ONE job through the standing operator — its own agents (per-job
+    host names, so no host accumulates enough incidents to trip the
+    reputation threshold mid-soak) and an optional per-job injector over
+    RemoteStore — wait for terminal AND the ledger fold, and return the
+    observation dict the fleet-ledger gates consume."""
+    ckpt_dir = os.path.join(root, name, "ckpt")
+    store = RemoteStore(operator.url, timeout=5.0)
+    injector = (
+        ChaosInjector(schedule, store, job_name=name, checkpoint_dir=ckpt_dir)
+        if schedule is not None
+        else None
+    )
+
+    def client() -> Any:
+        return (
+            injector.wrap()
+            if injector is not None
+            else RemoteStore(operator.url, timeout=5.0)
+        )
+
+    agents = [
+        HostAgent(
+            client(), f"{name}-h{i}", total_chips=1,
+            heartbeat_interval=0.25,
+            backend=LocalProcessControl(
+                client(), log_dir=os.path.join(root, name, "logs")
+            ),
+        )
+        for i in range(2)
+    ]
+    if injector is not None:
+        injector.agents = {a.name: a for a in agents}
+    obs: Dict[str, Any] = {"name": name}
+    for a in agents:
+        a.start()
+    try:
+        store.create(
+            _soak_job(
+                name, 1, 1, ckpt_dir, steps,
+                checkpoint_every=1, backoff_limit=2,
+                heartbeat_ttl=heartbeat_ttl, data_plane="light",
+                step_sleep_s=step_sleep_s,
+                workload_extra={
+                    # Same geometry as the autopilot A/B: a modeled
+                    # per-save blocking stall worth retuning away, fresh
+                    # telemetry every step, unthrottled directive polls.
+                    "save_stall_extra_s": save_stall_extra_s,
+                    "telemetry_every": 1,
+                    "cadence_poll_s": 0.0,
+                },
+                autopilot=autopilot,
+            )
+        )
+        if injector is not None:
+            injector.arm()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                st = store.get("TPUJob", "default", name).status
+            except TransientStoreError:
+                time.sleep(0.25)
+                continue
+            if is_finished(st) and (injector is None or injector.done):
+                break
+            time.sleep(0.25)
+        job_obj = store.get("TPUJob", "default", name)
+        st = job_obj.status
+        obs["uid"] = job_obj.metadata.uid
+        obs["succeeded"] = has_condition(st, ConditionType.SUCCEEDED)
+        obs["restarts"] = st.restart_count
+        obs["preemptions"] = st.preemption_count
+        # The tentpole contract: terminal observed => the record is IN
+        # the ledger (durably) before the soak moves on.
+        fold_deadline = time.monotonic() + 15.0
+        folded = False
+        while time.monotonic() < fold_deadline:
+            led = operator.ledger
+            if led is not None and led.has(obs["uid"]):
+                folded = True
+                break
+            time.sleep(0.1)
+        obs["folded"] = folded
+        trace = job_trace(store, "default", name)
+        obs["cadence_decisions"] = [
+            dict(s.attrs or {})
+            for s in sorted(
+                (s for s in trace
+                 if s.op == "autopilot-decision"
+                 and (s.attrs or {}).get("kind") == "cadence"),
+                key=lambda s: s.start_time,
+            )
+        ]
+        obs["applied"] = (
+            [a["kind"] for a in injector.applied] if injector is not None
+            else []
+        )
+    finally:
+        if injector is not None:
+            injector.stop()
+        for a in agents:
+            a.stop()
+    return obs
+
+
+def run_fleet_ledger_soak(
+    seed: int = 0,
+    history_jobs: int = 2,
+    history_steps: int = 6,
+    fresh_steps: int = 16,
+    step_sleep_s: float = 0.2,
+    save_stall_extra_s: float = 0.8,
+    max_checkpoint_every: int = 24,
+    within: float = 1.5,
+    timeout: float = 120.0,
+    workdir: Optional[str] = None,
+) -> FleetLedgerSoakResult:
+    """The fleet-ledger acceptance soak (r18), four phases against ONE
+    standing operator with a durable FleetLedger:
+
+    1. **History** — seeded crash-faulted jobs run to Succeeded; each
+       terminal folds exactly once, leaving the ledger a finite fleet
+       MTBF (the prior the fresh job will consume).
+    2. **Operator death** — the operator is killed and restarted;
+       ``GET /api/fleet/summary`` must be byte-identical across the
+       bounce (rollup + segment replay + dedup re-sweep).
+    3. **Prior A/B** — two identical fresh fault-free jobs, autopilot on
+       in both, differing ONLY in ``use_fleet_priors``. The OFF lane has
+       no failure history, so its first retune clamps to
+       ``max_checkpoint_every`` with ``mtbf_s=inf``; the ON lane's first
+       decision must carry the prior receipt attrs and land within
+       ``within``x of the Young/Daly optimum at the LEDGER's MTBF. The
+       clamp edge is sized to fail the ON gate (distinguishability).
+       ON runs first so neither lane's own fold can perturb the other's
+       prior (the OFF lane never consults the ledger at all).
+    4. **Job GC** — a history job is deleted from the store; the ledger
+       record must survive (jobs-folded count unchanged).
+
+    Also captures first-incarnation ``wal_stats()``: with per-step
+    telemetry from every job, Telemetry WAL bytes must be ZERO (skipped
+    counter positive) while TPUJob/Process kinds carry the durable bytes
+    — the coalescing satellite's receipt."""
+    import urllib.request
+
+    root = workdir or tempfile.mkdtemp(prefix="tpujob-fleet-ledger-")
+    operator = RestartableOperator(
+        os.path.join(root, "store"),
+        # Operator downtime must not masquerade as host loss (same
+        # reasoning as crash mode in run_soak).
+        heartbeat_ttl=10.0,
+        ledger_dir=os.path.join(root, "ledger"),
+    )
+    operator.start()
+    result = FleetLedgerSoakResult(
+        max_checkpoint_every=max_checkpoint_every, within=within
+    )
+
+    def fetch(path: str) -> bytes:
+        with urllib.request.urlopen(operator.url + path, timeout=5.0) as r:
+            return r.read()
+
+    try:
+        # Phase 1: build fleet history.
+        for i in range(history_jobs):
+            result.history.append(
+                _run_ledger_job(
+                    operator, root, f"fleet-hist-{i}",
+                    schedule=FaultSchedule.generate(
+                        seed + i, crashes=1, preemptions=0,
+                        first_step=2, spread_s=0.0,
+                    ),
+                    steps=history_steps, step_sleep_s=step_sleep_s,
+                    save_stall_extra_s=save_stall_extra_s,
+                    autopilot=None, timeout=timeout,
+                )
+            )
+        led = operator.ledger
+        prior = led.cadence_inputs("", "") if led is not None else {}
+        result.prior_mtbf_s = float(prior.get("mtbf_s") or 0.0)
+        result.prior_failures = int(prior.get("failures") or 0)
+        result.prior_jobs = int(prior.get("jobs") or 0)
+        # First-incarnation WAL accounting, before the restart resets the
+        # in-memory counters.
+        result.wal_stats = operator.store.wal_stats()
+        result.summary_before = fetch("/api/fleet/summary")
+        # Phase 2: kill + recover the whole control plane.
+        operator.restart()
+        result.operator_restarts = operator.restarts
+        result.summary_after = fetch("/api/fleet/summary")
+        # Phase 3: the prior A/B (ON first — see docstring).
+        base = {
+            "enabled": True,
+            "cooldown_s": 1.0,
+            "confirm_ticks": 2,
+            "max_checkpoint_every": max_checkpoint_every,
+        }
+        result.on = _run_ledger_job(
+            operator, root, "fleet-fresh-on", schedule=None,
+            steps=fresh_steps, step_sleep_s=step_sleep_s,
+            save_stall_extra_s=save_stall_extra_s,
+            autopilot={**base, "use_fleet_priors": True}, timeout=timeout,
+        )
+        result.off = _run_ledger_job(
+            operator, root, "fleet-fresh-off", schedule=None,
+            steps=fresh_steps, step_sleep_s=step_sleep_s,
+            save_stall_extra_s=save_stall_extra_s,
+            autopilot={**base, "use_fleet_priors": False}, timeout=timeout,
+        )
+        # Phase 4: GC a history job; its ledger record must survive.
+        led = operator.ledger
+        victim = result.history[0]
+        result.gc_jobs_folded_before = len(led) if led is not None else 0
+        store = RemoteStore(operator.url, timeout=5.0)
+        store.delete("TPUJob", "default", victim["name"])
+        gc_deadline = time.monotonic() + 15.0
+        while time.monotonic() < gc_deadline:
+            try:
+                store.get("TPUJob", "default", victim["name"])
+            except NotFoundError:
+                break
+            except TransientStoreError:
+                pass
+            time.sleep(0.25)
+        # Let the controller's GC sync (informer-cached None) run its
+        # gauge sweep before we assert.
+        time.sleep(1.5)
+        result.gc_uid_present = bool(
+            victim.get("uid")
+            and led is not None
+            and led.has(victim["uid"])
+        )
+        result.gc_jobs_folded_after = len(led) if led is not None else 0
+    finally:
+        operator.crash()
+    return result
+
+
+def fleetledger_artifact(
+    result: FleetLedgerSoakResult, seed: int
+) -> Dict[str, Any]:
+    """The checked-in receipt (artifacts/fleetledger_r18.json)."""
+    import json as _json
+
+    errors = result.check()
+
+    def lane(obs: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            "name": obs.get("name"),
+            "succeeded": obs.get("succeeded"),
+            "restarts": obs.get("restarts"),
+            "folded": obs.get("folded"),
+            "applied": obs.get("applied"),
+            "first_cadence_decision": result.first_decision(obs),
+            "cadence_decisions": obs.get("cadence_decisions"),
+        }
+
+    try:
+        summary = _json.loads(result.summary_after.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        summary = None
+    return {
+        "bench": "fleet-ledger-soak",
+        "seed": seed,
+        "history": [lane(o) for o in result.history],
+        "prior": {
+            "mtbf_s": result.prior_mtbf_s,
+            "failures": result.prior_failures,
+            "jobs": result.prior_jobs,
+        },
+        "operator_restarts": result.operator_restarts,
+        "summary_byte_identical_across_restart": bool(
+            result.summary_before
+            and result.summary_before == result.summary_after
+        ),
+        "gc": {
+            "record_survived": result.gc_uid_present,
+            "jobs_folded_before": result.gc_jobs_folded_before,
+            "jobs_folded_after": result.gc_jobs_folded_after,
+        },
+        "wal_stats": result.wal_stats,
+        "gate_within": result.within,
+        "max_checkpoint_every": result.max_checkpoint_every,
+        "converged_optimum_every": result.converged_every(),
+        "on": lane(result.on),
+        "off": lane(result.off),
+        "fleet_summary": summary,
         "errors": errors,
         "pass": not errors,
     }
@@ -1921,6 +2396,17 @@ def main(argv=None) -> int:
     p.add_argument("--save-stall-extra", type=float, default=0.8,
                    help="autopilot A/B: modeled per-save blocking stall "
                         "(seconds) the cadence retune amortizes")
+    p.add_argument("--fleet-ledger", action="store_true",
+                   help="fleet-ledger soak (r18): seeded crash-faulted "
+                        "history jobs fold into a durable FleetLedger; "
+                        "gates byte-identical /api/fleet/summary across an "
+                        "operator kill+restart, record survival across job "
+                        "GC, telemetry-coalesced WAL accounting, and the "
+                        "prior A/B — a fresh job with use_fleet_priors "
+                        "must make its FIRST cadence decision within 1.5x "
+                        "of the converged Young/Daly optimum (receipted "
+                        "with the prior numbers) while the no-prior lane "
+                        "sits at the clamp edge")
     p.add_argument("--kills", type=int, default=2,
                    help="elastic soak: number of kill/return faults")
     p.add_argument("--total-windows", type=int, default=900,
@@ -2000,6 +2486,31 @@ def main(argv=None) -> int:
         errors = aresult.check()
         for e in errors:
             print(f"AUTOPILOT INVARIANT VIOLATED: {e}", file=sys.stderr)
+        return 1 if errors else 0
+
+    if args.fleet_ledger:
+        import json as _json
+
+        # Like --autopilot-ab, the lane geometry is deliberately NOT
+        # driven by --steps/--step-sleep: the prior A/B needs the clamp
+        # edge well clear of 1.5x the converged optimum.
+        fresult = run_fleet_ledger_soak(
+            seed=args.seed,
+            save_stall_extra_s=args.save_stall_extra,
+            timeout=args.timeout, workdir=args.workdir,
+        )
+        artifact = fleetledger_artifact(fresult, args.seed)
+        print(_json.dumps(artifact))
+        if args.artifact:
+            os.makedirs(
+                os.path.dirname(os.path.abspath(args.artifact)), exist_ok=True
+            )
+            with open(args.artifact, "w") as f:
+                _json.dump(artifact, f, indent=2)
+            print(f"fleet-ledger receipt -> {args.artifact}")
+        errors = fresult.check()
+        for e in errors:
+            print(f"FLEET LEDGER INVARIANT VIOLATED: {e}", file=sys.stderr)
         return 1 if errors else 0
 
     if args.hang:

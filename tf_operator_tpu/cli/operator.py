@@ -76,6 +76,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--snapshot-every", type=int, default=1000,
                    help="mutations between WAL compactions (snapshot + "
                         "segment rotation) when --data-dir is set")
+    p.add_argument("--persist-telemetry", action="store_true",
+                   help="also WAL-log Telemetry ring-slot writes under "
+                        "--data-dir. Default off: telemetry is overwrite "
+                        "churn (the WAL would grow with step count, not "
+                        "object count) and rings refill from live "
+                        "reporters after a restart.")
+    p.add_argument("--ledger-dir", default=None,
+                   help="fleet ledger directory (obs/ledger.py): one "
+                        "compact record per terminal job, durable across "
+                        "operator death and job GC — feeds GET "
+                        "/api/fleet/*, `tpujob fleet`, autopilot MTBF "
+                        "priors, and host reputation. Defaults to "
+                        "<data-dir>/ledger when --data-dir is set.")
     p.add_argument("--wal-fsync", action="store_true",
                    help="fsync the WAL per mutation (and snapshots): "
                         "survives machine/power loss, not just operator "
@@ -239,6 +252,7 @@ def main(argv=None) -> int:
             args.data_dir,
             snapshot_every=args.snapshot_every,
             fsync=args.wal_fsync,
+            persist_telemetry=args.persist_telemetry,
         )
         if recovery.recovered:
             log.warning(
@@ -291,6 +305,19 @@ def main(argv=None) -> int:
         store, backend, resync_period=args.resync_period,
         controller_config=controller_config,
     )
+    # Fleet ledger (r18): the cross-job memory. attach_ledger sweeps any
+    # terminal jobs a previous incarnation died before folding, then
+    # seeds host reputation into the scheduler's deprioritized set.
+    ledger = None
+    ledger_dir = args.ledger_dir or (
+        os.path.join(args.data_dir, "ledger") if args.data_dir else None
+    )
+    if ledger_dir:
+        from tf_operator_tpu.obs.ledger import FleetLedger
+
+        ledger = FleetLedger(ledger_dir, fsync=args.wal_fsync)
+        controller.attach_ledger(ledger)
+        log.info("fleet ledger at %s (%d job records)", ledger_dir, len(ledger))
     warm_pool = None
     if args.warm_pool > 0 and args.local_agents == 0:
         # Single-host mode: the operator's own backend launches the gang,
@@ -327,6 +354,10 @@ def main(argv=None) -> int:
         controller.metrics.gauge_help["tpujob_cachesvc_entries"] = (
             "Entries resident in the fleet compile-cache service."
         )
+        if ledger is not None:
+            # The per-fleet compile-cache miss rate rides the ledger
+            # rollup (summary()["compile_cache"]) for capacity sizing.
+            ledger.cachesvc_stats = cachesvc.snapshot
         log.info("compile-cache service on %s (cap %d bytes, %d AOT workers)",
                  cachesvc.url, args.compile_cache_bytes, args.aot_workers)
     # In --store-server HA mode the primary API/UI lives on the store
@@ -337,7 +368,7 @@ def main(argv=None) -> int:
     dashboard = DashboardServer(
         store, host=args.host, port=args.port, metrics=controller.metrics,
         auth_token=auth_token, auth_reads=args.auth_reads,
-        max_workers=args.api_workers,
+        max_workers=args.api_workers, ledger=ledger,
     )
     chaos = ChaosMonkey(store, args.chaos_level, args.chaos_interval)
 
@@ -431,6 +462,8 @@ def main(argv=None) -> int:
     if cachesvc is not None:
         cachesvc.stop()
     dashboard.stop()
+    if ledger is not None:
+        ledger.close()
     return rc["code"]
 
 

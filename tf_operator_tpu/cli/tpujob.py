@@ -122,6 +122,16 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("file")
     qp = sub.add_parser("queues", help="list Queues with quota usage")
     qp.add_argument("--namespace", default=None)
+    fp = sub.add_parser(
+        "fleet",
+        help="fleet ledger rollup: cross-job MTBF, per-cause downtime "
+             "percentiles, goodput histogram, per-host incident counts — "
+             "the durable record that survives job GC and operator "
+             "restarts",
+    )
+    fp.add_argument("--json", action="store_true", dest="as_json",
+                    help="dump the raw summary+hosts payloads instead of "
+                         "the rendered table")
     return p
 
 
@@ -268,6 +278,74 @@ def render_top(payload: dict, job: dict = None, now: float = None) -> str:
     return "\n".join(lines)
 
 
+def render_fleet(summary: dict, hosts: dict) -> str:
+    """Render /api/fleet/summary + /api/fleet/hosts as the `tpujob
+    fleet` report (separated from main() so tests can golden-check it
+    without a live server)."""
+    lines = [f"FLEET      {summary.get('jobs', 0)} jobs recorded"]
+    phases = summary.get("phases") or {}
+    if phases:
+        lines.append(
+            "PHASES     "
+            + "  ".join(f"{k}={phases[k]}" for k in sorted(phases))
+        )
+    mtbf = summary.get("mtbf_s")
+    lines.append(
+        f"MTBF       {mtbf:.1f}s over {summary.get('failures', 0)} failures"
+        if mtbf is not None
+        else f"MTBF       - ({summary.get('failures', 0)} failures)"
+    )
+    if summary.get("goodput_mean") is not None:
+        lines.append(f"GOODPUT    mean {summary['goodput_mean']:.3f}")
+        hist = summary.get("goodput_hist") or {}
+        if any(hist.values()):
+            lines.append(
+                "  hist     "
+                + "  ".join(f"[{b}]={hist[b]}" for b in sorted(hist))
+            )
+    queues = summary.get("queues") or {}
+    for qname in sorted(queues):
+        q = queues[qname]
+        qm = q.get("mtbf_s")
+        lines.append(
+            f"  queue[{qname or '-'}]  jobs={q.get('jobs', 0)} "
+            f"failures={q.get('failures', 0)} "
+            f"mtbf={f'{qm:.1f}s' if qm is not None else '-'} "
+            f"goodput={q.get('goodput_mean', 0.0):.3f} "
+            f"save_stall={q.get('save_stall_s', 0.0):.3f}s"
+        )
+    causes = summary.get("causes") or {}
+    for cause in sorted(causes):
+        c = causes[cause]
+        lines.append(
+            f"  lost[{cause}]  {c.get('incidents', 0)} incidents, "
+            f"{c.get('lost_s', 0.0):.1f}s total "
+            f"(p50 {c.get('lost_p50_s', 0.0):.1f}s / "
+            f"p90 {c.get('lost_p90_s', 0.0):.1f}s / "
+            f"p99 {c.get('lost_p99_s', 0.0):.1f}s)"
+        )
+    cc = summary.get("compile_cache")
+    if cc:
+        rate = cc.get("miss_rate")
+        lines.append(
+            f"CACHE      hits={cc.get('hits', 0)} misses={cc.get('misses', 0)} "
+            f"evictions={cc.get('evictions', 0)} "
+            + (f"miss_rate={rate:.3f}" if rate is not None else "miss_rate=-")
+        )
+    hmap = (hosts or {}).get("hosts") or {}
+    if hmap:
+        lines.append(
+            f"{'HOST':<20} {'JOBS':<5} {'INCIDENT-JOBS':<13} {'FAILURES':<8}"
+        )
+        for h in sorted(hmap):
+            v = hmap[h]
+            lines.append(
+                f"{h:<20} {v.get('jobs', 0):<5} "
+                f"{v.get('incident_jobs', 0):<13} {v.get('failures', 0):<8}"
+            )
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -403,6 +481,13 @@ def main(argv=None) -> int:
                     f"{qobj.spec.quota_chips or '-':<12} {c:<11} {n:<5} "
                     f"{qobj.spec.max_running_jobs or '-':<8}"
                 )
+        elif args.cmd == "fleet":
+            summary = client.fleet_summary()
+            hosts = client.fleet_hosts()
+            if args.as_json:
+                print(json.dumps({"summary": summary, **hosts}, indent=2))
+            else:
+                print(render_fleet(summary, hosts))
     except TPUJobApiError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 1

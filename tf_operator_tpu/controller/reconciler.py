@@ -328,6 +328,17 @@ class TPUJobController:
         # acting through actuators that already exist. Keyed by uid like
         # the trackers — decision state dies with the incarnation.
         self._autopilots: Dict[str, JobAutopilot] = {}  # uid -> engine
+        # Fleet ledger (r18, obs/ledger.py): the durable cross-job record
+        # of outcomes. Attached by the daemon via attach_ledger(); every
+        # terminal job folds one compact JobRecord, and the ledger's
+        # per-cohort MTBF history feeds fresh jobs' first cadence
+        # decisions (use_fleet_priors) plus per-host reputation into the
+        # scheduler's deprioritized set. None = no cross-job memory.
+        self.ledger = None
+        # uid -> (prior_mtbf_s, prior_failures, prior_jobs): the prior is
+        # computed ONCE at the job's first autopilot tick and pinned, so
+        # records folded mid-run never shift a live job's estimate.
+        self._prior_cache: Dict[str, Tuple[float, int, int]] = {}
         self._host_risk: Dict[str, Dict[str, HostRisk]] = {}  # uid -> host -> risk
         self._last_step_time: Dict[str, float] = {}  # uid -> last window median
         self._ap_ttfs_seen: set = set()  # uids whose TTFS fed the cold/warm split
@@ -655,6 +666,14 @@ class TPUJobController:
             # job exactly like spans/telemetry; `tpujob debug` on a GC'd
             # job then 404s loudly instead of returning an empty tar.
             delete_forensics(self.store, namespace, name)
+            # Cardinality: the per-job goodput series is folded into the
+            # ledger's histogram by now — drop it so 100 submit->GC
+            # cycles leave /metrics bounded. (The ledger record itself
+            # SURVIVES this GC; that is its whole point.)
+            self.metrics.clear_gauge(
+                "tpujob_goodput_ratio",
+                labels={"namespace": namespace, "job": name},
+            )
             self.expectations.delete_expectations(self._exp_key(key))
             self._release_job(key)
             return
@@ -669,6 +688,13 @@ class TPUJobController:
             return
 
         if is_finished(job.status):
+            # Safety-net fold (r18): normally _finish folded the record;
+            # this covers a previous incarnation that wrote the terminal
+            # status and died before folding. Dedupe is durable (uid in
+            # the ledger), so the common case is one cheap has() check.
+            self._ledger_fold(
+                job, job.status.completion_time or time.time()
+            )
             self._delete_children(namespace, name, job.spec.run_policy.cleanup_policy)
             # Keep the replica counters live through the CleanUp window:
             # with them frozen at the terminal transition, active>0 would
@@ -1861,6 +1887,152 @@ class TPUJobController:
             },
         )
 
+    # ---- fleet ledger (r18) ---------------------------------------------
+
+    def attach_ledger(self, ledger) -> None:
+        """Attach the FleetLedger and sweep: fold any job the PREVIOUS
+        incarnation drove terminal but died before folding (SIGKILL
+        between the terminal status write and the fold). The ledger's
+        durable uid dedupe makes the sweep idempotent — a job folded
+        before the crash is skipped, so nothing double-counts. Runs at
+        every operator start, then seeds the scheduler's deprioritized
+        set from ledger host reputation so a host that ate jobs last
+        hour starts flagged before any new job touches it."""
+        self.ledger = ledger
+        if ledger is None:
+            return
+        now = time.time()
+        try:
+            jobs = self.store.list(KIND_TPUJOB)
+        except Exception:  # noqa: BLE001 — best-effort, like all obs
+            jobs = []
+        for job in jobs:
+            if is_finished(job.status) and not ledger.has(job.metadata.uid):
+                self._ledger_fold(job, job.status.completion_time or now)
+        self._apply_host_reputation(now)
+
+    def _ledger_fold(self, job: TPUJob, end: float) -> None:
+        """Fold one terminal job into the fleet ledger, exactly once
+        (the dedupe is the ledger's durable uid set, not process
+        memory). Best-effort: a fold failure never fails a sync."""
+        if self.ledger is None:
+            return
+        uid = job.metadata.uid
+        if not uid or self.ledger.has(uid):
+            return
+        try:
+            if self.ledger.fold(self._job_record(job, end)):
+                self._apply_host_reputation(time.time())
+        except Exception:  # noqa: BLE001
+            log.exception("ledger fold failed for %s", job.key())
+
+    def _job_record(self, job: TPUJob, end: float):
+        """Build the compact JobRecord from surfaces that already exist
+        (status counters, the trace, telemetry, live children). Runs
+        BEFORE _delete_children so hosts-touched is still observable."""
+        from tf_operator_tpu.obs.ledger import JobRecord
+
+        uid = job.metadata.uid
+        ns = job.metadata.namespace
+        name = job.metadata.name
+        phase = (
+            "Succeeded"
+            if has_condition(job.status, ConditionType.SUCCEEDED)
+            else "Failed"
+        )
+        submit = job.metadata.creation_timestamp or job.status.start_time or end
+        try:
+            spans = job_trace(self.store, ns, name)
+        except Exception:  # noqa: BLE001
+            spans = []
+        try:
+            batches = job_telemetry(self.store, ns, name)
+        except Exception:  # noqa: BLE001
+            batches = []
+        g = goodput_decomposition(spans, batches, submit, end)
+        stalls = [
+            s.duration() for s in spans
+            if s.op == "checkpoint-save-stall" and s.duration() is not None
+        ]
+        ttfs_s, ttfs_kind = 0.0, ""
+        try:
+            fs = self.store.get(KIND_SPAN, ns, first_step_span_name(name, uid))
+        except Exception:  # noqa: BLE001
+            fs = None
+        if fs is not None and fs.duration() is not None:
+            ttfs_s = fs.duration()
+            ttfs_kind = (
+                "warm"
+                if (getattr(fs, "attrs", None) or {}).get("warm") == "1"
+                else "cold"
+            )
+        decisions = [
+            dict(s.attrs or {}) for s in spans if s.op == "autopilot-decision"
+        ][-16:]  # bounded: the record stays compact however long the run
+        hosts = set()
+        try:
+            for p in self.store.list(
+                KIND_PROCESS, namespace=ns,
+                label_selector={LABEL_JOB_NAME: name},
+            ):
+                if p.spec.node_name:
+                    hosts.add(p.spec.node_name)
+        except Exception:  # noqa: BLE001
+            pass
+        for s in spans:  # restart/resize spans also name hosts they hit
+            host = (getattr(s, "attrs", None) or {}).get("host", "")
+            if host:
+                hosts.add(host)
+        return JobRecord(
+            uid=uid,
+            namespace=ns,
+            name=name,
+            queue=job.spec.scheduling.queue,
+            priority_class=job.spec.scheduling.priority_class,
+            job_class=job.spec.scheduling.job_class,
+            phase=phase,
+            submit_ts=submit,
+            end_ts=end,
+            wall_s=max(0.0, end - submit),
+            restarts=job.status.restart_count,
+            preemptions=job.status.preemption_count,
+            hangs=job.status.hang_count,
+            resizes=job.status.resize_count,
+            last_restart_cause=job.status.last_restart_cause,
+            lost_s={k: v for k, v in g["lost_s"].items() if v > 0},
+            goodput_ratio=g["goodput_ratio"],
+            ttfs_s=ttfs_s,
+            ttfs_kind=ttfs_kind,
+            save_stall_s=sum(stalls) / len(stalls) if stalls else 0.0,
+            saves=len(stalls),
+            step_time_s=self._last_step_time.get(uid, 0.0),
+            autopilot_decisions=int(
+                (job.status.autopilot or {}).get("decisions_total", 0)
+            ),
+            decisions=decisions,
+            hosts=sorted(hosts),
+        )
+
+    def _apply_host_reputation(self, now: float) -> None:
+        """Seed place_gang's soft-avoid set from ledger host reputation:
+        a host that ate REPUTATION_THRESHOLD incident jobs inside the
+        window starts deprioritized for the NEXT job — the same actuator
+        the autopilot's deprioritize decision uses, fed by fleet memory
+        instead of live telemetry."""
+        if self.ledger is None:
+            return
+        try:
+            flagged = self.ledger.host_reputation(now)
+        except Exception:  # noqa: BLE001
+            return
+        if not flagged:
+            return
+        with self._sched_lock:
+            for host in flagged:
+                self.fleet.deprioritize_host(
+                    host, now + AUTOPILOT_DEPRIORITIZE_TTL_S
+                )
+
     def _check_stragglers(self, job: TPUJob, processes: List[Process]) -> None:
         """Evaluate new cross-rank telemetry windows for stragglers.
 
@@ -2146,6 +2318,31 @@ class TPUJobController:
             + job.status.preemption_count
             + job.status.hang_count
         )
+        # Fleet prior (r18): the ledger cohort's MTBF, computed once per
+        # job and pinned in _prior_cache so mid-run folds never shift a
+        # live job's estimate. (0.0, 0, 0) = no usable history: the tick
+        # falls through to the plain own-data path.
+        prior_mtbf_s, prior_failures, prior_jobs = 0.0, 0, 0
+        if cfg.use_fleet_priors and self.ledger is not None:
+            cached_prior = self._prior_cache.get(uid)
+            if cached_prior is None:
+                from tf_operator_tpu.obs.priors import cadence_prior
+
+                try:
+                    p = cadence_prior(
+                        self.ledger,
+                        queue=job.spec.scheduling.queue,
+                        workload_class=job.spec.scheduling.job_class,
+                    )
+                except Exception:  # noqa: BLE001 — advisory
+                    p = None
+                cached_prior = (
+                    (p.mtbf_s, p.failures, p.jobs)
+                    if p is not None
+                    else (0.0, 0, 0)
+                )
+                self._prior_cache[uid] = cached_prior
+            prior_mtbf_s, prior_failures, prior_jobs = cached_prior
         submit = job.metadata.creation_timestamp or job.status.start_time or now
         return TickInputs(
             now=now,
@@ -2158,6 +2355,9 @@ class TPUJobController:
             current_every=current_every,
             directive_epoch=epoch,
             directive_acked=int(directive.get("applied_epoch", 0)) >= epoch,
+            prior_mtbf_s=prior_mtbf_s,
+            prior_failures=prior_failures,
+            prior_jobs=prior_jobs,
             host_risk=dict(self._host_risk.get(uid, {})),
             watchdog_stalled=wd is not None and wd.stalled,
             elastic_ok=(
@@ -3069,6 +3269,9 @@ class TPUJobController:
             self._observe_ckpt_spans(job)
             self._observe_serve_spans(job)
             self._observe_goodput(job, end)
+            # Fleet ledger fold (r18): BEFORE _delete_children below, so
+            # hosts-touched and the decision receipts are still live.
+            self._ledger_fold(job, end)
             self._sched_observed.discard(uid)
             self._ttfs_observed.discard(uid)
             self._ckpt_observed.discard(uid)
@@ -3086,6 +3289,7 @@ class TPUJobController:
         # per-incarnation; the fleet-level TTFS counters stay (they feed
         # warm-pool sizing across jobs).
         self._autopilots.pop(uid, None)
+        self._prior_cache.pop(uid, None)
         self._host_risk.pop(uid, None)
         self._last_step_time.pop(uid, None)
         self._ap_ttfs_seen.discard(uid)

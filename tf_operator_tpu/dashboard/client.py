@@ -140,6 +140,18 @@ class TPUJobClient:
         q = f"?namespace={namespace}" if namespace else ""
         return self._request("GET", f"/api/events{q}")["items"]
 
+    def fleet_summary(self) -> Dict[str, Any]:
+        """The fleet ledger rollup (obs/ledger.py): per-queue MTBF and
+        goodput, per-cause downtime percentiles, incident counts —
+        computed from the durable cross-job record set, so it survives
+        job GC and operator restarts. 404 when no ledger is wired."""
+        return self._request("GET", "/api/fleet/summary")
+
+    def fleet_hosts(self) -> Dict[str, Any]:
+        """Per-host ledger view: {"hosts": {host: {jobs, incident_jobs,
+        failures, last_end_ts}}}."""
+        return self._request("GET", "/api/fleet/hosts")
+
     # -- waiting (tf_job_client.py:104-161) --------------------------------
 
     def wait_for_job(

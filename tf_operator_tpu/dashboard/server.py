@@ -109,6 +109,7 @@ class _Handler(BaseHTTPRequestHandler):
     server_version = "tpujob-dashboard/0.1"
     store: Store = None  # set by server factory
     metrics = None  # ControllerMetrics, set by server factory when wired
+    ledger = None  # FleetLedger (obs/ledger.py), set by factory when wired
     watch_ping_interval: float = 15.0  # idle keep-alive period on watches
     auth_token: Optional[str] = None  # shared secret; None = open server
     auth_reads: bool = False  # r4 --auth-reads: bearer on EVERY route but /healthz
@@ -208,6 +209,26 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/api/events":
             evs = self.store.list(KIND_EVENT, namespace=ns)
             return self._json(200, {"items": [_to_jsonable(e) for e in evs]})
+        # Fleet ledger rollups (r18): computed from the durable record
+        # set, not the store — they survive job GC and operator death.
+        # Serialized with sort_keys so the acceptance's byte-identical
+        # before/after-recovery comparison is about content, not dict
+        # ordering.
+        if path in ("/api/fleet/summary", "/api/fleet/hosts"):
+            if self.ledger is None:
+                return self._error(404, "fleet ledger not wired (--ledger-dir)")
+            payload = (
+                self.ledger.summary()
+                if path == "/api/fleet/summary"
+                else {"hosts": self.ledger.hosts()}
+            )
+            body = json.dumps(payload, sort_keys=True).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
 
         m = _TRACE_RE.match(path)
         if m:
@@ -668,6 +689,7 @@ class DashboardServer:
         auth_token: Optional[str] = None,
         auth_reads: bool = False,
         max_workers: int = 64,
+        ledger=None,
     ) -> None:
         """``auth_token``: shared secret (utils.auth) required on mutating
         routes and the /api/v1 surface; None serves anonymously (tests,
@@ -691,6 +713,7 @@ class DashboardServer:
             {
                 "store": store,
                 "metrics": metrics,
+                "ledger": ledger,
                 "watch_ping_interval": watch_ping_interval,
                 "auth_token": auth_token,
                 "auth_reads": bool(auth_reads),

@@ -7,7 +7,7 @@ dependency-free vanilla-JS SPA with hash routing over the same REST API;
 all dynamic content is inserted via textContent so object fields are never
 interpreted as HTML.
 
-Routes: #/jobs  #/job/<ns>/<name>  #/create  #/events
+Routes: #/jobs  #/job/<ns>/<name>  #/create  #/events  #/fleet
 """
 
 UI_HTML = r"""<!doctype html>
@@ -54,6 +54,7 @@ UI_HTML = r"""<!doctype html>
  <a href="#/jobs" data-nav="jobs">Jobs</a>
  <a href="#/create" data-nav="create">Create</a>
  <a href="#/events" data-nav="events">Events</a>
+ <a href="#/fleet" data-nav="fleet">Fleet</a>
  <span style="flex:1"></span>
  <select id="nsSel" title="namespace"><option value="">all namespaces</option></select>
 </header>
@@ -514,6 +515,73 @@ async function viewEvents(){
     ...['Type','Namespace','Object','Reason','Message','Age'].map(h=>el('th',null,h)))), tb));
 }
 
+// ---- fleet -----------------------------------------------------------------
+// Cross-job ledger view (obs/ledger.py): rollups over every job that ever
+// reached a terminal, durable across operator restarts and job GC.
+async function viewFleet(){
+  let s, h;
+  try{
+    s = await api('/api/fleet/summary');
+    h = await api('/api/fleet/hosts');
+  }catch(e){ return render(el('div',{class:'err'},
+    'fleet ledger unavailable: '+String(e.message||e))); }
+  const root = el('div');
+
+  const kv = el('div',{class:'kv'});
+  const phases = Object.entries(s.phases||{}).map(([p,n])=>p+': '+n).join('  ');
+  const pairs = [
+    ['Jobs folded', String(s.jobs||0) + '  (' + phases + ')'],
+    ['Failures', String(s.failures||0)],
+    ['Fleet MTBF', s.mtbf_s!==null && s.mtbf_s!==undefined ? s.mtbf_s.toFixed(1)+'s' : 'none observed'],
+    ['Goodput mean', (s.goodput_mean||0).toFixed(3)],
+  ];
+  if (s.compile_cache){
+    const c = s.compile_cache;
+    pairs.push(['Compile cache', 'hits '+(c.hits||0)+', misses '+(c.misses||0)
+      +', miss rate '+((c.miss_rate||0)*100).toFixed(1)+'%'
+      +', evictions '+(c.evictions||0)+', intents '+(c.intents||0)]);
+  }
+  for (const [k,v] of pairs){ kv.appendChild(el('b',null,k)); kv.appendChild(el('span',null,v)); }
+  const hist = Object.entries(s.goodput_hist||{})
+    .map(([b,n])=>b+': '+n).join('   ');
+  root.appendChild(el('div',{class:'card'}, el('h2',null,'Fleet'), kv,
+    el('div',{class:'muted',style:'margin-top:.4rem'},'goodput histogram  '+hist)));
+
+  const qtb = el('tbody');
+  for (const [q, v] of Object.entries(s.queues||{}))
+    qtb.appendChild(el('tr',null, el('td',null,q||'(default)'),
+      el('td',null,String(v.jobs)), el('td',null,String(v.failures)),
+      el('td',null, v.mtbf_s!==null && v.mtbf_s!==undefined ? v.mtbf_s.toFixed(1)+'s' : '-'),
+      el('td',null,(v.goodput_mean||0).toFixed(3)),
+      el('td',null,(v.save_stall_s||0).toFixed(3)+'s')));
+  root.appendChild(el('div',{class:'card'}, el('h2',null,'Queues'),
+    el('table',null, el('thead',null, el('tr',null,
+      ...['Queue','Jobs','Failures','MTBF','Goodput','Save stall'].map(x=>el('th',null,x)))), qtb)));
+
+  const ctb = el('tbody');
+  for (const [c, v] of Object.entries(s.causes||{}))
+    ctb.appendChild(el('tr',null, el('td',null,c),
+      el('td',null,String(v.incidents)), el('td',null,(v.lost_s||0).toFixed(1)+'s'),
+      el('td',null,(v.lost_p50_s||0).toFixed(1)+'s'),
+      el('td',null,(v.lost_p90_s||0).toFixed(1)+'s'),
+      el('td',null,(v.lost_p99_s||0).toFixed(1)+'s')));
+  root.appendChild(el('div',{class:'card'}, el('h2',null,'Downtime by cause'),
+    el('table',null, el('thead',null, el('tr',null,
+      ...['Cause','Incidents','Lost','p50','p90','p99'].map(x=>el('th',null,x)))), ctb)));
+
+  const htb = el('tbody');
+  for (const [host, v] of Object.entries(h.hosts||{}))
+    htb.appendChild(el('tr',null, el('td',{class:'mono'},host),
+      el('td',null,String(v.jobs)),
+      el('td',{class:v.incident_jobs? 'Failed':''},String(v.incident_jobs)),
+      el('td',null,String(v.failures)),
+      el('td',{class:'muted'},age(v.last_end_ts)+' ago')));
+  root.appendChild(el('div',{class:'card'}, el('h2',null,'Hosts'),
+    el('table',null, el('thead',null, el('tr',null,
+      ...['Host','Jobs','Incident jobs','Failures','Last seen'].map(x=>el('th',null,x)))), htb)));
+  render(root);
+}
+
 // ---- router ----------------------------------------------------------------
 function render(node){ $main.innerHTML=''; $main.appendChild(node); }
 function setNav(which){
@@ -529,6 +597,7 @@ async function route(){
     if (parts[0] === 'job' && parts.length >= 3){ setNav('jobs'); await viewJob(parts[1], parts.slice(2).join('/')); }
     else if (parts[0] === 'create'){ setNav('create'); viewCreate(); return; } // no auto-refresh while editing
     else if (parts[0] === 'events'){ setNav('events'); await viewEvents(); }
+    else if (parts[0] === 'fleet'){ setNav('fleet'); await viewFleet(); }
     else { setNav('jobs'); await viewJobs(); }
   }catch(e){ render(el('div',{class:'err'}, String(e.message||e))); }
   timer = setTimeout(route, 3000);

@@ -1,0 +1,508 @@
+"""FleetLedger: the durable, compacted, cross-job memory of outcomes.
+
+Every observability surface the operator has — spans, telemetry windows,
+goodput decompositions, postmortems, autopilot receipts — is scoped to
+one live job and evaporates at job GC. The ledger is the layer above: at
+every job terminal the reconciler folds a compact :class:`JobRecord`
+(terminal phase, per-cause lost-seconds, goodput ratio, TTFS, autopilot
+decisions with their justifying numbers, hosts touched) into an
+append-only file set that survives operator death, job GC, and even
+total store loss. It is the one thing the operator remembers.
+
+Durability reuses the exact ``runtime/persist.py`` WAL recipe — the
+idioms, not the files (the ledger has its own directory and lifecycle;
+store snapshots GC with the store, ledger records never do):
+
+- ``records-<start_seq>.jsonl``: one CRC32-checked JSON record appended
+  per fold, flushed per record. A torn final record of the final segment
+  is truncated on open; a bad checksum anywhere else is corruption and
+  refuses loudly (``PersistenceError``).
+- ``rollup-<seq>.json``: every ``snapshot_every`` folds the full record
+  set is written tmp+rename, the segment rotates, and superseded files
+  are deleted. Recovery = newest rollup + replay of the segment suffix
+  (records with seq > rollup seq) — byte-identical rollups before and
+  after an operator SIGKILL.
+
+Exactly-once folding is durable, not in-memory: ``fold()`` dedupes on
+job uid against the recovered record set, so an operator SIGKILLed
+between writing a job's terminal status and folding it simply folds on
+the next incarnation's sweep — and a SIGKILL *after* the fold cannot
+double-count, because the uid is already on disk.
+
+Deliberate non-goals (design.md §6.4): the ledger is not a metrics
+TSDB — it keeps one compact record per job, never raw telemetry, never
+per-step series; queries are whole-fleet rollups recomputed from the
+record set, not time-range scans.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import re
+import threading
+from dataclasses import asdict, dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from tf_operator_tpu.runtime.persist import (
+    PersistenceError,
+    _checksum,
+    _replay_segment,
+    _unlink_quiet,
+)
+
+log = logging.getLogger("tpujob.ledger")
+
+_ROLLUP_RE = re.compile(r"^rollup-(\d+)\.json$")
+_RECORDS_RE = re.compile(r"^records-(\d+)\.jsonl$")
+
+DEFAULT_ROLLUP_EVERY = 256
+
+# Goodput-ratio histogram bucket edges (upper-inclusive last bucket).
+_GOODPUT_EDGES = (0.2, 0.4, 0.6, 0.8, 1.0)
+
+# Host-reputation defaults: "a host that ate three jobs last hour
+# starts flagged for the next one".
+REPUTATION_WINDOW_S = 3600.0
+REPUTATION_THRESHOLD = 3
+
+
+@dataclass
+class JobRecord:
+    """One job's terminal outcome, compact enough to keep forever."""
+
+    uid: str = ""
+    namespace: str = ""
+    name: str = ""
+    queue: str = ""
+    priority_class: str = ""
+    job_class: str = ""
+    phase: str = ""  # terminal phase: Succeeded | Failed
+    submit_ts: float = 0.0
+    end_ts: float = 0.0
+    wall_s: float = 0.0  # submit -> terminal (the MTBF numerator)
+    restarts: int = 0
+    preemptions: int = 0
+    hangs: int = 0
+    resizes: int = 0
+    last_restart_cause: str = ""
+    lost_s: Dict[str, float] = field(default_factory=dict)  # per-cause ledger
+    goodput_ratio: float = 0.0
+    ttfs_s: float = 0.0  # time to first step (0 = never stepped)
+    ttfs_kind: str = ""  # "cold" | "warm" | ""
+    save_stall_s: float = 0.0  # mean measured stall per accepted save
+    saves: int = 0  # save-stall spans backing save_stall_s
+    step_time_s: float = 0.0  # last cross-rank median step time
+    autopilot_decisions: int = 0  # executed decisions, total
+    decisions: List[Dict[str, str]] = field(default_factory=list)  # receipts
+    hosts: List[str] = field(default_factory=list)  # hosts touched
+
+    def failures(self) -> int:
+        """The MTBF denominator, same accounting as _autopilot_inputs."""
+        return self.restarts + self.preemptions + self.hangs
+
+
+def _failures(rec: Dict[str, Any]) -> int:
+    return (
+        int(rec.get("restarts", 0))
+        + int(rec.get("preemptions", 0))
+        + int(rec.get("hangs", 0))
+    )
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile (the pinned, hand-computable rule:
+    value at index ceil(q*n)-1 of the sorted list)."""
+    if not sorted_vals:
+        return 0.0
+    idx = max(0, math.ceil(q * len(sorted_vals)) - 1)
+    return sorted_vals[min(idx, len(sorted_vals) - 1)]
+
+
+def _r(x: float) -> float:
+    """Round for rollup display: keeps summaries deterministic and the
+    byte-identical acceptance check independent of float formatting."""
+    return round(float(x), 6)
+
+
+class FleetLedger:
+    """Append-only job-outcome ledger with compacted rollups.
+
+    Thread-safe; ``fold`` is called from the reconciler's sync path and
+    the HTTP handlers read rollups concurrently.
+    """
+
+    def __init__(
+        self,
+        data_dir: str,
+        snapshot_every: int = DEFAULT_ROLLUP_EVERY,
+        fsync: bool = False,
+    ) -> None:
+        self.data_dir = os.path.abspath(data_dir)
+        self.snapshot_every = max(1, int(snapshot_every))
+        self.fsync = bool(fsync)
+        os.makedirs(self.data_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._records: List[Dict[str, Any]] = []  # seq order
+        self._by_uid: Dict[str, Dict[str, Any]] = {}
+        self._seq = 0
+        self._since_rollup = 0
+        # Optional provider (cli/operator wires cachesvc.snapshot) whose
+        # hit/miss counters fold into summary()["compile_cache"].
+        self.cachesvc_stats: Optional[Callable[[], Dict[str, Any]]] = None
+        self._recover()
+        self._segment_path = os.path.join(
+            self.data_dir, f"records-{self._seq + 1}.jsonl"
+        )
+        self._wal = open(self._segment_path, "ab")
+
+    # -- recovery ----------------------------------------------------------
+
+    def _recover(self) -> None:
+        rollup_seq, rollup_records = self._load_rollup()
+        for rec in rollup_records:
+            self._admit(rec)
+        segments = []
+        for name in os.listdir(self.data_dir):
+            m = _RECORDS_RE.match(name)
+            if m:
+                segments.append((int(m.group(1)), os.path.join(self.data_dir, name)))
+        segments.sort()
+        replayed = 0
+        for i, (_, path) in enumerate(segments):
+            records, _truncated = _replay_segment(path, i == len(segments) - 1)
+            for rec in records:
+                if int(rec.get("seq", 0)) <= rollup_seq:
+                    continue  # already folded into the rollup
+                self._admit(rec)
+                replayed += 1
+        if self._records:
+            log.info(
+                "fleet ledger at %s: %d records (rollup seq %d + %d replayed)",
+                self.data_dir, len(self._records), rollup_seq, replayed,
+            )
+
+    def _load_rollup(self) -> "tuple[int, List[Dict[str, Any]]]":
+        best_seq, best_path = 0, None
+        for name in os.listdir(self.data_dir):
+            m = _ROLLUP_RE.match(name)
+            if m and int(m.group(1)) > best_seq:
+                best_seq = int(m.group(1))
+                best_path = os.path.join(self.data_dir, name)
+        if best_path is None:
+            return 0, []
+        try:
+            with open(best_path) as f:
+                body = json.load(f)
+        except (OSError, ValueError) as exc:
+            raise PersistenceError(
+                f"ledger rollup {best_path} unreadable: {exc}"
+            ) from exc
+        crc = body.get("crc")
+        if crc is not None and crc != _checksum(body):
+            raise PersistenceError(
+                f"ledger rollup {best_path} failed its checksum"
+            )
+        return int(body["seq"]), list(body.get("records", []))
+
+    def _admit(self, rec: Dict[str, Any]) -> None:
+        """Index one recovered/folded record (lock held or init)."""
+        uid = rec.get("uid", "")
+        if uid and uid in self._by_uid:
+            return  # duplicate uid in damaged-but-recoverable state: keep first
+        self._records.append(rec)
+        if uid:
+            self._by_uid[uid] = rec
+        self._seq = max(self._seq, int(rec.get("seq", 0)))
+
+    # -- write path --------------------------------------------------------
+
+    def fold(self, record: Any) -> bool:
+        """Fold one terminal job into the ledger. Exactly-once on uid:
+        returns False (and writes nothing) when the uid is already
+        recorded — durable across operator death, because the dedupe set
+        IS the recovered record set."""
+        rec = asdict(record) if isinstance(record, JobRecord) else dict(record)
+        uid = rec.get("uid", "")
+        with self._lock:
+            if uid and uid in self._by_uid:
+                return False
+            self._seq += 1
+            rec["seq"] = self._seq
+            rec.pop("crc", None)
+            rec["crc"] = _checksum(rec)
+            self._wal.write(json.dumps(rec, sort_keys=True).encode() + b"\n")
+            self._wal.flush()
+            if self.fsync:
+                os.fsync(self._wal.fileno())
+            self._records.append(rec)
+            if uid:
+                self._by_uid[uid] = rec
+            self._since_rollup += 1
+            if self._since_rollup >= self.snapshot_every:
+                self._rollup()
+        return True
+
+    def _rollup(self) -> None:
+        """Compact: full record set tmp+renamed, segment rotated,
+        superseded files GC'd (lock held)."""
+        seq = self._seq
+        body: Dict[str, Any] = {"seq": seq, "records": self._records}
+        body["crc"] = _checksum(body)
+        final = os.path.join(self.data_dir, f"rollup-{seq}.json")
+        tmp = final + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(body, f, sort_keys=True)
+            f.flush()
+            if self.fsync:
+                os.fsync(f.fileno())
+        os.rename(tmp, final)
+        self._wal.close()
+        self._segment_path = os.path.join(
+            self.data_dir, f"records-{seq + 1}.jsonl"
+        )
+        self._wal = open(self._segment_path, "ab")
+        if self.fsync:
+            fd = os.open(self.data_dir, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        self._since_rollup = 0
+        for name in os.listdir(self.data_dir):
+            path = os.path.join(self.data_dir, name)
+            if path == self._segment_path:
+                continue
+            m = _ROLLUP_RE.match(name) or _RECORDS_RE.match(name)
+            if m and int(m.group(1)) <= seq and name != f"rollup-{seq}.json":
+                _unlink_quiet(path)
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._wal.flush()
+                if self.fsync:
+                    os.fsync(self._wal.fileno())
+            finally:
+                self._wal.close()
+
+    # -- read path ---------------------------------------------------------
+
+    def has(self, uid: str) -> bool:
+        with self._lock:
+            return uid in self._by_uid
+
+    def get(self, uid: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            rec = self._by_uid.get(uid)
+            return dict(rec) if rec else None
+
+    def records(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(r) for r in self._records]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def summary(self) -> Dict[str, Any]:
+        """The fleet rollup: per-queue MTBF/goodput, per-cause downtime
+        percentiles and incident counts, a goodput histogram. Computed
+        purely from the record set with pinned rounding, so the JSON
+        serialization (sort_keys) is byte-identical across recovery."""
+        with self._lock:
+            recs = list(self._records)
+        out: Dict[str, Any] = {
+            "jobs": len(recs),
+            "seq": self._seq,
+            "phases": {},
+            "failures": 0,
+            "wall_s": 0.0,
+            "mtbf_s": None,
+            "queues": {},
+            "causes": {},
+            "goodput_hist": {},
+            "goodput_mean": None,
+        }
+        if not recs:
+            if self.cachesvc_stats is not None:
+                out["compile_cache"] = self._compile_cache()
+            return out
+        total_wall = 0.0
+        total_failures = 0
+        ratios: List[float] = []
+        queues: Dict[str, Dict[str, Any]] = {}
+        causes: Dict[str, Dict[str, Any]] = {}
+        cause_losses: Dict[str, List[float]] = {}
+        hist: Dict[str, int] = {}
+        lo = 0.0
+        for hi in _GOODPUT_EDGES:
+            hist[f"{lo:.1f}-{hi:.1f}"] = 0
+            lo = hi
+        for rec in recs:
+            phase = rec.get("phase", "") or "?"
+            out["phases"][phase] = out["phases"].get(phase, 0) + 1
+            wall = float(rec.get("wall_s", 0.0))
+            fails = _failures(rec)
+            total_wall += wall
+            total_failures += fails
+            ratio = float(rec.get("goodput_ratio", 0.0))
+            ratios.append(ratio)
+            lo = 0.0
+            for hi in _GOODPUT_EDGES:
+                if ratio <= hi or hi == _GOODPUT_EDGES[-1]:
+                    hist[f"{lo:.1f}-{hi:.1f}"] += 1
+                    break
+                lo = hi
+            q = queues.setdefault(rec.get("queue", ""), {
+                "jobs": 0, "failures": 0, "wall_s": 0.0,
+                "goodput_sum": 0.0, "saves": 0, "stall_weighted": 0.0,
+            })
+            q["jobs"] += 1
+            q["failures"] += fails
+            q["wall_s"] += wall
+            q["goodput_sum"] += ratio
+            saves = int(rec.get("saves", 0))
+            q["saves"] += saves
+            q["stall_weighted"] += float(rec.get("save_stall_s", 0.0)) * saves
+            for cause, lost in sorted((rec.get("lost_s") or {}).items()):
+                c = causes.setdefault(cause, {"incidents": 0, "lost_s": 0.0})
+                c["incidents"] += 1
+                c["lost_s"] += float(lost)
+                cause_losses.setdefault(cause, []).append(float(lost))
+        out["failures"] = total_failures
+        out["wall_s"] = _r(total_wall)
+        out["mtbf_s"] = (
+            _r(total_wall / total_failures) if total_failures > 0 else None
+        )
+        out["goodput_mean"] = _r(sum(ratios) / len(ratios))
+        out["goodput_hist"] = hist
+        for name in sorted(queues):
+            q = queues[name]
+            out["queues"][name] = {
+                "jobs": q["jobs"],
+                "failures": q["failures"],
+                "wall_s": _r(q["wall_s"]),
+                "mtbf_s": (
+                    _r(q["wall_s"] / q["failures"]) if q["failures"] else None
+                ),
+                "goodput_mean": _r(q["goodput_sum"] / q["jobs"]),
+                "save_stall_s": (
+                    _r(q["stall_weighted"] / q["saves"]) if q["saves"] else 0.0
+                ),
+            }
+        for cause in sorted(causes):
+            vals = sorted(cause_losses[cause])
+            out["causes"][cause] = {
+                "incidents": causes[cause]["incidents"],
+                "lost_s": _r(causes[cause]["lost_s"]),
+                "lost_p50_s": _r(_percentile(vals, 0.5)),
+                "lost_p90_s": _r(_percentile(vals, 0.9)),
+                "lost_p99_s": _r(_percentile(vals, 0.99)),
+            }
+        if self.cachesvc_stats is not None:
+            out["compile_cache"] = self._compile_cache()
+        return out
+
+    def _compile_cache(self) -> Dict[str, Any]:
+        try:
+            stats = self.cachesvc_stats() or {}
+        except Exception:  # provider is best-effort observability
+            return {}
+        hits = int(stats.get("hits", 0))
+        misses = int(stats.get("misses", 0))
+        total = hits + misses
+        return {
+            "hits": hits,
+            "misses": misses,
+            "evictions": int(stats.get("evictions", 0)),
+            "intents": int(stats.get("intents", 0)),
+            "miss_rate": _r(misses / total) if total else None,
+        }
+
+    def hosts(self) -> Dict[str, Dict[str, Any]]:
+        """Per-host ledger view: jobs touched, jobs with incidents
+        (restart/preemption/hang), last terminal seen."""
+        with self._lock:
+            recs = list(self._records)
+        out: Dict[str, Dict[str, Any]] = {}
+        for rec in recs:
+            fails = _failures(rec)
+            for host in rec.get("hosts") or []:
+                h = out.setdefault(host, {
+                    "jobs": 0, "incident_jobs": 0, "failures": 0,
+                    "last_end_ts": 0.0,
+                })
+                h["jobs"] += 1
+                h["failures"] += fails
+                if fails > 0:
+                    h["incident_jobs"] += 1
+                h["last_end_ts"] = max(
+                    h["last_end_ts"], _r(float(rec.get("end_ts", 0.0)))
+                )
+        return {k: out[k] for k in sorted(out)}
+
+    def host_reputation(
+        self,
+        now: float,
+        window_s: float = REPUTATION_WINDOW_S,
+        threshold: int = REPUTATION_THRESHOLD,
+    ) -> Dict[str, int]:
+        """Hosts that ate >= ``threshold`` incident jobs within the last
+        ``window_s`` seconds -> recent incident-job count. The reconciler
+        feeds these into the scheduler's soft-deprioritized set so the
+        next job starts flagged."""
+        with self._lock:
+            recs = list(self._records)
+        cutoff = now - window_s
+        counts: Dict[str, int] = {}
+        for rec in recs:
+            if _failures(rec) <= 0:
+                continue
+            if float(rec.get("end_ts", 0.0)) < cutoff:
+                continue
+            for host in rec.get("hosts") or []:
+                counts[host] = counts.get(host, 0) + 1
+        return {
+            h: n for h, n in sorted(counts.items()) if n >= max(1, threshold)
+        }
+
+    def cadence_inputs(
+        self, queue: str = "", job_class: str = ""
+    ) -> Dict[str, Any]:
+        """Aggregated prior inputs for one (queue, job_class) cohort.
+
+        Exact-cohort match first; an empty cohort falls back to the
+        whole fleet (a fresh queue still benefits from fleet-wide
+        history). Returns {} when the ledger is empty."""
+        with self._lock:
+            recs = list(self._records)
+        if not recs:
+            return {}
+        cohort = [
+            r for r in recs
+            if r.get("queue", "") == queue
+            and r.get("job_class", "") == job_class
+        ]
+        if not cohort:
+            cohort = recs
+        total_wall = sum(float(r.get("wall_s", 0.0)) for r in cohort)
+        total_failures = sum(_failures(r) for r in cohort)
+        total_saves = sum(int(r.get("saves", 0)) for r in cohort)
+        stall_weighted = sum(
+            float(r.get("save_stall_s", 0.0)) * int(r.get("saves", 0))
+            for r in cohort
+        )
+        return {
+            "jobs": len(cohort),
+            "failures": total_failures,
+            "wall_s": _r(total_wall),
+            "mtbf_s": (
+                _r(total_wall / total_failures) if total_failures > 0 else None
+            ),
+            "save_stall_s": (
+                _r(stall_weighted / total_saves) if total_saves > 0 else 0.0
+            ),
+        }

@@ -1,0 +1,103 @@
+"""Fleet priors: turning ledger history into a fresh job's first MTBF.
+
+A brand-new job has zero failures, so ``_tick_cadence`` computes
+``mtbf = inf`` and Young/Daly clamps the first cadence decision to
+``max_checkpoint_every`` — the clamp edge. But the fleet has watched
+dozens of jobs die on this queue; their aggregated MTBF is a far better
+opening estimate than "this job is immortal". This module is the pinned,
+hand-computable blend rule that injects that history WITHOUT letting it
+drown the job's own measurements once they exist.
+
+The rule is Bayesian-style shrinkage phrased in failure-count units so
+every number in the receipt is auditable by hand:
+
+- The prior contributes ``n_eff = min(prior_failures, PRIOR_CAP)``
+  pseudo-failures worth of evidence, each "lasting" the prior MTBF:
+  ``t_eff = n_eff * prior_mtbf_s``.
+- The blended MTBF is total time over total failures::
+
+      mtbf = (t_eff + own_elapsed_s) / (n_eff + own_failures)
+
+- The blend weight — how much of the failure evidence is the fleet's —
+  is ``n_eff / (n_eff + own_failures)``.
+
+Worked example (the one in docs/design.md §6.4 and test_ledger.py): a
+prior of MTBF 100s from 4 fleet failures, a job 50s old with 1 failure
+of its own: ``mtbf = (4*100 + 50) / (4 + 1) = 90s``, weight ``0.8``.
+
+Properties the tests pin:
+
+- ``own_failures == 0`` ⇒ the blended MTBF is FINITE (the fresh job
+  escapes the clamp edge) and the weight is 1.0.
+- As own failures accumulate the weight decays toward 0 and the blend
+  approaches the job's own ``elapsed/failures`` — the prior yields.
+- ``PRIOR_CAP`` bounds the prior's inertia: a thousand historical
+  failures still only argue with the strength of ``PRIOR_CAP`` of them,
+  so a handful of own-job failures can move the estimate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+# Max pseudo-failures the fleet prior may claim. 8 keeps the prior
+# decisive for a zero-failure fresh job while letting ~8 own-job
+# failures reduce it to a coin flip (weight 0.5).
+PRIOR_CAP = 8.0
+
+
+@dataclass
+class CadencePrior:
+    """Aggregated fleet history for one (queue, workload class) cohort."""
+
+    mtbf_s: float = 0.0  # aggregate fleet MTBF (total wall / total failures)
+    save_stall_s: float = 0.0  # saves-weighted mean measured save stall
+    failures: int = 0  # raw fleet failure count backing mtbf_s
+    jobs: int = 0  # records aggregated
+
+
+def cadence_prior(
+    ledger: Any, queue: str = "", workload_class: str = ""
+) -> Optional[CadencePrior]:
+    """The MTBF prior for a fresh job on ``(queue, workload_class)``.
+
+    ``ledger`` is anything with ``cadence_inputs(queue, job_class)`` —
+    a FleetLedger. Returns None when the fleet has no finite-MTBF
+    history for the cohort (zero failures observed ⇒ no prior: an empty
+    fleet must not invent one, and the caller falls back to the plain
+    own-data path).
+    """
+    if ledger is None:
+        return None
+    agg = ledger.cadence_inputs(queue, workload_class)
+    if not agg:
+        return None
+    mtbf = agg.get("mtbf_s")
+    failures = int(agg.get("failures", 0))
+    if not mtbf or mtbf <= 0 or failures <= 0:
+        return None
+    return CadencePrior(
+        mtbf_s=float(mtbf),
+        save_stall_s=float(agg.get("save_stall_s", 0.0)),
+        failures=failures,
+        jobs=int(agg.get("jobs", 0)),
+    )
+
+
+def blend_mtbf(
+    prior: CadencePrior, own_elapsed_s: float, own_failures: int
+) -> Tuple[float, float]:
+    """(blended MTBF seconds, prior blend weight in [0, 1]).
+
+    The weight is the fraction of failure evidence contributed by the
+    fleet — it is what the decision span receipts as ``prior_weight``.
+    """
+    n_eff = min(float(prior.failures), PRIOR_CAP)
+    t_eff = n_eff * prior.mtbf_s
+    denom = n_eff + float(own_failures)
+    if denom <= 0:  # unreachable given cadence_prior's failures > 0 gate
+        return prior.mtbf_s, 1.0
+    mtbf = (t_eff + max(0.0, float(own_elapsed_s))) / denom
+    weight = n_eff / denom
+    return mtbf, weight

@@ -46,6 +46,8 @@ import zlib
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
+from tf_operator_tpu.api.types import KIND_TELEMETRY
+
 log = logging.getLogger("tpujob.persist")
 
 _SNAPSHOT_RE = re.compile(r"^snapshot-(\d+)\.json$")
@@ -104,13 +106,23 @@ class StorePersister:
         snapshot_every: int = DEFAULT_SNAPSHOT_EVERY,
         fsync: bool = False,
         segment_start: int = 1,
+        persist_telemetry: bool = False,
     ) -> None:
         self.data_dir = os.path.abspath(data_dir)
         self.snapshot_every = max(1, int(snapshot_every))
         self.fsync = bool(fsync)
+        # Telemetry ring slots are overwrite-churn, not state: every rank
+        # rewrites its slot each window, so logging them as full mutations
+        # makes the WAL grow with step count instead of object count.
+        # Default False skips them (and filters them from snapshots) —
+        # after a restart the rings simply refill from live reporters.
+        self.persist_telemetry = bool(persist_telemetry)
         os.makedirs(self.data_dir, exist_ok=True)
         self._store: Any = None
         self._since_snapshot = 0
+        # Per-kind WAL accounting (tpujob_wal_{records,bytes}_total{kind}
+        # + the skipped columns): {"kind": {"records", "bytes", "skipped"}}.
+        self._stats: Dict[str, Dict[str, int]] = {}
         self._segment_path = os.path.join(
             self.data_dir, f"wal-{segment_start}.jsonl"
         )
@@ -126,6 +138,16 @@ class StorePersister:
     def append(self, op: str, obj: Any, rv: int) -> None:
         from tf_operator_tpu.runtime.serialize import to_doc
 
+        stats = self._stats.setdefault(
+            obj.kind, {"records": 0, "bytes": 0, "skipped": 0}
+        )
+        stats["records"] += 1
+        if not self.persist_telemetry and obj.kind == KIND_TELEMETRY:
+            # No write, no snapshot-counter bump: a skipped record leaves
+            # an rv gap, which recovery tolerates (replay applies records
+            # by rv order; no surviving object ever carries a skipped rv).
+            stats["skipped"] += 1
+            return
         meta = obj.metadata
         record: Dict[str, Any] = {
             "rv": rv,
@@ -136,7 +158,9 @@ class StorePersister:
             "obj": None if op == OP_DELETE else to_doc(obj),
         }
         record["crc"] = _checksum(record)
-        self._wal.write(json.dumps(record, sort_keys=True).encode() + b"\n")
+        line = json.dumps(record, sort_keys=True).encode() + b"\n"
+        self._wal.write(line)
+        stats["bytes"] += len(line)
         self._wal.flush()
         if self.fsync:
             os.fsync(self._wal.fileno())
@@ -144,13 +168,23 @@ class StorePersister:
         if self._since_snapshot >= self.snapshot_every:
             self._snapshot(rv)
 
+    def wal_stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-kind append accounting: {"kind": {"records": calls,
+        "bytes": bytes actually written, "skipped": records elided by
+        the telemetry-coalescing default}}."""
+        return {k: dict(v) for k, v in self._stats.items()}
+
     def _snapshot(self, rv: int) -> None:
         """Write the full object set at ``rv`` (atomic tmp+rename), rotate
         the WAL, and GC segments/snapshots the new snapshot supersedes."""
         from tf_operator_tpu.runtime.serialize import to_doc
 
         assert self._store is not None, "persister not bound to a store"
-        docs = [to_doc(o) for o in self._store._objects.values()]
+        docs = [
+            to_doc(o)
+            for o in self._store._objects.values()
+            if self.persist_telemetry or o.kind != KIND_TELEMETRY
+        ]
         body = {"rv": rv, "objects": docs}
         body["crc"] = _checksum(body)
         final = os.path.join(self.data_dir, f"snapshot-{rv}.json")
@@ -332,6 +366,7 @@ def open_store(
     snapshot_every: int = DEFAULT_SNAPSHOT_EVERY,
     fsync: bool = False,
     indexed_labels=None,
+    persist_telemetry: bool = False,
 ):
     """The one entry point: recover (or initialize) durable state under
     ``data_dir`` and return ``(Store, RecoveryInfo)`` with persistence
@@ -353,6 +388,7 @@ def open_store(
         snapshot_every=snapshot_every,
         fsync=fsync,
         segment_start=info.resource_version + 1,
+        persist_telemetry=persist_telemetry,
     )
     store.attach_persister(persister)
     log.info(

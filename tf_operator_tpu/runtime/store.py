@@ -419,6 +419,15 @@ class Store:
             out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
             return out
 
+    def wal_stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-kind WAL append accounting from the attached persister
+        (tpujob_wal_{records,bytes}_total{kind}); {} when the store is
+        in-memory only."""
+        with self._lock:
+            if self._persister is None:
+                return {}
+            return self._persister.wal_stats()
+
     def list_stats(self) -> Dict[str, int]:
         """Cumulative list-cost counters: calls, candidates scanned,
         objects returned. scanned ≈ returned is the index working;
