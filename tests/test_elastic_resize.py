@@ -293,3 +293,202 @@ def test_untagged_legacy_depot_steps_still_restorable(tmp_path):
         assert (url, step) == (depot.url, 5)
     finally:
         depot.stop()
+
+# ---- resize x preemption composition (r19) ------------------------------
+
+
+from tf_operator_tpu.controller.reconciler import (  # noqa: E402
+    ANNOTATION_PREEMPT,
+    ANNOTATION_RECLAIM,
+    CAUSE_OVERSPEC_RECLAIM,
+    RESIZE_HISTORY_KEEP,
+)
+
+
+def test_preempt_annotation_mid_shrink_is_deferred():
+    # The shrink directive has NO boundary published yet (mid-barrier):
+    # a preemption landing now must wait — draining the gang mid-re-carve
+    # would tear down members holding un-redealt positions.
+    job, procs = shrunk_job(workers=3)
+    procs[1].status.phase = ProcessPhase.PENDING  # keeps the regrow off too
+    job.metadata.annotations[ANNOTATION_PREEMPT] = "quota"
+    h = Harness(job, procs)
+    h.sync()
+    st = h.stored_job().status
+    assert h.fake.deleted == []
+    assert st.preemption_count == 0 and st.restart_count == 0
+    assert st.resize_directive["direction"] == "shrink"
+    # the annotation survives store-side so a later sync retries the drain
+    assert h.stored_job().metadata.annotations.get(ANNOTATION_PREEMPT)
+
+
+def test_deferred_preempt_drains_after_resize_boundary():
+    # Same shrink, but the workload published the barrier: the deferred
+    # preemption now drains the WHOLE live gang as one window.
+    job, procs = shrunk_job(workers=3)
+    job.status.resize_directive["boundary_remaining"] = 12
+    job.metadata.annotations[ANNOTATION_PREEMPT] = "quota"
+    h = Harness(job, procs)
+    h.sync()
+    st = h.stored_job().status
+    assert st.preemption_count == 1 and st.restart_count == 0
+    assert sorted(h.fake.deleted) == [
+        "default/trainer-coordinator-0",
+        "default/trainer-worker-0",
+        "default/trainer-worker-1",
+    ]
+
+
+def test_member_failure_with_pending_preempt_prefers_drain():
+    # A member dies in the same sync the preempt annotation is present:
+    # the drain wins (the gang is moving anyway) — shrinking first would
+    # resize a gang that is about to be torn down.
+    job = elastic_job(workers=3)
+    job.metadata.annotations[ANNOTATION_PREEMPT] = "quota"
+    h = Harness(job, seeded(job, failed_worker=2))
+    h.sync()
+    st = h.stored_job().status
+    assert st.resize_count == 0
+    assert st.preemption_count == 1 and st.restart_count == 0
+
+
+def test_shrink_refused_while_draining():
+    # begin_preempt marked the job draining; a member failure must NOT
+    # publish a shrink directive — the whole gang is on its way out.
+    job = elastic_job(workers=3)
+    h = Harness(job, seeded(job, failed_worker=2))
+    h.ctl.fleet.ensure_synced()
+    h.ctl.fleet.begin_preempt(job.key())
+    h.sync()
+    st = h.stored_job().status
+    assert st.resize_count == 0
+    assert not st.resize_directive
+
+
+def test_regrow_refused_while_draining():
+    # A shrunk gang under a preemption drain must not re-grow: admission
+    # is parked for draining jobs, and the directive must stay put.
+    job, procs = shrunk_job(workers=3)
+    h = Harness(job, procs)
+    h.ctl.fleet.ensure_synced()
+    h.ctl.fleet.begin_preempt(job.key())
+    h.sync()
+    assert not h.fake.created
+    st = h.stored_job().status
+    assert st.resize_epoch == 1
+    assert st.resize_directive["direction"] == "shrink"
+
+
+# ---- grow-beyond-spec (r19) ---------------------------------------------
+
+
+def grow_ready_job(workers=3, max_world=6):
+    job = elastic_job(workers=workers)
+    job.spec.scheduling.elastic_max_world = max_world
+    return job
+
+
+def test_grow_beyond_spec_creates_overspec_tail():
+    job = grow_ready_job(workers=3, max_world=6)
+    h = Harness(job, seeded(job))
+    h.sync()
+    created = {p.metadata.name: p for p in h.fake.created}
+    assert set(created) == {"trainer-worker-3", "trainer-worker-4"}
+    # over-spec members join through the same grow-epoch directive wait
+    for p in created.values():
+        assert p.spec.env[ENV_RESIZE_EPOCH] == "1"
+    st = h.stored_job().status
+    assert st.overspec_workers == 2
+    assert st.world_size == 6
+    assert st.restart_count == 0 and st.resize_count == 1
+    d = st.resize_directive
+    assert d["direction"] == "grow" and len(d["members"]) == 6
+    assert st.resize_history[-1]["cause"] == "grow-beyond-spec"
+    # the loan is charged to the queue: 2 members x 4 chips each
+    assert h.ctl.fleet.overspec_chips(job.key()) == 8
+
+
+def test_grow_beyond_spec_waits_for_running_gang():
+    job = grow_ready_job(workers=3, max_world=6)
+    h = Harness(job, seeded(job, phases={1: ProcessPhase.PENDING}))
+    h.sync()
+    assert not h.fake.created
+    assert h.stored_job().status.overspec_workers == 0
+
+
+def test_grow_beyond_spec_refused_mid_resize_barrier():
+    job = grow_ready_job(workers=3, max_world=6)
+    job.status.resize_epoch = 2
+    job.status.resize_directive = {
+        # no boundary_remaining: the workload barrier is still open
+        "epoch": 2, "direction": "grow", "world_size": 4,
+        "members": ["trainer-coordinator-0"]
+        + [f"trainer-worker-{i}" for i in range(3)],
+        "time": 0.0,
+    }
+    h = Harness(job, seeded(job))
+    h.sync()
+    assert not h.fake.created
+    assert h.stored_job().status.overspec_workers == 0
+
+
+def test_overspec_reclaim_is_two_phase():
+    job = grow_ready_job(workers=2, max_world=4)
+    h = Harness(job, seeded(job))
+    h.sync()  # grows beyond spec: worker-2 created, loan charged
+    key = job.key()
+    assert {p.metadata.name for p in h.fake.created} == {"trainer-worker-2"}
+    assert h.stored_job().status.overspec_workers == 1
+    assert h.ctl.fleet.overspec_chips(key) == 4
+    h.ctl.expectations.creation_observed(h.ctl._exp_key(key))
+
+    # the over-spec member comes up and the workload publishes the
+    # barrier; quota pressure stamps the reclaim annotation
+    w2 = make_process(job, ReplicaType.WORKER, 2, ProcessPhase.RUNNING)
+    h.store.create(w2)
+    stored = h.stored_job()
+    stored.status.resize_directive["boundary_remaining"] = 0
+    stored.metadata.annotations[ANNOTATION_RECLAIM] = "quota-pressure"
+    h.store.update(stored)
+    h.ctl.process_informer.seed(h.store.list("Process"))
+    h.ctl.job_informer.seed([h.stored_job()])
+    h.sync()  # reclaim deferred: the grow's resize span is still open
+    h.ctl.job_informer.seed([h.stored_job()])
+    h.sync()  # span closed at gang-running: the reclaim shrink publishes
+    st = h.stored_job().status
+    d = st.resize_directive
+    assert d["direction"] == "shrink" and d.get("reclaim") is True
+    assert d["world_size"] == 3 and "trainer-worker-2" not in d["members"]
+    assert st.resize_history[-1]["cause"] == CAUSE_OVERSPEC_RECLAIM
+    assert "default/trainer-worker-2" in h.fake.deleted
+    assert st.restart_count == 0 and st.preemption_count == 0
+    # phase one holds the loan until the member is observably gone
+    assert st.overspec_workers == 1
+    assert h.ctl.fleet.overspec_chips(key) == 4
+
+    # phase two: the tail member vanishes from the store
+    h.store.delete("Process", w2.metadata.namespace, w2.metadata.name)
+    h.ctl.process_informer._cache.clear()
+    h.ctl.process_informer.seed(h.store.list("Process"))
+    exp = h.ctl._exp_key(key)
+    h.ctl.expectations.deletion_observed(exp)
+    h.ctl.job_informer.seed([h.stored_job()])
+    h.sync()
+    st = h.stored_job().status
+    assert st.overspec_workers == 0
+    assert h.ctl.fleet.overspec_chips(key) == 0
+
+
+def test_resize_history_is_bounded_with_folded_count():
+    job = elastic_job(workers=2)
+    h = Harness(job)
+    stored = h.stored_job()
+    for e in range(40):
+        h.ctl._append_resize_history(stored, {
+            "epoch": e, "direction": "grow", "world_size": 3,
+            "cause": "test", "time": 0.0,
+        })
+    assert len(stored.status.resize_history) == RESIZE_HISTORY_KEEP == 32
+    assert stored.status.resize_history_folded == 8
+    # oldest surviving entry is the first NOT folded away
+    assert stored.status.resize_history[0]["epoch"] == 8

@@ -1,0 +1,172 @@
+"""Device-state re-shard (r19, train/reshard.py) — the row store's
+atomic durability, the rebuild's source order (re-layout vs re-fetch vs
+init) with its authoritative-row receipt, replay idempotence from the
+init base, and bit-identity of the live update path vs the
+uninterrupted-run reference."""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from tf_operator_tpu.train import reshard as R
+
+DIM = R.PARAM_DIM
+SEED = 5
+
+
+@pytest.fixture(scope="module")
+def sharding():
+    return R.replicated_sharding(R.local_mesh())
+
+
+@pytest.fixture(scope="module")
+def row_update():
+    return R.make_row_update()
+
+
+def consume(row_update, seed, p, w):
+    """One live consume of position ``p`` with window ``w`` — always from
+    the deterministic init base (replay idempotence by construction)."""
+    import jax.numpy as jnp
+
+    row, mom = row_update(
+        jnp.asarray(R.init_row(seed, p, DIM)),
+        jnp.zeros((), jnp.float32),
+        jnp.asarray(float(w), jnp.float32),
+    )
+    return np.asarray(row), float(np.asarray(mom))
+
+
+# ---- row store ----------------------------------------------------------
+
+
+def test_write_row_roundtrips_params_and_momentum(tmp_path):
+    sdir = str(tmp_path)
+    row = R.init_row(SEED, 3, DIM)
+    R.write_row(sdir, 3, row, 0.25)
+    got = R.read_row(sdir, 3, DIM)
+    assert got is not None
+    np.testing.assert_array_equal(got[0], row)
+    assert got[1] == 0.25
+
+
+def test_read_row_absent_or_wrong_shape_returns_none(tmp_path):
+    sdir = str(tmp_path)
+    assert R.read_row(sdir, 0, DIM) is None
+    # a row written at a different dim must be refused, not misread
+    R.write_row(sdir, 1, np.zeros(DIM + 2, np.float32), 0.0)
+    assert R.read_row(sdir, 1, DIM) is None
+
+
+def test_write_row_overwrite_is_atomic_no_tmp_leftovers(tmp_path):
+    sdir = str(tmp_path)
+    R.write_row(sdir, 0, np.zeros(DIM, np.float32), 0.0)
+    R.write_row(sdir, 0, np.ones(DIM, np.float32), 1.0)
+    got = R.read_row(sdir, 0, DIM)
+    np.testing.assert_array_equal(got[0], np.ones(DIM, np.float32))
+    # tmp-then-rename leaves no torn intermediates behind
+    assert [f for f in os.listdir(sdir) if ".tmp-" in f] == []
+
+
+# ---- rebuild source order + the plan receipt ----------------------------
+
+
+def test_rebuild_sources_relaid_refetched_inited(tmp_path, sharding,
+                                                 row_update):
+    total, sdir = 6, str(tmp_path)
+    # This member consumed rows 0-1 (device fresh); some OTHER member
+    # consumed rows 2-3 (store only); rows 4-5 untouched.
+    host = np.stack([R.init_row(SEED, p, DIM) for p in range(total)])
+    mom = np.zeros((total,), np.float32)
+    for p in (0, 1):
+        host[p], mom[p] = consume(row_update, SEED, p, w=10 + p)
+        R.write_row(sdir, p, host[p], mom[p])
+    dev_p = R.rows_to_device(host, sharding)
+    dev_m = R.rows_to_device(mom, sharding)
+    for p in (2, 3):
+        row, m = consume(row_update, SEED, p, w=20 + p)
+        R.write_row(sdir, p, row, m)
+
+    new_p, new_m, plan = R.rebuild_state(
+        total, DIM, SEED, sdir, dev_p, dev_m, fresh={0, 1},
+        sharding=sharding, epoch=7,
+    )
+    assert (plan.relaid, plan.refetched, plan.inited) == (2, 2, 2)
+    assert plan.epochs == [7]
+    # relaid + refetched rows are FINAL (one-touch update); init rows are
+    # not — another member may still consume them
+    assert plan.authoritative == {0, 1, 2, 3}
+    got = R.device_to_host(new_p)
+    for p in (0, 1):
+        np.testing.assert_array_equal(got[p], host[p])
+    for p in (2, 3):
+        np.testing.assert_array_equal(got[p], R.read_row(sdir, p, DIM)[0])
+    for p in (4, 5):
+        np.testing.assert_array_equal(got[p], R.init_row(SEED, p, DIM))
+    gm = R.device_to_host(new_m)
+    assert gm[0] == mom[0] and gm[4] == 0.0
+
+
+def test_rebuild_from_nothing_is_all_init(tmp_path, sharding):
+    _, _, plan = R.rebuild_state(
+        4, DIM, SEED, str(tmp_path), None, None, set(), sharding,
+    )
+    assert (plan.relaid, plan.refetched, plan.inited) == (0, 0, 4)
+    assert plan.authoritative == set()
+
+
+def test_plan_merge_accumulates_counts_across_epochs():
+    a = R.ReshardPlan(relaid=1, refetched=2, inited=3, epochs=[1])
+    a.merge(R.ReshardPlan(relaid=4, refetched=5, inited=6, epochs=[2]))
+    assert (a.relaid, a.refetched, a.inited) == (5, 7, 9)
+    assert a.epochs == [1, 2]
+
+
+# ---- replay idempotence + bit-identity ----------------------------------
+
+
+def test_consume_replay_is_idempotent(row_update):
+    """A member killed after write_row but before the record append
+    re-consumes the position: computing from the init base (never the
+    current device row) makes the replay produce the identical bits."""
+    first = consume(row_update, SEED, 2, w=42)
+    replay = consume(row_update, SEED, 2, w=42)
+    assert first[0].tobytes() == replay[0].tobytes()
+    assert first[1] == replay[1]
+
+
+def test_live_consumes_bit_identical_to_expected_params(tmp_path,
+                                                        row_update,
+                                                        sharding):
+    """Scrambled-order live consumes with an interleaved rebuild (the
+    resize) assemble to the SAME bytes as the uninterrupted-run
+    reference — the soak's tentpole gate, in miniature."""
+    total, sdir = 5, str(tmp_path)
+    order = [int(x) for x in np.random.default_rng(SEED).permutation(100)[:total]]
+    # member A consumes 0,2 then "dies"; a rebuild re-sources everything;
+    # member B consumes the rest in reverse
+    for p in (0, 2):
+        row, m = consume(row_update, SEED, p, order[p])
+        R.write_row(sdir, p, row, m)
+    _, _, plan = R.rebuild_state(
+        total, DIM, SEED, sdir, None, None, set(), sharding,
+    )
+    assert plan.refetched == 2
+    for p in (4, 3, 1):
+        row, m = consume(row_update, SEED, p, order[p])
+        R.write_row(sdir, p, row, m)
+
+    final = R.assemble_final(total, DIM, SEED, sdir)
+    expected = R.expected_params(total, DIM, SEED, order)
+    assert R.params_digest(final) == R.params_digest(expected)
+
+
+def test_params_digest_flags_any_row_difference():
+    a = np.zeros((3, DIM), np.float32)
+    b = a.copy()
+    b[1, 0] = np.float32(1e-7)  # one ulp-ish nudge in one row
+    assert R.params_digest(a) != R.params_digest(b)
+    assert R.params_digest(a) == R.params_digest(a.copy())

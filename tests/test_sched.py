@@ -30,7 +30,14 @@ from tf_operator_tpu.runtime.objects import (
 )
 from tf_operator_tpu.runtime.scheduler import GangScheduler, SchedulingError
 from tf_operator_tpu.runtime.store import Store
-from tf_operator_tpu.sched.fleet import ADMIT, FAIL, PREEMPT, WAIT, FleetScheduler
+from tf_operator_tpu.sched.fleet import (
+    ADMIT,
+    FAIL,
+    PREEMPT,
+    RECLAIM,
+    WAIT,
+    FleetScheduler,
+)
 from tf_operator_tpu.sched.objects import PriorityClass, Queue, QueueSpec, job_demand
 
 from tests.test_reconciler import Harness, make_job, make_process
@@ -498,3 +505,130 @@ def test_job_demand_prices_topology_or_replica_sum():
     assert job_demand(priced) == 12
     topo = make_job(workers=5)  # num_hosts=1 x chips_per_host=4
     assert job_demand(topo) == 4
+
+
+# ---- grow-beyond-spec loans + regrow-hold hygiene (r19) --------------------
+
+
+class TestOverspecLoans:
+    def _admitted(self, fleet, name="a", priority="", chips=8):
+        j = sjob(name, priority=priority, chips=chips)
+        assert fleet.admit(j).action == ADMIT
+        fleet.commit(j)
+        return j
+
+    def test_offer_grow_charges_usage_and_tracks_loan(self):
+        _, fleet = fleet_env(quota_chips=16)
+        j1 = self._admitted(fleet)
+        assert fleet.offer_grow(j1, 8) == 8
+        assert fleet.usage()[("t1", "main")] == (16, 1)
+        assert fleet.overspec_chips(j1.key()) == 8
+
+    def test_offer_grow_refused_over_quota(self):
+        _, fleet = fleet_env(quota_chips=16)
+        j1 = self._admitted(fleet)
+        assert fleet.offer_grow(j1, 16) == 0
+        assert fleet.overspec_chips(j1.key()) == 0
+
+    def test_offer_grow_refused_while_any_same_queue_job_waits(self):
+        # Backfill growth is strictly AFTER queued admissions: a waiting
+        # job in the same (ns, queue) vetoes the offer even when the
+        # extra chips would fit under quota.
+        _, fleet = fleet_env(quota_chips=16)
+        j1 = self._admitted(fleet)
+        j2 = sjob("b", chips=16)
+        assert fleet.admit(j2).action == WAIT  # 8 + 16 > 16: queued
+        assert fleet.offer_grow(j1, 8) == 0
+
+    def test_offer_grow_refused_while_draining_or_unadmitted(self):
+        _, fleet = fleet_env(quota_chips=16)
+        assert fleet.offer_grow(sjob("ghost"), 8) == 0  # never admitted
+        j1 = self._admitted(fleet)
+        fleet.begin_preempt(j1.key())
+        assert fleet.offer_grow(j1, 8) == 0
+
+    def test_reclaim_overspec_partial_then_full(self):
+        _, fleet = fleet_env(quota_chips=16)
+        j1 = self._admitted(fleet)
+        assert fleet.offer_grow(j1, 8) == 8
+        assert fleet.reclaim_overspec(j1.key(), chips=4) == 4
+        assert fleet.overspec_chips(j1.key()) == 4
+        assert fleet.usage()[("t1", "main")] == (12, 1)
+        assert fleet.reclaim_overspec(j1.key()) == 4
+        assert fleet.overspec_chips(j1.key()) == 0
+        assert fleet.usage()[("t1", "main")] == (8, 1)
+
+    def test_release_returns_loan_and_regrow_holds(self):
+        _, fleet = fleet_env(quota_chips=16)
+        j1 = self._admitted(fleet)
+        assert fleet.offer_grow(j1, 8) == 8
+        fleet.hold_for_regrow(j1.key(), {"h0": 4})
+        assert fleet.release(j1.key())
+        assert fleet.usage()[("t1", "main")] == (0, 0)
+        assert fleet.overspec_chips(j1.key()) == 0
+        assert fleet.reserved_for_others(sjob("z")) == {}
+
+    def test_regrow_hold_ttl_expires_leaked_holds(self):
+        # Satellite (r19): a hold whose lost host never returns must not
+        # pin capacity forever — it expires after hold_ttl_seconds and
+        # the chips become placeable again.
+        _, fleet = fleet_env(quota_chips=16)
+        j1 = self._admitted(fleet)
+        fleet.hold_for_regrow(j1.key(), {"h0": 4})
+        assert fleet.reserved_for_others(sjob("z")) == {"h0": 4}
+        fleet.hold_ttl_seconds = 10
+        assert fleet.expire_regrow_holds(now=time.time() + 11) == [j1.key()]
+        assert fleet.reserved_for_others(sjob("z")) == {}
+        # ttl <= 0 disables expiry entirely
+        fleet.hold_for_regrow(j1.key(), {"h0": 4})
+        fleet.hold_ttl_seconds = 0
+        assert fleet.expire_regrow_holds(now=time.time() + 1e6) == []
+        assert fleet.reserved_for_others(sjob("z")) == {"h0": 4}
+
+    def test_reserved_for_others_excludes_own_hold(self):
+        _, fleet = fleet_env(quota_chips=16)
+        j1 = self._admitted(fleet)
+        fleet.hold_for_regrow(j1.key(), {"h0": 4})
+        assert fleet.reserved_for_others(j1) == {}
+        assert fleet.reserved_for_others(sjob("z")) == {"h0": 4}
+
+    def test_quota_pressure_reclaims_loans_before_preempting(self):
+        # An over-spec loan is the FIRST thing quota pressure takes back:
+        # the waiting admitter gets RECLAIM (not PREEMPT), the loan stays
+        # charged until the over-spec members are observably gone, then
+        # the admitter re-enters at the head — strictly two-phase.
+        _, fleet = fleet_env(quota_chips=16)
+        j_low = self._admitted(fleet, "low", priority="low")
+        assert fleet.offer_grow(j_low, 8) == 8
+        j_high = sjob("high", priority="high")
+        d = fleet.admit(j_high)
+        assert d.action == RECLAIM
+        assert d.victims == [j_low.key()]
+        assert fleet.overspec_chips(j_low.key()) == 8  # not freed yet
+        assert fleet.reclaim_overspec(j_low.key()) == 8
+        assert fleet.next_queued() == [j_high.key()]
+        assert fleet.admit(j_high).action == ADMIT
+
+    def test_insufficient_reclaim_falls_through_to_preempt(self):
+        # Loans alone cannot bring the queue under quota: fall through to
+        # preempt-by-priority, where the victim's eviction credit counts
+        # its loan too (demand + loan frees in one eviction).
+        _, fleet = fleet_env(quota_chips=16)
+        j_low = self._admitted(fleet, "low", priority="low", chips=12)
+        assert fleet.offer_grow(j_low, 4) == 4
+        j_high = sjob("high", priority="high")
+        d = fleet.admit(j_high)
+        assert d.action == PREEMPT
+        assert d.victims == [j_low.key()]
+
+    def test_reclaim_never_frees_a_job_slot(self):
+        # max_running_jobs pressure cannot be answered by a chip reclaim:
+        # shrinking an elastic job back to spec frees chips, never a job
+        # slot — whole-job preemption is the only remedy.
+        _, fleet = fleet_env(quota_chips=32, max_jobs=1)
+        j_low = self._admitted(fleet, "low", priority="low")
+        assert fleet.offer_grow(j_low, 8) == 8
+        j_high = sjob("high", priority="high")
+        d = fleet.admit(j_high)
+        assert d.action == PREEMPT
+        assert j_low.key() in d.victims

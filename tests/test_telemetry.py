@@ -501,3 +501,15 @@ def test_labeled_gauge_set_and_clear_render():
     assert 'tpujob_goodput_ratio{job="j",namespace="d"} 0.93' in text
     m.clear_gauge("tpujob_straggler_host", labels={"host": "a"})
     assert "tpujob_straggler_host" not in m.render()
+
+
+def test_goodput_decomposition_splits_preemption_from_restart():
+    # r19: a restart span stamped cause=preemption is its own goodput
+    # cause — preempted downtime is quota policy, not crash-loop debt,
+    # and must never inflate cause=restart.
+    crash = _span("restart", 110.0, 115.0)
+    preempt = _span("restart", 130.0, 138.0)
+    preempt.attrs["cause"] = "preemption"
+    g = goodput_decomposition([crash, preempt], [], 100.0, 200.0)
+    assert g["lost_s"]["restart"] == pytest.approx(5.0)
+    assert g["lost_s"]["preemption"] == pytest.approx(8.0)

@@ -289,6 +289,14 @@ class SchedulingSpec:
     queue: str = ""  # Queue name in the job's namespace; "" ⇒ unqueued
     priority_class: str = ""  # PriorityClass name; "" ⇒ priority 0
     job_class: str = ""  # "" | JOB_CLASS_TRAINING | JOB_CLASS_SERVING
+    # Grow-beyond-spec (r19): the largest world size the fleet scheduler
+    # may offer this ELASTIC job when idle in-quota chips exist. 0 ⇒ the
+    # spec-derived gang size is the ceiling (no over-spec growth). Offers
+    # come strictly after every queued admission (backfill never starves
+    # the admission queue) and over-spec members are the FIRST thing
+    # reclaimed under any quota pressure — the job shrinks back to spec
+    # through the ordinary resize protocol, never charged to backoff.
+    elastic_max_world: int = 0
 
 
 @dataclass
@@ -365,9 +373,20 @@ class TPUJobStatus:
     # barrier fields the chief publishes back (boundary/offset/ack). Empty
     # when the gang runs at spec size with no resize in flight.
     resize_directive: Dict[str, Any] = field(default_factory=dict)
-    # Append-only audit of resizes: [{"epoch", "direction", "world_size",
-    # "time"}] — the dashboard/CLI surface for "visibly degraded".
+    # Bounded audit of resizes: the last RESIZE_HISTORY_KEEP entries of
+    # [{"epoch", "direction", "world_size", "cause", "time"}] — the
+    # dashboard/CLI surface for "visibly degraded". Older entries fold
+    # into resize_history_folded (a count) so a long elastic soak cannot
+    # grow the job status without limit; total resizes for display =
+    # resize_history_folded + len(resize_history).
     resize_history: List[Dict[str, Any]] = field(default_factory=list)
+    resize_history_folded: int = 0
+    # Grow-beyond-spec (r19): how many EXTRA worker indices beyond the
+    # spec replica count the fleet has grown this gang by. The gang's
+    # target membership is spec + overspec_workers; decremented only by
+    # a quota reclaim (a failure-shrink keeps the target so the
+    # symmetric re-grow can restore it).
+    overspec_workers: int = 0
     # Latest evaluator-reported scores, written by the Evaluator replica
     # through the API (workloads/eval.py → JobContext.report_eval_metrics):
     # {"step": int, "metrics": {name: value}, "time": ts}. The reference
@@ -542,6 +561,8 @@ def _tpujob_from_dict(data: Dict[str, Any]) -> TPUJob:
         world_size=status_d.get("world_size", 0),
         resize_directive=status_d.get("resize_directive", {}) or {},
         resize_history=list(status_d.get("resize_history", []) or []),
+        resize_history_folded=status_d.get("resize_history_folded", 0),
+        overspec_workers=status_d.get("overspec_workers", 0),
         profile_directive=status_d.get("profile_directive", {}) or {},
         hang_count=status_d.get("hang_count", 0),
         hang_state=status_d.get("hang_state", {}) or {},

@@ -74,7 +74,7 @@ import tempfile
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from tf_operator_tpu.api.types import (
     KIND_PROCESS,
@@ -1577,6 +1577,34 @@ class ElasticSoakResult:
     # from the live controller before teardown.
     goodput_scraped: bool = False
     lost_seconds: Dict[str, float] = field(default_factory=dict)
+    # Device-state mode (r19, tentpole leg a): the chief's final params
+    # digest vs the uninterrupted run's (the SAME jitted row update over
+    # the canonical order), and the chief's merged ReshardPlan counters —
+    # at least one row must have been re-laid-out device-to-device AND at
+    # least one re-fetched, or the re-shard never actually ran.
+    device_state: bool = False
+    params_digest: str = ""
+    expected_params_digest: str = ""
+    reshard_plan: Dict[str, Any] = field(default_factory=dict)
+    # Resize x preemption composition (r19, tentpole leg b): a fleet
+    # preemption annotation stamped MID-SHRINK (the directive published,
+    # the barrier not yet). The reconciler must defer the drain to the
+    # post-resize epoch: the stamped shrink span closes BEFORE the
+    # preemption restart span opens.
+    preempt_during_resize: bool = False
+    preempt_stamp_time: float = 0.0
+    preempt_stamped_epoch: int = 0
+    restart_windows: List[dict] = field(default_factory=list)
+    # Store-observed quota oracle: live gang chips of every job in the
+    # soak's Queue, polled continuously, must never exceed the quota —
+    # held-for-regrow and mid-drain chips included (no double-count).
+    quota_violations: List[str] = field(default_factory=list)
+
+    @property
+    def params_bit_identical(self) -> bool:
+        return bool(self.params_digest) and (
+            self.params_digest == self.expected_params_digest
+        )
 
     @property
     def bit_identical(self) -> bool:
@@ -1591,11 +1619,16 @@ class ElasticSoakResult:
         if not self.succeeded:
             errs.append(f"job did not succeed: {self.conditions}")
         # THE tentpole gate: member loss + return handled entirely by
-        # shrink/re-grow — zero full gang restarts of any flavor.
-        if self.restart_count or self.preemption_count:
+        # shrink/re-grow — zero full gang restarts of any flavor. The
+        # composed drain-during-shrink schedule (r19) sanctions exactly
+        # ONE gang teardown: the deliberately injected fleet preemption,
+        # which must land as a preemption (never a counted restart).
+        allowed_preempts = 1 if self.preempt_during_resize else 0
+        if self.restart_count or self.preemption_count != allowed_preempts:
             errs.append(
-                f"full gang restart happened (restarts="
+                f"unexpected gang restarts (restarts="
                 f"{self.restart_count} preemptions={self.preemption_count} "
+                f"want 0/{allowed_preempts} "
                 f"last_cause={self.last_restart_cause!r}) — member loss "
                 "must resize, not restart"
             )
@@ -1665,6 +1698,98 @@ class ElasticSoakResult:
                     "resize downtime leaked into cause=restart: "
                     f"{self.lost_seconds}"
                 )
+            # Satellite (r19): in the composed schedule the preemption's
+            # own downtime lands under cause=preemption and equals its
+            # own restart-span widths — resize and preemption never
+            # double-count one outage, however they interleave.
+            if self.preempt_during_resize:
+                p_expected = sum(
+                    w["downtime_s"] for w in self.restart_windows
+                    if w.get("cause") == "preemption"
+                    and w.get("downtime_s") is not None
+                )
+                p_got = self.lost_seconds.get("preemption", 0.0)
+                if p_expected > 0 and abs(p_got - p_expected) > max(
+                    0.5, 0.05 * p_expected
+                ):
+                    errs.append(
+                        f"lost_seconds{{cause=preemption}} {p_got:.2f}s != "
+                        f"closed preemption-window downtime "
+                        f"{p_expected:.2f}s"
+                    )
+        # Device-state gates (r19 tentpole leg a): final params
+        # bit-identical to the uninterrupted run, and the chief's merged
+        # plan proves the re-shard both re-laid-out device rows AND
+        # re-fetched rows other members advanced.
+        if self.device_state:
+            if not self.params_bit_identical:
+                errs.append(
+                    f"device-state params NOT bit-identical: got "
+                    f"{self.params_digest[:16] or '<none>'} want "
+                    f"{self.expected_params_digest[:16]} — a row was "
+                    "lost, duplicated, or mis-sourced across a resize"
+                )
+            # A full restart (preemption drain) wipes every member's
+            # device state, so the new chief's merged plan starts from
+            # scratch and may legitimately contain zero device-to-device
+            # re-layouts — the store re-fetch gate below still applies
+            # (that is exactly how a wiped gang recovers the rows).
+            if int(self.reshard_plan.get("relaid", 0) or 0) < 1 and not (
+                self.restart_count or self.preemption_count
+            ):
+                errs.append(
+                    f"re-shard never re-laid-out a device row: "
+                    f"{self.reshard_plan}"
+                )
+            if int(self.reshard_plan.get("refetched", 0) or 0) < 1:
+                errs.append(
+                    f"re-shard never re-fetched a row from the store: "
+                    f"{self.reshard_plan}"
+                )
+        # Composition gates (r19 tentpole leg b): the annotation landed
+        # mid-shrink, and the drain was DEFERRED — the in-flight shrink
+        # span closed before the preemption restart span opened.
+        if self.preempt_during_resize:
+            if not self.preempt_stamp_time:
+                errs.append(
+                    "composition probe never caught a shrink mid-flight "
+                    "to stamp the preempt annotation"
+                )
+            preempts = [
+                w for w in self.restart_windows
+                if w.get("cause") == "preemption"
+            ]
+            if len(preempts) != 1:
+                errs.append(
+                    f"expected exactly one preemption restart window: "
+                    f"{self.restart_windows}"
+                )
+            elif self.preempt_stamp_time:
+                w = preempts[0]
+                if w.get("downtime_s") is None:
+                    errs.append(f"preemption restart span never closed: {w}")
+                shrink = next(
+                    (z for z in self.resize_windows
+                     if z.get("direction") == "shrink"
+                     and str(z.get("epoch")) == str(self.preempt_stamped_epoch)),
+                    None,
+                )
+                if shrink is None or shrink.get("end") is None:
+                    errs.append(
+                        f"stamped shrink epoch {self.preempt_stamped_epoch} "
+                        f"has no closed resize span: {self.resize_windows}"
+                    )
+                elif w["start"] < shrink["end"] - 1e-6:
+                    errs.append(
+                        f"drain NOT deferred: preemption restart opened at "
+                        f"{w['start']:.3f} before the in-flight shrink "
+                        f"closed at {shrink['end']:.3f}"
+                    )
+        if self.quota_violations:
+            errs.append(
+                f"store-observed quota violations "
+                f"({len(self.quota_violations)}): {self.quota_violations[:3]}"
+            )
         return errs
 
 
@@ -1722,6 +1847,64 @@ def _elastic_phase_rates(
     }
 
 
+class _QuotaOracle(threading.Thread):
+    """Store-observed quota auditor (r19): at no sampled instant may the
+    summed live chips of a queue's jobs exceed its ``quota_chips``.
+    Over-spec loans are charged to the queue (grow-beyond-spec worlds
+    must still fit inside it), so this single invariant covers normal
+    admission, the composed resize×preemption schedule, AND the
+    grow/reclaim probe. Reads the store like an external auditor —
+    nothing the controller could fudge."""
+
+    def __init__(
+        self, store, queue_name: str, quota: int, poll_s: float = 0.15
+    ):
+        super().__init__(daemon=True)
+        self.store = store
+        self.queue_name = queue_name
+        self.quota = int(quota)
+        self.poll_s = poll_s
+        self.violations: List[str] = []
+        self._halt = threading.Event()
+
+    def _sample(self) -> int:
+        from tf_operator_tpu.api.types import LABEL_JOB_NAME
+
+        used = 0
+        for j in self.store.list("TPUJob", namespace="default"):
+            if getattr(j.spec.scheduling, "queue", "") != self.queue_name:
+                continue
+            used += sum(
+                max(p.spec.chips, 0)
+                for p in self.store.list(
+                    KIND_PROCESS,
+                    namespace="default",
+                    label_selector={LABEL_JOB_NAME: j.metadata.name},
+                )
+                if not p.is_finished()
+            )
+        return used
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            try:
+                used = self._sample()
+                if used > self.quota and len(self.violations) < 32:
+                    msg = (
+                        f"queue {self.queue_name} quota {self.quota} "
+                        f"exceeded: live chips = {used}"
+                    )
+                    if not self.violations or self.violations[-1] != msg:
+                        self.violations.append(msg)
+            except Exception:
+                pass
+            self._halt.wait(self.poll_s)
+
+    def stop(self) -> None:
+        self._halt.set()
+        self.join(timeout=5)
+
+
 def run_elastic_soak(
     seed: int = 0,
     schedule: Optional[FaultSchedule] = None,
@@ -1735,6 +1918,9 @@ def run_elastic_soak(
     workdir: Optional[str] = None,
     heartbeat_ttl: float = 2.0,
     downtime_bound_s: float = 60.0,
+    device_state: bool = False,
+    preempt_during_resize: bool = False,
+    queue_quota: int = 0,
 ) -> ElasticSoakResult:
     """Seeded kill/return soak over an ELASTIC job (run_policy.elastic):
     every member loss must be absorbed by a shrink directive and every
@@ -1743,7 +1929,21 @@ def run_elastic_soak(
     re-grown member restoring from a surviving peer's shard depot.
 
     One member per host (each agent holds exactly one chip), so a killed
-    member IS a lost host; agents run host-lifetime shard depots."""
+    member IS a lost host; agents run host-lifetime shard depots.
+
+    r19 knobs:
+
+    - ``device_state``: the workload carries a real params/opt pytree on
+      device through every resize (train/reshard.py); the gate hardens
+      to *bit-identical final params* vs the uninterrupted run.
+    - ``preempt_during_resize``: a probe thread stamps the fleet preempt
+      annotation the instant a shrink directive is mid-flight; the
+      reconciler must DEFER the drain until the resize epoch closes
+      (exactly one preemption restart, opening strictly after the
+      stamped shrink span ends).
+    - ``queue_quota``: creates a Queue with that many chips, binds the
+      job to it, and runs a store-polling quota oracle for the whole
+      soak — any sampled exceedance fails the run."""
     from tf_operator_tpu.train.data import elastic_global_order
     from tf_operator_tpu.workloads.elastic import _digest, _read_records
 
@@ -1815,6 +2015,22 @@ def run_elastic_soak(
         "checkpoint_backend": "npy",
         "elastic": True,
     }
+    if device_state:
+        job.spec.workload["device_state"] = True
+
+    oracle: Optional[_QuotaOracle] = None
+    queue_name = "elastic-soak-q"
+    if queue_quota > 0:
+        from tf_operator_tpu.sched.objects import Queue, QueueSpec
+
+        store.create(
+            Queue(
+                metadata=ObjectMeta(name=queue_name, namespace="default"),
+                spec=QueueSpec(quota_chips=queue_quota),
+            )
+        )
+        job.spec.scheduling.queue = queue_name
+        oracle = _QuotaOracle(store, queue_name, queue_quota)
 
     gang_names = [f"{job_name}-worker-{i}" for i in range(workers)]
 
@@ -1833,15 +2049,63 @@ def run_elastic_soak(
         store, job_name, gang_names, allowed_subset_fn=sanctioned_subset
     )
     result = ElasticSoakResult(
-        schedule=schedule, downtime_bound_s=downtime_bound_s
+        schedule=schedule, downtime_bound_s=downtime_bound_s,
+        device_state=device_state,
+        preempt_during_resize=preempt_during_resize,
+    )
+
+    stamp_halt = threading.Event()
+
+    def _stamp_preempt_mid_shrink() -> None:
+        # Composition probe (r19 leg b): the instant a shrink directive
+        # is in flight (published, barrier not yet closed), stamp the
+        # fleet preempt annotation. The reconciler must defer the drain
+        # to the post-resize epoch — check() verifies the preemption
+        # restart span opens only after the stamped shrink span closed.
+        from tf_operator_tpu.controller.reconciler import ANNOTATION_PREEMPT
+
+        while not stamp_halt.is_set():
+            try:
+                j = store.get("TPUJob", "default", job_name)
+                d = j.status.resize_directive or {}
+                if (
+                    d.get("direction") == "shrink"
+                    and "boundary_remaining" not in d
+                ):
+                    epoch = int(d.get("epoch", 0) or 0)
+
+                    def _stamp(fresh):
+                        if fresh.metadata.annotations.get(ANNOTATION_PREEMPT):
+                            return False
+                        fresh.metadata.annotations[ANNOTATION_PREEMPT] = (
+                            "chaos-soak/fleet-pressure"
+                        )
+
+                    if store.update_with_retry(
+                        "TPUJob", "default", job_name, _stamp
+                    ) is not None:
+                        result.preempt_stamp_time = time.monotonic()
+                        result.preempt_stamped_epoch = epoch
+                    return
+            except Exception:
+                pass
+            stamp_halt.wait(0.02)
+
+    stamper = (
+        threading.Thread(target=_stamp_preempt_mid_shrink, daemon=True)
+        if preempt_during_resize else None
     )
     for a in agents:
         a.start()
     ctl.run(workers=2)
     watcher.start()
+    if oracle is not None:
+        oracle.start()
     try:
         store.create(job)
         injector.arm()
+        if stamper is not None:
+            stamper.start()
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             st = store.get("TPUJob", "default", job_name).status
@@ -1865,12 +2129,15 @@ def run_elastic_soak(
             {
                 "direction": s.attrs.get("direction", ""),
                 "epoch": s.attrs.get("epoch", ""),
+                "start": s.start_time,
+                "end": s.end_time or None,
                 "downtime_s": (
                     round(s.end_time - s.start_time, 3) if s.end_time else None
                 ),
             }
             for s in trace if s.op == "resize"
         ]
+        result.restart_windows = derive_timings(trace).get("restarts", [])
         result.restore_sources = [
             s.attrs.get("source", "disk")
             for s in sorted(
@@ -1891,10 +2158,34 @@ def run_elastic_soak(
             [{"p": p, "w": int(order[p])} for p in range(total_windows)],
             total_windows,
         )
+        if device_state:
+            # Device-state receipts: the chief's done.json carries the
+            # assembled-params digest and the merged re-shard plan; the
+            # expected digest re-derives the uninterrupted run through
+            # the SAME jitted update the members ran.
+            import json as _json
+
+            from tf_operator_tpu.train import reshard as _reshard
+
+            done_path = os.path.join(gang_dir, "done.json")
+            if os.path.exists(done_path):
+                with open(done_path) as f:
+                    done = _json.load(f)
+                result.params_digest = done.get("params_digest", "")
+                result.reshard_plan = dict(done.get("reshard", {}))
+            result.expected_params_digest = _reshard.params_digest(
+                _reshard.expected_params(
+                    total_windows, _reshard.PARAM_DIM, seed, order
+                )
+            )
         result.lost_seconds = _scrape_lost_seconds(ctl.metrics)
         result.goodput_scraped = True
     finally:
         injector.stop()
+        stamp_halt.set()
+        if oracle is not None:
+            oracle.stop()
+            result.quota_violations = list(oracle.violations)
         watcher.stop()
         ctl.stop()
         for a in agents:
@@ -1930,7 +2221,9 @@ def elastic_artifact(result: ElasticSoakResult, seed: int) -> Dict[str, Any]:
         "resize_downtime_p99_s": _percentile(downtimes, 0.99),
         "tokens_per_s": result.tokens_per_s,
         "zero_full_restarts": (
-            result.restart_count == 0 and result.preemption_count == 0
+            result.restart_count == 0
+            and result.preemption_count
+            == (1 if result.preempt_during_resize else 0)
         ),
         "restart_count": result.restart_count,
         "preemption_count": result.preemption_count,
@@ -1943,7 +2236,326 @@ def elastic_artifact(result: ElasticSoakResult, seed: int) -> Dict[str, Any]:
         "lost_seconds": {
             k: round(v, 3) for k, v in sorted(result.lost_seconds.items())
         },
+        **(
+            {
+                "params_digest": result.params_digest,
+                "expected_params_digest": result.expected_params_digest,
+                "params_bit_identical": result.params_bit_identical,
+                "reshard": result.reshard_plan,
+            }
+            if result.device_state else {}
+        ),
+        **(
+            {
+                "preempt_stamped_epoch": result.preempt_stamped_epoch,
+                "restart_windows": result.restart_windows,
+                "quota_violations": result.quota_violations,
+            }
+            if result.preempt_during_resize else {}
+        ),
         "pass": not result.check(),
+    }
+
+
+@dataclass
+class GrowBeyondSpecResult:
+    """Observations of one grow-beyond-spec probe (r19 tentpole leg c):
+    a running elastic job with ``scheduling.elastic_max_world`` above its
+    spec must borrow idle in-quota chips and grow past spec, then shrink
+    cleanly back when a queued admission applies quota pressure — no
+    restart, no backoff charge, and the queue never over quota."""
+
+    spec_world: int = 0
+    max_world: int = 0
+    # Largest world_size ever observed on the primary job, and the
+    # largest status.overspec_workers alongside it.
+    grew_to: int = 0
+    overspec_seen: int = 0
+    primary_succeeded: bool = False
+    pressure_succeeded: bool = False
+    restart_count: int = 0
+    preemption_count: int = 0
+    final_overspec: int = 0
+    resize_history: List[dict] = field(default_factory=list)
+    conditions: List[tuple] = field(default_factory=list)
+    pressure_conditions: List[tuple] = field(default_factory=list)
+    quota_violations: List[str] = field(default_factory=list)
+
+    def check(self) -> List[str]:
+        errs = []
+        if not self.primary_succeeded:
+            errs.append(
+                f"primary elastic job did not succeed: {self.conditions}"
+            )
+        if not self.pressure_succeeded:
+            errs.append(
+                f"pressure job did not succeed (reclaim never freed its "
+                f"chips?): {self.pressure_conditions}"
+            )
+        if self.grew_to <= self.spec_world:
+            errs.append(
+                f"never grew beyond spec: world peaked at {self.grew_to} "
+                f"(spec {self.spec_world}, elastic_max_world "
+                f"{self.max_world})"
+            )
+        if self.overspec_seen < 1:
+            errs.append("status.overspec_workers never went positive")
+        if self.restart_count or self.preemption_count:
+            errs.append(
+                f"reclaim charged a restart (restarts={self.restart_count} "
+                f"preemptions={self.preemption_count}) — over-spec "
+                "reclaim must shrink, not tear down"
+            )
+        causes = {h.get("cause") for h in self.resize_history}
+        if "grow-beyond-spec" not in causes:
+            errs.append(
+                f"resize history lacks a grow-beyond-spec entry: "
+                f"{self.resize_history}"
+            )
+        if "overspec-reclaim" not in causes:
+            errs.append(
+                f"resize history lacks an overspec-reclaim entry: "
+                f"{self.resize_history}"
+            )
+        if self.final_overspec:
+            errs.append(
+                f"job ended still holding an over-spec loan: "
+                f"{self.final_overspec} member(s)"
+            )
+        if self.quota_violations:
+            errs.append(
+                f"store-observed quota violations "
+                f"({len(self.quota_violations)}): {self.quota_violations[:3]}"
+            )
+        return errs
+
+
+def run_grow_beyond_spec_probe(
+    seed: int = 0,
+    workers: int = 2,
+    max_world: int = 3,
+    total_windows: int = 600,
+    step_sleep_s: float = 0.05,
+    timeout: float = 120.0,
+    workdir: Optional[str] = None,
+) -> GrowBeyondSpecResult:
+    """Grow-beyond-spec probe (r19 tentpole leg c). ``max_world`` hosts
+    with one chip each, a Queue whose quota covers all of them, and an
+    elastic job specced at ``workers`` with ``elastic_max_world`` =
+    ``max_world``: the fleet must offer the idle in-quota chips and the
+    job grow past spec. Then a 1-chip pressure job joins the queue —
+    quota pressure must reclaim the loan FIRST (the job shrinks back to
+    spec with no restart and no backoff charge) and the pressure job run
+    to completion on the freed chip. A store-polling quota oracle audits
+    the whole composition."""
+    tmp = workdir or tempfile.mkdtemp(prefix="tpujob-grow-spec-")
+    gang_dir = os.path.join(tmp, "gang")
+    ckpt_dir = os.path.join(tmp, "ckpt")
+    os.makedirs(gang_dir, exist_ok=True)
+    primary, pressure = "grow-primary", "grow-pressure"
+    queue_name = "grow-q"
+
+    from tf_operator_tpu.sched.objects import Queue, QueueSpec
+
+    store = Store()
+    agents = [
+        HostAgent(
+            store,
+            f"grow-h{i}",
+            total_chips=1,
+            heartbeat_interval=0.25,
+            backend=LocalProcessControl(
+                store, log_dir=os.path.join(tmp, "logs")
+            ),
+            depot=True,
+        )
+        for i in range(max_world)
+    ]
+    fake = FakeProcessControl()
+    ctl = TPUJobController(store, fake, resync_period=0.5)
+    from tf_operator_tpu.dashboard import DashboardServer
+
+    dashboard = DashboardServer(store, host="127.0.0.1", port=0)
+    dashboard.start()
+    ctl.api_url = dashboard.url
+
+    env = dict(DATAPLANE_ENV)
+    env["PYTHONPATH"] = _ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")
+    job = TPUJob(
+        metadata=ObjectMeta(name=primary),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=workers,
+                    template=ProcessTemplate(
+                        entrypoint="tf_operator_tpu.workloads.elastic:main",
+                        env=env,
+                        chips_per_process=1,
+                    ),
+                )
+            },
+            topology=TopologySpec(num_hosts=workers, chips_per_host=1),
+        ),
+    )
+    job.spec.run_policy.elastic = True
+    job.spec.run_policy.heartbeat_ttl_seconds = 2.0
+    job.spec.scheduling.queue = queue_name
+    job.spec.scheduling.elastic_max_world = max_world
+    job.spec.workload = {
+        "workdir": gang_dir,
+        "total_windows": total_windows,
+        "step_sleep_s": step_sleep_s,
+        "data_seed": seed,
+        "checkpoint_dir": ckpt_dir,
+        "checkpoint_every": 10,
+        "checkpoint_backend": "npy",
+        "elastic": True,
+    }
+    presser = TPUJob(
+        metadata=ObjectMeta(name=pressure),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=1,
+                    template=ProcessTemplate(
+                        entrypoint="tf_operator_tpu.workloads.noop:main",
+                        env=env,
+                        chips_per_process=1,
+                    ),
+                )
+            },
+            topology=TopologySpec(num_hosts=1, chips_per_host=1),
+        ),
+    )
+    presser.spec.scheduling.queue = queue_name
+    presser.spec.workload = {"sleep_s": 2.0}
+
+    store.create(
+        Queue(
+            metadata=ObjectMeta(name=queue_name, namespace="default"),
+            spec=QueueSpec(quota_chips=max_world),
+        )
+    )
+    oracle = _QuotaOracle(store, queue_name, max_world)
+    result = GrowBeyondSpecResult(spec_world=workers, max_world=max_world)
+    for a in agents:
+        a.start()
+    ctl.run(workers=2)
+    oracle.start()
+    try:
+        store.create(job)
+        deadline = time.monotonic() + timeout
+        injected = False
+        while time.monotonic() < deadline:
+            st = store.get("TPUJob", "default", primary).status
+            result.grew_to = max(result.grew_to, st.world_size)
+            result.overspec_seen = max(
+                result.overspec_seen, st.overspec_workers
+            )
+            if is_finished(st):
+                if injected:
+                    pst = store.get("TPUJob", "default", pressure).status
+                    if is_finished(pst):
+                        break
+                else:
+                    break  # finished before the pressure landed: probe fails
+            if not injected and st.world_size >= max_world:
+                # Beyond spec on loaned chips: now apply quota pressure.
+                store.create(presser)
+                injected = True
+            time.sleep(0.1)
+        st = store.get("TPUJob", "default", primary).status
+        result.primary_succeeded = has_condition(st, ConditionType.SUCCEEDED)
+        result.restart_count = st.restart_count
+        result.preemption_count = st.preemption_count
+        result.final_overspec = st.overspec_workers
+        result.resize_history = list(st.resize_history or [])
+        result.conditions = [
+            (c.type.value, c.reason, c.message) for c in st.conditions
+        ]
+        if injected:
+            pst = store.get("TPUJob", "default", pressure).status
+            result.pressure_succeeded = has_condition(
+                pst, ConditionType.SUCCEEDED
+            )
+            result.pressure_conditions = [
+                (c.type.value, c.reason, c.message) for c in pst.conditions
+            ]
+    finally:
+        oracle.stop()
+        result.quota_violations = list(oracle.violations)
+        ctl.stop()
+        for a in agents:
+            a.stop()
+        dashboard.stop()
+        fake.clear()
+    return result
+
+
+def run_elastic_general_soak(
+    seed: int = 0, workdir: Optional[str] = None, timeout: float = 150.0
+) -> Tuple[ElasticSoakResult, ElasticSoakResult, GrowBeyondSpecResult]:
+    """The r19 acceptance composition (CI ``elastic-general-soak``):
+
+    1. **device-state soak** — the r12 kill/return schedule with a real
+       device param/opt pytree carried through every resize; gate is
+       bit-identical final params + eval digest vs the uninterrupted
+       run, with >=1 peer-depot shard restore.
+    2. **drain-during-shrink** — one kill/return overlapped with a fleet
+       preemption stamped mid-shrink, under a store-audited Queue; gate
+       is the deferred drain (exactly one preemption restart, opening
+       after the stamped shrink closed), zero quota violations, and the
+       same bit-identity.
+    3. **grow-beyond-spec probe** — world_size past spec on loaned
+       in-quota chips, first-reclaimed cleanly under injected pressure.
+    """
+    base = workdir or tempfile.mkdtemp(prefix="tpujob-elastic-general-")
+    device = run_elastic_soak(
+        seed=seed, kills=2, workers=3, total_windows=900,
+        step_sleep_s=0.06, device_state=True, timeout=timeout,
+        workdir=os.path.join(base, "device"),
+    )
+    # Slow, short windows: each step is a wide stamp-landing target, so
+    # the probe reliably catches the shrink between directive publish
+    # and barrier completion.
+    drain = run_elastic_soak(
+        seed=seed + 1, kills=1, workers=3, total_windows=90,
+        step_sleep_s=0.4, device_state=True, preempt_during_resize=True,
+        queue_quota=3, timeout=timeout,
+        workdir=os.path.join(base, "drain"),
+    )
+    grow = run_grow_beyond_spec_probe(
+        seed=seed + 2, workdir=os.path.join(base, "grow"),
+        timeout=timeout,
+    )
+    return device, drain, grow
+
+
+def elastic_general_artifact(
+    device: ElasticSoakResult,
+    drain: ElasticSoakResult,
+    grow: GrowBeyondSpecResult,
+    seed: int,
+) -> Dict[str, Any]:
+    """The elasticbench receipt for the composed r19 acceptance (CI
+    writes it to ``artifacts/elasticbench_r19.json``)."""
+    return {
+        "bench": "elastic-general-soak",
+        "seed": seed,
+        "device_state_soak": elastic_artifact(device, seed),
+        "drain_during_shrink": elastic_artifact(drain, seed + 1),
+        "grow_beyond_spec": {
+            "spec_world": grow.spec_world,
+            "elastic_max_world": grow.max_world,
+            "grew_to": grow.grew_to,
+            "overspec_seen": grow.overspec_seen,
+            "restart_count": grow.restart_count,
+            "preemption_count": grow.preemption_count,
+            "resize_history": grow.resize_history,
+            "quota_violations": grow.quota_violations,
+            "pass": not grow.check(),
+        },
+        "pass": not (device.check() or drain.check() or grow.check()),
     }
 
 
@@ -2407,6 +3019,16 @@ def main(argv=None) -> int:
                         "of the converged Young/Daly optimum (receipted "
                         "with the prior numbers) while the no-prior lane "
                         "sits at the clamp edge")
+    p.add_argument("--elastic-general", action="store_true",
+                   help="composed r19 elastic acceptance: (1) the "
+                        "kill/return soak with a REAL device param/opt "
+                        "pytree re-sharded through every resize "
+                        "(bit-identical final params), (2) a fleet "
+                        "preemption stamped mid-shrink under a "
+                        "store-audited Queue (drain deferred, zero quota "
+                        "violations), (3) the grow-beyond-spec probe "
+                        "(world past spec on loaned chips, clean "
+                        "first-reclaim under pressure)")
     p.add_argument("--kills", type=int, default=2,
                    help="elastic soak: number of kill/return faults")
     p.add_argument("--total-windows", type=int, default=900,
@@ -2537,6 +3159,35 @@ def main(argv=None) -> int:
         errors = hresult.check()
         for e in errors:
             print(f"HANG INVARIANT VIOLATED: {e}", file=sys.stderr)
+        return 1 if errors else 0
+
+    if args.elastic_general:
+        import json as _json
+
+        device, drain, grow = run_elastic_general_soak(
+            seed=args.seed, workdir=args.workdir, timeout=args.timeout
+        )
+        artifact = elastic_general_artifact(device, drain, grow, args.seed)
+        print(_json.dumps(artifact))
+        if args.artifact:
+            os.makedirs(
+                os.path.dirname(os.path.abspath(args.artifact)), exist_ok=True
+            )
+            with open(args.artifact, "w") as f:
+                _json.dump(artifact, f, indent=2)
+            print(f"elastic-general receipt -> {args.artifact}")
+        errors = []
+        for tag, errs in (
+            ("device-state", device.check()),
+            ("drain-during-shrink", drain.check()),
+            ("grow-beyond-spec", grow.check()),
+        ):
+            for e in errs:
+                print(
+                    f"ELASTIC INVARIANT VIOLATED [{tag}]: {e}",
+                    file=sys.stderr,
+                )
+                errors.append(e)
         return 1 if errors else 0
 
     if args.elastic:

@@ -382,13 +382,18 @@ def main(argv=None) -> int:
             for j in jobs:
                 # world_size 0 = never resized: the spec-derived size applies
                 world = j.status.world_size or "-"
+                # RESIZES = the bounded history plus everything folded out
+                # of it (r19): the lifetime total survives the 32-entry cap.
+                resizes = j.status.resize_history_folded + len(
+                    j.status.resize_history or []
+                )
                 print(
                     f"{j.metadata.namespace:<12} {j.metadata.name:<24} "
                     f"{j.status.phase().value or '-':<10} "
                     f"{j.spec.scheduling.queue or '-':<12} "
                     f"{j.spec.scheduling.priority_class or '-':<10} "
                     f"{j.status.restart_count:<8} {j.status.preemption_count:<9} "
-                    f"{world:<6} {j.status.resize_count:<7}"
+                    f"{world:<6} {resizes:<7}"
                 )
         elif args.cmd == "get":
             print(json.dumps(client.get(args.namespace, args.name), indent=2))

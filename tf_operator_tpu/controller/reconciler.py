@@ -153,6 +153,13 @@ ANNOTATION_PORT = "tpujob.dev/rendezvous-port"
 # preemption lifecycle (cause ``preemption``, warm-resumed, backoff-exempt)
 # and clears the annotation store-side.
 ANNOTATION_PREEMPT = "tpujob.dev/preempt"
+# Grow-beyond-spec reclaim request (r19): stamped on an elastic job that
+# holds over-spec chips when quota pressure needs them back (value = the
+# requester's key). The victim's own sync shrinks it back to spec through
+# the ordinary resize protocol — no drain, no restart, no backoff charge
+# — and the loaned chips return to the queue once the over-spec members
+# are observably gone.
+ANNOTATION_RECLAIM = "tpujob.dev/reclaim-overspec"
 # Straggler flag: stamped on a gang member Process whose host the detector
 # flagged (value = the host name); cleared when the host's step times
 # return under the bar for the hysteresis window.
@@ -188,6 +195,14 @@ CAUSE_HANG = "hang"
 # span, never charged to backoff) — the cause string in resize_history
 # records that the straggler signal, not a failure, triggered it.
 CAUSE_AUTOPILOT_MIGRATE = "autopilot-straggler"
+# Grow-beyond-spec reclaim (r19): the resize_history cause for the shrink
+# that returns loaned over-spec chips under quota pressure. Same
+# accounting as any other resize (resize span, never backoff).
+CAUSE_OVERSPEC_RECLAIM = "overspec-reclaim"
+# Bound on status.resize_history (r19 satellite): older entries fold into
+# status.resize_history_folded so a long elastic soak cannot grow the job
+# status without limit. Display total = folded + len(history).
+RESIZE_HISTORY_KEEP = 32
 # Host annotation the autopilot's warm-pool actuator writes (value = the
 # slot target as a decimal string); each HostAgent's heartbeat loop
 # polls its own Host object and resizes its local pool to match.
@@ -306,6 +321,10 @@ class TPUJobController:
         self._open_schedwait: Dict[str, Dict[str, Any]] = {}
         self._open_queued: Dict[str, Dict[str, Any]] = {}  # uid -> span info
         self._open_resize: Dict[str, Dict[str, Any]] = {}  # uid -> span info
+        # Preempt/reclaim requests deferred because they landed mid-resize
+        # (r19): uids whose deferral was already evented, so the wait
+        # doesn't spam one warning per sync. Cleared when the drain runs.
+        self._deferred_preempts: set = set()
         self._goodput_observed: set = set()  # uids whose goodput was folded
         # Straggler detection (obs/telemetry.py): per-job flap-damped
         # trackers over the live telemetry stream, plus the fleet-wide
@@ -882,13 +901,18 @@ class TPUJobController:
     def _gang_roles(job: TPUJob) -> List[Tuple[ReplicaType, int]]:
         """Orderered gang membership: coordinator first, then workers.
         Evaluators are not gang members — like the evaluator's exclusion
-        from the reference's cluster spec (controller_tensorflow.go:91-95)."""
+        from the reference's cluster spec (controller_tensorflow.go:91-95).
+        Grow-beyond-spec (r19): status.overspec_workers extra worker
+        indices append to the tail, so the expanded gang is the real
+        membership everywhere (placement, world size, hang/straggler
+        checks) until a quota reclaim shrinks it back."""
         gang: List[Tuple[ReplicaType, int]] = []
         if ReplicaType.COORDINATOR in job.spec.replica_specs:
             gang.append((ReplicaType.COORDINATOR, 0))
         workers = job.spec.replica_specs.get(ReplicaType.WORKER)
         if workers is not None:
-            gang.extend((ReplicaType.WORKER, i) for i in range(workers.replicas or 1))
+            count = (workers.replicas or 1) + max(job.status.overspec_workers, 0)
+            gang.extend((ReplicaType.WORKER, i) for i in range(count))
         return gang
 
     @staticmethod
@@ -1016,42 +1040,86 @@ class TPUJobController:
         # succeeding, so a sync from a stale informer snapshot can never
         # drain the gang twice for one request.
         if job.metadata.annotations.get(ANNOTATION_PREEMPT):
-            preemptor = job.metadata.annotations.pop(ANNOTATION_PREEMPT)
-
-            def _drop_preempt(fresh):
-                if ANNOTATION_PREEMPT not in fresh.metadata.annotations:
-                    return False
-                fresh.metadata.annotations.pop(ANNOTATION_PREEMPT, None)
-
-            cleared = self.store.update_with_retry(
-                KIND_TPUJOB, job.metadata.namespace, job.metadata.name,
-                _drop_preempt,
-            )
-            if cleared is not None:
-                # Two-phase handoff: the victim KEEPS its quota while the
-                # gang drains (the chips are still physically occupied);
-                # _create_processes releases it once the gang is observed
-                # gone, so victim and preemptor never hold the same
-                # headroom at once — not even for one store snapshot.
-                with self._sched_lock:
-                    self.fleet.begin_preempt(key)
-                live = [
-                    p
-                    for r in gang
-                    if (p := observed.get((r[0].value, r[1]))) is not None
-                    and not p.is_finished()
-                ]
-                if live:
-                    self.recorder.warning(
-                        job, ev.REASON_JOB_PREEMPTED,
-                        f"preempted by higher-priority job {preemptor}; gang "
-                        "restarting (checkpoint-resumed, not counted against "
-                        "backoff)",
+            # Resize×preemption commutation (r19): a preempt landing
+            # MID-RESIZE defers until the resize epoch completes. Draining
+            # now would kill survivors the chief's ack barrier is waiting
+            # on (shrink) or members mid-(re)creation (grow) — the drain
+            # is strictly ordered AFTER the resize, never interleaved.
+            # Mid-resize = the resize span is still open, or the live
+            # directive has no chief-published barrier yet (the span can
+            # close between syncs while the workload still re-deals).
+            d = job.status.resize_directive or {}
+            if job.metadata.uid in self._open_resize or (
+                d and "boundary_remaining" not in d
+            ):
+                if job.metadata.uid not in self._deferred_preempts:
+                    self._deferred_preempts.add(job.metadata.uid)
+                    self.recorder.normal(
+                        job, ev.REASON_JOB_PREEMPTING,
+                        f"preemption deferred: resize epoch "
+                        f"{job.status.resize_epoch} still completing; gang "
+                        "drains at the post-resize epoch",
                     )
-                    self._restart_gang(
-                        job, gang, observed, exp_key, cause=CAUSE_PREEMPTION
-                    )
-                    return
+                # Leave the annotation STORE-side (this sync only drops
+                # its local copy) and fall through, so this sync keeps
+                # driving the resize to completion; the completion sync
+                # re-enters here with the barrier published and drains.
+                job.metadata.annotations.pop(ANNOTATION_PREEMPT, None)
+                self._enqueue(key)
+            else:
+                self._deferred_preempts.discard(job.metadata.uid)
+                preemptor = job.metadata.annotations.pop(ANNOTATION_PREEMPT)
+
+                def _drop_preempt(fresh):
+                    if ANNOTATION_PREEMPT not in fresh.metadata.annotations:
+                        return False
+                    fresh.metadata.annotations.pop(ANNOTATION_PREEMPT, None)
+
+                cleared = self.store.update_with_retry(
+                    KIND_TPUJOB, job.metadata.namespace, job.metadata.name,
+                    _drop_preempt,
+                )
+                if cleared is not None:
+                    # Two-phase handoff: the victim KEEPS its quota while
+                    # the gang drains (the chips are still physically
+                    # occupied); _create_processes releases it once the
+                    # gang is observed gone, so victim and preemptor never
+                    # hold the same headroom at once — not even for one
+                    # store snapshot.
+                    with self._sched_lock:
+                        self.fleet.begin_preempt(key)
+                    live = [
+                        p
+                        for r in gang
+                        if (p := observed.get((r[0].value, r[1]))) is not None
+                        and not p.is_finished()
+                    ]
+                    if live:
+                        self.recorder.warning(
+                            job, ev.REASON_JOB_PREEMPTED,
+                            f"preempted by higher-priority job {preemptor}; "
+                            "gang restarting (checkpoint-resumed, not "
+                            "counted against backoff)",
+                        )
+                        self._restart_gang(
+                            job, gang, observed, exp_key,
+                            cause=CAUSE_PREEMPTION,
+                        )
+                        return
+
+        # -- grow-beyond-spec reclaim request (r19) -----------------------
+        # Quota pressure wants this job's loaned over-spec chips back:
+        # shrink to spec through the resize protocol (no drain, no
+        # restart). Deferred mid-resize exactly like a preemption.
+        if job.metadata.annotations.get(ANNOTATION_RECLAIM):
+            if self._handle_overspec_reclaim(job, gang, active, observed, exp_key):
+                return
+        # A published reclaim completes once the over-spec members are
+        # observably gone: only THEN does the loan return to the queue
+        # (two-phase, like begin_preempt→release).
+        if self._finish_overspec_reclaim(job, gang, observed):
+            gang = self._gang_roles(job)
+            active = self._active_members(job, gang)
 
         # -- failure handling --------------------------------------------
         # Hosts under a preemption notice: live members there take the
@@ -1175,6 +1243,11 @@ class TPUJobController:
         elif active != gang:
             if self._try_regrow(job, gang, active, observed, exp_key):
                 return
+        elif self._try_grow_beyond_spec(job, gang, active, observed, exp_key):
+            # Grow-beyond-spec (r19): a full-strength elastic gang with
+            # elastic_max_world headroom took idle in-quota chips. End
+            # the sync for the same reason _try_regrow does.
+            return
 
         # -- running condition -------------------------------------------
         gang_running = active and all(
@@ -1324,12 +1397,22 @@ class TPUJobController:
             downtime,
             labels={"cause": info["cause"]},
         )
-        # Goodput: the SAME width feeds lost-seconds under cause
-        # "restart" — one close point, so the histogram and the goodput
-        # surface can never double-count each other.
+        # Goodput: the SAME width feeds lost-seconds — one close point,
+        # so the histogram and the goodput surface can never
+        # double-count each other. A preemption drain gets its own
+        # cause (r19): its remedy is quota/priority policy, not
+        # crash-loop debugging, and folding it into "restart" would
+        # make the cause ledger claim downtime the backoff budget never
+        # charged.
         self.metrics.inc(
             "tpujob_lost_seconds_total", downtime,
-            labels={"cause": GOODPUT_RESTART},
+            labels={
+                "cause": (
+                    CAUSE_PREEMPTION
+                    if info["cause"] == CAUSE_PREEMPTION
+                    else GOODPUT_RESTART
+                )
+            },
         )
 
     # ---- hang plane (r15, obs/watchdog.py + obs/blackbox.py) -------------
@@ -1565,6 +1648,17 @@ class TPUJobController:
             return False
         if cause is CAUSE_PREEMPTION or cause is CAUSE_OOM:
             return False
+        # Resize×preemption commutation (r19): a shrink landing MID-DRAIN
+        # is refused until the victim's quota releases — the gang is
+        # winding down whole; publishing a resize epoch now would leave
+        # survivors running on chips the preemptor was promised. Same for
+        # a preempt request that just landed (annotation still pending):
+        # the drain, deferred or not, owns the gang's next transition.
+        with self._sched_lock:
+            if self.fleet.draining(job.key()):
+                return False
+        if job.metadata.annotations.get(ANNOTATION_PREEMPT):
+            return False
         failed_keys = {
             (p.spec.replica_type, p.spec.replica_index) for p in gang_failed
         }
@@ -1591,7 +1685,7 @@ class TPUJobController:
             "members": members,
             "time": now,
         }
-        job.status.resize_history.append({
+        self._append_resize_history(job, {
             "epoch": epoch, "direction": "shrink",
             "world_size": len(survivors), "cause": cause, "time": now,
         })
@@ -1665,6 +1759,19 @@ class TPUJobController:
         lost = [r for r in gang if r not in active]
         if not lost:
             return False
+        # A reclaim shrink in flight (r19) deliberately removed the
+        # over-spec tail: recreating it here would undo the reclaim. Once
+        # the loan returns (overspec_workers back to 0) the gang equals
+        # spec and ordinary re-grow of failure-lost members resumes.
+        if job.status.overspec_workers > 0 and (
+            (job.status.resize_directive or {}).get("reclaim")
+        ):
+            return False
+        # Mid-drain the gang is winding down whole — no resize commutes
+        # with that (same refusal as _try_resize_shrink).
+        with self._sched_lock:
+            if self.fleet.draining(job.key()):
+                return False
         for r in active:
             p = observed.get((r[0].value, r[1]))
             if p is None or p.status.phase is not ProcessPhase.RUNNING:
@@ -1686,7 +1793,7 @@ class TPUJobController:
             "members": [self._process_name(job, r[0], r[1]) for r in gang],
             "time": now,
         }
-        job.status.resize_history.append({
+        self._append_resize_history(job, {
             "epoch": epoch, "direction": "grow",
             "world_size": len(gang), "cause": "member-returned", "time": now,
         })
@@ -1704,6 +1811,299 @@ class TPUJobController:
         )
         with self._sched_lock:
             self.fleet.clear_regrow_hold(job.key())
+        self._write_status(job)
+        return True
+
+    @staticmethod
+    def _append_resize_history(job: TPUJob, entry: Dict[str, Any]) -> None:
+        """Bounded history append (r19 satellite): keep the newest
+        RESIZE_HISTORY_KEEP entries, fold everything older into the
+        resize_history_folded count. Display total = folded + len."""
+        job.status.resize_history.append(entry)
+        overflow = len(job.status.resize_history) - RESIZE_HISTORY_KEEP
+        if overflow > 0:
+            del job.status.resize_history[:overflow]
+            job.status.resize_history_folded += overflow
+
+    def _try_grow_beyond_spec(
+        self,
+        job: TPUJob,
+        gang: List[Tuple[ReplicaType, int]],
+        active: List[Tuple[ReplicaType, int]],
+        observed: Dict[Tuple[str, int], Process],
+        exp_key: str,
+    ) -> bool:
+        """Grow-beyond-spec (r19): a fully-RUNNING elastic job with
+        ``scheduling.elastic_max_world`` above its current world asks the
+        fleet for idle in-quota chips and, when granted, drives the grow
+        path past spec size — extra worker indices append to the gang
+        tail and the usual grow directive re-carves the mesh. The fleet
+        refuses whenever ANY queued admission exists in the job's queue
+        (backfill never starves the admission queue); the loaned chips
+        are the first thing reclaimed under quota pressure.
+
+        Returns True when a grow was published — the caller must END the
+        sync, exactly like _try_regrow."""
+        target = int(
+            getattr(job.spec.scheduling, "elastic_max_world", 0) or 0
+        )
+        if target <= len(gang):
+            return False
+        if not job.spec.run_policy.elastic or not _elastic_mesh_ok(job):
+            return False
+        if job.metadata.uid in self._open_resize:
+            return False
+        d = job.status.resize_directive or {}
+        if d and "boundary_remaining" not in d:
+            return False  # prior resize still at the re-deal barrier
+        if job.metadata.annotations.get(
+            ANNOTATION_PREEMPT
+        ) or job.metadata.annotations.get(ANNOTATION_RECLAIM):
+            return False
+        for r in active:
+            p = observed.get((r[0].value, r[1]))
+            if p is None or p.status.phase is not ProcessPhase.RUNNING:
+                return False
+        workers = job.spec.replica_specs.get(ReplicaType.WORKER)
+        if workers is None:
+            return False
+        chips_each = max(
+            workers.template.chips_per_process
+            or job.spec.topology.chips_per_host
+            or 1,
+            1,
+        )
+        # Largest affordable step first: the fleet's grant is
+        # all-or-nothing per offer, so probe k, k-1, ... 1 members.
+        granted_members = 0
+        for k in range(target - len(gang), 0, -1):
+            with self._sched_lock:
+                if self.fleet.offer_grow(job, k * chips_each):
+                    granted_members = k
+                    break
+        if not granted_members:
+            return False
+        prev_over = job.status.overspec_workers
+        job.status.overspec_workers = prev_over + granted_members
+        new_gang = self._gang_roles(job)
+        new_members = [r for r in new_gang if r not in gang]
+        epoch = job.status.resize_epoch + 1
+        if not self._create_processes(
+            job, new_members, exp_key, observed, resize_epoch=epoch
+        ):
+            # Placement refused the offer: hand the loan straight back
+            # (only the chips just borrowed — an earlier grant stays).
+            job.status.overspec_workers = prev_over
+            with self._sched_lock:
+                self.fleet.reclaim_overspec(
+                    job.key(), chips=granted_members * chips_each
+                )
+            return False
+        now = time.time()
+        job.status.resize_epoch = epoch
+        job.status.resize_count += 1
+        job.status.world_size = len(new_gang)
+        job.status.last_restart_cause = CAUSE_RESIZE_GROW
+        job.status.resize_directive = {
+            "epoch": epoch,
+            "direction": "grow",
+            "world_size": len(new_gang),
+            "members": [
+                self._process_name(job, r[0], r[1]) for r in new_gang
+            ],
+            "time": now,
+        }
+        self._append_resize_history(job, {
+            "epoch": epoch, "direction": "grow",
+            "world_size": len(new_gang), "cause": "grow-beyond-spec",
+            "time": now,
+        })
+        self.metrics.inc("tpujob_gang_resizes_total")
+        self.metrics.inc(
+            "tpujob_gang_resizes_by_direction_total",
+            labels={"direction": "grow"},
+        )
+        self.metrics.inc(
+            "tpujob_overspec_grants_total", granted_members * chips_each
+        )
+        self._open_resize_span(job, "grow", epoch, now)
+        self.recorder.normal(
+            job, ev.REASON_JOB_RUNNING,
+            f"grow-beyond-spec #{job.status.resize_count} (epoch {epoch}): "
+            f"{len(gang)} -> {len(new_gang)} members on "
+            f"{granted_members * chips_each} idle in-quota chip(s); "
+            "first-reclaimed under quota pressure",
+        )
+        self._write_status(job)
+        return True
+
+    def _clear_reclaim_annotation(self, job: TPUJob):
+        """Drop the reclaim request locally AND store-side; returns the
+        store's update result (None ⇒ another sync already took it)."""
+        job.metadata.annotations.pop(ANNOTATION_RECLAIM, None)
+
+        def _drop(fresh):
+            if ANNOTATION_RECLAIM not in fresh.metadata.annotations:
+                return False
+            fresh.metadata.annotations.pop(ANNOTATION_RECLAIM, None)
+
+        return self.store.update_with_retry(
+            KIND_TPUJOB, job.metadata.namespace, job.metadata.name, _drop
+        )
+
+    def _handle_overspec_reclaim(
+        self,
+        job: TPUJob,
+        gang: List[Tuple[ReplicaType, int]],
+        active: List[Tuple[ReplicaType, int]],
+        observed: Dict[Tuple[str, int], Process],
+        exp_key: str,
+    ) -> bool:
+        """Victim side of a grow-beyond-spec reclaim (r19): publish a
+        reclaim-flagged shrink back to spec and SIGTERM the over-spec
+        tail. No drain, no restart, no backoff charge — the job keeps
+        running on its spec world. Deferred mid-resize exactly like a
+        preemption. Returns True when the shrink was published (the
+        caller ends the sync)."""
+        key = job.key()
+        k = max(job.status.overspec_workers, 0)
+        d = job.status.resize_directive or {}
+        if not k or d.get("reclaim"):
+            # Stale request (nothing loaned) or a reclaim already in
+            # flight: clear the annotation; completion handles the rest.
+            self._clear_reclaim_annotation(job)
+            return False
+        if job.metadata.uid in self._open_resize or (
+            d and "boundary_remaining" not in d
+        ):
+            if job.metadata.uid not in self._deferred_preempts:
+                self._deferred_preempts.add(job.metadata.uid)
+                self.recorder.normal(
+                    job, ev.REASON_JOB_PREEMPTING,
+                    f"over-spec reclaim deferred: resize epoch "
+                    f"{job.status.resize_epoch} still completing",
+                )
+            job.metadata.annotations.pop(ANNOTATION_RECLAIM, None)
+            self._enqueue(key)
+            return False
+        self._deferred_preempts.discard(job.metadata.uid)
+        requester = job.metadata.annotations.get(ANNOTATION_RECLAIM, "")
+        if self._clear_reclaim_annotation(job) is None:
+            return False  # raced: another sync already handled it
+        spec_gang = gang[: len(gang) - k]
+        # Survivors = the spec members still active (a concurrent failure
+        # shrink may have lost one; it stays lost and re-grows later).
+        keep = [r for r in spec_gang if r in active]
+        targets = [
+            observed[(r[0].value, r[1])]
+            for r in gang[len(gang) - k:]
+            if (r[0].value, r[1]) in observed
+        ]
+        now = time.time()
+        epoch = job.status.resize_epoch + 1
+        members = [self._process_name(job, r[0], r[1]) for r in keep]
+        job.status.resize_epoch = epoch
+        job.status.resize_count += 1
+        job.status.world_size = len(keep)
+        job.status.last_restart_cause = CAUSE_RESIZE_SHRINK
+        job.status.resize_directive = {
+            "epoch": epoch,
+            "direction": "shrink",
+            "world_size": len(keep),
+            "members": members,
+            "time": now,
+            # The workload's completion gate honors this flag: a reclaim
+            # shrink is terminal-eligible (no symmetric re-grow of the
+            # over-spec tail is coming), unlike a failure shrink whose
+            # done gate holds for the re-grow.
+            "reclaim": True,
+        }
+        self._append_resize_history(job, {
+            "epoch": epoch, "direction": "shrink",
+            "world_size": len(keep), "cause": CAUSE_OVERSPEC_RECLAIM,
+            "time": now,
+        })
+        self.metrics.inc("tpujob_gang_resizes_total")
+        self.metrics.inc(
+            "tpujob_gang_resizes_by_direction_total",
+            labels={"direction": "shrink"},
+        )
+        self._open_resize_span(job, "shrink", epoch, now)
+        self.recorder.normal(
+            job, ev.REASON_JOB_RESTARTING,
+            f"over-spec reclaim (epoch {epoch}"
+            + (f", requested by {requester}" if requester else "")
+            + f"): {len(gang)} -> {len(keep)} members; loaned chips "
+            "return to the queue once the over-spec members exit (not "
+            "counted against backoff)",
+        )
+        # SIGTERM the over-spec tail by deleting its records — the same
+        # mechanism every drain uses. Survivors are untouched.
+        if targets:
+            self.expectations.expect_deletions(exp_key, len(targets))
+            deleted = 0
+            try:
+                for p in targets:
+                    self._delete_child(p)
+                    deleted += 1
+            except Exception:
+                for _ in range(len(targets) - deleted):
+                    self.expectations.deletion_failed(exp_key)
+                raise
+        self._write_status(job)
+        return True
+
+    def _finish_overspec_reclaim(
+        self,
+        job: TPUJob,
+        gang: List[Tuple[ReplicaType, int]],
+        observed: Dict[Tuple[str, int], Process],
+    ) -> bool:
+        """Completion side of a reclaim: once every over-spec member is
+        observably gone (absent or finished), zero overspec_workers and
+        return the loan to the queue — strictly two-phase, so the waiting
+        admitter and the over-spec members never hold the same headroom
+        at once. Returns True when the loan was just returned (callers
+        recompute gang/active from the now-spec-sized membership)."""
+        k = max(job.status.overspec_workers, 0)
+        d = job.status.resize_directive or {}
+        if not k or not d.get("reclaim") or d.get("direction") != "shrink":
+            return False
+        tail = gang[len(gang) - k:]
+        leftovers: List[Process] = []
+        for r in tail:
+            p = observed.get((r[0].value, r[1]))
+            if p is not None and not p.is_finished():
+                return False  # still winding down: the loan stays charged
+            if p is not None:
+                leftovers.append(p)
+        # A member that exited on its own (directive SystemExit beat the
+        # delete) leaves a finished record — clear it with the rest.
+        if leftovers:
+            exp_key = self._exp_key(job.key())
+            self.expectations.expect_deletions(exp_key, len(leftovers))
+            deleted = 0
+            try:
+                for p in leftovers:
+                    self._delete_child(p)
+                    deleted += 1
+            except Exception:
+                for _ in range(len(leftovers) - deleted):
+                    self.expectations.deletion_failed(exp_key)
+                raise
+        job.status.overspec_workers = 0
+        with self._sched_lock:
+            freed = self.fleet.reclaim_overspec(job.key())
+            keys = self.fleet.next_queued() if freed else []
+        for qk in keys:
+            self._enqueue(qk)
+        if freed:
+            self.metrics.inc("tpujob_overspec_reclaimed_chips_total", freed)
+        self.recorder.normal(
+            job, ev.REASON_JOB_RUNNING,
+            f"over-spec reclaim complete: {k} member(s) gone, "
+            f"{freed} chip(s) returned to the queue",
+        )
         self._write_status(job)
         return True
 
@@ -2697,13 +3097,34 @@ class TPUJobController:
                 # rank mod num_hosts, and slots already holding LIVE bound
                 # members stay pinned to those hosts — a partial recreate
                 # keeps every member's topology position.
+                # Over-spec elastic members (r19) ride on loaned idle
+                # chips OUTSIDE the slice shape: no gang rank (the spec
+                # slots are exactly full), no slot pin — place_gang's
+                # overflow path parks them on any host with room.
+                spec_workers = (
+                    job.spec.replica_specs.get(ReplicaType.WORKER)
+                )
+                spec_replicas = (
+                    (spec_workers.replicas or 1) if spec_workers else 0
+                )
+                overspec_names = {
+                    self._process_name(job, r[0], r[1])
+                    for r in gang
+                    if (job.status.overspec_workers or 0) > 0
+                    and r[0] is ReplicaType.WORKER
+                    and r[1] >= spec_replicas
+                }
                 ranks = {
                     self._process_name(job, r[0], r[1]): i
                     for i, r in enumerate(gang)
+                    if self._process_name(job, r[0], r[1])
+                    not in overspec_names
                 }
                 bound_slots: Dict[int, str] = {}
                 want_hosts = max(1, job.spec.topology.num_hosts)
                 for i, r in enumerate(gang):
+                    if self._process_name(job, r[0], r[1]) in overspec_names:
+                        continue
                     live = (observed or {}).get((r[0].value, r[1]))
                     if live is not None and not live.is_finished() and live.spec.node_name:
                         bound_slots[i % want_hosts] = live.spec.node_name
@@ -2712,6 +3133,7 @@ class TPUJobController:
                         job, procs, ranks=ranks, bound_slots=bound_slots,
                         ttl=self._job_heartbeat_ttl(job),
                         reserved=self.fleet.reserved_for_others(job),
+                        overflow=overspec_names or None,
                         # Straggler-flagged hosts plus the autopilot's
                         # TTL-bounded deprioritizations (r16) — both soft:
                         # the scheduler prefers other hosts but still
@@ -2782,7 +3204,13 @@ class TPUJobController:
                 self._finish(job)
                 return False
             if blocked.victims:
-                self._request_preemptions(job, blocked.victims)
+                if blocked.action == fleetsched.RECLAIM:
+                    # Quota pressure reclaims over-spec loans FIRST —
+                    # the victims shrink back to spec (no drain) and the
+                    # freed chips re-kick this job's admission.
+                    self._request_overspec_reclaims(job, blocked.victims)
+                else:
+                    self._request_preemptions(job, blocked.victims)
             self._queue_job(job, sched_reason or blocked.reason)
             return False
         return True
@@ -3018,6 +3446,35 @@ class TPUJobController:
                 f"job(s): {', '.join(sorted(stamped))}",
             )
 
+    def _request_overspec_reclaims(
+        self, job: TPUJob, victims: List[str]
+    ) -> None:
+        """Stamp the reclaim annotation on each over-spec holder; the
+        holder's own sync shrinks it back to spec through the resize
+        protocol and the loan returns once its over-spec members exit.
+        Idempotent like _request_preemptions."""
+        stamped = []
+        for vkey in victims:
+            ns, _, name = vkey.partition("/")
+
+            def _stamp(fresh):
+                if is_finished(fresh.status):
+                    return False
+                if fresh.metadata.annotations.get(ANNOTATION_RECLAIM):
+                    return False  # already being reclaimed
+                fresh.metadata.annotations[ANNOTATION_RECLAIM] = job.key()
+
+            if self.store.update_with_retry(KIND_TPUJOB, ns, name, _stamp) is not None:
+                stamped.append(vkey)
+                self.metrics.inc("tpujob_overspec_reclaims_requested_total")
+                self._enqueue(vkey)
+        if stamped:
+            self.recorder.normal(
+                job, ev.REASON_JOB_PREEMPTING,
+                f"requested over-spec reclaim from {len(stamped)} elastic "
+                f"job(s): {', '.join(sorted(stamped))}",
+            )
+
     def _release_job(self, key: str) -> None:
         """Release a finished/deleted/preempted job's quota and re-kick the
         admission-queue heads. ONE lock hold for both steps — _sched_lock
@@ -3212,6 +3669,14 @@ class TPUJobController:
 
     def _finish(self, job: TPUJob) -> None:
         """Terminal transition: persist status, then clean up children."""
+        # A terminal job holds no over-spec loan: _release_job below
+        # returns the chips regardless of where the reclaim two-phase
+        # stood, so the status must agree — a job can finish between
+        # publishing a reclaim shrink and observing its tail gone, and
+        # reconcile never runs _finish_overspec_reclaim for a terminal
+        # job.
+        if job.status.overspec_workers:
+            job.status.overspec_workers = 0
         # Forensics first (r15): freeze the flight recorder into the
         # postmortem bundle for ANY terminal failure — the children are
         # about to be GC'd and the scene with them. Idempotent (the
@@ -3359,19 +3824,36 @@ class TPUJobController:
             rz_count = max(fresh.status.resize_count, job.status.resize_count)
             if fresh.status.resize_epoch > job.status.resize_epoch:
                 directive = fresh.status.resize_directive
-                history = fresh.status.resize_history
                 world = fresh.status.world_size
+                overspec = fresh.status.overspec_workers
             else:
                 directive = dict(job.status.resize_directive or {})
                 if fresh.status.resize_epoch == job.status.resize_epoch:
                     directive.update(fresh.status.resize_directive or {})
-                history = (
-                    fresh.status.resize_history
-                    if len(fresh.status.resize_history)
-                    > len(job.status.resize_history)
-                    else job.status.resize_history
-                )
                 world = job.status.world_size or fresh.status.world_size
+                # overspec_workers travels with the resize-epoch winner;
+                # at EQUAL epochs the reclaim-completion write zeroes it
+                # without bumping the epoch, so the smaller value is the
+                # newer one (grants always come with an epoch bump).
+                overspec = (
+                    min(fresh.status.overspec_workers, job.status.overspec_workers)
+                    if fresh.status.resize_epoch == job.status.resize_epoch
+                    else job.status.overspec_workers
+                )
+            # The bounded history and its folded count move together —
+            # whichever side recorded more TOTAL resizes has the newer
+            # pair (folding only ever raises the total).
+            if (
+                fresh.status.resize_history_folded
+                + len(fresh.status.resize_history)
+                > job.status.resize_history_folded
+                + len(job.status.resize_history)
+            ):
+                history = fresh.status.resize_history
+                rz_folded = fresh.status.resize_history_folded
+            else:
+                history = job.status.resize_history
+                rz_folded = job.status.resize_history_folded
             eval_metrics = fresh.status.eval_metrics
             # profile_directive is API-authored end to end (the CLI/server
             # publishes requests, the chief acks captures) — always keep
@@ -3417,7 +3899,9 @@ class TPUJobController:
             fresh.status.resize_count = rz_count
             fresh.status.resize_directive = directive
             fresh.status.resize_history = history
+            fresh.status.resize_history_folded = rz_folded
             fresh.status.world_size = world
+            fresh.status.overspec_workers = overspec
             fresh.status.eval_metrics = eval_metrics
             fresh.status.profile_directive = profile_directive
             fresh.status.hang_count = hang_count
@@ -3473,10 +3957,12 @@ def _annotations_except_port(annotations: Dict[str, str]) -> Dict[str, str]:
     # (_request_preemptions stamps it, the victim's drain clears it);
     # merging it back from a stale snapshot would re-preempt the victim
     # on every status write.
+    # ANNOTATION_RECLAIM (r19) is store-managed the same way: stamped by
+    # the admitter, cleared by the holder's own sync.
     return {
         k: v
         for k, v in annotations.items()
-        if k not in (ANNOTATION_PORT, ANNOTATION_PREEMPT)
+        if k not in (ANNOTATION_PORT, ANNOTATION_PREEMPT, ANNOTATION_RECLAIM)
     }
 
 

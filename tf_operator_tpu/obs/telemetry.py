@@ -77,6 +77,14 @@ CAUSE_RESIZE = "resize"
 # exactly one cause (the recovery restart deliberately does NOT open a
 # "restart" span; docs/design.md §6.3 cause-attribution rule).
 CAUSE_HANG = "hang"
+# Preemption (r19): span-derived like restart — the reconciler opens the
+# same "restart" span for a preemption drain but stamps cause=preemption
+# in the span attrs, and both decompose() and the controller's
+# lost-seconds counter split on that attr. Keeping preempted downtime
+# out of cause=restart matters because the two have different remedies
+# (quota/priority policy vs. crash-loop debugging) and different
+# accounting (preemptions never charge the backoff budget).
+CAUSE_PREEMPTION = "preemption"
 GOODPUT_CAUSES = (
     CAUSE_COMPILE_INIT,
     CAUSE_DATA_WAIT,
@@ -84,6 +92,7 @@ GOODPUT_CAUSES = (
     CAUSE_RESTART,
     CAUSE_RESIZE,
     CAUSE_HANG,
+    CAUSE_PREEMPTION,
 )
 
 
@@ -405,7 +414,13 @@ def goodput_decomposition(
         if s.op == "first-step" and s.start_time > 0:
             lost[CAUSE_COMPILE_INIT] = min(wall, max(0.0, s.start_time - submit))
         elif s.op == "restart" and s.end_time:
-            lost[CAUSE_RESTART] += max(0.0, s.end_time - s.start_time)
+            attrs = getattr(s, "attrs", None) or {}
+            cause = (
+                CAUSE_PREEMPTION
+                if attrs.get("cause") == CAUSE_PREEMPTION
+                else CAUSE_RESTART
+            )
+            lost[cause] += max(0.0, s.end_time - s.start_time)
         elif s.op == "resize" and s.end_time:
             lost[CAUSE_RESIZE] += max(0.0, s.end_time - s.start_time)
         elif s.op == "hang" and s.end_time:

@@ -179,6 +179,7 @@ class GangScheduler:
         ttl: Optional[float] = None,
         reserved: Optional[Dict[str, int]] = None,
         deprioritized: Optional[set] = None,
+        overflow: Optional[set] = None,
     ) -> Dict[str, Host]:
         """Atomically choose a Host for every process in ``procs``.
 
@@ -212,6 +213,12 @@ class GangScheduler:
         fleet can hold the gang, but they stay SCHEDULABLE — a flagged
         host is slow, not broken, and refusing it outright would turn a
         soft signal into artificial capacity loss.
+
+        ``overflow`` names processes allowed OUTSIDE the slice shape
+        (r19 over-spec elastic members riding on loaned idle chips):
+        like rankless members they try the slot hosts first, but when no
+        slot host has room they may take any other schedulable host with
+        capacity instead of failing the whole gang.
         """
         want_hosts = max(1, job.spec.topology.num_hosts)
         states = self._states(job.spec.topology.slice_type, now, ttl)
@@ -324,6 +331,31 @@ class GangScheduler:
                     (slot_host[s] for s in range(want_hosts) if fits(slot_host[s], need)),
                     None,
                 )
+                if state is None and overflow and \
+                        proc.metadata.name in overflow:
+                    # Over-spec elastic members ride outside the slice
+                    # shape by design: the slot hosts are exactly full of
+                    # the spec gang, so borrow any other schedulable host
+                    # with capacity — most-free first so the loan lands
+                    # on the emptiest chips and reclaim frees whole hosts.
+                    slot_names = {
+                        st.host.metadata.name for st in slot_host.values()
+                    }
+                    state = next(
+                        (
+                            st
+                            for st in sorted(
+                                states,
+                                key=lambda st: (
+                                    -free[st.host.metadata.name],
+                                    st.host.metadata.name,
+                                ),
+                            )
+                            if st.host.metadata.name not in slot_names
+                            and fits(st, need)
+                        ),
+                        None,
+                    )
                 if state is None:
                     raise SchedulingError(
                         f"no host has capacity for {proc.metadata.name} "

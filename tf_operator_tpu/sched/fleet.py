@@ -54,6 +54,15 @@ ADMIT = "admit"  # proceed to placement
 WAIT = "wait"  # park in QUEUED; a release/resync will retry
 FAIL = "fail"  # permanently unsatisfiable (demand > quota)
 PREEMPT = "preempt"  # drain victims, then park until their chips free up
+# Reclaim over-spec chips (r19): victims are elastic jobs that grew
+# beyond spec; they shrink back through the resize protocol (no drain,
+# no backoff charge) and their loaned chips return to the queue.
+RECLAIM = "reclaim"
+
+# Default TTL for elastic re-grow holds (r19 satellite): a hold whose
+# lost host never returns converts back into ordinary free capacity
+# after this long, instead of pinning fleet capacity forever.
+DEFAULT_HOLD_TTL_SECONDS = 900.0
 
 # PriorityClass objects are cluster-scoped in spirit; they live in this
 # namespace and are resolved by name from any tenant namespace.
@@ -113,6 +122,14 @@ class FleetScheduler:
         # impossible. Merged into reserved_for_others() for every OTHER
         # job; cleared when the gang re-grows or the job ends.
         self._regrow_holds: Dict[str, Dict[str, int]] = {}
+        # When each job's re-grow hold was last (re)stamped; past
+        # hold_ttl_seconds the hold expires into free capacity (r19).
+        self._regrow_hold_since: Dict[str, float] = {}
+        self.hold_ttl_seconds: float = DEFAULT_HOLD_TTL_SECONDS
+        # Grow-beyond-spec loans (r19): job key -> extra chips this
+        # elastic job holds ABOVE its spec demand. Charged to queue usage
+        # while outstanding; the first thing any quota pressure reclaims.
+        self._overspec: Dict[str, int] = {}
         # Autopilot host deprioritization (r16): host -> expiry timestamp.
         # A risk-flagged host (straggler tracker via the autopilot) is fed
         # into place_gang's deprioritized set fleet-wide — SOFT avoidance:
@@ -228,11 +245,94 @@ class FleetScheduler:
         hold = self._regrow_holds.setdefault(key, {})
         for host, chips in host_chips.items():
             hold[host] = hold.get(host, 0) + max(int(chips), 0)
+        self._regrow_hold_since[key] = time.time()
 
     def clear_regrow_hold(self, key: str) -> None:
         """The gang re-grew to full strength (or the job ended): stop
         claiming capacity for its lost members."""
         self._regrow_holds.pop(key, None)
+        self._regrow_hold_since.pop(key, None)
+
+    def expire_regrow_holds(self, now: Optional[float] = None) -> List[str]:
+        """Drop holds older than ``hold_ttl_seconds`` (r19 satellite): a
+        hold whose lost host never returns must not pin capacity forever.
+        The job stays admitted and can still re-grow — it just competes
+        for placement like any other gang. Returns the expired keys."""
+        now = time.time() if now is None else now
+        if self.hold_ttl_seconds <= 0:
+            return []
+        expired = [
+            k
+            for k, t in self._regrow_hold_since.items()
+            if now - t > self.hold_ttl_seconds
+        ]
+        for k in expired:
+            self._regrow_holds.pop(k, None)
+            self._regrow_hold_since.pop(k, None)
+        return expired
+
+    # ---- grow-beyond-spec loans (r19) -----------------------------------
+
+    def offer_grow(self, job: TPUJob, extra_chips: int) -> int:
+        """Offer idle in-quota chips to a running elastic job so it can
+        grow past its spec world size. Returns the chips granted (0 ⇒
+        refused). Strictly after every queued admission: ANY queued job
+        in the same (namespace, queue) vetoes the offer, so backfill
+        growth can never starve the admission queue. Granted chips are
+        charged to queue usage immediately and tracked as an over-spec
+        loan — the first thing reclaimed under quota pressure."""
+        self.ensure_synced()
+        key = job.key()
+        if extra_chips <= 0 or key in self._draining:
+            return 0
+        info = self._admitted.get(key)
+        if info is None:
+            return 0  # not admitted ⇒ nothing to grow
+        if any(
+            w.namespace == info.namespace and w.queue == info.queue
+            for w in self._queued.values()
+        ):
+            return 0
+        q = self.queue_for(job)
+        if q is not None:
+            quota = max(q.spec.quota_chips, 0)
+            used, _ = self._usage.get((info.namespace, info.queue), (0, 0))
+            if quota and used + extra_chips > quota:
+                return 0
+        u = self._usage.setdefault((info.namespace, info.queue), [0, 0])
+        u[0] += extra_chips
+        self._overspec[key] = self._overspec.get(key, 0) + extra_chips
+        return extra_chips
+
+    def reclaim_overspec(self, key: str, chips: Optional[int] = None) -> int:
+        """Second half of a grow-beyond-spec reclaim: called once the
+        over-spec processes are observably gone, returning their chips to
+        the queue. Mirrors the begin_preempt→release two-phase handoff —
+        quota is NOT freed at reclaim-request time, so a waiting admitter
+        and the over-spec member can never hold the same headroom at
+        once. ``chips`` limits the return to that many (the grow-rollback
+        path returns only the chips it just borrowed); default is the
+        whole loan. Returns the chips freed."""
+        if chips is None:
+            extra = self._overspec.pop(key, 0)
+        else:
+            extra = min(max(chips, 0), self._overspec.get(key, 0))
+            left = self._overspec.get(key, 0) - extra
+            if left > 0:
+                self._overspec[key] = left
+            else:
+                self._overspec.pop(key, None)
+        if not extra:
+            return 0
+        info = self._admitted.get(key)
+        if info is not None:
+            u = self._usage.get((info.namespace, info.queue))
+            if u is not None:
+                u[0] = max(0, u[0] - extra)
+        return extra
+
+    def overspec_chips(self, key: str) -> int:
+        return self._overspec.get(key, 0)
 
     def deprioritize_host(self, host: str, until: float) -> None:
         """Autopilot actuator (r16): soft-avoid ``host`` for new gang
@@ -260,14 +360,16 @@ class FleetScheduler:
         it held quota — callers then kick the queue head."""
         self._draining.discard(key)
         self._regrow_holds.pop(key, None)
+        self._regrow_hold_since.pop(key, None)
         self._queued.pop(key, None)
         self._reservations.pop(key, None)
+        extra = self._overspec.pop(key, 0)  # loaned chips go back too
         info = self._admitted.pop(key, None)
         if info is None:
             return False
         u = self._usage.get((info.namespace, info.queue))
         if u is not None:
-            u[0] = max(0, u[0] - info.demand)
+            u[0] = max(0, u[0] - info.demand - extra)
             u[1] = max(0, u[1] - 1)
         return True
 
@@ -318,6 +420,18 @@ class FleetScheduler:
         if (quota and used + info.demand > quota) or (
             max_jobs and running + 1 > max_jobs
         ):
+            reclaims = self._overspec_reclaims(info, quota, max_jobs)
+            if reclaims:
+                self._queued[key] = info
+                return Decision(
+                    RECLAIM,
+                    reason=(
+                        f"over queue {info.queue!r} quota; reclaiming "
+                        f"over-spec chips from {len(reclaims)} elastic "
+                        "job(s)"
+                    ),
+                    victims=reclaims,
+                )
             victims = self._quota_victims(info, quota, max_jobs)
             self._queued[key] = info
             if victims:
@@ -348,6 +462,48 @@ class FleetScheduler:
                 ),
             )
         return Decision(ADMIT)
+
+    def _overspec_reclaims(
+        self, info: _JobInfo, quota: int, max_jobs: int
+    ) -> List[str]:
+        """Over-spec loans are the FIRST thing quota pressure reclaims
+        (r19): before any preemption, ask same-queue elastic jobs that
+        grew beyond spec to shrink back. Any-priority — a loaned chip is
+        not an entitlement. Returned only when the reclaimed chips alone
+        bring the queue under quota for ``info``; otherwise the caller
+        falls through to preempt-by-priority (the next admit pass
+        composes both once reclaims complete)."""
+        used, running = self._usage.get((info.namespace, info.queue), (0, 0))
+        if max_jobs and running + 1 > max_jobs:
+            return []  # a reclaim frees chips, never a job slot
+        if not quota:
+            return []
+        cands = [
+            (k, extra)
+            for k, extra in self._overspec.items()
+            if extra > 0
+            and k != info.key
+            and k not in self._draining
+            and k in self._admitted
+            and self._admitted[k].namespace == info.namespace
+            and self._admitted[k].queue == info.queue
+        ]
+        # Lowest-priority, newest first — the preemption order, applied
+        # among the loans themselves.
+        cands.sort(
+            key=lambda kv: (
+                self._admitted[kv[0]].priority,
+                -self._admitted[kv[0]].ctime,
+                kv[0],
+            )
+        )
+        keys: List[str] = []
+        for k, extra in cands:
+            if used + info.demand <= quota:
+                break
+            keys.append(k)
+            used -= extra
+        return keys if keys and used + info.demand <= quota else []
 
     def _quota_victims(
         self, info: _JobInfo, quota: int, max_jobs: int
@@ -380,7 +536,9 @@ class FleetScheduler:
             if fits():
                 break
             victims.append(a.key)
-            used -= a.demand
+            # Eviction releases the victim's spec demand AND any
+            # over-spec loan it still holds (release() returns both).
+            used -= a.demand + self._overspec.get(a.key, 0)
             running -= 1
         return victims if victims and fits() else []
 
@@ -446,6 +604,7 @@ class FleetScheduler:
         precedence: the shrunk job's quota is still charged for those
         chips, so letting anyone backfill them would double-book."""
         self.ensure_synced()
+        self.expire_regrow_holds()
         mine = job.key()
         merged: Dict[str, int] = {}
         for key, hold in self._regrow_holds.items():
@@ -495,6 +654,18 @@ class FleetScheduler:
             return []
         cands = [a for a in self._admitted.values() if a.priority < info.priority]
         cands.sort(key=lambda a: (a.priority, -a.ctime, a.key))
+        # Chips held for another job's re-grow are NOT preemptable
+        # headroom (r19): draining a victim on a held host hands the
+        # freed chips straight to the hold, not to this gang. Discount
+        # them so victims keep accumulating until genuinely-free chips
+        # cover the demand (conservative: placement re-verifies anyway).
+        self.expire_regrow_holds()
+        held: Dict[str, int] = {}
+        for hkey, hold in self._regrow_holds.items():
+            if hkey == info.key:
+                continue
+            for host, chips in hold.items():
+                held[host] = held.get(host, 0) + chips
         victims: List[Tuple[str, Dict[str, int]]] = []
         freed = 0
         need = max(info.demand, 1)
@@ -505,7 +676,11 @@ class FleetScheduler:
             if not hosts:
                 continue
             victims.append((a.key, hosts))
-            freed += sum(hosts.values())
+            for host, chips in hosts.items():
+                absorbed = min(chips, held.get(host, 0))
+                if absorbed:
+                    held[host] -= absorbed
+                freed += chips - absorbed
         return victims if victims and freed >= need else []
 
     def _head_reservation(self, job: TPUJob, info: _JobInfo) -> Dict[str, int]:

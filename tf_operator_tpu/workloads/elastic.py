@@ -36,6 +36,20 @@ contract end to end:
   ``workdir/eval_digest.txt`` + ``done.json``. A faulted run is
   bit-identical to an uninterrupted run at the same token count iff the
   digests match — the elastic soak's hard gate.
+- ``device_state: true`` (r19) additionally carries a real (small)
+  param/opt pytree on device through every resize: each member holds the
+  full ``(total, PARAM_DIM)`` params + per-row momentum as jax arrays,
+  applies a one-touch jitted row update per consumed position, and
+  rebuilds the arrays at every re-carve boundary through
+  ``train.reshard.rebuild_state`` — own device copy re-laid-out via
+  pjit, rows other members advanced re-fetched from the shared row
+  store, a re-grown member's warm base restored through the peer shard
+  depot first. The update is computed from the deterministic init base
+  (not the current row), so replaying a consume whose record was torn
+  is idempotent. The chief's ``done.json`` then carries
+  ``params_digest`` — sha256 over the final float32 params — and the
+  soak gate becomes bit-identical params + eval digest vs the
+  uninterrupted run.
 
 Requires a workers-only gang (chief = worker 0), like the light soak
 data plane.
@@ -155,6 +169,25 @@ def main(ctx: JobContext) -> None:
     ckpt = WorkloadCheckpointer(wl, ctx=ctx)
     mgr = ckpt.manager
 
+    # -- device-state mode (r19) -----------------------------------------
+    device_state = bool(wl.get("device_state"))
+    R = None
+    dev_params = dev_mom = None
+    fresh: set = set()
+    dim = int(wl.get("param_dim", 0))
+    seed = int(wl.get("data_seed", 0))
+    if device_state:
+        import jax.numpy as jnp
+
+        from tf_operator_tpu.train import reshard as _reshard
+        R = _reshard
+        dim = dim or R.PARAM_DIM
+        sdir = R.state_dir(workdir)
+        sharding = R.replicated_sharding(R.local_mesh())
+        row_update = R.make_row_update()
+        zero_mom = jnp.zeros((), jnp.float32)
+        plan_total = R.ReshardPlan()
+
     # -- join ------------------------------------------------------------
     my_epoch = 0
     if ctx.resize_epoch > 0:
@@ -180,35 +213,114 @@ def main(ctx: JobContext) -> None:
                 source = ckpt.prefetch_from_peers()
             start = mgr.latest_step() or 0
             if start:
-                mgr.restore({"step": np.asarray(start)})
+                tmpl = {"step": np.asarray(start)}
+                if device_state:
+                    # Warm base for the rebuild below: the chief's last
+                    # committed params/momentum, sourced peer-depot-first.
+                    # The row store overlays anything newer row by row.
+                    tmpl["params"] = np.zeros((total, dim), np.float32)
+                    tmpl["mom"] = np.zeros((total,), np.float32)
+                mgr.restore(tmpl)
                 ckpt.restore_source = source
                 ctx.record_restore(source, start, t0, time.time())
                 log.info("re-grown member restored step %d (source=%s)",
                          start, source)
     elif is_chief:
         # Epoch 0: the full gang in worker-index rank order, dealt the
-        # whole corpus.
-        members = [f"{ctx.job_name}-worker-{i}"
-                   for i in range(ctx.num_processes)]
-        _write_json_atomic(_epoch_path(workdir, 0), {
-            "epoch": 0, "direction": "start", "members": members,
-            "positions": _deal(list(range(total)), members),
-        })
+        # whole corpus. A full restart at epoch 0 must NOT re-deal —
+        # the surviving records already cover part of the corpus and
+        # the assignment filter below skips them against the old doc.
+        if not os.path.exists(_epoch_path(workdir, 0)):
+            members = [f"{ctx.job_name}-worker-{i}"
+                       for i in range(ctx.num_processes)]
+            _write_json_atomic(_epoch_path(workdir, 0), {
+                "epoch": 0, "direction": "start", "members": members,
+                "positions": _deal(list(range(total)), members),
+            })
+
+    if is_chief and ctx.resize_epoch > 0:
+        # Full gang restart mid-resize: the controller stamps EVERY
+        # member (chief included) with the open resize epoch, so the
+        # chief lands in the join path too. If the pre-restart chief
+        # never wrote this epoch's deal, nobody else ever will — the
+        # whole gang would wait forever on a doc only we can write.
+        # Re-carve it here WITHOUT an ack barrier: a full restart means
+        # no member is still consuming an older deal, and the durable
+        # records are the complete consumption history. An existing doc
+        # (restart landed after the re-carve) is reused as-is; the
+        # assignment filter below drops recorded positions either way.
+        live = ctx.poll_resize_directive()
+        e = max(int(live.get("epoch", 0)) if live else 0, my_epoch)
+        if e > 0 and _latest_epoch_file(workdir, e) is None:
+            members = list(live.get("members", [])) if live else []
+            if me in members:
+                records = _read_records(workdir)
+                seen = {int(r["p"]) for r in records}
+                remaining = [p for p in range(total) if p not in seen]
+                _write_json_atomic(_epoch_path(workdir, e), {
+                    "epoch": e,
+                    "direction": str(live.get("direction", "")),
+                    "members": members,
+                    "positions": _deal(remaining, members),
+                    "reclaim": bool(live.get("reclaim", False)),
+                })
+                ctx.publish_resize_barrier(e, {
+                    "completed": total - len(remaining),
+                    "boundary_remaining": len(remaining),
+                })
+                log.info("%s re-carved epoch %d after full restart: %d "
+                         "remaining", me, e, len(remaining))
 
     epoch_doc = None
+    acked: set = set()
     while epoch_doc is None:
         epoch_doc = _latest_epoch_file(workdir, my_epoch)
         if epoch_doc is None:
+            if ctx.resize_epoch > 0 and not is_chief:
+                # Anyone in the join path (a re-grown member, or a
+                # restarted survivor after a full mid-resize restart)
+                # is by definition not consuming, so it can ack ANY
+                # live barrier the moment it sees it. Without this, a
+                # kill landing while we wait here deadlocks: the chief
+                # counts us among the barrier's survivors while we wait
+                # for the epoch doc it will only write after our ack.
+                live = ctx.poll_resize_directive()
+                e = int(live.get("epoch", 0)) if live else 0
+                if e >= ctx.resize_epoch and e not in acked and \
+                        me in live.get("members", []):
+                    with open(os.path.join(workdir, f"ack-{me}-{e}"),
+                              "w"):
+                        pass
+                    acked.add(e)
             time.sleep(_POLL_S)
     my_epoch = int(epoch_doc["epoch"])
     assignment = list(epoch_doc["positions"].get(me, []))
+    if assignment:
+        # A reused deal (full restart, or a joiner adopting a doc cut
+        # before it landed) may contain positions whose records are
+        # already durable — never consume a position twice.
+        seen = {int(r["p"]) for r in _read_records(workdir)}
+        assignment = [p for p in assignment if p not in seen]
     idx = 0
     consumed = 0
     rec_f = open(_record_path(workdir, me), "a")
 
+    if device_state:
+        # Initial rebuild: re-fetch every already-published row from the
+        # shared store (covers re-grown joins and full restarts alike),
+        # deterministic init for the untouched rest. The one-touch update
+        # makes every fetched row final, so it stays authoritative across
+        # all later re-carves.
+        dev_params, dev_mom, plan = R.rebuild_state(
+            total, dim, seed, sdir, None, None, set(), sharding,
+            epoch=my_epoch)
+        fresh = set(plan.authoritative)
+        plan_total.merge(plan)
+
     def handle_resize(directive: dict) -> None:
         """Act on a directive whose epoch is ahead of ours."""
         nonlocal my_epoch, assignment, idx, epoch_doc
+        nonlocal dev_params, dev_mom, fresh
         t0 = time.time()
         epoch = int(directive["epoch"])
         direction = str(directive.get("direction", ""))
@@ -248,6 +360,10 @@ def main(ctx: JobContext) -> None:
             _write_json_atomic(_epoch_path(workdir, epoch), {
                 "epoch": epoch, "direction": direction, "members": members,
                 "positions": _deal(remaining, members),
+                # Over-spec reclaim shrinks back to the SPEC mesh — the
+                # full mesh eval runs on — so the done gate must not hold
+                # the final digest waiting for a re-grow nobody owes.
+                "reclaim": bool(directive.get("reclaim", False)),
             })
             ctx.publish_resize_barrier(epoch, {
                 "completed": total - len(remaining),
@@ -268,6 +384,16 @@ def main(ctx: JobContext) -> None:
         my_epoch = int(epoch_doc["epoch"])
         assignment = list(epoch_doc["positions"].get(me, []))
         idx = 0
+        if device_state:
+            # Re-shard for the new world: rows this member is still
+            # authoritative for re-layout device-to-device, everything
+            # another member advanced since the last barrier re-fetches
+            # from the row store.
+            dev_params, dev_mom, plan = R.rebuild_state(
+                total, dim, seed, sdir, dev_params, dev_mom, fresh,
+                sharding, epoch=my_epoch)
+            fresh = set(plan.authoritative)
+            plan_total.merge(plan)
         ctx.record_resize(direction, my_epoch, t0, time.time())
         log.info("%s re-carved at epoch %d (%s): %d positions",
                  me, my_epoch, direction, len(assignment))
@@ -282,7 +408,8 @@ def main(ctx: JobContext) -> None:
         if idx >= len(assignment):
             if os.path.exists(done_path):
                 break
-            if is_chief and epoch_doc.get("direction") != "shrink":
+            if is_chief and (epoch_doc.get("direction") != "shrink"
+                             or epoch_doc.get("reclaim")):
                 # Eval runs on the full mesh: while the gang is shrunk a
                 # re-grow is still owed, so hold the final digest until
                 # the grow directive lands (the loop keeps polling).
@@ -298,10 +425,24 @@ def main(ctx: JobContext) -> None:
                     with open(os.path.join(workdir, "eval_digest.txt"),
                               "w") as f:
                         f.write(digest + "\n")
-                    _write_json_atomic(done_path, {
+                    done = {
                         "digest": digest, "total": total,
                         "records": len(records),
-                    })
+                    }
+                    if device_state:
+                        final = R.assemble_final(total, dim, seed, sdir)
+                        pdigest = R.params_digest(final)
+                        with open(os.path.join(
+                                workdir, "params_digest.txt"), "w") as f:
+                            f.write(pdigest + "\n")
+                        done["params_digest"] = pdigest
+                        done["reshard"] = {
+                            "relaid": plan_total.relaid,
+                            "refetched": plan_total.refetched,
+                            "inited": plan_total.inited,
+                            "epochs": plan_total.epochs,
+                        }
+                    _write_json_atomic(done_path, done)
                     log.info("elastic run complete: %d windows, digest %s",
                              total, digest[:12])
                     break
@@ -309,6 +450,20 @@ def main(ctx: JobContext) -> None:
             continue
         p = assignment[idx]
         time.sleep(sleep_s)
+        if device_state:
+            # One-touch update computed from the deterministic init base,
+            # NOT the current device row: a member killed after the row
+            # write but before the record append leaves p in `remaining`,
+            # and the re-consumer must recompute the identical bits.
+            # Row published durably BEFORE the record — a durable record
+            # implies a durable row, so the re-carve can trust the store.
+            row, mom = row_update(
+                jnp.asarray(R.init_row(seed, p, dim)), zero_mom,
+                jnp.asarray(float(int(order[p])), jnp.float32))
+            dev_params = dev_params.at[p].set(row)
+            dev_mom = dev_mom.at[p].set(mom)
+            R.write_row(sdir, p, np.asarray(row), float(np.asarray(mom)))
+            fresh.add(p)
         rec_f.write(json.dumps({
             "p": int(p), "w": int(order[p]), "t": time.time(),
             "m": me, "e": my_epoch,
@@ -320,10 +475,21 @@ def main(ctx: JobContext) -> None:
             ctx.mark_first_step(1)
         if is_chief and mgr is not None and ckpt.every and \
                 consumed % ckpt.every == 0:
-            mgr.save(consumed, {"step": np.asarray(consumed)})
+            state = {"step": np.asarray(consumed)}
+            if device_state:
+                # Committed params travel with the step so the depot
+                # push is world-size-tagged alongside it — a re-grown
+                # member's warm restore base.
+                state["params"] = np.asarray(dev_params)
+                state["mom"] = np.asarray(dev_mom)
+            mgr.save(consumed, state)
 
     if is_chief and mgr is not None:
-        mgr.save(max(consumed, 1), {"step": np.asarray(consumed)}, wait=True)
+        state = {"step": np.asarray(consumed)}
+        if device_state:
+            state["params"] = np.asarray(dev_params)
+            state["mom"] = np.asarray(dev_mom)
+        mgr.save(max(consumed, 1), state, wait=True)
         mgr.close()
     rec_f.close()
     log.info("%s done: consumed %d positions (final epoch %d)",

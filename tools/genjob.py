@@ -625,7 +625,11 @@ def run_elastic_bench(args) -> int:
     on: zero full gang restarts, bit-identical eval after the re-grow
     vs an uninterrupted run at the same token count, and at least one
     resize restored from a peer depot rather than disk."""
-    from tf_operator_tpu.chaos.soak import elastic_artifact, run_elastic_soak
+    from tf_operator_tpu.chaos.soak import (
+        elastic_artifact,
+        run_elastic_soak,
+        run_grow_beyond_spec_probe,
+    )
 
     result = run_elastic_soak(
         seed=args.seed,
@@ -633,15 +637,38 @@ def run_elastic_bench(args) -> int:
         workers=args.workers,
         total_windows=args.bench_elastic_windows,
         timeout=args.timeout,
+        device_state=args.bench_elastic_device_state,
+        preempt_during_resize=args.bench_elastic_preempt_during_resize,
+        queue_quota=(
+            args.workers if args.bench_elastic_preempt_during_resize else 0
+        ),
     )
     artifact = elastic_artifact(result, args.seed)
+    violations = result.check()
+    if args.bench_elastic_grow_beyond_spec:
+        # r19 probe: the same receipt line grows a grow_beyond_spec
+        # section — world past spec on loaned in-quota chips, cleanly
+        # first-reclaimed under injected queue pressure.
+        grow = run_grow_beyond_spec_probe(
+            seed=args.seed, timeout=args.timeout
+        )
+        artifact["grow_beyond_spec"] = {
+            "spec_world": grow.spec_world,
+            "elastic_max_world": grow.max_world,
+            "grew_to": grow.grew_to,
+            "overspec_seen": grow.overspec_seen,
+            "resize_history": grow.resize_history,
+            "quota_violations": grow.quota_violations,
+            "pass": not grow.check(),
+        }
+        violations += grow.check()
+        artifact["pass"] = not violations
     line = json.dumps(artifact)
     print(line)
     if args.bench_out:
         os.makedirs(os.path.dirname(args.bench_out) or ".", exist_ok=True)
         with open(args.bench_out, "w") as f:
             f.write(line + "\n")
-    violations = result.check()
     for v in violations:
         print(f"FAIL: {v}", file=sys.stderr)
     return 1 if violations else 0
@@ -1044,6 +1071,20 @@ def main(argv=None) -> int:
                    help="kill/return events in the elastic schedule")
     p.add_argument("--bench-elastic-windows", type=int, default=400,
                    help="total data windows the elastic workload consumes")
+    p.add_argument("--bench-elastic-device-state", action="store_true",
+                   help="carry a real device param/opt pytree through "
+                        "every resize (train/reshard.py); hardens the "
+                        "gate to bit-identical final params vs an "
+                        "uninterrupted run")
+    p.add_argument("--bench-elastic-preempt-during-resize",
+                   action="store_true",
+                   help="stamp a fleet preemption mid-shrink (r19 "
+                        "composition probe): the drain must defer to the "
+                        "post-resize epoch, under a store-audited Queue")
+    p.add_argument("--bench-elastic-grow-beyond-spec", action="store_true",
+                   help="also run the r19 grow-beyond-spec probe: world "
+                        "past spec on loaned in-quota chips, cleanly "
+                        "first-reclaimed under injected queue pressure")
     p.add_argument("--seed", type=int, default=12,
                    help="schedule seed for --bench-elastic")
     args = p.parse_args(argv)
